@@ -1,0 +1,23 @@
+"""spark_rapids_tpu — a TPU-native columnar SQL execution framework.
+
+A brand-new framework with the capabilities of the RAPIDS Accelerator for
+Apache Spark (the reference at /root/reference): columnar operators whose
+batches live in TPU HBM and are evaluated as fused XLA programs, a
+plan-rewrite layer with per-operator CPU fallback and explain output, a
+collective-based shuffle over the device mesh, a device→host→disk spill
+hierarchy, a UDF bytecode compiler, and zero-copy export to JAX ML.
+
+See SURVEY.md for the capability blueprint and the mapping from each
+reference component to its TPU-native counterpart here.
+"""
+
+import jax
+
+# The SQL type system requires real int64/float64 columns (Spark bigint /
+# double). jax disables 64-bit types by default; turn them on before any
+# array is created anywhere in the package.
+jax.config.update("jax_enable_x64", True)
+
+from .version import __version__  # noqa: E402,F401
+from . import types  # noqa: E402,F401
+from .config import TpuConf  # noqa: E402,F401

@@ -1,0 +1,316 @@
+"""Typed configuration registry — the analog of ``RapidsConf``.
+
+The reference builds every config key through a typed builder DSL that records
+the key, type, default, and doc string in one registry, then auto-generates
+``docs/configs.md`` from it (reference: ``RapidsConf.scala:100-170`` for the
+builders, ``:641`` for the doc generator). Per-operator enable keys are
+synthesized from class names (``GpuOverrides.scala:126-131``).
+
+We keep the same architecture: ``ConfEntry`` descriptors registered at import
+time, a ``TpuConf`` snapshot object with typed accessors, and
+``TpuConf.help_markdown()`` regenerating the user docs. Key namespace follows
+the reference (``spark.rapids.sql.*``) with TPU-specific keys under
+``spark.rapids.tpu.*``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional
+
+_REGISTRY: Dict[str, "ConfEntry"] = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class ConfEntry:
+    key: str
+    default: Any
+    doc: str
+    conv: Callable[[str], Any]
+    internal: bool = False
+
+    def get(self, conf: Dict[str, Any]) -> Any:
+        if self.key in conf:
+            v = conf[self.key]
+            return self.conv(v) if isinstance(v, str) else v
+        return self.default
+
+
+def _to_bool(s: str) -> bool:
+    return s.strip().lower() in ("true", "1", "yes")
+
+
+def _register(key, default, doc, conv, internal=False) -> ConfEntry:
+    if key in _REGISTRY:
+        raise ValueError(f"duplicate conf key {key}")
+    e = ConfEntry(key, default, doc, conv, internal)
+    _REGISTRY[key] = e
+    return e
+
+
+def conf_bool(key: str, default: bool, doc: str, internal: bool = False) -> ConfEntry:
+    return _register(key, default, doc, _to_bool, internal)
+
+
+def conf_int(key: str, default: int, doc: str, internal: bool = False) -> ConfEntry:
+    return _register(key, default, doc, int, internal)
+
+
+def conf_float(key: str, default: float, doc: str, internal: bool = False) -> ConfEntry:
+    return _register(key, default, doc, float, internal)
+
+
+def conf_str(key: str, default: Optional[str], doc: str, internal: bool = False) -> ConfEntry:
+    return _register(key, default, doc, str, internal)
+
+
+# ---------------------------------------------------------------------------
+# Core feature gates (reference RapidsConf.scala:329-478)
+# ---------------------------------------------------------------------------
+
+SQL_ENABLED = conf_bool(
+    "spark.rapids.sql.enabled", True,
+    "Enable or disable the TPU columnar execution of SQL plans entirely.")
+
+EXPLAIN = conf_str(
+    "spark.rapids.sql.explain", "NONE",
+    "Explain why parts of a query were or were not placed on the TPU. "
+    "Options: NONE, NOT_ON_TPU, ALL.")
+
+TEST_ENABLED = conf_bool(
+    "spark.rapids.sql.test.enabled", False,
+    "Intended for internal tests only: fail if any operator in an executed plan "
+    "fell back to the CPU instead of running on the TPU.")
+
+TEST_ALLOWED_NON_TPU = conf_str(
+    "spark.rapids.sql.test.allowedNonTpu", "",
+    "Comma-separated operator class names allowed to stay on CPU when "
+    "spark.rapids.sql.test.enabled is on.")
+
+INCOMPATIBLE_OPS = conf_bool(
+    "spark.rapids.sql.incompatibleOps.enabled", False,
+    "Enable operators that produce results that differ from Spark in corner "
+    "cases (e.g. float-to-string formatting).")
+
+HAS_NANS = conf_bool(
+    "spark.rapids.sql.hasNans", True,
+    "Assume floating point data may contain NaNs; disables some device "
+    "aggregations/joins on float keys unless set to false.")
+
+VARIABLE_FLOAT_AGG = conf_bool(
+    "spark.rapids.sql.variableFloatAgg.enabled", False,
+    "Allow float/double aggregations whose result can differ from CPU Spark "
+    "because parallel reduction order is not fixed.")
+
+IMPROVED_FLOAT_OPS = conf_bool(
+    "spark.rapids.sql.improvedFloatOps.enabled", False,
+    "Enable float ops (e.g. float->string cast) that do not match Spark exactly.")
+
+CAST_FLOAT_TO_STRING = conf_bool(
+    "spark.rapids.sql.castFloatToString.enabled", False,
+    "Enable float/double to string casts; formatting can differ from Spark.")
+
+CAST_STRING_TO_FLOAT = conf_bool(
+    "spark.rapids.sql.castStringToFloat.enabled", False,
+    "Enable string to float casts; some edge-case strings parse differently.")
+
+CAST_STRING_TO_TIMESTAMP = conf_bool(
+    "spark.rapids.sql.castStringToTimestamp.enabled", False,
+    "Enable string to timestamp casts; only fixed formats are supported.")
+
+REPLACE_SORT_MERGE_JOIN = conf_bool(
+    "spark.rapids.sql.replaceSortMergeJoin.enabled", True,
+    "Replace sort-merge joins with hash joins on the device "
+    "(reference RapidsConf.scala:384).")
+
+EXPORT_COLUMNAR_RDD = conf_bool(
+    "spark.rapids.sql.exportColumnarRdd", False,
+    "Allow exporting device-resident columnar batches to ML frameworks "
+    "zero-copy (reference RapidsConf.scala:329).")
+
+UDF_COMPILER_ENABLED = conf_bool(
+    "spark.rapids.sql.udfCompiler.enabled", True,
+    "Compile Python UDF bytecode into the expression IR so UDFs run as fused "
+    "XLA/Pallas code instead of falling back to the CPU.")
+
+# ---------------------------------------------------------------------------
+# Batch sizing (reference RapidsConf.scala:306-325)
+# ---------------------------------------------------------------------------
+
+BATCH_SIZE_ROWS = conf_int(
+    "spark.rapids.sql.batchSizeRows", 1 << 20,
+    "Target number of rows for device batches produced by coalescing and reads.")
+
+MAX_READ_BATCH_SIZE_ROWS = conf_int(
+    "spark.rapids.sql.reader.batchSizeRows", 1 << 19,
+    "Soft limit on rows per batch produced by file readers.")
+
+MAX_READ_BATCH_SIZE_BYTES = conf_int(
+    "spark.rapids.sql.reader.batchSizeBytes", 512 * 1024 * 1024,
+    "Soft limit on bytes per batch produced by file readers.")
+
+# ---------------------------------------------------------------------------
+# Memory & admission (reference RapidsConf.scala:241-301)
+# ---------------------------------------------------------------------------
+
+CONCURRENT_TPU_TASKS = conf_int(
+    "spark.rapids.sql.concurrentTpuTasks", 2,
+    "Number of tasks that may hold the TPU concurrently "
+    "(reference spark.rapids.sql.concurrentGpuTasks).")
+
+HBM_ALLOC_FRACTION = conf_float(
+    "spark.rapids.memory.tpu.allocFraction", 0.9,
+    "Fraction of HBM the arena allocator may use "
+    "(reference spark.rapids.memory.gpu.allocFraction).")
+
+HOST_SPILL_STORAGE_SIZE = conf_int(
+    "spark.rapids.memory.host.spillStorageSize", 1 << 30,
+    "Bytes of host memory used to hold spilled device buffers before "
+    "overflowing to disk (reference RapidsConf.scala:274).")
+
+MEMORY_DEBUG = conf_bool(
+    "spark.rapids.memory.tpu.debug", False,
+    "Log every device allocation/free for leak hunting "
+    "(reference spark.rapids.memory.gpu.debug).")
+
+# ---------------------------------------------------------------------------
+# Shuffle (reference RapidsConf.scala:522-618)
+# ---------------------------------------------------------------------------
+
+SHUFFLE_COMPRESSION_CODEC = conf_str(
+    "spark.rapids.shuffle.compression.codec", "none",
+    "Codec for shuffle payloads: none, lz4, zstd.")
+
+SHUFFLE_PARTITIONS = conf_int(
+    "spark.sql.shuffle.partitions", 16,
+    "Number of partitions used for exchanges (Spark's own key, honored here).")
+
+SHUFFLE_ICI_ENABLED = conf_bool(
+    "spark.rapids.shuffle.ici.enabled", True,
+    "Exchange partitions between chips with XLA all_to_all collectives over "
+    "ICI instead of host round-trips (the UCX-transport analog).")
+
+SHUFFLE_MAX_INFLIGHT_BYTES = conf_int(
+    "spark.rapids.shuffle.maxReceiveInflightBytes", 1 << 30,
+    "Throttle on bytes being fetched concurrently by the shuffle client "
+    "(reference RapidsShuffleTransport.scala:418-425).")
+
+# ---------------------------------------------------------------------------
+# TPU-specific knobs (no reference analog; new hardware, new keys)
+# ---------------------------------------------------------------------------
+
+TPU_CAPACITY_BUCKETING = conf_bool(
+    "spark.rapids.tpu.capacityBucketing.enabled", True,
+    "Pad device batches to power-of-two capacities so XLA compiles one program "
+    "per bucket instead of one per row count.")
+
+TPU_MIN_CAPACITY = conf_int(
+    "spark.rapids.tpu.minCapacity", 128,
+    "Smallest device batch capacity; aligns with the 8x128 VPU lane layout.")
+
+TPU_JOIN_OUTPUT_GROWTH = conf_float(
+    "spark.rapids.tpu.join.outputGrowthFactor", 1.0,
+    "Initial output-capacity estimate for joins as a multiple of the probe "
+    "side; joins re-execute with a larger bucket on overflow.")
+
+DEVICE_BACKEND = conf_str(
+    "spark.rapids.tpu.backend", None,
+    "Force a jax backend for device execution (tpu/cpu). Default: jax default.",
+    internal=True)
+
+
+class TpuConf:
+    """Immutable snapshot of configuration, with typed accessors.
+
+    Mirrors the accessor layer of ``RapidsConf`` (reference
+    RapidsConf.scala:700-885).
+    """
+
+    def __init__(self, conf: Optional[Dict[str, Any]] = None):
+        self._conf = dict(conf or {})
+        for k in self._conf:
+            if k.startswith("spark.rapids.") and k not in _REGISTRY:
+                raise KeyError(f"unknown rapids conf key: {k}")
+
+    def get(self, entry: ConfEntry) -> Any:
+        return entry.get(self._conf)
+
+    def with_overrides(self, **kv: Any) -> "TpuConf":
+        merged = dict(self._conf)
+        merged.update(kv)
+        return TpuConf(merged)
+
+    def raw(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._conf.get(key, default)
+
+    # Typed shortcuts used widely.
+    @property
+    def sql_enabled(self) -> bool:
+        return self.get(SQL_ENABLED)
+
+    @property
+    def explain(self) -> str:
+        return str(self.get(EXPLAIN)).upper()
+
+    @property
+    def test_enabled(self) -> bool:
+        return self.get(TEST_ENABLED)
+
+    @property
+    def allowed_non_tpu(self) -> List[str]:
+        raw = self.get(TEST_ALLOWED_NON_TPU)
+        return [s.strip() for s in raw.split(",") if s.strip()]
+
+    @property
+    def batch_size_rows(self) -> int:
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def shuffle_partitions(self) -> int:
+        return self.get(SHUFFLE_PARTITIONS)
+
+    def is_operator_enabled(self, conf_key: str, incompat: bool, disabled_by_default: bool) -> bool:
+        """Three-state per-operator gating (reference RapidsMeta.tagForGpu:195-210)."""
+        raw = self._conf.get(conf_key)
+        if raw is not None:
+            return raw if isinstance(raw, bool) else _to_bool(raw)
+        if incompat:
+            return self.get(INCOMPATIBLE_OPS)
+        return not disabled_by_default
+
+    @staticmethod
+    def operator_conf_key(kind: str, name: str) -> str:
+        """Synthesized per-op enable key (reference GpuOverrides.scala:126-131)."""
+        return f"spark.rapids.sql.{kind}.{name}"
+
+    @staticmethod
+    def register_operator_key(kind: str, name: str, incompat: bool,
+                              disabled_by_default: bool, doc: str) -> str:
+        key = TpuConf.operator_conf_key(kind, name)
+        if key not in _REGISTRY:
+            default = not disabled_by_default and not incompat
+            conf_bool(key, default, doc)
+        return key
+
+    @staticmethod
+    def help_markdown() -> str:
+        """Generate docs/configs.md, like ``RapidsConf.help`` (RapidsConf.scala:641)."""
+        lines = [
+            "# TPU Accelerator for Apache Spark Configuration",
+            "",
+            "The following configs control the TPU-native execution backend. They can be",
+            "set at session creation or per query. Generated by "
+            "`TpuConf.help_markdown()` — do not edit by hand.",
+            "",
+            "Name | Description | Default Value",
+            "-----|-------------|--------------",
+        ]
+        for key in sorted(_REGISTRY):
+            e = _REGISTRY[key]
+            if e.internal:
+                continue
+            lines.append(f"{e.key}|{e.doc}|{e.default}")
+        return "\n".join(lines) + "\n"
+
+
+DEFAULT_CONF = TpuConf()

@@ -1,0 +1,134 @@
+"""Device columnar batches — the ``ColumnarBatch``/``Table`` analog.
+
+A :class:`ColumnarBatch` is a pytree of :class:`DeviceColumn` plus a traced
+``n_rows`` scalar; its capacity and schema are static treedef data. This is
+the unit that flows between device operators, exactly as cudf-backed
+``ColumnarBatch`` objects flow between GPU execs in the reference
+(``GpuColumnVector.java:40``, ``GpuExec`` iterators) — but shaped for XLA:
+one compiled program per (schema, capacity-bucket), row count fully dynamic.
+
+``HostBatch`` wraps a pyarrow ``RecordBatch`` and is the currency of the CPU
+(oracle / fallback) execution path, standing in for Spark's host
+``ColumnarBatch`` of rows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from .column import DeviceColumn, bucket_capacity
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class ColumnarBatch:
+    """A device-resident table slice with a dynamic live-row count."""
+
+    columns: tuple  # tuple[DeviceColumn]
+    n_rows: jax.Array  # int32 scalar, traced
+    schema: T.Schema  # static
+
+    def tree_flatten(self):
+        return (self.columns, self.n_rows), (self.schema,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        columns, n_rows = children
+        return cls(columns=tuple(columns), n_rows=n_rows, schema=aux[0])
+
+    @property
+    def num_columns(self) -> int:
+        return len(self.columns)
+
+    @property
+    def capacity(self) -> int:
+        if self.columns:
+            return self.columns[0].capacity
+        return 0
+
+    def column(self, key: Union[int, str]) -> DeviceColumn:
+        if isinstance(key, str):
+            key = self.schema.index_of(key)
+        return self.columns[key]
+
+    def with_columns(self, columns: Sequence[DeviceColumn],
+                     schema: T.Schema) -> "ColumnarBatch":
+        return ColumnarBatch(tuple(columns), self.n_rows, schema)
+
+    def row_mask(self) -> jax.Array:
+        """bool[capacity] — True for live rows."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.n_rows
+
+    # -- host interchange ---------------------------------------------------
+    @staticmethod
+    def from_arrow(rb: pa.RecordBatch, min_capacity: int = 128,
+                   capacity: Optional[int] = None) -> "ColumnarBatch":
+        schema = T.schema_from_arrow(rb.schema)
+        cap = capacity or bucket_capacity(rb.num_rows, min_capacity)
+        cols = tuple(DeviceColumn.from_arrow(rb.column(i), cap)
+                     for i in range(rb.num_columns))
+        return ColumnarBatch(cols, jnp.asarray(rb.num_rows, dtype=jnp.int32), schema)
+
+    def to_arrow(self) -> pa.RecordBatch:
+        """Download to host. Syncs ``n_rows`` — only call at stage boundaries."""
+        n = int(self.n_rows)
+        arrays = [c.to_arrow(n) for c in self.columns]
+        fields = [pa.field(f.name, T.to_arrow_type(f.data_type), f.nullable)
+                  for f in self.schema]
+        return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+
+    @property
+    def device_size_bytes(self) -> int:
+        total = 0
+        for c in self.columns:
+            total += c.data.size * c.data.dtype.itemsize
+            total += c.validity.size
+            if c.offsets is not None:
+                total += c.offsets.size * 4
+        return total
+
+
+@dataclasses.dataclass
+class HostBatch:
+    """Host-side batch: the CPU oracle / fallback path currency."""
+
+    rb: pa.RecordBatch
+
+    @property
+    def num_rows(self) -> int:
+        return self.rb.num_rows
+
+    @property
+    def schema(self) -> T.Schema:
+        return T.schema_from_arrow(self.rb.schema)
+
+    def to_device(self, min_capacity: int = 128) -> ColumnarBatch:
+        return ColumnarBatch.from_arrow(self.rb, min_capacity)
+
+    @staticmethod
+    def from_device(batch: ColumnarBatch) -> "HostBatch":
+        return HostBatch(batch.to_arrow())
+
+    @staticmethod
+    def from_pydict(data: dict, schema: Optional[T.Schema] = None) -> "HostBatch":
+        if schema is not None:
+            rb = pa.RecordBatch.from_pydict(data, schema=T.schema_to_arrow(schema))
+        else:
+            rb = pa.RecordBatch.from_pydict(data)
+        return HostBatch(rb)
+
+
+def concat_host(batches: List[HostBatch]) -> HostBatch:
+    tables = pa.Table.from_batches([b.rb for b in batches])
+    combined = tables.combine_chunks()
+    if combined.num_rows == 0:
+        return HostBatch(pa.RecordBatch.from_pydict(
+            {n: [] for n in combined.schema.names}, schema=combined.schema))
+    return HostBatch(combined.to_batches()[0])

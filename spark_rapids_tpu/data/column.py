@@ -1,0 +1,256 @@
+"""Device-resident columnar vectors — the ``GpuColumnVector`` analog.
+
+The reference wraps cudf device columns in Spark ``ColumnVector`` objects
+(reference: ``sql-plugin/src/main/java/.../GpuColumnVector.java:40``). cuDF's
+model is eager and dynamically shaped: every kernel allocates an exactly-sized
+output. That model is hostile to XLA, which wants static shapes and traced
+programs.
+
+The TPU-native model here is different by design:
+
+* A :class:`DeviceColumn` owns a **fixed-capacity** buffer (power-of-two
+  bucketed, lane-aligned) plus a validity mask. The number of live rows is
+  tracked by the enclosing batch as a *traced* scalar, so data-dependent row
+  counts (filters, joins) flow through a compiled program without host syncs
+  or recompilation.
+* Invariant: rows at index >= n_rows always have ``validity == False`` and
+  deterministic (zero) data, so masked reductions never need the row count and
+  padding never changes results.
+* Strings use the Arrow layout — ``offsets: int32[capacity+1]`` into a
+  ``uint8[byte_capacity]`` payload — the same layout cudf uses on GPU, which is
+  also the right layout for TPU gather/scatter kernels.
+
+Columns are registered as jax pytrees, so whole batches can be passed straight
+through ``jax.jit`` boundaries; the dtype/capacity live in the static treedef,
+giving one compiled program per capacity bucket.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+
+#: Lane width of the VPU — the minimum sensible capacity granularity.
+LANE = 128
+
+
+def bucket_capacity(n: int, min_capacity: int = LANE) -> int:
+    """Round up to a power of two (>= min_capacity) to bound jit cache size."""
+    cap = max(int(min_capacity), LANE)
+    n = max(int(n), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class DeviceColumn:
+    """One column of one device batch.
+
+    For fixed-width types, ``data`` has shape ``[capacity]``. For strings,
+    ``data`` is the ``uint8`` byte payload, ``offsets`` is ``int32[capacity+1]``
+    and for entries past the live row count offsets are clamped to the last
+    valid offset.
+    """
+
+    data: jax.Array
+    validity: jax.Array  # bool[capacity]
+    dtype: T.DataType
+    offsets: Optional[jax.Array] = None  # int32[capacity + 1], strings only
+    #: Static upper bound on any single string's byte length (strings only).
+    #: Host-known at upload; device string kernels use it to bound the padded
+    #: char-matrix width. Propagates through string ops (substr keeps it,
+    #: concat sums it).
+    max_bytes: int = 0
+
+    # -- pytree protocol ----------------------------------------------------
+    def tree_flatten(self):
+        if self.offsets is None:
+            return (self.data, self.validity), (self.dtype, False, 0)
+        return (self.data, self.validity, self.offsets), (self.dtype, True, self.max_bytes)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        dtype, has_offsets, max_bytes = aux
+        if has_offsets:
+            data, validity, offsets = children
+            return cls(data=data, validity=validity, dtype=dtype, offsets=offsets,
+                       max_bytes=max_bytes)
+        data, validity = children
+        return cls(data=data, validity=validity, dtype=dtype, offsets=None)
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def is_string(self) -> bool:
+        return self.offsets is not None
+
+    @property
+    def capacity(self) -> int:
+        if self.is_string:
+            return int(self.offsets.shape[0]) - 1
+        return int(self.data.shape[0])
+
+    @property
+    def byte_capacity(self) -> int:
+        assert self.is_string
+        return int(self.data.shape[0])
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_numpy(values: np.ndarray, validity: Optional[np.ndarray],
+                   dtype: T.DataType, capacity: int) -> "DeviceColumn":
+        """Upload a host fixed-width array, padding to ``capacity``."""
+        n = len(values)
+        assert n <= capacity, (n, capacity)
+        np_dt = dtype.np_dtype
+        buf = np.zeros(capacity, dtype=np_dt)
+        buf[:n] = values.astype(np_dt, copy=False)
+        mask = np.zeros(capacity, dtype=np.bool_)
+        if validity is None:
+            mask[:n] = True
+        else:
+            mask[:n] = validity
+            buf[:n] = np.where(validity, buf[:n], np.zeros((), np_dt))
+        return DeviceColumn(jnp.asarray(buf), jnp.asarray(mask), dtype)
+
+    @staticmethod
+    def string_from_host(offsets: np.ndarray, data: np.ndarray,
+                         validity: Optional[np.ndarray], capacity: int,
+                         byte_capacity: Optional[int] = None) -> "DeviceColumn":
+        """Upload Arrow string buffers, padding offsets by clamping to the end."""
+        n = len(offsets) - 1
+        assert n <= capacity
+        nbytes = int(offsets[-1])
+        byte_capacity = byte_capacity or bucket_capacity(max(nbytes, 1))
+        off = np.full(capacity + 1, nbytes, dtype=np.int32)
+        off[: n + 1] = offsets.astype(np.int32, copy=False)
+        payload = np.zeros(byte_capacity, dtype=np.uint8)
+        payload[:nbytes] = data[:nbytes]
+        mask = np.zeros(capacity, dtype=np.bool_)
+        if validity is None:
+            mask[:n] = True
+        else:
+            mask[:n] = validity
+        item_lens = np.diff(offsets)
+        max_bytes = bucket_capacity(int(item_lens.max()) if n else 1, 8)
+        return DeviceColumn(jnp.asarray(payload), jnp.asarray(mask), T.STRING,
+                            offsets=jnp.asarray(off), max_bytes=max_bytes)
+
+    @staticmethod
+    def from_arrow(arr: pa.Array, capacity: int) -> "DeviceColumn":
+        """Upload a pyarrow array (the host interchange format, like
+        JCudfSerialization host buffers in the reference)."""
+        dtype = T.from_arrow_type(arr.type)
+        arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        if dtype is T.STRING:
+            arr = arr.cast(pa.string())
+            validity = _arrow_validity(arr)
+            offsets = np.asarray(arr.buffers()[1], dtype=np.uint8).view(np.int32)
+            offsets = offsets[arr.offset: arr.offset + len(arr) + 1].copy()
+            base = offsets[0]
+            offsets -= base
+            payload_buf = arr.buffers()[2]
+            if payload_buf is None:
+                payload = np.zeros(0, dtype=np.uint8)
+            else:
+                payload = np.asarray(payload_buf, dtype=np.uint8)[
+                    base: base + offsets[-1]]
+            # Null slots may have nonzero extent in arrow; normalize so hashes
+            # of null rows are deterministic.
+            return DeviceColumn.string_from_host(offsets, payload, validity, capacity)
+        if dtype is T.NULL:
+            return DeviceColumn.from_numpy(
+                np.zeros(len(arr), dtype=np.int8),
+                np.zeros(len(arr), dtype=np.bool_), T.NULL, capacity)
+        if dtype is T.TIMESTAMP:
+            arr = arr.cast(pa.timestamp("us"))
+        validity = _arrow_validity(arr)
+        # Null slots get a deterministic zero so padded/invalid data never
+        # perturbs hashes or reductions.
+        filled = arr.fill_null(False if dtype is T.BOOLEAN else 0) \
+            if arr.null_count else arr
+        values = filled.to_numpy(zero_copy_only=False)
+        if values.dtype.kind == "M":  # datetime64 from date32/timestamp
+            unit = "D" if dtype is T.DATE else "us"
+            values = values.astype(f"datetime64[{unit}]").view(np.int64)
+        return DeviceColumn.from_numpy(
+            values.astype(dtype.np_dtype, copy=False), validity, dtype, capacity)
+
+    # -- download -----------------------------------------------------------
+    def to_arrow(self, n_rows: int) -> pa.Array:
+        """Download the first ``n_rows`` live rows as a pyarrow array."""
+        validity = np.asarray(self.validity[:n_rows])
+        if self.dtype is T.NULL:
+            return pa.nulls(n_rows)
+        if self.is_string:
+            offsets = np.asarray(self.offsets[: n_rows + 1]).astype(np.int64)
+            payload = np.asarray(self.data)
+            out = []
+            for i in range(n_rows):
+                if validity[i]:
+                    out.append(bytes(payload[offsets[i]: offsets[i + 1]]).decode(
+                        "utf-8", errors="replace"))
+                else:
+                    out.append(None)
+            return pa.array(out, type=pa.string())
+        values = np.asarray(self.data[:n_rows])
+        arrow_type = T.to_arrow_type(self.dtype)
+        if validity.all():
+            return pa.array(values, type=arrow_type)
+        masked = [values[i].item() if validity[i] else None for i in range(n_rows)]
+        return pa.array(masked, type=arrow_type)
+
+
+def _arrow_validity(arr: pa.Array) -> Optional[np.ndarray]:
+    if arr.null_count == 0:
+        return None
+    return np.asarray(arr.is_valid())
+
+
+def null_column(dtype: T.DataType, capacity: int) -> DeviceColumn:
+    """An all-null column of the given type (used for outer-join padding)."""
+    if dtype is T.STRING:
+        return DeviceColumn(
+            data=jnp.zeros(LANE, dtype=jnp.uint8),
+            validity=jnp.zeros(capacity, dtype=jnp.bool_),
+            dtype=T.STRING,
+            offsets=jnp.zeros(capacity + 1, dtype=jnp.int32),
+            max_bytes=8)
+    return DeviceColumn(
+        data=jnp.zeros(capacity, dtype=dtype.np_dtype),
+        validity=jnp.zeros(capacity, dtype=jnp.bool_),
+        dtype=dtype)
+
+
+def scalar_column(value, dtype: T.DataType, capacity: int,
+                  n_rows) -> DeviceColumn:
+    """Broadcast a literal into a column (GpuLiteral expansion,
+    reference literals.scala:128)."""
+    if value is None:
+        return null_column(dtype, capacity)
+    if dtype is T.STRING:
+        raw = np.frombuffer(str(value).encode("utf-8"), dtype=np.uint8)
+        ln = len(raw)
+        byte_cap = bucket_capacity(max(ln, 1) * capacity)
+        payload = np.zeros(byte_cap, dtype=np.uint8)
+        if ln:
+            payload[: ln * capacity] = np.tile(raw, capacity)
+        offsets = np.arange(capacity + 1, dtype=np.int64) * ln
+        valid = jnp.arange(capacity) < n_rows
+        return DeviceColumn(
+            data=jnp.asarray(payload),
+            validity=valid,
+            dtype=T.STRING,
+            offsets=jnp.asarray(offsets.astype(np.int32)),
+            max_bytes=bucket_capacity(max(ln, 1), 8))
+    valid = jnp.arange(capacity) < n_rows
+    data = jnp.where(valid, jnp.asarray(value, dtype=dtype.np_dtype), 0)
+    return DeviceColumn(data=data.astype(dtype.np_dtype), validity=valid, dtype=dtype)

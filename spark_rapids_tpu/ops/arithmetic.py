@@ -1,0 +1,235 @@
+"""Arithmetic expressions — Spark non-ANSI semantics on device.
+
+Mirrors the reference's arithmetic family (reference:
+``sql-plugin/src/main/scala/org/apache/spark/sql/rapids/arithmetic.scala``):
+Add/Subtract/Multiply/Divide/IntegralDivide/Remainder/Pmod/UnaryMinus/Abs.
+
+Spark (non-ANSI) semantics implemented here:
+* integral add/sub/mul wrap (Java two's-complement), floats follow IEEE;
+* ``Divide`` always produces double and yields null on divisor 0;
+* ``IntegralDivide``/``Remainder``/``Pmod`` yield null on divisor 0.
+
+Host kernels use numpy (wrapping by construction); device kernels use jnp.
+Type coercion (promoting both sides to a common type) is inserted as explicit
+casts by :func:`spark_rapids_tpu.ops.coercion.coerce`.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import pyarrow as pa
+
+from .. import types as T
+from .expression import BinaryExpression, UnaryExpression
+
+
+def _np_of(arr: pa.Array):
+    """pa.Array -> (zero-filled numpy values, validity numpy bool)."""
+    validity = np.asarray(arr.is_valid()) if arr.null_count else None
+    if arr.null_count:
+        zero = False if pa.types.is_boolean(arr.type) else 0
+        arr = arr.fill_null(zero)
+    return arr.to_numpy(zero_copy_only=False), validity
+
+
+def _to_pa(values: np.ndarray, validity, dtype: T.DataType) -> pa.Array:
+    return pa.array(values.astype(dtype.np_dtype, copy=False),
+                    type=T.to_arrow_type(dtype),
+                    mask=None if validity is None else ~validity)
+
+
+def _and_validity(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b
+
+
+class BinaryArithmetic(BinaryExpression):
+    """Shared plumbing: numpy host kernel with explicit validity math."""
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.numeric_promote(self.left.data_type, self.right.data_type)
+
+    def do_host(self, l: pa.Array, r: pa.Array) -> pa.Array:
+        lv, lval = _np_of(l)
+        rv, rval = _np_of(r)
+        validity = _and_validity(lval, rval)
+        with np.errstate(all="ignore"):
+            out, extra_null = self.np_kernel(
+                lv.astype(self.data_type.np_dtype, copy=False),
+                rv.astype(self.data_type.np_dtype, copy=False))
+        if extra_null is not None:
+            validity = _and_validity(validity, ~extra_null)
+        if validity is not None:
+            out = np.where(validity, out, np.zeros((), out.dtype))
+        return _to_pa(out, validity, self.data_type)
+
+    def do_device(self, l: jnp.ndarray, r: jnp.ndarray):
+        np_dt = self.data_type.np_dtype
+        return self.jnp_kernel(l.astype(np_dt), r.astype(np_dt))
+
+    def np_kernel(self, l, r):
+        raise NotImplementedError
+
+    def jnp_kernel(self, l, r):
+        raise NotImplementedError
+
+
+class Add(BinaryArithmetic):
+    def np_kernel(self, l, r):
+        return l + r, None
+
+    def jnp_kernel(self, l, r):
+        return l + r, None
+
+
+class Subtract(BinaryArithmetic):
+    def np_kernel(self, l, r):
+        return l - r, None
+
+    def jnp_kernel(self, l, r):
+        return l - r, None
+
+
+class Multiply(BinaryArithmetic):
+    def np_kernel(self, l, r):
+        return l * r, None
+
+    def jnp_kernel(self, l, r):
+        return l * r, None
+
+
+class Divide(BinaryArithmetic):
+    """Double division; divisor 0 -> null (Spark non-ANSI)."""
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DOUBLE
+
+    def np_kernel(self, l, r):
+        zero = r == 0
+        return np.divide(l, np.where(zero, 1, r)), zero
+
+    def jnp_kernel(self, l, r):
+        zero = r == 0
+        return l / jnp.where(zero, 1.0, r), zero
+
+
+class IntegralDivide(BinaryArithmetic):
+    """``div`` — long division truncating toward zero; /0 -> null."""
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LONG
+
+    def np_kernel(self, l, r):
+        zero = r == 0
+        safe = np.where(zero, 1, r)
+        # numpy // floors; Spark/Java truncates toward zero.
+        return _trunc_div_int(l, safe), zero
+
+    def jnp_kernel(self, l, r):
+        zero = r == 0
+        safe = jnp.where(zero, 1, r)
+        q = l // safe
+        rem = l - q * safe
+        # Adjust floor -> trunc when signs differ and remainder nonzero.
+        adjust = (rem != 0) & ((l < 0) != (safe < 0))
+        return q + adjust.astype(q.dtype), zero
+
+
+def _trunc_div_int(l: np.ndarray, r: np.ndarray) -> np.ndarray:
+    q = l // r
+    rem = l - q * r
+    adjust = (rem != 0) & ((l < 0) != (r < 0))
+    return q + adjust.astype(q.dtype)
+
+
+class Remainder(BinaryArithmetic):
+    """Java % semantics (sign of dividend); /0 -> null."""
+
+    def np_kernel(self, l, r):
+        zero = r == 0
+        safe = np.where(zero, 1, r)
+        if self.data_type.is_floating:
+            return np.fmod(l, safe), zero
+        return l - _trunc_div_int(l, safe) * safe, zero
+
+    def jnp_kernel(self, l, r):
+        zero = r == 0
+        one = jnp.ones((), dtype=r.dtype)
+        safe = jnp.where(zero, one, r)
+        if self.data_type.is_floating:
+            return _jnp_fmod(l, safe), zero
+        q = l // safe
+        rem = l - q * safe
+        adjust = (rem != 0) & ((l < 0) != (safe < 0))
+        q = q + adjust.astype(q.dtype)
+        return l - q * safe, zero
+
+
+def _jnp_fmod(l, r):
+    return l - jnp.trunc(l / r) * r
+
+
+class Pmod(BinaryArithmetic):
+    """Positive modulus; /0 -> null."""
+
+    def np_kernel(self, l, r):
+        zero = r == 0
+        safe = np.where(zero, 1, r)
+        if self.data_type.is_floating:
+            m = np.fmod(l, safe)
+            m = np.where((m != 0) & ((m < 0) != (safe < 0)), m + safe, m)
+            return m, zero
+        m = l - _trunc_div_int(l, safe) * safe
+        m = np.where((m != 0) & ((m < 0) != (safe < 0)), m + safe, m)
+        return m, zero
+
+    def jnp_kernel(self, l, r):
+        zero = r == 0
+        one = jnp.ones((), dtype=r.dtype)
+        safe = jnp.where(zero, one, r)
+        if self.data_type.is_floating:
+            m = _jnp_fmod(l, safe)
+        else:
+            q = l // safe
+            rem = l - q * safe
+            adjust = (rem != 0) & ((l < 0) != (safe < 0))
+            m = l - (q + adjust.astype(q.dtype)) * safe
+        m = jnp.where((m != 0) & ((m < 0) != (safe < 0)), m + safe, m)
+        return m, zero
+
+
+class UnaryMinus(UnaryExpression):
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        vv, val = _np_of(v)
+        with np.errstate(all="ignore"):
+            out = (-vv).astype(self.data_type.np_dtype)
+        return _to_pa(out, val, self.data_type)
+
+    def do_device(self, data: jnp.ndarray):
+        return -data, None
+
+
+class Abs(UnaryExpression):
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        vv, val = _np_of(v)
+        with np.errstate(all="ignore"):
+            out = np.abs(vv).astype(self.data_type.np_dtype)
+        return _to_pa(out, val, self.data_type)
+
+    def do_device(self, data: jnp.ndarray):
+        return jnp.abs(data), None

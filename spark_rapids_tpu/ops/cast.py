@@ -1,0 +1,132 @@
+"""Cast — Java/Spark narrowing semantics on both paths.
+
+The reference's ``GpuCast`` covers every numeric/string/date/timestamp cast
+with conf gates on the inexact float<->string paths (reference:
+``GpuCast.scala:79,181``; gates ``RapidsConf.scala:395-425``). Semantics
+implemented here (Spark non-ANSI = Java conversions):
+
+* integral -> narrower integral: two's-complement bit truncation (wraps);
+* float/double -> integral: NaN -> 0, +/-inf and out-of-range clamp to
+  MIN/MAX (JLS 5.1.3);
+* numeric -> boolean: ``x != 0``; boolean -> numeric: 1/0;
+* date -> timestamp: midnight UTC; timestamp -> date: floor to day.
+
+String casts are separate expressions in :mod:`strings` (conf-gated like the
+reference).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from .arithmetic import _np_of, _to_pa
+from .expression import Expression, UnaryExpression
+
+_INT_BOUNDS = {
+    "tinyint": (-(2 ** 7), 2 ** 7 - 1),
+    "smallint": (-(2 ** 15), 2 ** 15 - 1),
+    "int": (-(2 ** 31), 2 ** 31 - 1),
+    "bigint": (-(2 ** 63), 2 ** 63 - 1),
+}
+
+_US_PER_DAY = 86_400_000_000
+
+
+class Cast(UnaryExpression):
+    def __init__(self, child: Expression, to: T.DataType):
+        super().__init__(child)
+        self.to = to
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.to
+
+    def with_children(self, children):
+        return Cast(children[0], self.to)
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        src = T.from_arrow_type(v.type)
+        if src.name == self.to.name:
+            return v
+        vals, validity = _np_of(v)
+        if vals.dtype.kind == "M":
+            unit = "D" if src is T.DATE else "us"
+            vals = vals.astype(f"datetime64[{unit}]").view(np.int64)
+        out = _np_cast(vals, src, self.to)
+        return _to_pa(out, validity, self.to)
+
+    def do_device(self, data: jnp.ndarray):
+        src = self.child.data_type
+        if src.name == self.to.name:
+            return data, None
+        return _jnp_cast(data, src, self.to), None
+
+    def __str__(self) -> str:
+        return f"cast({self.children[0]} as {self.to})"
+
+
+def _np_cast(vals: np.ndarray, src: T.DataType, to: T.DataType) -> np.ndarray:
+    if to is T.BOOLEAN:
+        return vals != 0
+    if src is T.BOOLEAN:
+        return vals.astype(to.np_dtype)
+    if src is T.DATE and to is T.TIMESTAMP:
+        return vals.astype(np.int64) * _US_PER_DAY
+    if src is T.TIMESTAMP and to is T.DATE:
+        return np.floor_divide(vals, _US_PER_DAY).astype(np.int32)
+    if src.is_floating and to.is_integral:
+        lo, hi = _INT_BOUNDS[to.name]
+        with np.errstate(invalid="ignore"):
+            t = np.trunc(vals.astype(np.float64))
+            nan = np.isnan(t)
+            # Compare in float64; hi rounds up to 2**63 for bigint, so values
+            # at/above the rounded bound route to the clamp and the residual
+            # cast below only ever sees exactly-representable in-range values.
+            over = ~nan & (t >= np.float64(hi))
+            under = ~nan & (t <= np.float64(lo))
+            safe = np.where(nan | over | under, 0.0, t)
+        out = safe.astype(to.np_dtype)
+        out[over] = hi
+        out[under] = lo
+        return out
+    # integral narrowing wraps via astype; widening and float casts are exact.
+    with np.errstate(all="ignore"):
+        return vals.astype(to.np_dtype)
+
+
+def _jnp_cast(data: jnp.ndarray, src: T.DataType, to: T.DataType) -> jnp.ndarray:
+    if to is T.BOOLEAN:
+        return data != 0
+    if src is T.BOOLEAN:
+        return data.astype(to.np_dtype)
+    if src is T.DATE and to is T.TIMESTAMP:
+        return data.astype(jnp.int64) * _US_PER_DAY
+    if src is T.TIMESTAMP and to is T.DATE:
+        return jnp.floor_divide(data, _US_PER_DAY).astype(jnp.int32)
+    if src.is_floating and to.is_integral:
+        lo, hi = _INT_BOUNDS[to.name]
+        t = jnp.trunc(data.astype(jnp.float64))
+        nan = jnp.isnan(t)
+        over = ~nan & (t >= np.float64(hi))
+        under = ~nan & (t <= np.float64(lo))
+        safe = jnp.where(nan | over | under, 0.0, t).astype(to.np_dtype)
+        out = jnp.where(over, jnp.asarray(hi, to.np_dtype), safe)
+        return jnp.where(under, jnp.asarray(lo, to.np_dtype), out)
+    return data.astype(to.np_dtype)
+
+
+def coerce_binary(left: Expression, right: Expression):
+    """Insert casts promoting both sides to a common numeric type — the
+    analyzer-side type coercion Spark does before the plugin sees the plan."""
+    lt, rt = left.data_type, right.data_type
+    if lt.name == rt.name:
+        return left, right
+    common = T.numeric_promote(lt, rt)
+    if lt.name != common.name:
+        left = Cast(left, common)
+    if rt.name != common.name:
+        right = Cast(right, common)
+    return left, right

@@ -1,0 +1,157 @@
+"""Conditional and null-handling expressions.
+
+Mirrors the reference families ``conditionalExpressions.scala`` (If, CaseWhen,
+NaNvl) and ``nullExpressions.scala`` (Coalesce) — SURVEY.md §2.4.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax.numpy as jnp
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, HostBatch
+from ..data.column import DeviceColumn
+from .expression import Expression, host_to_array, make_column
+
+
+class If(Expression):
+    def __init__(self, predicate: Expression, true_value: Expression,
+                 false_value: Expression):
+        self.children = [predicate, true_value, false_value]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[1].data_type
+
+    def with_children(self, children):
+        return If(*children)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        p = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        t = host_to_array(self.children[1].eval_host(batch), batch.num_rows)
+        f = host_to_array(self.children[2].eval_host(batch), batch.num_rows)
+        # SQL: a null predicate selects the false branch.
+        return pc.if_else(pc.fill_null(p, False), t, f)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        p = self.children[0].eval_device(batch)
+        t = self.children[1].eval_device(batch)
+        f = self.children[2].eval_device(batch)
+        take_true = p.data & p.validity
+        if t.is_string:
+            raise NotImplementedError("string If lowers via select kernel later")
+        data = jnp.where(take_true, t.data, f.data)
+        validity = jnp.where(take_true, t.validity, f.validity)
+        return make_column(data, validity, self.data_type)
+
+
+class CaseWhen(Expression):
+    """CASE WHEN c1 THEN v1 ... ELSE e END."""
+
+    def __init__(self, branches: List[Tuple[Expression, Expression]],
+                 else_value: Optional[Expression] = None):
+        self.branches = list(branches)
+        self.else_value = else_value
+        flat: List[Expression] = []
+        for c, v in branches:
+            flat += [c, v]
+        if else_value is not None:
+            flat.append(else_value)
+        self.children = flat
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.branches[0][1].data_type
+
+    def with_children(self, children):
+        n = len(self.branches)
+        branches = [(children[2 * i], children[2 * i + 1]) for i in range(n)]
+        else_v = children[2 * n] if self.else_value is not None else None
+        return CaseWhen(branches, else_v)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        result = (host_to_array(self.else_value.eval_host(batch), batch.num_rows)
+                  if self.else_value is not None
+                  else pa.nulls(batch.num_rows, T.to_arrow_type(self.data_type)))
+        for cond, val in reversed(self.branches):
+            c = host_to_array(cond.eval_host(batch), batch.num_rows)
+            v = host_to_array(val.eval_host(batch), batch.num_rows)
+            result = pc.if_else(pc.fill_null(c, False), v, result)
+        return result
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        if self.else_value is not None:
+            acc = self.else_value.eval_device(batch)
+            data, validity = acc.data, acc.validity
+        else:
+            np_dt = self.data_type.np_dtype
+            data = jnp.zeros(batch.capacity, dtype=np_dt)
+            validity = jnp.zeros(batch.capacity, dtype=jnp.bool_)
+        for cond, val in reversed(self.branches):
+            c = cond.eval_device(batch)
+            v = val.eval_device(batch)
+            take = c.data & c.validity
+            data = jnp.where(take, v.data, data)
+            validity = jnp.where(take, v.validity, validity)
+        return make_column(data, validity, self.data_type)
+
+
+class Coalesce(Expression):
+    """First non-null argument."""
+
+    def __init__(self, *children: Expression):
+        self.children = list(children)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return Coalesce(*children)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        args = [host_to_array(c.eval_host(batch), batch.num_rows)
+                for c in self.children]
+        return pc.coalesce(*args)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        cols = [c.eval_device(batch) for c in self.children]
+        data = cols[0].data
+        validity = cols[0].validity
+        for c in cols[1:]:
+            take_next = ~validity & c.validity
+            data = jnp.where(take_next, c.data, data)
+            validity = validity | c.validity
+        return make_column(data, validity, self.data_type)
+
+
+class NaNvl(Expression):
+    """nanvl(a, b): b when a is NaN else a."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type
+
+    def with_children(self, children):
+        return NaNvl(*children)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        l = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        r = host_to_array(self.children[1].eval_host(batch), batch.num_rows)
+        isnan = pc.fill_null(pc.is_nan(l), False)
+        return pc.if_else(isnan, r, l)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        l = self.children[0].eval_device(batch)
+        r = self.children[1].eval_device(batch)
+        isnan = jnp.isnan(l.data) & l.validity
+        data = jnp.where(isnan, r.data, l.data)
+        validity = jnp.where(isnan, r.validity, l.validity)
+        return make_column(data, validity, self.data_type)

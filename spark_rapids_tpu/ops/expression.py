@@ -1,0 +1,342 @@
+"""Expression IR with dual host/device evaluation — the ``GpuExpression`` analog.
+
+The reference defines a ``GpuExpression`` trait whose ``columnarEval(batch)``
+produces a cudf column (reference: ``GpuExpressions.scala:69,93``), with
+abstract Unary/Binary op classes bridging to cudf ops
+(``GpuExpressions.scala:101-366``) and reference binding via
+``GpuBindReferences`` (``GpuBoundAttribute.scala:24,89``).
+
+Here every expression evaluates two ways:
+
+* ``eval_device(batch)`` — traced jax ops over :class:`DeviceColumn`s. Called
+  inside ``jit``; the whole expression tree fuses into one XLA computation.
+* ``eval_host(batch)`` — pyarrow compute over a host batch. This is the CPU
+  oracle and the fallback path; kept deliberately independent of the device
+  code so differential tests are meaningful.
+
+Null semantics follow Spark: most operators propagate null if any input is
+null; data under a null is forced to zero so padded lanes never affect
+results. Division by zero yields null (Spark non-ANSI).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, HostBatch
+from ..data.column import DeviceColumn, scalar_column
+
+
+class Expression:
+    """Base class. Subclasses set ``children`` and implement evaluation."""
+
+    children: Sequence["Expression"] = ()
+
+    @property
+    def data_type(self) -> T.DataType:
+        raise NotImplementedError(type(self).__name__)
+
+    @property
+    def nullable(self) -> bool:
+        return any(c.nullable for c in self.children)
+
+    @property
+    def name(self) -> str:
+        return str(self)
+
+    # -- evaluation ---------------------------------------------------------
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        raise NotImplementedError(type(self).__name__)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        raise NotImplementedError(type(self).__name__)
+
+    # -- tree utilities -----------------------------------------------------
+    def transform(self, fn) -> "Expression":
+        """Bottom-up rewrite; fn may return a replacement or None."""
+        new_children = [c.transform(fn) for c in self.children]
+        node = self.with_children(new_children) if new_children != list(self.children) else self
+        replaced = fn(node)
+        return replaced if replaced is not None else node
+
+    def with_children(self, children: List["Expression"]) -> "Expression":
+        if not self.children:
+            return self
+        raise NotImplementedError(type(self).__name__)
+
+    def references(self) -> List[str]:
+        out = []
+        for c in self.children:
+            out.extend(c.references())
+        return out
+
+    def bind(self, schema: T.Schema) -> "Expression":
+        """Resolve AttributeReferences to ordinals (GpuBindReferences analog)."""
+        def rewrite(e):
+            if isinstance(e, AttributeReference):
+                idx = schema.index_of(e._name)
+                return BoundReference(idx, schema[idx].data_type, schema[idx].nullable)
+            return None
+        return self.transform(rewrite)
+
+    def __str__(self) -> str:  # pragma: no cover
+        args = ", ".join(str(c) for c in self.children)
+        return f"{type(self).__name__}({args})"
+
+
+# ---------------------------------------------------------------------------
+# Leaves
+# ---------------------------------------------------------------------------
+
+
+class AttributeReference(Expression):
+    """An unresolved column-by-name reference (pre-binding)."""
+
+    def __init__(self, name: str, dtype: Optional[T.DataType] = None,
+                 nullable: bool = True):
+        self._name = name
+        self._dtype = dtype
+        self._nullable = nullable
+
+    @property
+    def data_type(self) -> T.DataType:
+        if self._dtype is None:
+            raise RuntimeError(f"unresolved attribute {self._name}; bind() first")
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    @property
+    def name(self) -> str:
+        return self._name
+
+    def references(self) -> List[str]:
+        return [self._name]
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        return batch.rb.column(self._name)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        return batch.column(self._name)
+
+    def __str__(self) -> str:
+        return self._name
+
+
+class BoundReference(Expression):
+    """A column reference resolved to an ordinal (GpuBoundReference analog,
+    reference GpuBoundAttribute.scala:89)."""
+
+    def __init__(self, ordinal: int, dtype: T.DataType, nullable: bool = True):
+        self.ordinal = ordinal
+        self._dtype = dtype
+        self._nullable = nullable
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self._nullable
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        return batch.rb.column(self.ordinal)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        return batch.columns[self.ordinal]
+
+    def __str__(self) -> str:
+        return f"input[{self.ordinal}]"
+
+
+class Literal(Expression):
+    """A constant (GpuLiteral, reference literals.scala:128)."""
+
+    def __init__(self, value: Any, dtype: Optional[T.DataType] = None):
+        if dtype is None:
+            dtype = infer_literal_type(value)
+        self.value = value
+        self._dtype = dtype
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return self.value is None
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        return pa.scalar(self.value, type=T.to_arrow_type(self._dtype))
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        return scalar_column(self.value, self._dtype, batch.capacity, batch.n_rows)
+
+    def __str__(self) -> str:
+        return repr(self.value)
+
+
+def infer_literal_type(value: Any) -> T.DataType:
+    if value is None:
+        return T.NULL
+    if isinstance(value, bool):
+        return T.BOOLEAN
+    if isinstance(value, int):
+        return T.INT if -(2 ** 31) <= value < 2 ** 31 else T.LONG
+    if isinstance(value, float):
+        return T.DOUBLE
+    if isinstance(value, str):
+        return T.STRING
+    raise TypeError(f"cannot infer literal type for {value!r}")
+
+
+def lit(value: Any, dtype: Optional[T.DataType] = None) -> Literal:
+    return Literal(value, dtype)
+
+
+def col(name: str) -> AttributeReference:
+    return AttributeReference(name)
+
+
+class Alias(Expression):
+    """Rename an expression's output (GpuAlias, namedExpressions.scala)."""
+
+    def __init__(self, child: Expression, alias: str):
+        self.children = [child]
+        self._alias = alias
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    @property
+    def name(self) -> str:
+        return self._alias
+
+    def with_children(self, children):
+        return Alias(children[0], self._alias)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        return self.child.eval_host(batch)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        return self.child.eval_device(batch)
+
+    def __str__(self) -> str:
+        return f"{self.child} AS {self._alias}"
+
+
+# ---------------------------------------------------------------------------
+# Helpers shared by operator implementations
+# ---------------------------------------------------------------------------
+
+
+def host_to_array(v, length: int) -> pa.Array:
+    """Normalize host eval results: broadcast scalars to arrays."""
+    if isinstance(v, pa.ChunkedArray):
+        return v.combine_chunks()
+    if isinstance(v, pa.Scalar):
+        if v.is_valid:
+            return pa.array([v.as_py()] * length, type=v.type)
+        return pa.nulls(length, type=v.type)
+    return v
+
+
+def combined_validity(*cols: DeviceColumn) -> jnp.ndarray:
+    out = cols[0].validity
+    for c in cols[1:]:
+        out = out & c.validity
+    return out
+
+
+def make_column(data: jnp.ndarray, validity: jnp.ndarray,
+                dtype: T.DataType) -> DeviceColumn:
+    """Build a fixed-width column enforcing the null-data-is-zero invariant."""
+    np_dt = dtype.np_dtype
+    zero = jnp.zeros((), dtype=np_dt)
+    data = jnp.where(validity, data.astype(np_dt), zero)
+    return DeviceColumn(data=data, validity=validity, dtype=dtype)
+
+
+class UnaryExpression(Expression):
+    """Null-propagating unary op. Subclasses implement the two kernels."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def child(self) -> Expression:
+        return self.children[0]
+
+    def with_children(self, children):
+        return type(self)(children[0])
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.child.eval_host(batch), batch.num_rows)
+        return self.do_host(v)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.child.eval_device(batch)
+        data, extra_null = self.do_device(c.data)
+        validity = c.validity if extra_null is None else c.validity & ~extra_null
+        return make_column(data, validity, self.data_type)
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        raise NotImplementedError
+
+    def do_device(self, data: jnp.ndarray):
+        """Return (result_data, extra_null_mask_or_None)."""
+        raise NotImplementedError
+
+
+class BinaryExpression(Expression):
+    """Null-propagating binary op over fixed-width inputs."""
+
+    def __init__(self, left: Expression, right: Expression):
+        self.children = [left, right]
+
+    @property
+    def left(self) -> Expression:
+        return self.children[0]
+
+    @property
+    def right(self) -> Expression:
+        return self.children[1]
+
+    def with_children(self, children):
+        return type(self)(children[0], children[1])
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        l = host_to_array(self.left.eval_host(batch), batch.num_rows)
+        r = host_to_array(self.right.eval_host(batch), batch.num_rows)
+        return self.do_host(l, r)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        l = self.left.eval_device(batch)
+        r = self.right.eval_device(batch)
+        data, extra_null = self.do_device(l.data, r.data)
+        validity = combined_validity(l, r)
+        if extra_null is not None:
+            validity = validity & ~extra_null
+        return make_column(data, validity, self.data_type)
+
+    def do_host(self, l: pa.Array, r: pa.Array) -> pa.Array:
+        raise NotImplementedError
+
+    def do_device(self, l: jnp.ndarray, r: jnp.ndarray):
+        """Return (result_data, extra_null_mask_or_None)."""
+        raise NotImplementedError

@@ -1,0 +1,150 @@
+"""Math expression family — 28 classes mirroring the reference's
+``mathExpressions.scala`` (SURVEY.md §2.4): trig, log family, sqrt/cbrt,
+floor/ceil/rint, signum, exp/expm1, pow/atan2.
+
+Spark math functions operate on doubles and return null only for null inputs
+(domain errors produce NaN, following java.lang.Math). Device kernels are
+single jnp calls — XLA fuses chains of these into one VPU loop.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from .arithmetic import _np_of, _to_pa
+from .expression import BinaryExpression, UnaryExpression
+
+
+class MathUnary(UnaryExpression):
+    np_fn = None
+    jnp_fn = None
+    result_type = T.DOUBLE
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.result_type
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        vals, validity = _np_of(v)
+        with np.errstate(all="ignore"):
+            out = type(self).np_fn(vals.astype(np.float64))
+        if validity is not None:
+            out = np.where(validity, out, 0.0)
+        return _to_pa(out, validity, self.result_type)
+
+    def do_device(self, data: jnp.ndarray):
+        return type(self).jnp_fn(data.astype(jnp.float64)), None
+
+
+def _unary(name, np_fn, jnp_fn, result_type=T.DOUBLE):
+    cls = type(name, (MathUnary,), {
+        "np_fn": staticmethod(np_fn),
+        "jnp_fn": staticmethod(jnp_fn),
+        "result_type": result_type,
+    })
+    return cls
+
+
+Sin = _unary("Sin", np.sin, jnp.sin)
+Cos = _unary("Cos", np.cos, jnp.cos)
+Tan = _unary("Tan", np.tan, jnp.tan)
+Asin = _unary("Asin", np.arcsin, jnp.arcsin)
+Acos = _unary("Acos", np.arccos, jnp.arccos)
+Atan = _unary("Atan", np.arctan, jnp.arctan)
+Sinh = _unary("Sinh", np.sinh, jnp.sinh)
+Cosh = _unary("Cosh", np.cosh, jnp.cosh)
+Tanh = _unary("Tanh", np.tanh, jnp.tanh)
+Exp = _unary("Exp", np.exp, jnp.exp)
+Expm1 = _unary("Expm1", np.expm1, jnp.expm1)
+Log = _unary("Log", np.log, jnp.log)
+Log2 = _unary("Log2", np.log2, jnp.log2)
+Log10 = _unary("Log10", np.log10, jnp.log10)
+Log1p = _unary("Log1p", np.log1p, jnp.log1p)
+Sqrt = _unary("Sqrt", np.sqrt, jnp.sqrt)
+Cbrt = _unary("Cbrt", np.cbrt, jnp.cbrt)
+Rint = _unary("Rint", np.rint, jnp.round)
+ToDegrees = _unary("ToDegrees", np.degrees, jnp.degrees)
+ToRadians = _unary("ToRadians", np.radians, jnp.radians)
+
+
+class Signum(MathUnary):
+    np_fn = staticmethod(np.sign)
+    jnp_fn = staticmethod(jnp.sign)
+
+
+class _FloorCeil(UnaryExpression):
+    """floor/ceil on double -> bigint with Java (long) saturation."""
+
+    round_np = None
+    round_jnp = None
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LONG if self.child.data_type.is_floating else self.child.data_type
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        from .cast import _np_cast
+        vals, validity = _np_of(v)
+        if self.child.data_type.is_floating:
+            with np.errstate(all="ignore"):
+                out = _np_cast(type(self).round_np(vals), T.DOUBLE, T.LONG)
+        else:
+            out = vals
+        return _to_pa(out, validity, self.data_type)
+
+    def do_device(self, data: jnp.ndarray):
+        from .cast import _jnp_cast
+        if self.child.data_type.is_floating:
+            return _jnp_cast(type(self).round_jnp(data), T.DOUBLE, T.LONG), None
+        return data, None
+
+
+class Floor(_FloorCeil):
+    round_np = staticmethod(np.floor)
+    round_jnp = staticmethod(jnp.floor)
+
+
+class Ceil(_FloorCeil):
+    round_np = staticmethod(np.ceil)
+    round_jnp = staticmethod(jnp.ceil)
+
+
+class Pow(BinaryExpression):
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DOUBLE
+
+    def do_host(self, l: pa.Array, r: pa.Array) -> pa.Array:
+        lv, lval = _np_of(l)
+        rv, rval = _np_of(r)
+        validity = lval if rval is None else (rval if lval is None else lval & rval)
+        with np.errstate(all="ignore"):
+            out = np.power(lv.astype(np.float64), rv.astype(np.float64))
+        if validity is not None:
+            out = np.where(validity, out, 0.0)
+        return _to_pa(out, validity, T.DOUBLE)
+
+    def do_device(self, l, r):
+        return jnp.power(l.astype(jnp.float64), r.astype(jnp.float64)), None
+
+
+class Atan2(BinaryExpression):
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DOUBLE
+
+    def do_host(self, l: pa.Array, r: pa.Array) -> pa.Array:
+        lv, lval = _np_of(l)
+        rv, rval = _np_of(r)
+        validity = lval if rval is None else (rval if lval is None else lval & rval)
+        with np.errstate(all="ignore"):
+            out = np.arctan2(lv.astype(np.float64), rv.astype(np.float64))
+        if validity is not None:
+            out = np.where(validity, out, 0.0)
+        return _to_pa(out, validity, T.DOUBLE)
+
+    def do_device(self, l, r):
+        return jnp.arctan2(l.astype(jnp.float64), r.astype(jnp.float64)), None

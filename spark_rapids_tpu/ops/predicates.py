@@ -1,0 +1,286 @@
+"""Predicates, comparisons and three-valued logic — Spark semantics.
+
+Mirrors the reference's predicate family (reference:
+``sql-plugin/src/main/scala/org/apache/spark/sql/rapids/predicates.scala``,
+631 LoC): And/Or/Not with Kleene logic, the six comparisons, In/InSet,
+IsNull/IsNotNull/IsNaN.
+
+Comparisons return null when either side is null. AND/OR use SQL three-valued
+logic: ``false AND null = false``, ``true OR null = true``. Device columns
+carry (data, validity) pairs so Kleene logic is explicit mask algebra — which
+XLA fuses to a handful of vector ops.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, HostBatch
+from ..data.column import DeviceColumn
+from .expression import (BinaryExpression, Expression, UnaryExpression,
+                         host_to_array, make_column)
+from .strings_util import device_string_compare
+
+
+class Comparison(BinaryExpression):
+    """Base for =, <, <=, >, >=; null if either input is null."""
+
+    op = ""  # pc comparison function name
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    def do_host(self, l: pa.Array, r: pa.Array) -> pa.Array:
+        return getattr(pc, self.op)(l, r)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        l = self.left.eval_device(batch)
+        r = self.right.eval_device(batch)
+        validity = l.validity & r.validity
+        if l.is_string or r.is_string:
+            data = device_string_compare(self.op, l, r)
+        else:
+            data = self.jnp_kernel(l.data, r.data)
+        return make_column(data, validity, T.BOOLEAN)
+
+    def jnp_kernel(self, l, r):
+        raise NotImplementedError
+
+
+class EqualTo(Comparison):
+    op = "equal"
+
+    def jnp_kernel(self, l, r):
+        return l == r
+
+
+class NotEqual(Comparison):
+    op = "not_equal"
+
+    def jnp_kernel(self, l, r):
+        return l != r
+
+
+class LessThan(Comparison):
+    op = "less"
+
+    def jnp_kernel(self, l, r):
+        return l < r
+
+
+class LessThanOrEqual(Comparison):
+    op = "less_equal"
+
+    def jnp_kernel(self, l, r):
+        return l <= r
+
+
+class GreaterThan(Comparison):
+    op = "greater"
+
+    def jnp_kernel(self, l, r):
+        return l > r
+
+
+class GreaterThanOrEqual(Comparison):
+    op = "greater_equal"
+
+    def jnp_kernel(self, l, r):
+        return l >= r
+
+
+class EqualNullSafe(BinaryExpression):
+    """<=> — nulls compare equal; never returns null."""
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def do_host(self, l: pa.Array, r: pa.Array) -> pa.Array:
+        eq = pc.equal(l, r)
+        both_null = pc.and_(pc.is_null(l), pc.is_null(r))
+        return pc.if_else(pc.is_null(eq), both_null, eq)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        l = self.left.eval_device(batch)
+        r = self.right.eval_device(batch)
+        if l.is_string or r.is_string:
+            eq = device_string_compare("equal", l, r)
+        else:
+            eq = l.data == r.data
+        both_valid = l.validity & r.validity
+        both_null = ~l.validity & ~r.validity
+        data = jnp.where(both_valid, eq, both_null)
+        # Result is only defined for live rows; reuse live-row mask.
+        live = batch.row_mask()
+        return DeviceColumn(data=data & live, validity=live, dtype=T.BOOLEAN)
+
+
+class And(BinaryExpression):
+    """Kleene AND: false wins over null."""
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    def do_host(self, l: pa.Array, r: pa.Array) -> pa.Array:
+        return pc.and_kleene(l, r)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        l = self.left.eval_device(batch)
+        r = self.right.eval_device(batch)
+        data = l.data & r.data & l.validity & r.validity
+        known_false = (l.validity & ~l.data) | (r.validity & ~r.data)
+        validity = (l.validity & r.validity) | known_false
+        return make_column(data, validity, T.BOOLEAN)
+
+
+class Or(BinaryExpression):
+    """Kleene OR: true wins over null."""
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    def do_host(self, l: pa.Array, r: pa.Array) -> pa.Array:
+        return pc.or_kleene(l, r)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        l = self.left.eval_device(batch)
+        r = self.right.eval_device(batch)
+        known_true = (l.validity & l.data) | (r.validity & r.data)
+        validity = (l.validity & r.validity) | known_true
+        data = known_true
+        return make_column(data, validity, T.BOOLEAN)
+
+
+class Not(UnaryExpression):
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        return pc.invert(v)
+
+    def do_device(self, data):
+        return ~data, None
+
+
+class IsNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def with_children(self, children):
+        return IsNull(children[0])
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        return pc.is_null(v)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+        live = batch.row_mask()
+        return DeviceColumn(data=~c.validity & live, validity=live, dtype=T.BOOLEAN)
+
+
+class IsNotNull(Expression):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def with_children(self, children):
+        return IsNotNull(children[0])
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        return pc.is_valid(v)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+        live = batch.row_mask()
+        return DeviceColumn(data=c.validity & live, validity=live, dtype=T.BOOLEAN)
+
+
+class IsNaN(UnaryExpression):
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.child.eval_host(batch), batch.num_rows)
+        isnan = pc.is_nan(v)
+        return pc.fill_null(isnan, False)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.child.eval_device(batch)
+        live = batch.row_mask()
+        data = jnp.isnan(c.data) & c.validity & live
+        return DeviceColumn(data=data, validity=live, dtype=T.BOOLEAN)
+
+
+class In(Expression):
+    """value IN (literals...) — null semantics: null input -> null; if not
+    found and the list contains a null literal -> null (Spark)."""
+
+    def __init__(self, child: Expression, values: List):
+        self.children = [child]
+        self.values = list(values)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    def with_children(self, children):
+        return In(children[0], self.values)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        non_null = [x for x in self.values if x is not None]
+        has_null = len(non_null) != len(self.values)
+        found = pc.is_in(v, value_set=pa.array(non_null, type=v.type))
+        found = pc.if_else(pc.is_null(v), pa.scalar(None, pa.bool_()), found)
+        if has_null:
+            found = pc.if_else(found, found, pa.scalar(None, pa.bool_()))
+        return found
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+        non_null = [x for x in self.values if x is not None]
+        has_null = len(non_null) != len(self.values)
+        if c.is_string:
+            raise NotImplementedError("IN on strings runs via dictionary codes")
+        found = jnp.zeros_like(c.validity)
+        for x in non_null:
+            found = found | (c.data == jnp.asarray(x, dtype=c.data.dtype))
+        validity = c.validity & (found | (not has_null))
+        return make_column(found, validity, T.BOOLEAN)

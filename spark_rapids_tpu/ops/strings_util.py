@@ -1,0 +1,100 @@
+"""Device string primitives: the padded char-matrix trick.
+
+Variable-width data in a vector ISA is the classic TPU-hostile case (SURVEY.md
+§7 "Strings on TPU"). The kernel strategy: materialize, inside the traced
+program, a ``[capacity, W]`` int16 character matrix from the Arrow
+offsets+payload layout, where ``W`` is the column's static ``max_bytes`` bound
+and positions past each string's end hold ``-1`` (sorts before every real
+byte). Gathers of this shape vectorize cleanly on the VPU, and XLA fuses the
+downstream compare/reduce.
+
+cudf solves the same problems with specialized CUDA kernels over the raw
+offsets (reference relies on libcudf's strings support via the
+``ai.rapids.cudf`` JNI, SURVEY.md §2.10); the char-matrix is the XLA-native
+equivalent for bounded-width columns.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..data.column import DeviceColumn
+
+#: Character value used for "past end of string" — sorts before every byte.
+PAD = -1
+
+
+def char_matrix(col: DeviceColumn, width: int = None) -> jnp.ndarray:
+    """[capacity, W] int16; row i holds string i's bytes, PAD past its end."""
+    assert col.is_string
+    w = width or max(col.max_bytes, 1)
+    starts = col.offsets[:-1]
+    ends = col.offsets[1:]
+    pos = starts[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_range = pos < ends[:, None]
+    byte_cap = col.data.shape[0]
+    chars = col.data[jnp.clip(pos, 0, byte_cap - 1)].astype(jnp.int16)
+    return jnp.where(in_range, chars, PAD)
+
+
+def lengths(col: DeviceColumn) -> jnp.ndarray:
+    """Byte length per row, int32[capacity]."""
+    return col.offsets[1:] - col.offsets[:-1]
+
+
+def device_string_compare(op: str, l: DeviceColumn, r: DeviceColumn) -> jnp.ndarray:
+    """Lexicographic byte comparison of two string columns.
+
+    ``op`` uses pyarrow.compute naming so predicate classes can share it:
+    equal/not_equal/less/less_equal/greater/greater_equal.
+    """
+    w = max(max(l.max_bytes, r.max_bytes), 1)
+    lm = char_matrix(l, w)
+    rm = char_matrix(r, w)
+    if op == "equal":
+        return jnp.all(lm == rm, axis=1)
+    if op == "not_equal":
+        return jnp.any(lm != rm, axis=1)
+    cmp = _lex_cmp(lm, rm)
+    if op == "less":
+        return cmp < 0
+    if op == "less_equal":
+        return cmp <= 0
+    if op == "greater":
+        return cmp > 0
+    if op == "greater_equal":
+        return cmp >= 0
+    raise ValueError(op)
+
+
+def _lex_cmp(lm: jnp.ndarray, rm: jnp.ndarray) -> jnp.ndarray:
+    """-1/0/+1 per row comparing char matrices; PAD (-1) makes shorter-prefix
+    strings compare less, matching byte-wise UTF-8 ordering."""
+    diff = lm != rm
+    any_diff = jnp.any(diff, axis=1)
+    first = jnp.argmax(diff, axis=1)
+    rows = jnp.arange(lm.shape[0])
+    lv = lm[rows, first]
+    rv = rm[rows, first]
+    sign = jnp.sign(lv - rv).astype(jnp.int32)
+    return jnp.where(any_diff, sign, 0)
+
+
+def sort_keys_for_strings(col: DeviceColumn) -> list:
+    """Decompose a string column into a list of int16 columns usable as
+    lexicographic sort keys for ``lax.sort`` (one operand per char position)."""
+    m = char_matrix(col)
+    return [m[:, i] for i in range(m.shape[1])]
+
+
+def string_hash(col: DeviceColumn, seed: int = 42) -> jnp.ndarray:
+    """FNV-1a over the char matrix — used for hash partitioning of string
+    keys. Deterministic across hosts/chips."""
+    m = char_matrix(col)
+    valid = m != PAD
+    mu = jnp.where(valid, m, 0).astype(jnp.uint32)
+    h = jnp.full(m.shape[0], jnp.uint32(2166136261 ^ seed), dtype=jnp.uint32)
+    for i in range(m.shape[1]):
+        nh = (h ^ mu[:, i]) * jnp.uint32(16777619)
+        h = jnp.where(valid[:, i], nh, h)
+    return h

@@ -1,0 +1,24 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): unit tests run locally
+and deterministically; multi-chip sharding logic is exercised on a faked
+8-device mesh via ``xla_force_host_platform_device_count``, exactly as the
+driver validates ``dryrun_multichip``. Bench runs (bench.py) use the real TPU.
+
+Note: the CPU backend is also what makes float64 tests exact — the axon TPU
+tunnel emulates f64 with ~1 ulp of upload error, which the differential
+harness would flag as false diffs.
+"""
+import os
+
+# Must be set before the jax backend initializes. JAX_PLATFORMS alone is not
+# honored once the axon TPU plugin is present; jax_platforms config is.
+os.environ.setdefault("JAX_PLATFORM_NAME", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

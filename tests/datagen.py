@@ -1,0 +1,135 @@
+"""Randomized data generators for differential tests.
+
+Mirrors the reference's composable generator library
+(``integration_tests/src/main/python/data_gen.py:26-500`` and the Scala
+``FuzzerUtils.scala:33``): seeded generators per type with controllable null
+fraction and special values (NaN, infinities, extremes), assembled into host
+batches that tests run through both the CPU-oracle and device paths.
+"""
+
+from __future__ import annotations
+
+import string
+from typing import List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu import types as T
+
+
+class Gen:
+    def __init__(self, dtype: T.DataType, nullable: bool = True,
+                 null_prob: float = 0.1):
+        self.dtype = dtype
+        self.nullable = nullable
+        self.null_prob = null_prob if nullable else 0.0
+
+    def generate(self, rng: np.random.Generator, n: int) -> pa.Array:
+        vals = self.values(rng, n)
+        if self.null_prob > 0:
+            mask = rng.random(n) < self.null_prob
+            vals = [None if m else v for v, m in zip(vals, mask)]
+        return pa.array(vals, type=T.to_arrow_type(self.dtype))
+
+    def values(self, rng, n) -> List:
+        raise NotImplementedError
+
+
+class IntGen(Gen):
+    def __init__(self, dtype=T.INT, lo=None, hi=None, **kw):
+        super().__init__(dtype, **kw)
+        bits = {T.BYTE: 8, T.SHORT: 16, T.INT: 32, T.LONG: 64}[dtype]
+        self.lo = lo if lo is not None else -(2 ** (bits - 1))
+        self.hi = hi if hi is not None else 2 ** (bits - 1) - 1
+
+    def values(self, rng, n):
+        base = rng.integers(self.lo, self.hi, size=n, endpoint=True, dtype=np.int64)
+        # Sprinkle boundary values like the reference generators do.
+        for special in (self.lo, self.hi, 0):
+            idx = rng.integers(0, n)
+            base[idx] = special
+        return base.tolist()
+
+
+class FloatGen(Gen):
+    def __init__(self, dtype=T.DOUBLE, no_nans=False, **kw):
+        super().__init__(dtype, **kw)
+        self.no_nans = no_nans
+
+    def values(self, rng, n):
+        vals = (rng.random(n) - 0.5) * rng.choice(
+            [1.0, 100.0, 1e6, 1e-6], size=n)
+        out = vals.tolist()
+        specials = [0.0, -0.0, 1.0, -1.0]
+        if not self.no_nans:
+            specials += [float("nan"), float("inf"), float("-inf")]
+        for s in specials:
+            out[int(rng.integers(0, n))] = s
+        if self.dtype is T.FLOAT:
+            out = [np.float32(v).item() for v in out]
+        return out
+
+
+class BoolGen(Gen):
+    def __init__(self, **kw):
+        super().__init__(T.BOOLEAN, **kw)
+
+    def values(self, rng, n):
+        return rng.integers(0, 2, size=n).astype(bool).tolist()
+
+
+class StringGen(Gen):
+    def __init__(self, max_len=12, alphabet=string.ascii_letters + string.digits,
+                 **kw):
+        super().__init__(T.STRING, **kw)
+        self.max_len = max_len
+        self.alphabet = alphabet
+
+    def values(self, rng, n):
+        out = []
+        for _ in range(n):
+            ln = int(rng.integers(0, self.max_len + 1))
+            out.append("".join(rng.choice(list(self.alphabet), size=ln)))
+        return out
+
+
+class DateGen(Gen):
+    def __init__(self, **kw):
+        super().__init__(T.DATE, **kw)
+
+    def values(self, rng, n):
+        import datetime
+        days = rng.integers(-25000, 25000, size=n)
+        epoch = datetime.date(1970, 1, 1)
+        return [epoch + datetime.timedelta(days=int(d)) for d in days]
+
+
+class TimestampGen(Gen):
+    def __init__(self, **kw):
+        super().__init__(T.TIMESTAMP, **kw)
+
+    def values(self, rng, n):
+        import datetime
+        us = rng.integers(-2**50, 2**50, size=n)
+        epoch = datetime.datetime(1970, 1, 1)
+        return [epoch + datetime.timedelta(microseconds=int(u)) for u in us]
+
+
+def gen_batch(gens: dict, n: int = 256, seed: int = 0) -> pa.RecordBatch:
+    rng = np.random.default_rng(seed)
+    arrays, names = [], []
+    for name, gen in gens.items():
+        arrays.append(gen.generate(rng, n))
+        names.append(name)
+    return pa.RecordBatch.from_arrays(arrays, names=names)
+
+
+#: Shorthand suites, like data_gen.py's numeric_gens / all_basic_gens.
+def numeric_gens():
+    return [IntGen(T.BYTE), IntGen(T.SHORT), IntGen(T.INT), IntGen(T.LONG),
+            FloatGen(T.FLOAT), FloatGen(T.DOUBLE)]
+
+
+def integral_gens():
+    return [IntGen(T.BYTE), IntGen(T.SHORT), IntGen(T.INT), IntGen(T.LONG)]
