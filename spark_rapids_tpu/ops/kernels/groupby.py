@@ -1,0 +1,134 @@
+"""Sort-based group-by kernel — the libcudf ``groupby`` replacement.
+
+cuDF hash-aggregates with device hash tables (reached via JNI from
+``aggregate.scala:728`` in the reference). Hash tables are a poor fit for
+XLA's static-shape model, so the TPU-native design is sort-based:
+
+1. lexicographic ``lax.sort`` of the key columns (validity participates so
+   null forms its own group, like Spark),
+2. segment boundaries where adjacent sorted keys differ,
+3. ``jax.ops.segment_*`` reductions with ``num_segments = capacity``,
+4. group keys gathered from each segment's first row.
+
+The output batch has one live row per distinct key; its capacity equals the
+input capacity (worst case all-distinct), carried as the usual traced
+``n_rows``. Partial->final merge reuses the same kernel with merge
+aggregations (sum-of-partial-sums etc.), mirroring the reference's
+partial/final mode split (``aggregate.scala:259-450``).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ... import types as T
+from ...data.column import DeviceColumn
+from ..strings_util import char_matrix
+from .rowops import gather_column, orderable_key, sort_permutation, string_sort_keys
+
+
+def _equal_adjacent(col: DeviceColumn, perm: jnp.ndarray) -> jnp.ndarray:
+    """bool[capacity]: row i (sorted order) has the same key as row i-1."""
+    sorted_validity = col.validity[perm]
+    vprev = jnp.concatenate([sorted_validity[:1], sorted_validity[:-1]])
+    if col.is_string:
+        m = char_matrix(col)[perm]
+        prev = jnp.concatenate([m[:1], m[:-1]], axis=0)
+        data_eq = jnp.all(m == prev, axis=1)
+    else:
+        key, _ = orderable_key(col)  # canonicalizes NaN/-0.0
+        k = key[perm]
+        kprev = jnp.concatenate([k[:1], k[:-1]])
+        data_eq = k == kprev
+    both_null = ~sorted_validity & ~vprev
+    return (data_eq & sorted_validity & vprev) | both_null
+
+
+def group_ids(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray
+              ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Compute (segment_id_per_original_row, n_groups, first_row_index_per_group).
+
+    segment ids are dense [0, n_groups); dead rows get id capacity-1 is NOT
+    safe, so they get id = capacity (dropped by segment reductions bounded to
+    capacity via clamping at use sites); here they receive the last live
+    group's id but contribute nothing because callers mask their inputs.
+    """
+    capacity = keys[0].capacity
+    perm = sort_permutation(keys, n_rows)
+    eq = jnp.ones(capacity, dtype=jnp.bool_)
+    for k in keys:
+        eq = eq & _equal_adjacent(k, perm)
+    live_sorted = (jnp.arange(capacity, dtype=jnp.int32) < n_rows)
+    # First row of the sorted array starts a segment by definition.
+    is_boundary = (~eq | (jnp.arange(capacity) == 0)) & live_sorted
+    seg_sorted = jnp.cumsum(is_boundary.astype(jnp.int32)) - 1
+    seg_sorted = jnp.maximum(seg_sorted, 0)
+    n_groups = jnp.sum(is_boundary.astype(jnp.int32))
+    # Scatter segment ids back to original row order.
+    seg = jnp.zeros(capacity, dtype=jnp.int32).at[perm].set(seg_sorted)
+    # First original-row index of each segment (for gathering key values).
+    firsts = jnp.zeros(capacity, dtype=jnp.int32).at[seg_sorted].max(
+        jnp.where(is_boundary, perm, 0))
+    return seg, n_groups, firsts
+
+
+def segment_reduce(values: jnp.ndarray, validity: jnp.ndarray,
+                   seg: jnp.ndarray, capacity: int, op: str,
+                   live: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reduce ``values`` per segment. Returns (result[capacity], non_empty
+    count[capacity] of valid contributions)."""
+    contrib = validity & live
+    counts = jax.ops.segment_sum(contrib.astype(jnp.int64), seg,
+                                 num_segments=capacity)
+    if op == "sum":
+        masked = jnp.where(contrib, values, 0)
+        out = jax.ops.segment_sum(masked, seg, num_segments=capacity)
+    elif op == "min":
+        neutral = _max_value(values.dtype)
+        masked = jnp.where(contrib, values, neutral)
+        out = jax.ops.segment_min(masked, seg, num_segments=capacity)
+    elif op == "max":
+        neutral = _min_value(values.dtype)
+        masked = jnp.where(contrib, values, neutral)
+        out = jax.ops.segment_max(masked, seg, num_segments=capacity)
+    elif op == "count":
+        out = counts
+    elif op == "first":
+        idx = jnp.arange(values.shape[0], dtype=jnp.int32)
+        first_idx = jax.ops.segment_min(
+            jnp.where(contrib, idx, values.shape[0]), seg,
+            num_segments=capacity)
+        safe = jnp.clip(first_idx, 0, values.shape[0] - 1)
+        out = values[safe]
+    elif op == "last":
+        idx = jnp.arange(values.shape[0], dtype=jnp.int32)
+        last_idx = jax.ops.segment_max(jnp.where(contrib, idx, -1), seg,
+                                       num_segments=capacity)
+        safe = jnp.clip(last_idx, 0, values.shape[0] - 1)
+        out = values[safe]
+    else:
+        raise ValueError(op)
+    return out, counts
+
+
+def _max_value(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).max, dtype)
+
+
+def _min_value(dtype):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.asarray(-jnp.inf, dtype)
+    return jnp.asarray(jnp.iinfo(dtype).min, dtype)
+
+
+def gather_group_keys(keys: Sequence[DeviceColumn], firsts: jnp.ndarray,
+                      n_groups: jnp.ndarray) -> List[DeviceColumn]:
+    """Group-key output columns: each group's key from its first member row."""
+    capacity = keys[0].capacity
+    live = jnp.arange(capacity, dtype=jnp.int32) < n_groups
+    return [gather_column(k, firsts, live) for k in keys]

@@ -1,0 +1,141 @@
+"""Equi-join kernels — the libcudf hash-join replacement.
+
+The reference's joins concat the build side and call cudf's hash join
+(``GpuHashJoin.scala:113-166``). Hash tables don't map to XLA, so the
+TPU-native algorithm is rank-based:
+
+1. **Dense key ids**: concatenate build and probe key columns, lexicographic
+   ``lax.sort``, assign each distinct key tuple a dense id, scatter ids back.
+   This reduces any multi-column / string / float key to ONE int32 key with
+   exact equality (no collision handling, unlike hashing).
+2. **Sorted search**: sort build ids, ``searchsorted`` each probe id for its
+   [lo, hi) match range; ``counts = hi - lo`` (null keys never match, Spark
+   semantics).
+3. **Expansion**: output slot k maps back to its probe row by searchsorted
+   over the cumulative counts; the build row is recovered from the offset
+   within the range. Static output capacity with an overflow count returned;
+   callers re-execute with a bigger bucket when it overflows (the dynamic
+   part of join output sizing happens at batch granularity, not row).
+
+Inner/left/right/full/semi/anti all derive from (lo, hi, counts).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...data.batch import ColumnarBatch
+from ...data.column import DeviceColumn
+from ..strings_util import char_matrix
+from .rowops import orderable_key, string_sort_keys
+
+
+def dense_key_ids(build_keys: Sequence[DeviceColumn],
+                  probe_keys: Sequence[DeviceColumn],
+                  n_build: jnp.ndarray, n_probe: jnp.ndarray
+                  ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Assign dense ids to distinct key tuples across both sides.
+
+    Returns (build_ids[cap_b], probe_ids[cap_p]); dead rows and null-keyed
+    rows get id -1 (never match; Spark equi-join null semantics).
+    """
+    cap_b = build_keys[0].capacity
+    cap_p = probe_keys[0].capacity
+    total = cap_b + cap_p
+
+    operands: List[jnp.ndarray] = []
+    null_key = jnp.zeros(total, dtype=jnp.bool_)
+    live = jnp.concatenate([
+        jnp.arange(cap_b, dtype=jnp.int32) < n_build,
+        jnp.arange(cap_p, dtype=jnp.int32) < n_probe])
+    for b, p in zip(build_keys, probe_keys):
+        null_key = null_key | ~jnp.concatenate([b.validity, p.validity])
+        if b.is_string:
+            # Both sides must expand to the same char width.
+            w = max(b.max_bytes, p.max_bytes, 1)
+            mb, mp = char_matrix(b, w), char_matrix(p, w)
+            m = jnp.concatenate([mb, mp], axis=0)
+            operands.extend(m[:, i] for i in range(w))
+        else:
+            kb, _ = orderable_key(b)
+            kp, _ = orderable_key(p)
+            operands.append(jnp.concatenate([kb, kp]))
+    usable = live & ~null_key
+    # Unusable rows sort to the end and never start/join a group.
+    operands.insert(0, jnp.where(usable, 0, 1).astype(jnp.int8))
+    iota = jnp.arange(total, dtype=jnp.int32)
+    sorted_ops = jax.lax.sort(tuple(operands) + (iota,),
+                              num_keys=len(operands), is_stable=True)
+    perm = sorted_ops[-1]
+    keys_sorted = [o[perm] for o in operands]
+    eq = jnp.ones(total, dtype=jnp.bool_)
+    for o in keys_sorted:
+        prev = jnp.concatenate([o[:1], o[:-1]])
+        eq = eq & (o == prev)
+    usable_sorted = usable[perm]
+    boundary = (~eq | (iota == 0)) & usable_sorted
+    ids_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    ids_sorted = jnp.where(usable_sorted, jnp.maximum(ids_sorted, 0), -1)
+    ids = jnp.zeros(total, dtype=jnp.int32).at[perm].set(ids_sorted)
+    return ids[:cap_b], ids[cap_b:]
+
+
+def match_ranges(build_ids: jnp.ndarray, probe_ids: jnp.ndarray,
+                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Sort build ids; for each probe row return (lo, hi) in the sorted build
+    order plus the sorted->original build permutation."""
+    cap_b = build_ids.shape[0]
+    iota = jnp.arange(cap_b, dtype=jnp.int32)
+    sorted_ids, build_perm = jax.lax.sort(
+        (jnp.where(build_ids < 0, jnp.int32(2 ** 31 - 1), build_ids), iota),
+        num_keys=1, is_stable=True)
+    valid_probe = probe_ids >= 0
+    lo = jnp.searchsorted(sorted_ids, probe_ids, side="left")
+    hi = jnp.searchsorted(sorted_ids, probe_ids, side="right")
+    counts = jnp.where(valid_probe, hi - lo, 0).astype(jnp.int32)
+    return lo.astype(jnp.int32), counts, build_perm, sorted_ids
+
+
+def expand_matches(lo: jnp.ndarray, counts: jnp.ndarray,
+                   build_perm: jnp.ndarray, out_capacity: int
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Materialize (probe_idx, build_idx) pairs for all matches.
+
+    Returns (probe_idx[out_cap], build_idx[out_cap], n_out, total) where
+    ``total`` may exceed out_capacity — caller must check and re-run bigger.
+    """
+    offsets = jnp.cumsum(counts)
+    total = offsets[-1]
+    starts = offsets - counts
+    k = jnp.arange(out_capacity, dtype=jnp.int32)
+    probe_idx = jnp.searchsorted(offsets, k, side="right").astype(jnp.int32)
+    safe_probe = jnp.clip(probe_idx, 0, counts.shape[0] - 1)
+    within = k - starts[safe_probe]
+    build_sorted_pos = lo[safe_probe] + within
+    build_idx = build_perm[jnp.clip(build_sorted_pos, 0, build_perm.shape[0] - 1)]
+    n_out = jnp.minimum(total, out_capacity)
+    return safe_probe, build_idx, n_out.astype(jnp.int32), total
+
+
+def left_outer_counts(counts: jnp.ndarray, valid_probe_live: jnp.ndarray
+                      ) -> jnp.ndarray:
+    """Left join: unmatched live probe rows still emit one (null-build) row."""
+    return jnp.where(valid_probe_live & (counts == 0), 1, counts)
+
+
+def build_hit_mask(build_ids: jnp.ndarray, sorted_ids: jnp.ndarray,
+                   probe_ids: jnp.ndarray, n_probe: jnp.ndarray) -> jnp.ndarray:
+    """For full-outer/right joins: which build rows matched >=1 probe row."""
+    cap_p = probe_ids.shape[0]
+    live_probe = jnp.arange(cap_p, dtype=jnp.int32) < n_probe
+    usable = (probe_ids >= 0) & live_probe
+    # A build row matched iff its id appears among usable probe ids.
+    sorted_pids, _ = jax.lax.sort(
+        (jnp.where(usable, probe_ids, jnp.int32(2 ** 31 - 1)),
+         jnp.arange(cap_p, dtype=jnp.int32)), num_keys=1, is_stable=True)
+    pos = jnp.searchsorted(sorted_pids, build_ids, side="left")
+    found = sorted_pids[jnp.clip(pos, 0, cap_p - 1)] == build_ids
+    return found & (build_ids >= 0)

@@ -1,0 +1,159 @@
+"""Row-rearrangement kernels: gather, compact (filter), multi-key sort.
+
+These replace libcudf's ``Table.filter`` / ``gather`` / ``Table.sort`` (the
+reference reaches them through the cudf JNI, e.g.
+``basicPhysicalOperators.scala:127`` for filter) with XLA-native equivalents:
+
+* **compact**: a stable argsort of the drop-mask moves kept rows to the
+  front — no dynamic shapes; the live-row count shrinks instead.
+* **multi-key sort**: ``lax.sort`` with one operand per key. Float keys are
+  transformed to order-preserving int bit patterns so NaN ordering and
+  -0.0 == 0.0 match Spark; nulls order via an explicit validity key.
+* **string gather** rebuilds offsets+payload through the char matrix.
+
+Everything here is traced (jit-safe): static capacities, dynamic row counts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import types as T
+from ...data.batch import ColumnarBatch
+from ...data.column import DeviceColumn, bucket_capacity
+from ..strings_util import PAD, char_matrix
+
+
+def orderable_key(col: DeviceColumn, ascending: bool = True,
+                  nulls_first: bool = True) -> jnp.ndarray:
+    """Map a fixed-width column to an int array whose ascending order equals
+    the requested SQL order (nulls placed per ``nulls_first``)."""
+    assert not col.is_string, "string sort keys expand via string_sort_keys"
+    data = col.data
+    if col.dtype.is_floating:
+        if data.dtype == jnp.float32:
+            bits = data.view(jnp.int32).astype(jnp.int64)
+        else:
+            bits = data.view(jnp.int64)
+        # Canonicalize NaN and -0.0 so grouping equality matches Spark
+        # (FloatUtils.scala:84 does the same normalization on GPU).
+        canon_nan = jnp.int64(0x7FF8000000000000 if data.dtype == jnp.float64
+                              else 0x7FC00000)
+        bits = jnp.where(jnp.isnan(data), canon_nan, bits)
+        bits = jnp.where(data == 0, jnp.int64(0), bits)
+        # IEEE total-order trick: negatives map (order-reversed) below zero,
+        # positives keep their bit order. Wrapping int64 add is intended.
+        int64_min = jnp.int64(-0x8000000000000000)
+        key = jnp.where(bits < 0, ~bits + int64_min, bits)
+    else:
+        key = data.astype(jnp.int64)
+    if not ascending:
+        key = ~key  # bitwise NOT reverses order with no overflow
+    null_bucket = jnp.where(col.validity, 0, -1 if nulls_first else 1)
+    return key, null_bucket.astype(jnp.int8)
+
+
+def string_sort_keys(col: DeviceColumn, ascending: bool = True,
+                     nulls_first: bool = True) -> List[jnp.ndarray]:
+    """Expand a string column into per-char int16 sort operands."""
+    m = char_matrix(col)
+    cols = [m[:, i] for i in range(m.shape[1])]
+    if not ascending:
+        cols = [-(c.astype(jnp.int32) + 1) for c in cols]
+    null_bucket = jnp.where(col.validity, 0, -1 if nulls_first else 1)
+    return [null_bucket.astype(jnp.int8)] + cols
+
+
+def sort_permutation(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray,
+                     ascending: Optional[Sequence[bool]] = None,
+                     nulls_first: Optional[Sequence[bool]] = None) -> jnp.ndarray:
+    """Stable permutation ordering live rows by the given keys; dead rows sink
+    to the end. Returns int32[capacity] indices."""
+    capacity = keys[0].capacity
+    asc = ascending or [True] * len(keys)
+    nf = nulls_first or [True] * len(keys)
+    operands: List[jnp.ndarray] = []
+    live = jnp.arange(capacity, dtype=jnp.int32) < n_rows
+    # Dead rows order after everything.
+    operands.append(jnp.where(live, 0, 1).astype(jnp.int8))
+    for k, a, n in zip(keys, asc, nf):
+        if k.is_string:
+            operands.extend(string_sort_keys(k, a, n))
+        else:
+            key, null_bucket = orderable_key(k, a, n)
+            operands.append(null_bucket)
+            operands.append(key)
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    out = jax.lax.sort(tuple(operands) + (iota,), num_keys=len(operands),
+                       is_stable=True)
+    return out[-1]
+
+
+def gather_column(col: DeviceColumn, indices: jnp.ndarray,
+                  index_valid: Optional[jnp.ndarray] = None) -> DeviceColumn:
+    """Gather rows of ``col`` at ``indices`` (int32[out_capacity])."""
+    out_cap = indices.shape[0]
+    safe = jnp.clip(indices, 0, col.capacity - 1)
+    validity = col.validity[safe]
+    if index_valid is not None:
+        validity = validity & index_valid
+    if not col.is_string:
+        data = jnp.where(validity, col.data[safe], 0)
+        return DeviceColumn(data=data, validity=validity, dtype=col.dtype)
+    # Strings: gather rows of the char matrix, then rebuild offsets+payload.
+    m = char_matrix(col)[safe]  # [out_cap, W]
+    m = jnp.where(validity[:, None], m, PAD)
+    return strings_from_matrix(m, validity, col.max_bytes)
+
+
+def strings_from_matrix(m: jnp.ndarray, validity: jnp.ndarray,
+                        max_bytes: int) -> DeviceColumn:
+    """Rebuild (offsets, payload) from a char matrix (PAD-terminated rows)."""
+    out_cap, w = m.shape
+    lens = jnp.sum((m != PAD).astype(jnp.int32), axis=1)
+    offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    byte_cap = bucket_capacity(out_cap * w)
+    flat_pos = (offsets[:-1][:, None]
+                + jnp.arange(w, dtype=jnp.int32)[None, :])
+    in_str = m != PAD
+    # Out-of-range target + mode="drop" discards pad positions instead of
+    # racing them into a dump slot.
+    target = jnp.where(in_str, flat_pos, byte_cap)
+    payload = jnp.zeros(byte_cap, dtype=jnp.uint8)
+    payload = payload.at[target.reshape(-1)].set(
+        jnp.where(in_str, m, 0).astype(jnp.uint8).reshape(-1), mode="drop")
+    return DeviceColumn(data=payload, validity=validity, dtype=T.STRING,
+                        offsets=offsets, max_bytes=max_bytes)
+
+
+def gather_batch(batch: ColumnarBatch, indices: jnp.ndarray,
+                 new_n_rows: jnp.ndarray,
+                 index_valid: Optional[jnp.ndarray] = None) -> ColumnarBatch:
+    out_cap = indices.shape[0]
+    live = jnp.arange(out_cap, dtype=jnp.int32) < new_n_rows
+    iv = live if index_valid is None else (index_valid & live)
+    cols = tuple(gather_column(c, indices, iv) for c in batch.columns)
+    return ColumnarBatch(cols, new_n_rows.astype(jnp.int32), batch.schema)
+
+
+def compact(batch: ColumnarBatch, keep: jnp.ndarray) -> ColumnarBatch:
+    """Filter: move kept rows to the front, shrink n_rows. ``keep`` is a
+    bool[capacity] mask (already False for dead/invalid-predicate rows)."""
+    keep = keep & batch.row_mask()
+    n_kept = jnp.sum(keep.astype(jnp.int32))
+    drop = (~keep).astype(jnp.int8)
+    iota = jnp.arange(batch.capacity, dtype=jnp.int32)
+    _, perm = jax.lax.sort((drop, iota), num_keys=1, is_stable=True)
+    return gather_batch(batch, perm, n_kept)
+
+
+def sort_batch(batch: ColumnarBatch, key_ordinals: Sequence[int],
+               ascending: Sequence[bool], nulls_first: Sequence[bool]) -> ColumnarBatch:
+    keys = [batch.columns[i] for i in key_ordinals]
+    perm = sort_permutation(keys, batch.n_rows, ascending, nulls_first)
+    return gather_batch(batch, perm, batch.n_rows)
