@@ -1,0 +1,685 @@
+"""TPU physical operators — the ``Gpu*Exec`` analogs.
+
+Each exec consumes/produces device :class:`ColumnarBatch` streams. Per-batch
+work is a jitted function over the batch pytree: XLA compiles one program per
+(schema, capacity bucket) and fuses the whole operator expression tree
+(project chains, filter masks, aggregation updates) into a handful of fused
+kernels — the TPU answer to cudf's pre-compiled kernel library.
+
+Operator parity map (reference locations in SURVEY.md §2.3):
+* TpuProjectExec / TpuFilterExec  <- basicPhysicalOperators.scala:66,127
+* TpuHashAggregateExec            <- aggregate.scala:227 (partial/merge loop)
+* TpuSortExec                     <- GpuSortExec.scala:50 (RequireSingleBatch)
+* TpuShuffledHashJoinExec         <- GpuShuffledHashJoinExec.scala:76 +
+                                     GpuHashJoin.doJoin:113-166
+* TpuRangeExec / TpuUnionExec / TpuLimitExec / TpuExpandExec
+                                  <- basicPhysicalOperators.scala:182,301 /
+                                     limit.scala:115 / GpuExpandExec.scala:66
+* HostToDeviceExec / DeviceToHostExec <- HostColumnarToGpu.scala:222 /
+                                     GpuColumnarToRowExec.scala:35
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, HostBatch
+from ..data.column import DeviceColumn, bucket_capacity
+from ..ops import aggregates as AGG
+from ..ops.expression import BoundReference, Expression, make_column
+from ..ops.kernels import concat as KC
+from ..ops.kernels import groupby as KG
+from ..ops.kernels import join as KJ
+from ..ops.kernels import rowops as KR
+from ..plan.logical import SortOrder
+from ..plan.physical import ExecContext, PhysicalPlan
+from ..utils.tracing import trace_range
+
+
+def _bind_all(exprs: List[Expression], schema: T.Schema) -> List[Expression]:
+    return [e.bind(schema) for e in exprs]
+
+
+class TpuExec(PhysicalPlan):
+    columnar = True
+
+    def describe(self):
+        return self.node_name()
+
+
+# ---------------------------------------------------------------------------
+# Transitions
+# ---------------------------------------------------------------------------
+
+
+class HostToDeviceExec(TpuExec):
+    """Upload host batches, coalescing toward the batch-size goal
+    (HostColumnarToGpu + CoalesceGoal, reference HostColumnarToGpu.scala:222)."""
+
+    def __init__(self, child: PhysicalPlan, goal_rows: int = 1 << 20):
+        self.children = [child]
+        self.goal_rows = goal_rows
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        arrow = T.schema_to_arrow(self.schema)
+
+        def run(part):
+            pending: List[pa.RecordBatch] = []
+            pending_rows = 0
+            for hb in part:
+                rb = hb.rb
+                if rb.num_rows == 0:
+                    continue
+                pending.append(rb.cast(arrow))
+                pending_rows += rb.num_rows
+                if pending_rows >= self.goal_rows:
+                    yield self._upload(pending)
+                    pending, pending_rows = [], 0
+            if pending:
+                yield self._upload(pending)
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+    def _upload(self, rbs: List[pa.RecordBatch]) -> ColumnarBatch:
+        with trace_range("HostToDevice.upload"):
+            if len(rbs) == 1:
+                combined = rbs[0]
+            else:
+                combined = pa.Table.from_batches(rbs).combine_chunks() \
+                    .to_batches()[0]
+            return ColumnarBatch.from_arrow(combined)
+
+
+class DeviceToHostExec(PhysicalPlan):
+    """Download device batches to host (GpuColumnarToRowExec analog)."""
+
+    columnar = False
+
+    def __init__(self, child: PhysicalPlan):
+        self.children = [child]
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        def run(part):
+            for db in part:
+                with trace_range("DeviceToHost.download"):
+                    yield HostBatch.from_device(db)
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
+# ---------------------------------------------------------------------------
+# Narrow operators
+# ---------------------------------------------------------------------------
+
+
+class TpuProjectExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, exprs: List[Expression]):
+        self.children = [child]
+        self.exprs = exprs
+
+    @property
+    def schema(self):
+        return T.Schema([T.StructField(e.name, e.data_type, e.nullable)
+                         for e in self.exprs])
+
+    def describe(self):
+        return "TpuProject [" + ", ".join(e.name for e in self.exprs) + "]"
+
+    def execute(self, ctx):
+        bound = _bind_all(self.exprs, self.children[0].schema)
+        out_schema = self.schema
+
+        @jax.jit
+        def project(batch: ColumnarBatch) -> ColumnarBatch:
+            cols = tuple(e.eval_device(batch) for e in bound)
+            return batch.with_columns(cols, out_schema)
+
+        def run(part):
+            for db in part:
+                yield project(db)
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
+class TpuFilterExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, condition: Expression):
+        self.children = [child]
+        self.condition = condition
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"TpuFilter ({self.condition})"
+
+    def execute(self, ctx):
+        bound = self.condition.bind(self.children[0].schema)
+
+        @jax.jit
+        def filt(batch: ColumnarBatch) -> ColumnarBatch:
+            mask_col = bound.eval_device(batch)
+            keep = mask_col.data & mask_col.validity
+            return KR.compact(batch, keep)
+
+        def run(part):
+            for db in part:
+                yield filt(db)
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
+class TpuRangeExec(TpuExec):
+    def __init__(self, start: int, end: int, step: int,
+                 batch_rows: int = 1 << 20):
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+
+    @property
+    def schema(self):
+        return T.Schema([T.StructField("id", T.LONG, False)])
+
+    def execute(self, ctx):
+        def gen():
+            n_total = max(0, -(-(self.end - self.start) // self.step))
+            done = 0
+            while done < n_total:
+                n = min(self.batch_rows, n_total - done)
+                cap = bucket_capacity(n)
+                start = self.start + done * self.step
+                data = start + jnp.arange(cap, dtype=jnp.int64) * self.step
+                valid = jnp.arange(cap, dtype=jnp.int32) < n
+                col = DeviceColumn(data=jnp.where(valid, data, 0),
+                                   validity=valid, dtype=T.LONG)
+                yield ColumnarBatch((col,), jnp.asarray(n, jnp.int32),
+                                    self.schema)
+                done += n
+        return [gen()]
+
+
+class TpuUnionExec(TpuExec):
+    def __init__(self, children: List[PhysicalPlan], schema: T.Schema):
+        self.children = list(children)
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self, ctx):
+        parts = []
+        for c in self.children:
+            def relabel(p):
+                for db in p:
+                    yield ColumnarBatch(db.columns, db.n_rows, self._schema)
+            parts.extend(relabel(p) for p in c.execute(ctx))
+        return parts
+
+
+class TpuLimitExec(TpuExec):
+    """Global limit: truncates the live-row count batch by batch (one host
+    sync per batch, like the reference's per-batch row slicing limit.scala:115)."""
+
+    def __init__(self, child: PhysicalPlan, n: int):
+        self.children = [child]
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        def gen():
+            remaining = self.n
+            for part in self.children[0].execute(ctx):
+                for db in part:
+                    if remaining <= 0:
+                        return
+                    rows = int(db.n_rows)
+                    take = min(rows, remaining)
+                    remaining -= take
+                    if take == rows:
+                        yield db
+                    else:
+                        yield _truncate(db, take)
+        return [gen()]
+
+
+@jax.jit
+def _truncate(db: ColumnarBatch, take) -> ColumnarBatch:
+    take = jnp.asarray(take, jnp.int32)
+    live = jnp.arange(db.capacity, dtype=jnp.int32) < take
+    cols = []
+    for c in db.columns:
+        v = c.validity & live
+        if c.is_string:
+            cols.append(DeviceColumn(c.data, v, c.dtype, c.offsets, c.max_bytes))
+        else:
+            cols.append(DeviceColumn(
+                jnp.where(v, c.data, jnp.zeros((), c.data.dtype)), v, c.dtype))
+    return ColumnarBatch(tuple(cols), take, db.schema)
+
+
+class TpuExpandExec(TpuExec):
+    def __init__(self, child: PhysicalPlan, projections, schema: T.Schema):
+        self.children = [child]
+        self.projections = projections
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self, ctx):
+        child_schema = self.children[0].schema
+        bound = [
+            _bind_all(proj, child_schema) for proj in self.projections]
+        out_schema = self._schema
+
+        def make_projection(proj):
+            @jax.jit
+            def project(batch):
+                cols = []
+                for e, f in zip(proj, out_schema):
+                    c = e.eval_device(batch)
+                    if c.dtype.name != f.data_type.name:
+                        from ..ops.cast import _jnp_cast
+                        data = _jnp_cast(c.data, c.dtype, f.data_type)
+                        c = make_column(data, c.validity, f.data_type)
+                    cols.append(c)
+                return batch.with_columns(tuple(cols), out_schema)
+            return project
+
+        fns = [make_projection(p) for p in bound]
+
+        def run(part):
+            for db in part:
+                for fn in fns:
+                    yield fn(db)
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
+# ---------------------------------------------------------------------------
+# Sort
+# ---------------------------------------------------------------------------
+
+
+class TpuSortExec(TpuExec):
+    """Global sort requires a single batch (RequireSingleBatch, reference
+    GpuSortExec.scala:54): coalesce all partitions then one device sort."""
+
+    def __init__(self, child: PhysicalPlan, orders: List[SortOrder]):
+        self.children = [child]
+        self.orders = orders
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        schema = self.schema
+        key_exprs = [o.child.bind(schema) for o in self.orders]
+        asc = [o.ascending for o in self.orders]
+        nf = [o.effective_nulls_first for o in self.orders]
+
+        def gen():
+            batches = []
+            for part in self.children[0].execute(ctx):
+                batches.extend(part)
+            if not batches:
+                return
+            merged = _coalesce_device(batches)
+
+            @jax.jit
+            def do_sort(b):
+                keys = [e.eval_device(b) for e in key_exprs]
+                perm = KR.sort_permutation(keys, b.n_rows, asc, nf)
+                return KR.gather_batch(b, perm, b.n_rows)
+            yield do_sort(merged)
+        return [gen()]
+
+
+def _coalesce_device(batches: List[ColumnarBatch]) -> ColumnarBatch:
+    """Concat device batches, sizing output by synced total rows."""
+    if len(batches) == 1:
+        return batches[0]
+    total = sum(int(b.n_rows) for b in batches)
+    cap = bucket_capacity(max(total, 1))
+    return KC.concat_batches(batches, cap)
+
+
+# ---------------------------------------------------------------------------
+# Hash aggregate
+# ---------------------------------------------------------------------------
+
+
+class TpuHashAggregateExec(TpuExec):
+    """Partial-per-batch aggregation with a device merge loop, mirroring the
+    reference's concat + re-aggregate accumulation (aggregate.scala:330-400),
+    then a final buffer-evaluation projection."""
+
+    def __init__(self, child: PhysicalPlan, groupings: List[Expression],
+                 aggregates: List[AGG.AggregateExpression]):
+        self.children = [child]
+        self.groupings = groupings
+        self.aggregates = aggregates
+
+    @property
+    def schema(self):
+        fields = [T.StructField(g.name, g.data_type, g.nullable)
+                  for g in self.groupings]
+        fields += [T.StructField(a.name, a.func.data_type, a.func.nullable)
+                   for a in self.aggregates]
+        return T.Schema(fields)
+
+    def describe(self):
+        return ("TpuHashAggregate [" + ", ".join(g.name for g in self.groupings)
+                + "] [" + ", ".join(a.name for a in self.aggregates) + "]")
+
+    # Buffer schema: groupings then per-agg buffers.
+    def _buffer_schema(self) -> T.Schema:
+        fields = [T.StructField(g.name, g.data_type, g.nullable)
+                  for g in self.groupings]
+        for i, a in enumerate(self.aggregates):
+            for spec in a.func.buffers():
+                fields.append(T.StructField(f"_buf{i}_{spec.suffix}",
+                                            spec.dtype, True))
+        return T.Schema(fields)
+
+    def execute(self, ctx):
+        child_schema = self.children[0].schema
+        groupings = _bind_all(self.groupings, child_schema)
+        aggs = [AGG.AggregateExpression(a.func.bind(child_schema), a.name)
+                for a in self.aggregates]
+        buf_schema = self._buffer_schema()
+        n_keys = len(groupings)
+
+        @jax.jit
+        def partial(batch: ColumnarBatch) -> ColumnarBatch:
+            return _aggregate_batch(batch, groupings, aggs, buf_schema,
+                                    n_keys, update_mode=True)
+
+        @jax.jit
+        def merge(batch: ColumnarBatch) -> ColumnarBatch:
+            key_refs = [BoundReference(i, f.data_type, f.nullable)
+                        for i, f in enumerate(buf_schema)][:n_keys]
+            return _aggregate_batch(batch, key_refs, aggs, buf_schema,
+                                    n_keys, update_mode=False)
+
+        def gen():
+            state: Optional[ColumnarBatch] = None
+            for part in self.children[0].execute(ctx):
+                for db in part:
+                    p = partial(db)
+                    if state is None:
+                        state = p
+                    else:
+                        both = _coalesce_device([state, p])
+                        state = merge(both)
+            if state is None or (not self.groupings
+                                 and int(state.n_rows) == 0):
+                yield self._empty_result()
+                return
+            yield self._finalize(state, buf_schema)
+        return [gen()]
+
+    def _finalize(self, state: ColumnarBatch, buf_schema: T.Schema
+                  ) -> ColumnarBatch:
+        out_schema = self.schema
+        n_keys = len(self.groupings)
+
+        @jax.jit
+        def final(b: ColumnarBatch) -> ColumnarBatch:
+            cols = list(b.columns[:n_keys])
+            bi = n_keys
+            for a in self.aggregates:
+                specs = a.func.buffers()
+                refs = [BoundReference(bi + j, s.dtype, True)
+                        for j, s in enumerate(specs)]
+                bi += len(specs)
+                result_expr = a.func.evaluate(refs)
+                cols.append(result_expr.eval_device(b))
+            return ColumnarBatch(tuple(cols), b.n_rows, out_schema)
+        return final(state)
+
+    def _empty_result(self) -> ColumnarBatch:
+        """Global aggregation of empty input: one row (count=0, rest null)."""
+        arrays = []
+        for a in self.aggregates:
+            if isinstance(a.func, AGG.Count):
+                arrays.append(pa.array([0], pa.int64()))
+            else:
+                arrays.append(pa.nulls(1, T.to_arrow_type(a.func.data_type)))
+        rb = pa.RecordBatch.from_arrays(
+            arrays, schema=T.schema_to_arrow(self.schema))
+        return ColumnarBatch.from_arrow(rb)
+
+
+def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
+                     aggs: List[AGG.AggregateExpression],
+                     buf_schema: T.Schema, n_keys: int,
+                     update_mode: bool) -> ColumnarBatch:
+    """One grouping pass. update_mode: inputs are raw rows (evaluate agg
+    children, apply update ops). merge mode: inputs are buffer columns."""
+    capacity = batch.capacity
+    live = batch.row_mask()
+    keys = [e.eval_device(batch) for e in key_exprs]
+    if keys:
+        seg, n_groups, firsts = KG.group_ids(keys, batch.n_rows)
+        key_cols = KG.gather_group_keys(keys, firsts, n_groups)
+    else:
+        seg = jnp.zeros(capacity, dtype=jnp.int32)
+        n_groups = jnp.minimum(batch.n_rows, 1).astype(jnp.int32)
+        key_cols = []
+    group_live = jnp.arange(capacity, dtype=jnp.int32) < n_groups
+
+    out_cols = list(key_cols)
+    bi = n_keys
+    for a in aggs:
+        specs = a.func.buffers()
+        for j, spec in enumerate(specs):
+            if update_mode:
+                if a.func.child is None:  # count(*)
+                    values = jnp.ones(capacity, dtype=jnp.int64)
+                    validity = jnp.ones(capacity, dtype=jnp.bool_)
+                else:
+                    c = a.func.child.eval_device(batch)
+                    from ..ops.cast import _jnp_cast
+                    values = _jnp_cast(c.data, c.dtype, spec.dtype) \
+                        if c.dtype.name != spec.dtype.name else c.data
+                    validity = c.validity
+                op = spec.update_op
+            else:
+                c = batch.columns[bi + j]
+                values = c.data
+                validity = c.validity
+                op = spec.merge_op
+            result, counts = KG.segment_reduce(values, validity, seg,
+                                               capacity, op, live)
+            if spec.from_count:
+                data = counts if op == "count" else result
+                validity_out = group_live
+            else:
+                data = result
+                validity_out = (counts > 0) & group_live
+            out_cols.append(make_column(data.astype(spec.dtype.np_dtype),
+                                        validity_out, spec.dtype))
+        bi += len(specs)
+    return ColumnarBatch(tuple(out_cols), n_groups, buf_schema)
+
+
+# ---------------------------------------------------------------------------
+# Joins
+# ---------------------------------------------------------------------------
+
+
+class TpuShuffledHashJoinExec(TpuExec):
+    """Equi-join: build side fully concatenated on device, probe side
+    streamed (GpuShuffledHashJoinExec/GpuHashJoin analog). Also covers the
+    broadcast-join shape in single-process mode."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 join_type: str, left_keys: List[Expression],
+                 right_keys: List[Expression], schema: T.Schema,
+                 condition: Optional[Expression] = None,
+                 growth: float = 1.0):
+        self.children = [left, right]
+        self.join_type = join_type
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self._schema = schema
+        self.condition = condition
+        self.growth = growth
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"TpuShuffledHashJoin {self.join_type}"
+
+    def execute(self, ctx):
+        left, right = self.children
+        if self.join_type == "right":
+            # Mirror: right outer = left outer with sides swapped.
+            inner = TpuShuffledHashJoinExec(
+                right, left, "left", self.right_keys, self.left_keys,
+                _swap_schema(self._schema, len(left.schema)),
+                self.condition, self.growth)
+            parts = inner.execute(ctx)
+            n_right = len(right.schema)
+            out_schema = self._schema
+
+            def reorder(p):
+                for db in p:
+                    cols = db.columns[n_right:] + db.columns[:n_right]
+                    yield ColumnarBatch(cols, db.n_rows, out_schema)
+            return [reorder(p) for p in parts]
+
+        lkeys = _bind_all(self.left_keys, left.schema)
+        rkeys = _bind_all(self.right_keys, right.schema)
+        jt = self.join_type
+        out_schema = self._schema
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def kernel(probe, build, out_cap):
+            pk = [e.eval_device(probe) for e in lkeys]
+            bk = [e.eval_device(build) for e in rkeys]
+            bids, pids = KJ.dense_key_ids(bk, pk, build.n_rows, probe.n_rows)
+            lo, counts, perm, sorted_ids = KJ.match_ranges(bids, pids)
+            live_p = probe.row_mask()
+            counts = jnp.where(live_p, counts, 0)
+            matched = counts > 0
+            hits = None
+            if jt == "full":
+                hits = KJ.build_hit_mask(bids, sorted_ids, pids, probe.n_rows)
+            if jt in ("left_semi", "left_anti"):
+                keep = matched if jt == "left_semi" else (~matched & live_p)
+                return KR.compact(probe, keep), hits
+            exp_counts = counts
+            if jt in ("left", "full"):
+                exp_counts = KJ.left_outer_counts(counts, live_p)
+            p_idx, b_idx, n_out, total = KJ.expand_matches(
+                lo, exp_counts, perm, out_cap)
+            real = matched[p_idx]
+            out_live = jnp.arange(out_cap, dtype=jnp.int32) < n_out
+            pcols = [KR.gather_column(c, p_idx, out_live)
+                     for c in probe.columns]
+            bcols = [KR.gather_column(c, b_idx, out_live & real)
+                     for c in build.columns]
+            out = ColumnarBatch(tuple(pcols + bcols), n_out, out_schema)
+            return (out, hits), total
+
+        post_filter = None
+        if self.condition is not None:
+            cond = self.condition.bind(out_schema)
+
+            @jax.jit
+            def post_filter(b):
+                mask = cond.eval_device(b)
+                return KR.compact(b, mask.data & mask.validity)
+
+        def join_batch(probe, build):
+            out_cap = bucket_capacity(
+                max(int(probe.capacity * self.growth), 128))
+            if jt in ("left_semi", "left_anti"):
+                out, hits = kernel(probe, build, out_cap)
+                return ColumnarBatch(out.columns, out.n_rows, out_schema), hits
+            (out, hits), total = kernel(probe, build, out_cap)
+            t = int(total)
+            if t > out_cap:
+                (out, hits), _ = kernel(probe, build, bucket_capacity(t))
+            if post_filter is not None:
+                out = post_filter(out)
+            return out, hits
+
+        def gen():
+            build_batches = []
+            for part in right.execute(ctx):
+                build_batches.extend(part)
+            build = _coalesce_device(build_batches) if build_batches else None
+
+            hit_acc = None
+            for part in left.execute(ctx):
+                for probe in part:
+                    if build is None:
+                        if jt in ("left", "full"):
+                            yield _null_extend_right(probe, out_schema,
+                                                     len(right.schema))
+                        elif jt == "left_anti":
+                            yield ColumnarBatch(probe.columns, probe.n_rows,
+                                                out_schema)
+                        continue
+                    out, hits = join_batch(probe, build)
+                    if hit_acc is None:
+                        hit_acc = hits
+                    elif hits is not None:
+                        hit_acc = hit_acc | hits
+                    yield out
+            if jt == "full" and build is not None:
+                yield self._unmatched_build(build, hit_acc)
+        return [gen()]
+
+    def _unmatched_build(self, build: ColumnarBatch, hit_acc) -> ColumnarBatch:
+        n_left = len(self.children[0].schema)
+
+        @jax.jit
+        def kernel(build, hits):
+            live_b = build.row_mask()
+            keep = live_b & ~hits if hits is not None else live_b
+            compacted = KR.compact(build, keep)
+            null_left = [
+                _null_col(f.data_type, build.capacity)
+                for f in self.children[0].schema]
+            cols = tuple(null_left) + compacted.columns
+            return ColumnarBatch(cols, compacted.n_rows, self._schema)
+        return kernel(build, hit_acc)
+
+
+def _null_col(dtype: T.DataType, capacity: int) -> DeviceColumn:
+    from ..data.column import null_column
+    return null_column(dtype, capacity)
+
+
+def _null_extend_right(probe: ColumnarBatch, schema: T.Schema,
+                       n_right: int) -> ColumnarBatch:
+    null_cols = tuple(_null_col(schema[len(probe.columns) + i].data_type,
+                                probe.capacity)
+                      for i in range(n_right))
+    return ColumnarBatch(probe.columns + null_cols, probe.n_rows, schema)
+
+
+def _swap_schema(schema: T.Schema, n_first: int) -> T.Schema:
+    fields = list(schema)
+    return T.Schema(fields[n_first:] + fields[:n_first])

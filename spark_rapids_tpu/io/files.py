@@ -1,0 +1,127 @@
+"""File scans — Parquet/ORC/CSV readers (host decode milestone).
+
+The reference reads files in two stages: CPU-side footer/stripe selection and
+byte assembly, then device-side decode via cudf (GpuParquetScan.scala:314 —
+readPartFile rebuilds a mini parquet file in host memory, then
+Table.readParquet decodes on GPU). The TPU analog of stage two (device decode
+kernels for RLE/dictionary/bitpack leaves) is a later milestone (SURVEY.md §7
+hard parts); this module implements stage one with pyarrow: predicate
+pushdown, column pruning, and row-group-granular chunked reads honoring
+``spark.rapids.sql.reader.batchSizeRows/Bytes``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pyarrow as pa
+import pyarrow.dataset as ds
+
+from .. import types as T
+from ..config import MAX_READ_BATCH_SIZE_BYTES, MAX_READ_BATCH_SIZE_ROWS
+from ..data.batch import HostBatch
+from ..ops import predicates as PRED
+from ..ops.expression import AttributeReference, Expression, Literal
+from ..plan.physical import PhysicalPlan
+
+
+def infer_schema(fmt: str, paths: List[str], options: dict) -> T.Schema:
+    dataset = _dataset(fmt, paths, options)
+    return T.schema_from_arrow(dataset.schema)
+
+
+def _dataset(fmt: str, paths: List[str], options: dict) -> ds.Dataset:
+    if fmt == "parquet":
+        return ds.dataset(paths, format="parquet")
+    if fmt == "orc":
+        return ds.dataset(paths, format="orc")
+    if fmt == "csv":
+        import pyarrow.csv as pacsv
+        parse = pacsv.ParseOptions(
+            delimiter=options.get("delimiter", ","))
+        read = pacsv.ReadOptions()
+        convert = pacsv.ConvertOptions()
+        if not options.get("header", True):
+            read = pacsv.ReadOptions(autogenerate_column_names=True)
+        fmt_obj = ds.CsvFileFormat(parse_options=parse,
+                                   read_options=read,
+                                   convert_options=convert)
+        return ds.dataset(paths, format=fmt_obj)
+    raise ValueError(f"unknown format {fmt}")
+
+
+def to_arrow_filter(expr: Expression) -> Optional[ds.Expression]:
+    """Best-effort conversion of a pushed filter to a pyarrow dataset filter
+    (the ParquetFilters predicate-pushdown analog, GpuParquetScan.scala:290)."""
+    import pyarrow.compute as pc
+    try:
+        if isinstance(expr, PRED.And):
+            l = to_arrow_filter(expr.children[0])
+            r = to_arrow_filter(expr.children[1])
+            if l is not None and r is not None:
+                return l & r
+            return l if r is None else r
+        if isinstance(expr, PRED.Or):
+            l = to_arrow_filter(expr.children[0])
+            r = to_arrow_filter(expr.children[1])
+            return (l | r) if l is not None and r is not None else None
+        if isinstance(expr, PRED.Comparison):
+            left, right = expr.children
+            if isinstance(left, AttributeReference) and isinstance(right, Literal):
+                f = pc.field(left._name)
+                v = right.value
+                op = {"equal": f.__eq__, "not_equal": f.__ne__,
+                      "less": f.__lt__, "less_equal": f.__le__,
+                      "greater": f.__gt__, "greater_equal": f.__ge__}[expr.op]
+                return op(v)
+        if isinstance(expr, PRED.IsNotNull) and isinstance(
+                expr.children[0], AttributeReference):
+            return ~pc.field(expr.children[0]._name).is_null()
+        if isinstance(expr, PRED.IsNull) and isinstance(
+                expr.children[0], AttributeReference):
+            return pc.field(expr.children[0]._name).is_null()
+    except Exception:
+        return None
+    return None
+
+
+class CpuFileScanExec(PhysicalPlan):
+    """Host file scan; one partition per input fragment (file/row-group
+    cluster), chunked by reader batch-size limits."""
+
+    def __init__(self, fmt: str, paths: List[str], schema: T.Schema,
+                 options: dict, pushed_filters: List[Expression]):
+        self.fmt = fmt
+        self.paths = paths
+        self._schema = schema
+        self.options = options
+        self.pushed_filters = pushed_filters
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"CpuFileScan {self.fmt} {self.paths}"
+
+    def execute(self, ctx):
+        dataset = _dataset(self.fmt, self.paths, self.options)
+        arrow_schema = T.schema_to_arrow(self._schema)
+        names = [f.name for f in arrow_schema]
+        filt = None
+        for f in self.pushed_filters:
+            af = to_arrow_filter(f)
+            if af is not None:
+                filt = af if filt is None else (filt & af)
+        max_rows = ctx.conf.get(MAX_READ_BATCH_SIZE_ROWS)
+        fragments = list(dataset.get_fragments())
+
+        def read_fragment(frag):
+            scanner = ds.Scanner.from_fragment(
+                frag, columns=names, filter=filt, batch_size=max_rows)
+            for rb in scanner.to_batches():
+                if rb.num_rows:
+                    yield HostBatch(rb.cast(arrow_schema))
+        if not fragments:
+            return [iter([])]
+        return [read_fragment(f) for f in fragments]

@@ -1,0 +1,61 @@
+"""Device manager — the ``GpuDeviceManager`` analog.
+
+The reference acquires the single GPU per executor, initializes the RMM pool
+with a fraction of VRAM, and wires the spill event handler
+(GpuDeviceManager.scala:120-214). JAX/XLA owns HBM allocation on TPU, so the
+TPU-native analog manages: backend selection, the one-device invariant for
+local execution, HBM budget accounting for the spill framework, and the task
+semaphore bootstrap. Multi-chip execution goes through the mesh layer
+(:mod:`..parallel.mesh`) instead of one-process-per-device.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+
+from ..config import (CONCURRENT_TPU_TASKS, DEVICE_BACKEND,
+                      HBM_ALLOC_FRACTION, MEMORY_DEBUG, TpuConf)
+from .semaphore import TpuSemaphore
+
+
+class DeviceManager:
+    _instance: Optional["DeviceManager"] = None
+    _lock = threading.Lock()
+
+    def __init__(self, conf: TpuConf):
+        backend = conf.get(DEVICE_BACKEND)
+        self.devices = (jax.devices(backend) if backend else jax.devices())
+        self.device = self.devices[0]
+        self.debug = conf.get(MEMORY_DEBUG)
+        # HBM budget for the spill framework; jax doesn't expose exact HBM
+        # sizes for every backend, so fall back to a conservative default.
+        frac = conf.get(HBM_ALLOC_FRACTION)
+        try:
+            stats = self.device.memory_stats() or {}
+            total = stats.get("bytes_limit", 16 << 30)
+        except Exception:
+            total = 16 << 30
+        self.hbm_budget_bytes = int(total * frac)
+        self.semaphore = TpuSemaphore(conf.get(CONCURRENT_TPU_TASKS))
+
+    @classmethod
+    def get_or_create(cls, conf: TpuConf) -> "DeviceManager":
+        with cls._lock:
+            if cls._instance is None:
+                cls._instance = DeviceManager(conf)
+            return cls._instance
+
+    @classmethod
+    def reset(cls):
+        with cls._lock:
+            cls._instance = None
+
+    def memory_in_use(self) -> int:
+        try:
+            stats = self.device.memory_stats() or {}
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0

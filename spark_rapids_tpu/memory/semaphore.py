@@ -1,0 +1,52 @@
+"""Task admission semaphore — the ``GpuSemaphore`` analog.
+
+The reference bounds concurrent tasks holding the GPU
+(``spark.rapids.sql.concurrentGpuTasks``) with a per-task reentrant acquire
+released by a completion listener (GpuSemaphore.scala:74-161). Our execution
+threads acquire it around device work; re-entrant per thread so nested
+operators don't deadlock.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+
+class TpuSemaphore:
+    def __init__(self, max_concurrent: int):
+        self.max_concurrent = max_concurrent
+        self._sem = threading.Semaphore(max_concurrent)
+        self._held: Dict[int, int] = {}
+        self._lock = threading.Lock()
+
+    def acquire_if_necessary(self):
+        """Reentrant acquire (GpuSemaphore.acquireIfNecessary:74)."""
+        tid = threading.get_ident()
+        with self._lock:
+            if self._held.get(tid, 0) > 0:
+                self._held[tid] += 1
+                return
+        self._sem.acquire()
+        with self._lock:
+            self._held[tid] = self._held.get(tid, 0) + 1
+
+    def release_if_necessary(self):
+        tid = threading.get_ident()
+        with self._lock:
+            count = self._held.get(tid, 0)
+            if count == 0:
+                return
+            if count > 1:
+                self._held[tid] = count - 1
+                return
+            del self._held[tid]
+        self._sem.release()
+
+    def __enter__(self):
+        self.acquire_if_necessary()
+        return self
+
+    def __exit__(self, *exc):
+        self.release_if_necessary()
+        return False

@@ -1,0 +1,188 @@
+"""Aggregate functions — the ``CudfAggregate``/``GpuDeclarativeAggregate`` analog.
+
+The reference declares each aggregate as buffer columns + cudf update/merge
+ops + a final projection (``AggregateFunctions.scala:69,252`` —
+GpuMin/Max/Sum/Count/Average at ``:276-361``, First/Last in shims). We keep
+exactly that declarative structure, but the ops name **segment-reduction
+kernels** (:mod:`..ops.kernels.groupby`) instead of cudf ops, so the same
+declaration drives partial mode, merge mode, and reduction (no-key) mode:
+
+* ``update_ops`` — per-buffer (kernel_op, buffer_dtype) applied to the input
+  column in partial aggregation;
+* ``merge_ops`` — kernel ops combining partial buffers in final aggregation;
+* ``evaluate`` — expression over the merged buffers producing the result.
+
+Host-side (oracle/fallback) evaluation maps to pyarrow group_by aggregation
+names, deliberately an independent implementation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from .. import types as T
+from .arithmetic import Divide
+from .cast import Cast
+from .expression import BoundReference, Expression
+
+
+@dataclasses.dataclass(frozen=True)
+class BufferSpec:
+    """One partial-aggregation buffer column."""
+    suffix: str
+    update_op: str  # kernel op producing it from the input
+    merge_op: str   # kernel op merging partials
+    dtype: T.DataType
+    #: count buffers are non-null; value buffers are null when count==0
+    from_count: bool = False
+
+
+class AggregateFunction(Expression):
+    """Declarative aggregate. ``children`` holds the input expression."""
+
+    def __init__(self, child: Optional[Expression] = None):
+        self.children = [child] if child is not None else []
+
+    @property
+    def child(self) -> Optional[Expression]:
+        return self.children[0] if self.children else None
+
+    def with_children(self, children):
+        return type(self)(children[0]) if children else type(self)()
+
+    # -- declarative surface -------------------------------------------------
+    def buffers(self) -> List[BufferSpec]:
+        raise NotImplementedError
+
+    def evaluate(self, buffer_refs: List[Expression]) -> Expression:
+        """Final projection over merged buffers (identity for simple aggs)."""
+        return buffer_refs[0]
+
+    #: pyarrow group_by aggregation name for the host oracle.
+    pa_agg: str = ""
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+
+class Min(AggregateFunction):
+    pa_agg = "min"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def buffers(self):
+        return [BufferSpec("min", "min", "min", self.data_type)]
+
+
+class Max(AggregateFunction):
+    pa_agg = "max"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def buffers(self):
+        return [BufferSpec("max", "max", "max", self.data_type)]
+
+
+class Sum(AggregateFunction):
+    """Spark widens integral sums to bigint, float sums to double."""
+
+    pa_agg = "sum"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DOUBLE if self.child.data_type.is_floating else T.LONG
+
+    def buffers(self):
+        return [BufferSpec("sum", "sum", "sum", self.data_type)]
+
+
+class Count(AggregateFunction):
+    """count(expr) — non-null count; count(*) when child is None."""
+
+    pa_agg = "count"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.LONG
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def buffers(self):
+        return [BufferSpec("count", "count", "sum", T.LONG, from_count=True)]
+
+
+class Average(AggregateFunction):
+    """avg = sum/count carried as two buffers (reference GpuAverage:361)."""
+
+    pa_agg = "mean"
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.DOUBLE
+
+    def buffers(self):
+        return [BufferSpec("sum", "sum", "sum", T.DOUBLE),
+                BufferSpec("count", "count", "sum", T.LONG, from_count=True)]
+
+    def evaluate(self, buffer_refs):
+        # Divide yields null on zero count, matching Spark's empty-group avg.
+        return Divide(buffer_refs[0], Cast(buffer_refs[1], T.DOUBLE))
+
+
+class First(AggregateFunction):
+    """first(expr, ignoreNulls) — reference keeps First/Last in shims
+    (shims/spark300/.../GpuFirst.scala:51)."""
+
+    pa_agg = "first"
+
+    def __init__(self, child: Optional[Expression] = None,
+                 ignore_nulls: bool = True):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, children):
+        return First(children[0], self.ignore_nulls)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def buffers(self):
+        return [BufferSpec("first", "first", "first", self.data_type)]
+
+
+class Last(AggregateFunction):
+    pa_agg = "last"
+
+    def __init__(self, child: Optional[Expression] = None,
+                 ignore_nulls: bool = True):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def with_children(self, children):
+        return Last(children[0], self.ignore_nulls)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def buffers(self):
+        return [BufferSpec("last", "last", "last", self.data_type)]
+
+
+@dataclasses.dataclass
+class AggregateExpression:
+    """A named aggregate in an Aggregate node (GpuAggregateExpression analog)."""
+    func: AggregateFunction
+    name: str
+
+    def bind(self, schema) -> "AggregateExpression":
+        return AggregateExpression(self.func.bind(schema), self.name)

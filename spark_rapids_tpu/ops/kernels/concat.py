@@ -1,0 +1,79 @@
+"""Device batch concatenation — the ``Table.concatenate`` replacement used by
+coalescing (reference GpuCoalesceBatches.scala:21,502) and build-side assembly.
+
+Traced implementation: each input batch's live rows scatter into the output at
+its dynamic cumulative offset (``mode="drop"`` discards dead lanes), so a
+fixed list of input capacities compiles to one program regardless of live
+counts. Strings route through the char matrix and rebuild offsets."""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ... import types as T
+from ...data.batch import ColumnarBatch
+from ...data.column import DeviceColumn, bucket_capacity
+from ..strings_util import PAD, char_matrix
+from .rowops import strings_from_matrix
+
+
+def concat_columns(cols: List[DeviceColumn], n_rows_list, out_capacity: int,
+                   total_rows) -> DeviceColumn:
+    dtype = cols[0].dtype
+    live_out = jnp.arange(out_capacity, dtype=jnp.int32) < total_rows
+    if cols[0].is_string:
+        w = max(max(c.max_bytes for c in cols), 1)
+        offset = jnp.zeros((), jnp.int32)
+        out_m = jnp.full((out_capacity, w), PAD, dtype=jnp.int16)
+        out_v = jnp.zeros(out_capacity, dtype=jnp.bool_)
+        for c, n in zip(cols, n_rows_list):
+            m = char_matrix(c, w)
+            idx = jnp.arange(c.capacity, dtype=jnp.int32)
+            live = idx < n
+            target = jnp.where(live, idx + offset, out_capacity)
+            out_m = out_m.at[target].set(
+                jnp.where(live[:, None], m, PAD), mode="drop")
+            out_v = out_v.at[target].set(c.validity & live, mode="drop")
+            offset = offset + n
+        out_v = out_v & live_out
+        return strings_from_matrix(jnp.where(out_v[:, None], out_m, PAD),
+                                   out_v, w)
+    out_data = jnp.zeros(out_capacity, dtype=dtype.np_dtype)
+    out_valid = jnp.zeros(out_capacity, dtype=jnp.bool_)
+    offset = jnp.zeros((), jnp.int32)
+    for c, n in zip(cols, n_rows_list):
+        idx = jnp.arange(c.capacity, dtype=jnp.int32)
+        live = idx < n
+        target = jnp.where(live, idx + offset, out_capacity)
+        out_data = out_data.at[target].set(
+            jnp.where(live & c.validity, c.data, jnp.zeros((), c.data.dtype)),
+            mode="drop")
+        out_valid = out_valid.at[target].set(c.validity & live, mode="drop")
+        offset = offset + n
+    out_valid = out_valid & live_out
+    return DeviceColumn(data=jnp.where(out_valid, out_data, jnp.zeros((), out_data.dtype)),
+                        validity=out_valid, dtype=dtype)
+
+
+def concat_batches(batches: List[ColumnarBatch],
+                   out_capacity: int) -> ColumnarBatch:
+    """Concatenate device batches (same schema) into one of ``out_capacity``.
+    Caller sizes out_capacity >= sum of live rows (sync or worst-case sum of
+    capacities)."""
+    assert batches
+    if len(batches) == 1 and batches[0].capacity == out_capacity:
+        return batches[0]
+    schema = batches[0].schema
+    n_list = [b.n_rows for b in batches]
+    total = sum(n_list[1:], n_list[0])
+    cols = []
+    for ci in range(batches[0].num_columns):
+        cols.append(concat_columns([b.columns[ci] for b in batches],
+                                   n_list, out_capacity, total))
+    return ColumnarBatch(tuple(cols), total.astype(jnp.int32), schema)
+
+
+def worst_case_capacity(batches: List[ColumnarBatch]) -> int:
+    return bucket_capacity(sum(b.capacity for b in batches))

@@ -102,7 +102,7 @@ def gather_column(col: DeviceColumn, indices: jnp.ndarray,
     if index_valid is not None:
         validity = validity & index_valid
     if not col.is_string:
-        data = jnp.where(validity, col.data[safe], 0)
+        data = jnp.where(validity, col.data[safe], jnp.zeros((), col.data.dtype))
         return DeviceColumn(data=data, validity=validity, dtype=col.dtype)
     # Strings: gather rows of the char matrix, then rebuild offsets+payload.
     m = char_matrix(col)[safe]  # [out_cap, W]

@@ -1,0 +1,448 @@
+"""Logical plans and the DataFrame API.
+
+The reference is a plugin: Spark's Catalyst supplies the logical plan and the
+plugin only rewrites physical plans. A standalone framework needs its own
+frontend, so this module provides the minimal Catalyst analog: typed logical
+nodes with resolved schemas, plus a DataFrame builder API shaped like
+pyspark's. Analysis (attribute resolution + type coercion) happens eagerly at
+node construction, so every node always knows its output schema.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import pyarrow as pa
+
+from .. import types as T
+from ..ops import aggregates as AGG
+from ..ops.cast import Cast, coerce_binary
+from ..ops.expression import (Alias, AttributeReference, Expression, Literal,
+                              col, lit)
+from ..ops import arithmetic as ARITH
+from ..ops import predicates as PRED
+
+
+# ---------------------------------------------------------------------------
+# Analysis helpers
+# ---------------------------------------------------------------------------
+
+
+def resolve(expr: Expression, schema: T.Schema) -> Expression:
+    """Fill in attribute types from the child schema and insert coercion
+    casts (the analyzer work Spark does before the plugin sees the plan)."""
+
+    def fill(e):
+        if isinstance(e, AttributeReference):
+            f = schema.field_maybe(e._name)
+            if f is None:
+                raise KeyError(
+                    f"column '{e._name}' not found in {schema}")
+            return AttributeReference(e._name, f.data_type, f.nullable)
+        return None
+
+    expr = expr.transform(fill)
+
+    def coerce(e):
+        if isinstance(e, (ARITH.Add, ARITH.Subtract, ARITH.Multiply,
+                          ARITH.Remainder, ARITH.Pmod)):
+            l, r = coerce_binary(e.children[0], e.children[1])
+            if l is not e.children[0] or r is not e.children[1]:
+                return type(e)(l, r)
+        if isinstance(e, ARITH.Divide):
+            l, r = e.children
+            if l.data_type is not T.DOUBLE:
+                l = Cast(l, T.DOUBLE)
+            if r.data_type is not T.DOUBLE:
+                r = Cast(r, T.DOUBLE)
+            if l is not e.children[0] or r is not e.children[1]:
+                return ARITH.Divide(l, r)
+        if isinstance(e, ARITH.IntegralDivide):
+            l, r = e.children
+            if l.data_type is not T.LONG:
+                l = Cast(l, T.LONG)
+            if r.data_type is not T.LONG:
+                r = Cast(r, T.LONG)
+            if l is not e.children[0] or r is not e.children[1]:
+                return ARITH.IntegralDivide(l, r)
+        if isinstance(e, PRED.Comparison) or isinstance(e, PRED.EqualNullSafe):
+            l, r = e.children
+            if l.data_type.is_numeric and r.data_type.is_numeric \
+                    and l.data_type.name != r.data_type.name:
+                l, r = coerce_binary(l, r)
+                return type(e)(l, r)
+        return None
+
+    return expr.transform(coerce)
+
+
+def _as_expr(c) -> Expression:
+    if isinstance(c, Expression):
+        return c
+    if isinstance(c, str):
+        return col(c)
+    return lit(c)
+
+
+@dataclasses.dataclass(frozen=True)
+class SortOrder:
+    child: Expression
+    ascending: bool = True
+    nulls_first: Optional[bool] = None  # default: Spark's (first asc, last desc)
+
+    @property
+    def effective_nulls_first(self) -> bool:
+        return self.ascending if self.nulls_first is None else self.nulls_first
+
+
+# ---------------------------------------------------------------------------
+# Logical nodes
+# ---------------------------------------------------------------------------
+
+
+class LogicalPlan:
+    children: Sequence["LogicalPlan"] = ()
+
+    @property
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        out = "  " * indent + self.describe() + "\n"
+        for c in self.children:
+            out += c.tree_string(indent + 1)
+        return out
+
+    def describe(self) -> str:
+        return self.node_name()
+
+
+class LocalRelation(LogicalPlan):
+    """In-memory data (test tables, createDataFrame)."""
+
+    def __init__(self, batches: List[pa.RecordBatch], schema: T.Schema):
+        self.batches = batches
+        self._schema = schema
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+
+class Range(LogicalPlan):
+    """spark.range() analog (GpuRangeExec, basicPhysicalOperators.scala:182)."""
+
+    def __init__(self, start: int, end: int, step: int = 1):
+        self.start, self.end, self.step = start, end, step
+
+    @property
+    def schema(self) -> T.Schema:
+        return T.Schema([T.StructField("id", T.LONG, False)])
+
+    def describe(self):
+        return f"Range({self.start}, {self.end}, {self.step})"
+
+
+class Scan(LogicalPlan):
+    """File scan (parquet/csv/orc)."""
+
+    def __init__(self, fmt: str, paths: List[str], schema: T.Schema,
+                 options: Optional[dict] = None,
+                 pushed_filters: Optional[List[Expression]] = None,
+                 projected: Optional[List[str]] = None):
+        self.fmt = fmt
+        self.paths = paths
+        self._schema = schema
+        self.options = options or {}
+        self.pushed_filters = pushed_filters or []
+        self.projected = projected
+
+    @property
+    def schema(self) -> T.Schema:
+        if self.projected is None:
+            return self._schema
+        return T.Schema([self._schema[n] for n in self.projected])
+
+    def describe(self):
+        return f"Scan {self.fmt} {self.paths}"
+
+
+class Project(LogicalPlan):
+    def __init__(self, child: LogicalPlan, exprs: List[Expression]):
+        self.children = [child]
+        self.exprs = [resolve(e, child.schema) for e in exprs]
+
+    @property
+    def schema(self) -> T.Schema:
+        return T.Schema([
+            T.StructField(e.name, e.data_type, e.nullable) for e in self.exprs])
+
+    def describe(self):
+        return "Project [" + ", ".join(str(e) for e in self.exprs) + "]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, child: LogicalPlan, condition: Expression):
+        self.children = [child]
+        self.condition = resolve(condition, child.schema)
+        if self.condition.data_type is not T.BOOLEAN:
+            raise TypeError(f"filter condition must be boolean, got "
+                            f"{self.condition.data_type}")
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Filter ({self.condition})"
+
+
+class Aggregate(LogicalPlan):
+    def __init__(self, child: LogicalPlan, groupings: List[Expression],
+                 aggregates: List[AGG.AggregateExpression]):
+        self.children = [child]
+        self.groupings = [resolve(g, child.schema) for g in groupings]
+        self.aggregates = [
+            AGG.AggregateExpression(resolve(a.func, child.schema), a.name)
+            for a in aggregates]
+
+    @property
+    def schema(self) -> T.Schema:
+        fields = [T.StructField(g.name, g.data_type, g.nullable)
+                  for g in self.groupings]
+        fields += [T.StructField(a.name, a.func.data_type, a.func.nullable)
+                   for a in self.aggregates]
+        return T.Schema(fields)
+
+    def describe(self):
+        return ("Aggregate [" + ", ".join(str(g) for g in self.groupings)
+                + "], [" + ", ".join(a.name for a in self.aggregates) + "]")
+
+
+class Join(LogicalPlan):
+    TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 join_type: str, left_keys: List[Expression],
+                 right_keys: List[Expression],
+                 condition: Optional[Expression] = None):
+        if join_type not in self.TYPES:
+            raise ValueError(f"unknown join type {join_type}")
+        self.children = [left, right]
+        self.join_type = join_type
+        self.left_keys = [resolve(k, left.schema) for k in left_keys]
+        self.right_keys = [resolve(k, right.schema) for k in right_keys]
+        # Key type coercion across sides.
+        lk, rk = [], []
+        for l, r in zip(self.left_keys, self.right_keys):
+            if l.data_type.name != r.data_type.name:
+                l, r = coerce_binary(l, r)
+            lk.append(l)
+            rk.append(r)
+        self.left_keys, self.right_keys = lk, rk
+        self.condition = condition  # residual non-equi condition (post-filter)
+
+    @property
+    def schema(self) -> T.Schema:
+        left, right = self.children
+        if self.join_type in ("left_semi", "left_anti"):
+            return left.schema
+        lf = [T.StructField(f.name, f.data_type,
+                            f.nullable or self.join_type in ("right", "full"))
+              for f in left.schema]
+        rf = [T.StructField(f.name, f.data_type,
+                            f.nullable or self.join_type in ("left", "full"))
+              for f in right.schema]
+        return T.Schema(lf + rf)
+
+    def describe(self):
+        keys = ", ".join(f"{l}={r}" for l, r in
+                         zip(self.left_keys, self.right_keys))
+        return f"Join {self.join_type} [{keys}]"
+
+
+class Sort(LogicalPlan):
+    def __init__(self, child: LogicalPlan, orders: List[SortOrder],
+                 global_sort: bool = True):
+        self.children = [child]
+        self.orders = [
+            SortOrder(resolve(o.child, child.schema), o.ascending, o.nulls_first)
+            for o in orders]
+        self.global_sort = global_sort
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def describe(self):
+        return "Sort [" + ", ".join(
+            f"{o.child} {'ASC' if o.ascending else 'DESC'}"
+            for o in self.orders) + "]"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, n: int):
+        self.children = [child]
+        self.n = n
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Limit {self.n}"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: List[LogicalPlan]):
+        self.children = list(children)
+        s0 = self.children[0].schema
+        for c in self.children[1:]:
+            if [f.data_type.name for f in c.schema] != \
+                    [f.data_type.name for f in s0]:
+                raise TypeError("union requires matching column types")
+
+    @property
+    def schema(self) -> T.Schema:
+        first = self.children[0].schema
+        nullable = [any(c.schema[i].nullable for c in self.children)
+                    for i in range(len(first))]
+        return T.Schema([T.StructField(f.name, f.data_type, n)
+                         for f, n in zip(first, nullable)])
+
+
+class Expand(LogicalPlan):
+    """Multiple projections per input row (grouping sets / rollup / cube;
+    GpuExpandExec, GpuExpandExec.scala:66)."""
+
+    def __init__(self, child: LogicalPlan, projections: List[List[Expression]],
+                 names: List[str]):
+        self.children = [child]
+        self.projections = [[resolve(e, child.schema) for e in proj]
+                            for proj in projections]
+        self.names = names
+
+    @property
+    def schema(self) -> T.Schema:
+        first = self.projections[0]
+        fields = []
+        for i, name in enumerate(self.names):
+            dt = first[i].data_type
+            nullable = any(p[i].nullable or p[i].data_type is T.NULL
+                           for p in self.projections)
+            if dt is T.NULL:
+                for p in self.projections:
+                    if p[i].data_type is not T.NULL:
+                        dt = p[i].data_type
+                        break
+            fields.append(T.StructField(name, dt, nullable))
+        return T.Schema(fields)
+
+
+# ---------------------------------------------------------------------------
+# DataFrame API
+# ---------------------------------------------------------------------------
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: List[Expression]):
+        self._df = df
+        self._keys = keys
+
+    def agg(self, *aggs: AGG.AggregateExpression) -> "DataFrame":
+        plan = Aggregate(self._df._plan, self._keys, list(aggs))
+        return DataFrame(plan, self._df._session)
+
+    def count(self) -> "DataFrame":
+        return self.agg(AGG.AggregateExpression(AGG.Count(), "count"))
+
+
+class DataFrame:
+    def __init__(self, plan: LogicalPlan, session):
+        self._plan = plan
+        self._session = session
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self._plan.schema.names
+
+    def select(self, *cols) -> "DataFrame":
+        exprs = []
+        for c in cols:
+            e = _as_expr(c)
+            if not isinstance(e, (Alias, AttributeReference)) \
+                    and not isinstance(e, AGG.AggregateExpression):
+                e = Alias(e, e.name if hasattr(e, "name") else str(e))
+            exprs.append(e)
+        return DataFrame(Project(self._plan, exprs), self._session)
+
+    def where(self, condition: Expression) -> "DataFrame":
+        return DataFrame(Filter(self._plan, condition), self._session)
+
+    filter = where
+
+    def with_column(self, name: str, expr: Expression) -> "DataFrame":
+        exprs = [col(n) for n in self.columns if n != name]
+        exprs.append(Alias(_as_expr(expr), name))
+        return DataFrame(Project(self._plan, exprs), self._session)
+
+    def group_by(self, *keys) -> GroupedData:
+        return GroupedData(self, [_as_expr(k) for k in keys])
+
+    groupBy = group_by
+
+    def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and all(isinstance(k, str) for k in on):
+            lk = [col(k) for k in on]
+            rk = [col(k) for k in on]
+        else:
+            raise NotImplementedError("join on expression conditions: use keys")
+        plan = Join(self._plan, other._plan, how, lk, rk)
+        return DataFrame(plan, self._session)
+
+    def sort(self, *orders) -> "DataFrame":
+        so = []
+        for o in orders:
+            if isinstance(o, SortOrder):
+                so.append(o)
+            else:
+                so.append(SortOrder(_as_expr(o)))
+        return DataFrame(Sort(self._plan, so, global_sort=True), self._session)
+
+    orderBy = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(Limit(self._plan, n), self._session)
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(Union([self._plan, other._plan]), self._session)
+
+    def distinct(self) -> "DataFrame":
+        return DataFrame(
+            Aggregate(self._plan, [col(n) for n in self.columns], []),
+            self._session)
+
+    # -- actions ------------------------------------------------------------
+    def collect(self) -> pa.Table:
+        return self._session.execute(self._plan)
+
+    def to_pandas(self):
+        return self.collect().to_pandas()
+
+    def count_rows(self) -> int:
+        return self.collect().num_rows
+
+    def explain(self, extended: bool = False) -> str:
+        text = self._session.explain(self._plan)
+        print(text)
+        return text

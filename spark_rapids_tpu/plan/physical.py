@@ -1,0 +1,447 @@
+"""Physical plan: base classes and the CPU (oracle / fallback) operators.
+
+The reference rewrites Spark physical plans; CPU execution of any node is
+"whatever Spark does". Standalone, we supply both sides: every logical node
+plans to a Cpu*Exec here (pyarrow-based, row-correct, deliberately independent
+of the device kernels), and :mod:`.overrides` replaces eligible nodes with
+Tpu*Execs. Differential testing = run the same plan with overrides off/on.
+
+Execution model: ``execute(ctx)`` returns a list of partitions, each a
+generator of batches — ``HostBatch`` for CPU nodes, device ``ColumnarBatch``
+for TPU nodes (``columnar`` flags which, mirroring Spark's
+``supportsColumnar``)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as T
+from ..config import TpuConf
+from ..data.batch import HostBatch, concat_host
+from ..ops import aggregates as AGG
+from ..ops.expression import Expression, host_to_array
+from .logical import SortOrder
+
+
+@dataclasses.dataclass
+class ExecContext:
+    conf: TpuConf
+    metrics: dict = dataclasses.field(default_factory=dict)
+
+    def metric(self, node: str, name: str, value):
+        self.metrics.setdefault(node, {})
+        self.metrics[node][name] = self.metrics[node].get(name, 0) + value
+
+
+class PhysicalPlan:
+    """Base physical operator."""
+
+    children: List["PhysicalPlan"] = ()
+    #: True when execute() yields device ColumnarBatch (Spark supportsColumnar)
+    columnar = False
+
+    @property
+    def schema(self) -> T.Schema:
+        raise NotImplementedError
+
+    def execute(self, ctx: ExecContext) -> List[Iterator]:
+        raise NotImplementedError
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent: int = 0) -> str:
+        out = "  " * indent + self.describe() + "\n"
+        for c in self.children:
+            out += c.tree_string(indent + 1)
+        return out
+
+    def describe(self) -> str:
+        return self.node_name()
+
+    def with_children(self, children: List["PhysicalPlan"]) -> "PhysicalPlan":
+        clone = dataclasses.replace(self) if dataclasses.is_dataclass(self) \
+            else self._clone()
+        clone.children = list(children)
+        return clone
+
+    def _clone(self):
+        import copy
+        return copy.copy(self)
+
+    def transform_up(self, fn) -> "PhysicalPlan":
+        new_children = [c.transform_up(fn) for c in self.children]
+        node = self
+        if list(new_children) != list(self.children):
+            node = self.with_children(new_children)
+        replaced = fn(node)
+        return replaced if replaced is not None else node
+
+
+def _arrow_schema(schema: T.Schema):
+    return T.schema_to_arrow(schema)
+
+
+def _empty_batch(schema: T.Schema) -> HostBatch:
+    arrow = _arrow_schema(schema)
+    return HostBatch(pa.RecordBatch.from_pydict(
+        {f.name: pa.array([], type=f.type) for f in arrow}, schema=arrow))
+
+
+def collect_partitions(plan: PhysicalPlan, ctx: ExecContext) -> pa.Table:
+    """Run a host-side plan and assemble a pyarrow Table."""
+    assert not plan.columnar, "root must be host-side (insert DeviceToHost)"
+    batches = []
+    for part in plan.execute(ctx):
+        for hb in part:
+            if hb.num_rows:
+                batches.append(hb.rb)
+    arrow = _arrow_schema(plan.schema)
+    if not batches:
+        return pa.Table.from_batches([], schema=arrow)
+    return pa.Table.from_batches(batches).cast(arrow)
+
+
+# ---------------------------------------------------------------------------
+# CPU operators
+# ---------------------------------------------------------------------------
+
+
+class CpuLocalScanExec(PhysicalPlan):
+    def __init__(self, batches: List[pa.RecordBatch], schema: T.Schema,
+                 n_partitions: int = 1):
+        self.batches = batches
+        self._schema = schema
+        self.n_partitions = max(1, min(n_partitions, max(len(batches), 1)))
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self, ctx):
+        parts = [[] for _ in range(self.n_partitions)]
+        for i, rb in enumerate(self.batches):
+            parts[i % self.n_partitions].append(rb)
+        return [iter([HostBatch(rb) for rb in p]) for p in parts]
+
+
+class CpuRangeExec(PhysicalPlan):
+    def __init__(self, start: int, end: int, step: int, batch_rows: int = 1 << 20):
+        self.start, self.end, self.step = start, end, step
+        self.batch_rows = batch_rows
+
+    @property
+    def schema(self):
+        return T.Schema([T.StructField("id", T.LONG, False)])
+
+    def execute(self, ctx):
+        def gen():
+            vals = np.arange(self.start, self.end, self.step, dtype=np.int64)
+            for i in range(0, len(vals), self.batch_rows):
+                chunk = vals[i: i + self.batch_rows]
+                yield HostBatch(pa.RecordBatch.from_arrays(
+                    [pa.array(chunk)], names=["id"]))
+        return [gen()]
+
+
+class CpuProjectExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, exprs: List[Expression]):
+        self.children = [child]
+        self.exprs = exprs
+
+    @property
+    def schema(self):
+        return T.Schema([T.StructField(e.name, e.data_type, e.nullable)
+                         for e in self.exprs])
+
+    def describe(self):
+        return "CpuProject [" + ", ".join(e.name for e in self.exprs) + "]"
+
+    def execute(self, ctx):
+        arrow = _arrow_schema(self.schema)
+
+        def run(part):
+            for hb in part:
+                arrays = [
+                    host_to_array(e.eval_host(hb), hb.num_rows).cast(f.type)
+                    for e, f in zip(self.exprs, arrow)]
+                yield HostBatch(pa.RecordBatch.from_arrays(arrays, schema=arrow))
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
+class CpuFilterExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, condition: Expression):
+        self.children = [child]
+        self.condition = condition
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"CpuFilter ({self.condition})"
+
+    def execute(self, ctx):
+        def run(part):
+            for hb in part:
+                mask = host_to_array(self.condition.eval_host(hb), hb.num_rows)
+                mask = pc.fill_null(mask, False)
+                yield HostBatch(hb.rb.filter(mask))
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
+class CpuHashAggregateExec(PhysicalPlan):
+    """Complete-mode aggregation via pyarrow group_by (the oracle)."""
+
+    def __init__(self, child: PhysicalPlan, groupings: List[Expression],
+                 aggregates: List[AGG.AggregateExpression]):
+        self.children = [child]
+        self.groupings = groupings
+        self.aggregates = aggregates
+
+    @property
+    def schema(self):
+        fields = [T.StructField(g.name, g.data_type, g.nullable)
+                  for g in self.groupings]
+        fields += [T.StructField(a.name, a.func.data_type, a.func.nullable)
+                   for a in self.aggregates]
+        return T.Schema(fields)
+
+    def describe(self):
+        return ("CpuHashAggregate [" + ", ".join(g.name for g in self.groupings)
+                + "] [" + ", ".join(a.name for a in self.aggregates) + "]")
+
+    def execute(self, ctx):
+        # Materialize all input (oracle path; perf is not the point here).
+        rows = []
+        child = self.children[0]
+        for part in child.execute(ctx):
+            for hb in part:
+                cols, names = [], []
+                for i, g in enumerate(self.groupings):
+                    cols.append(host_to_array(g.eval_host(hb), hb.num_rows))
+                    names.append(f"_g{i}")
+                for i, a in enumerate(self.aggregates):
+                    fn = a.func
+                    if fn.child is None:
+                        cols.append(pa.array([1] * hb.num_rows, pa.int64()))
+                    else:
+                        cols.append(host_to_array(fn.child.eval_host(hb),
+                                                  hb.num_rows))
+                    names.append(f"_a{i}")
+                if hb.num_rows:
+                    rows.append(pa.RecordBatch.from_arrays(cols, names=names))
+
+        out_arrow = _arrow_schema(self.schema)
+        if not rows:
+            if self.groupings:
+                return [iter([_empty_batch(self.schema)])]
+            # Global aggregation over empty input still yields one row.
+            vals = []
+            for a in self.aggregates:
+                if isinstance(a.func, AGG.Count):
+                    vals.append(pa.array([0], pa.int64()))
+                else:
+                    vals.append(pa.nulls(1, T.to_arrow_type(a.func.data_type)))
+            rb = pa.RecordBatch.from_arrays(vals, schema=out_arrow)
+            return [iter([HostBatch(rb)])]
+
+        table = pa.Table.from_batches(rows)
+        keys = [f"_g{i}" for i in range(len(self.groupings))]
+        aggs = []
+        for i, a in enumerate(self.aggregates):
+            pa_agg = a.func.pa_agg
+            if isinstance(a.func, AGG.Count) and a.func.child is None:
+                pa_agg = "sum"  # count(*) over the synthesized ones column
+            aggs.append((f"_a{i}", pa_agg))
+        if not aggs:
+            aggs = [(keys[0], "count")] if keys else []
+        grouped = table.group_by(keys, use_threads=False).aggregate(aggs)
+        arrays = []
+        for i, g in enumerate(self.groupings):
+            arrays.append(grouped.column(f"_g{i}").combine_chunks()
+                          .cast(T.to_arrow_type(g.data_type)))
+        for i, a in enumerate(self.aggregates):
+            pa_agg = aggs[i][1] if i < len(aggs) else a.func.pa_agg
+            cname = f"_a{i}_{pa_agg}"
+            arr = grouped.column(cname).combine_chunks()
+            if isinstance(a.func, AGG.Count) and a.func.child is None:
+                arr = pc.fill_null(arr, 0)
+            arrays.append(arr.cast(T.to_arrow_type(a.func.data_type)))
+        rb_out = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
+        return [iter([HostBatch(rb_out)])]
+
+
+class CpuJoinExec(PhysicalPlan):
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 join_type: str, left_keys: List[Expression],
+                 right_keys: List[Expression], schema: T.Schema):
+        self.children = [left, right]
+        self.join_type = join_type
+        self.left_keys = left_keys
+        self.right_keys = right_keys
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"CpuJoin {self.join_type}"
+
+    def _materialize(self, plan, ctx, keys, prefix) -> pa.Table:
+        """Collect a side as a Table with collision-proof prefixed names and
+        evaluated key columns appended."""
+        arrow = pa.schema(
+            [pa.field(f"{prefix}c{i}", T.to_arrow_type(f.data_type))
+             for i, f in enumerate(plan.schema)] +
+            [pa.field(f"{prefix}k{i}", T.to_arrow_type(k.data_type))
+             for i, k in enumerate(keys)])
+        batches = []
+        for part in plan.execute(ctx):
+            for hb in part:
+                cols = list(hb.rb.columns) + [
+                    host_to_array(k.eval_host(hb), hb.num_rows) for k in keys]
+                batches.append(pa.RecordBatch.from_arrays(
+                    [c.cast(f.type) for c, f in zip(cols, arrow)],
+                    schema=arrow))
+        return pa.Table.from_batches(batches, schema=arrow)
+
+    def execute(self, ctx):
+        left, right = self.children
+        lt = self._materialize(left, ctx, self.left_keys, "__l")
+        rt = self._materialize(right, ctx, self.right_keys, "__r")
+        out_arrow = _arrow_schema(self.schema)
+        lk = [f"__lk{i}" for i in range(len(self.left_keys))]
+        rk = [f"__rk{i}" for i in range(len(self.right_keys))]
+        pa_type = {"inner": "inner", "left": "left outer",
+                   "right": "right outer", "full": "full outer",
+                   "left_semi": "left semi", "left_anti": "left anti"}[
+            self.join_type]
+        joined = lt.join(rt, keys=lk, right_keys=rk, join_type=pa_type,
+                         coalesce_keys=False, use_threads=False)
+        raw_names = [f"__lc{i}" for i in range(len(left.schema))]
+        if self.join_type not in ("left_semi", "left_anti"):
+            raw_names += [f"__rc{i}" for i in range(len(right.schema))]
+        arrays = [joined.column(rn).combine_chunks().cast(f.type)
+                  for rn, f in zip(raw_names, out_arrow)]
+        rb = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
+        return [iter([HostBatch(rb)])]
+
+
+class CpuSortExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, orders: List[SortOrder]):
+        self.children = [child]
+        self.orders = orders
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        child = self.children[0]
+        batches = []
+        for part in child.execute(ctx):
+            for hb in part:
+                cols = [host_to_array(o.child.eval_host(hb), hb.num_rows)
+                        for o in self.orders]
+                names = list(hb.rb.schema.names) + \
+                    [f"_s{i}" for i in range(len(cols))]
+                batches.append(pa.RecordBatch.from_arrays(
+                    list(hb.rb.columns) + cols, names=names))
+        if not batches:
+            return [iter([_empty_batch(self.schema)])]
+        table = pa.Table.from_batches(batches)
+        # pyarrow sort_by has one global null_placement; emulate per-key
+        # placement via successive stable sorts (last key first).
+        indices = None
+        n = table.num_rows
+        current = table
+        for i in reversed(range(len(self.orders))):
+            o = self.orders[i]
+            order = "ascending" if o.ascending else "descending"
+            placement = "at_start" if o.effective_nulls_first else "at_end"
+            idx = pc.sort_indices(
+                current, sort_keys=[(f"_s{i}", order)],
+                null_placement=placement)
+            current = current.take(idx)
+        out_arrow = _arrow_schema(self.schema)
+        arrays = [current.column(f.name).combine_chunks().cast(f.type)
+                  for f in out_arrow]
+        rb = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
+        return [iter([HostBatch(rb)])]
+
+
+class CpuLimitExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, n: int):
+        self.children = [child]
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        def gen():
+            remaining = self.n
+            for part in self.children[0].execute(ctx):
+                for hb in part:
+                    if remaining <= 0:
+                        return
+                    take = min(remaining, hb.num_rows)
+                    remaining -= take
+                    yield HostBatch(hb.rb.slice(0, take))
+        return [gen()]
+
+
+class CpuUnionExec(PhysicalPlan):
+    def __init__(self, children: List[PhysicalPlan], schema: T.Schema):
+        self.children = list(children)
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self, ctx):
+        arrow = _arrow_schema(self.schema)
+        parts = []
+        for c in self.children:
+            def run(p, arrow=arrow):
+                for hb in p:
+                    arrays = [c.cast(f.type)
+                              for c, f in zip(hb.rb.columns, arrow)]
+                    yield HostBatch(pa.RecordBatch.from_arrays(
+                        arrays, schema=arrow))
+            parts.extend(run(p) for p in c.execute(ctx))
+        return parts
+
+
+class CpuExpandExec(PhysicalPlan):
+    def __init__(self, child: PhysicalPlan, projections, schema: T.Schema):
+        self.children = [child]
+        self.projections = projections
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def execute(self, ctx):
+        arrow = _arrow_schema(self.schema)
+
+        def run(part):
+            for hb in part:
+                for proj in self.projections:
+                    arrays = []
+                    for e, f in zip(proj, arrow):
+                        arr = host_to_array(e.eval_host(hb), hb.num_rows)
+                        arrays.append(arr.cast(f.type))
+                    yield HostBatch(pa.RecordBatch.from_arrays(
+                        arrays, schema=arrow))
+        return [run(p) for p in self.children[0].execute(ctx)]

@@ -1,0 +1,46 @@
+"""Logical -> CPU physical planning.
+
+Standalone analog of Spark's query planner: every logical node plans to its
+Cpu*Exec. The TPU rewrite then happens as a separate pass over the physical
+plan (:mod:`.overrides`), mirroring how the reference intercepts Spark's
+already-planned physical plan rather than planning itself.
+"""
+
+from __future__ import annotations
+
+from . import logical as L
+from . import physical as P
+
+
+def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
+    if isinstance(plan, L.LocalRelation):
+        return P.CpuLocalScanExec(plan.batches, plan.schema)
+    if isinstance(plan, L.Range):
+        return P.CpuRangeExec(plan.start, plan.end, plan.step)
+    if isinstance(plan, L.Scan):
+        from ..io.files import CpuFileScanExec
+        return CpuFileScanExec(plan.fmt, plan.paths, plan.schema,
+                               plan.options, plan.pushed_filters)
+    if isinstance(plan, L.Project):
+        return P.CpuProjectExec(plan_physical(plan.children[0]), plan.exprs)
+    if isinstance(plan, L.Filter):
+        return P.CpuFilterExec(plan_physical(plan.children[0]), plan.condition)
+    if isinstance(plan, L.Aggregate):
+        return P.CpuHashAggregateExec(plan_physical(plan.children[0]),
+                                      plan.groupings, plan.aggregates)
+    if isinstance(plan, L.Join):
+        return P.CpuJoinExec(plan_physical(plan.children[0]),
+                             plan_physical(plan.children[1]),
+                             plan.join_type, plan.left_keys, plan.right_keys,
+                             plan.schema)
+    if isinstance(plan, L.Sort):
+        return P.CpuSortExec(plan_physical(plan.children[0]), plan.orders)
+    if isinstance(plan, L.Limit):
+        return P.CpuLimitExec(plan_physical(plan.children[0]), plan.n)
+    if isinstance(plan, L.Union):
+        return P.CpuUnionExec([plan_physical(c) for c in plan.children],
+                              plan.schema)
+    if isinstance(plan, L.Expand):
+        return P.CpuExpandExec(plan_physical(plan.children[0]),
+                               plan.projections, plan.schema)
+    raise NotImplementedError(f"no physical plan for {type(plan).__name__}")

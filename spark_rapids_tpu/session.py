@@ -1,0 +1,109 @@
+"""TpuSession — the user entry point (SparkSession + Plugin bootstrap analog).
+
+The reference's lifecycle: driver plugin fixes configs and installs the SQL
+extension; executor plugin initializes the device, memory pool, and semaphore
+(Plugin.scala:104-143, GpuDeviceManager.scala:120). Standalone, the session
+owns all of that: it holds the :class:`TpuConf`, initializes the device
+runtime once, builds DataFrames, and runs plans through the planner +
+TpuOverrides rewrite.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import pyarrow as pa
+
+from . import types as T
+from .config import TpuConf
+from .data.batch import HostBatch
+from .memory.device_manager import DeviceManager
+from .plan import logical as L
+from .plan import physical as P
+from .plan.overrides import TpuOverrides
+from .plan.planner import plan_physical
+
+
+class DataFrameReader:
+    def __init__(self, session: "TpuSession"):
+        self._session = session
+        self._options: Dict[str, str] = {}
+
+    def option(self, key: str, value) -> "DataFrameReader":
+        self._options[key] = value
+        return self
+
+    def _scan(self, fmt: str, paths) -> "L.DataFrame":
+        from .io.files import infer_schema
+        if isinstance(paths, str):
+            paths = [paths]
+        schema = infer_schema(fmt, paths, self._options)
+        plan = L.Scan(fmt, paths, schema, self._options)
+        return L.DataFrame(plan, self._session)
+
+    def parquet(self, *paths):
+        return self._scan("parquet", list(paths))
+
+    def orc(self, *paths):
+        return self._scan("orc", list(paths))
+
+    def csv(self, *paths):
+        return self._scan("csv", list(paths))
+
+
+class TpuSession:
+    def __init__(self, conf: Optional[dict] = None):
+        self.conf = TpuConf(conf)
+        self.device_manager = DeviceManager.get_or_create(self.conf)
+        self._overrides = TpuOverrides(self.conf)
+
+    # -- conf ---------------------------------------------------------------
+    def with_conf(self, **kv) -> "TpuSession":
+        s = TpuSession.__new__(TpuSession)
+        s.conf = self.conf.with_overrides(**kv)
+        s.device_manager = self.device_manager
+        s._overrides = TpuOverrides(s.conf)
+        return s
+
+    # -- data sources -------------------------------------------------------
+    @property
+    def read(self) -> DataFrameReader:
+        return DataFrameReader(self)
+
+    def create_dataframe(self, data, schema: Optional[T.Schema] = None
+                         ) -> L.DataFrame:
+        if isinstance(data, pa.Table):
+            rbs = data.combine_chunks().to_batches()
+            s = T.schema_from_arrow(data.schema)
+        elif isinstance(data, pa.RecordBatch):
+            rbs = [data]
+            s = T.schema_from_arrow(data.schema)
+        elif isinstance(data, dict):
+            hb = HostBatch.from_pydict(data, schema)
+            rbs = [hb.rb]
+            s = hb.schema
+        else:  # pandas
+            table = pa.Table.from_pandas(data)
+            rbs = table.combine_chunks().to_batches()
+            s = T.schema_from_arrow(table.schema)
+        return L.DataFrame(L.LocalRelation(rbs, schema or s), self)
+
+    def range(self, start: int, end: Optional[int] = None, step: int = 1
+              ) -> L.DataFrame:
+        if end is None:
+            start, end = 0, start
+        return L.DataFrame(L.Range(start, end, step), self)
+
+    # -- execution ----------------------------------------------------------
+    def plan(self, logical: L.LogicalPlan) -> P.PhysicalPlan:
+        cpu_plan = plan_physical(logical)
+        return self._overrides.apply(cpu_plan)
+
+    def execute(self, logical: L.LogicalPlan) -> pa.Table:
+        physical = self.plan(logical)
+        ctx = P.ExecContext(self.conf)
+        return P.collect_partitions(physical, ctx)
+
+    def explain(self, logical: L.LogicalPlan) -> str:
+        physical = self.plan(logical)
+        return physical.tree_string()

@@ -1,0 +1,37 @@
+"""Profiler trace annotations — the NVTX-range analog.
+
+The reference wraps every operator phase in NvtxRange so Nsight shows named
+spans (~40 files; NvtxWithMetrics.scala couples a range with a Spark SQL
+metric — SURVEY.md §5). On TPU the equivalent is jax.profiler's TraceAnnotation
+(XLA TraceMe): spans show up in the TensorBoard/XProf trace viewer.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+def trace_range(name: str):
+    """Named profiler span; also usable when no profiler session is active."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class NanoTimer:
+    """Couples a trace range with an accumulated nanosecond metric
+    (NvtxWithMetrics analog)."""
+
+    def __init__(self, name: str, metrics: dict, key: str):
+        self.name = name
+        self.metrics = metrics
+        self.key = key
+
+    @contextlib.contextmanager
+    def __call__(self):
+        start = time.perf_counter_ns()
+        with trace_range(self.name):
+            yield
+        self.metrics[self.key] = self.metrics.get(self.key, 0) + (
+            time.perf_counter_ns() - start)

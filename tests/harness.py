@@ -1,0 +1,102 @@
+"""Differential query harness — the SparkQueryCompareTestSuite /
+assert_gpu_and_cpu_are_equal_collect analog (reference
+SparkQueryCompareTestSuite.scala:54, asserts.py:28).
+
+Every test builds a DataFrame via a lambda and runs it twice: once with
+``spark.rapids.sql.enabled=false`` (pure CPU oracle) and once with ``=true``
+plus ``spark.rapids.sql.test.enabled=true`` so any unexpected CPU fallback is
+a hard failure. Results compare as row multisets (optionally ordered), with
+NaN/null awareness and optional float tolerance.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from spark_rapids_tpu.session import TpuSession
+
+_CPU = None
+_TPU_BASE = None
+
+
+def cpu_session() -> TpuSession:
+    global _CPU
+    if _CPU is None:
+        _CPU = TpuSession({"spark.rapids.sql.enabled": False})
+    return _CPU
+
+
+def tpu_session(**conf) -> TpuSession:
+    global _TPU_BASE
+    if _TPU_BASE is None:
+        _TPU_BASE = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.test.enabled": True,
+        })
+    if conf:
+        return _TPU_BASE.with_conf(**conf)
+    return _TPU_BASE
+
+
+def _canonical_rows(table: pa.Table):
+    rows = []
+    for row in zip(*[table.column(i).to_pylist()
+                     for i in range(table.num_columns)]):
+        rows.append(tuple(_canon(v) for v in row))
+    return rows
+
+
+def _canon(v):
+    if isinstance(v, float):
+        if math.isnan(v):
+            return ("NaN",)
+        if v == 0.0:
+            return 0.0  # -0.0 == 0.0
+        return v
+    return v
+
+
+def _rows_equal(a, b, approx: Optional[float]) -> bool:
+    if len(a) != len(b):
+        return False
+    for x, y in zip(a, b):
+        if x == y:
+            continue
+        if approx is not None and isinstance(x, float) and isinstance(y, float):
+            if math.isclose(x, y, rel_tol=approx, abs_tol=1e-12):
+                continue
+        return False
+    return True
+
+
+def assert_tpu_and_cpu_are_equal(
+        df_fn: Callable[[TpuSession], "object"],
+        ignore_order: bool = True,
+        approx: Optional[float] = None,
+        conf: Optional[dict] = None,
+        allowed_non_tpu: Optional[list] = None):
+    """Run df_fn under both sessions and compare collected results."""
+    extra = dict(conf or {})
+    if allowed_non_tpu:
+        extra["spark.rapids.sql.test.allowedNonTpu"] = ",".join(allowed_non_tpu)
+    cpu_result = df_fn(cpu_session()).collect()
+    tpu_result = df_fn(tpu_session(**extra)).collect()
+    assert cpu_result.schema.equals(tpu_result.schema), \
+        f"schema mismatch:\nCPU: {cpu_result.schema}\nTPU: {tpu_result.schema}"
+    cpu_rows = _canonical_rows(cpu_result)
+    tpu_rows = _canonical_rows(tpu_result)
+    if ignore_order:
+        key = lambda r: tuple((x is None, ("NaN",) == x if isinstance(x, tuple)
+                               else False, str(x)) for x in r)
+        cpu_rows = sorted(cpu_rows, key=key)
+        tpu_rows = sorted(tpu_rows, key=key)
+    assert len(cpu_rows) == len(tpu_rows), \
+        f"row count: CPU {len(cpu_rows)} vs TPU {len(tpu_rows)}"
+    for i, (c, t) in enumerate(zip(cpu_rows, tpu_rows)):
+        if not _rows_equal(c, t, approx):
+            raise AssertionError(
+                f"row {i} differs:\nCPU: {c}\nTPU: {t}")
