@@ -1,0 +1,92 @@
+"""Benchmark: TPC-DS-q5-shaped query (scan -> join -> group-by aggregate) on
+the device vs the CPU oracle — BASELINE.md config 1.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+value = device wall time for the query (post-compile, median of 3);
+vs_baseline = CPU-oracle time / device time (speedup; >1 means the TPU path
+beats the pyarrow CPU path on the same machine). The reference publishes no
+machine-readable numbers (BASELINE.md), so the CPU oracle is the baseline we
+measure against, exactly like the reference's CPU-Spark-vs-GPU methodology.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def build_tables(session, n_fact: int, n_dim: int):
+    rng = np.random.default_rng(42)
+    fact = {
+        "k": rng.integers(0, n_dim, n_fact).astype(np.int64).tolist(),
+        "q": rng.integers(1, 100, n_fact).astype(np.int64).tolist(),
+        "p": rng.integers(1, 1000, n_fact).astype(np.int64).tolist(),
+    }
+    dim = {
+        "k": list(range(n_dim)),
+        "cat": rng.integers(0, 20, n_dim).astype(np.int64).tolist(),
+    }
+    return session.create_dataframe(fact), session.create_dataframe(dim)
+
+
+def q5_like(session, n_fact: int, n_dim: int):
+    from spark_rapids_tpu.ops import aggregates as AGG
+    from spark_rapids_tpu.ops import predicates as P
+    from spark_rapids_tpu.ops.arithmetic import Multiply
+    from spark_rapids_tpu.ops.expression import col, lit
+
+    fact, dim = build_tables(session, n_fact, n_dim)
+    return (fact
+            .where(P.LessThan(col("q"), lit(95)))
+            .with_column("rev", Multiply(col("q"), col("p")))
+            .join(dim, on="k", how="inner")
+            .group_by(col("cat"))
+            .agg(AGG.AggregateExpression(AGG.Sum(col("rev")), "total_rev"),
+                 AGG.AggregateExpression(AGG.Count(), "cnt"),
+                 AGG.AggregateExpression(AGG.Min(col("p")), "min_p"),
+                 AGG.AggregateExpression(AGG.Max(col("q")), "max_q")))
+
+
+def timed(fn, reps=3):
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def main():
+    import spark_rapids_tpu  # noqa: F401
+    from spark_rapids_tpu.session import TpuSession
+
+    n_fact = 1 << 20
+    n_dim = 1000
+
+    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+    tpu = TpuSession({"spark.rapids.sql.enabled": True})
+
+    cpu_result = q5_like(cpu, n_fact, n_dim).collect()
+    tpu_result = q5_like(tpu, n_fact, n_dim).collect()  # warmup + compile
+    # Correctness gate: bench numbers are meaningless if results differ.
+    c = {tuple(r): None for r in zip(
+        *[cpu_result.column(i).to_pylist() for i in range(4)])}
+    t = {tuple(r): None for r in zip(
+        *[tpu_result.column(i).to_pylist() for i in range(4)])}
+    assert c.keys() == t.keys(), "TPU result != CPU oracle result"
+
+    cpu_time = timed(lambda: q5_like(cpu, n_fact, n_dim).collect())
+    tpu_time = timed(lambda: q5_like(tpu, n_fact, n_dim).collect())
+
+    print(json.dumps({
+        "metric": "q5like_1Mrows_device_time",
+        "value": round(tpu_time * 1000, 2),
+        "unit": "ms",
+        "vs_baseline": round(cpu_time / tpu_time, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,45 @@
+"""Device mesh management — the multi-chip execution substrate.
+
+The reference's parallelism model is Spark data parallelism: one process per
+executor, one GPU each, exchange via shuffle (SURVEY.md §2.6 "Parallelism
+strategy inventory"). The TPU-native model replaces one-process-per-device
+with a single SPMD program over a ``jax.sharding.Mesh``: partitions live as
+shards of device arrays, and the exchange runs as XLA collectives over ICI
+(:mod:`..shuffle.ici`) instead of a point-to-point UCX transport.
+
+The canonical mesh axis is ``"part"`` — the partition-parallel axis that
+carries both the data-parallel scan/filter/project work and the all_to_all
+shuffle. This is the honest analog of the reference's executor grid; a SQL
+engine has no tensor/pipeline axes (the reference has none either).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+PART_AXIS = "part"
+
+
+def make_mesh(n_devices: Optional[int] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    if devices is None:
+        devices = jax.devices()
+        if n_devices is not None:
+            if len(devices) < n_devices:
+                raise ValueError(
+                    f"need {n_devices} devices, have {len(devices)}")
+            devices = devices[:n_devices]
+    return Mesh(np.asarray(devices), (PART_AXIS,))
+
+
+def partitioned(mesh: Mesh) -> NamedSharding:
+    """Sharding that splits the leading (row/partition) dim across the mesh."""
+    return NamedSharding(mesh, PartitionSpec(PART_AXIS))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,94 @@
+"""ICI collective shuffle — the RapidsShuffleManager/UCX replacement.
+
+The reference's GPU-resident shuffle is a point-to-point tag-matched UCX
+transport with bounce buffers and a metadata plane (SURVEY.md §2.6). On TPU
+the exchange IS a collective: every chip partitions its rows by key hash,
+lays them out as ``[n_parts, bucket_cap]`` send buffers, and one XLA
+``all_to_all`` over the ICI mesh delivers every bucket to its owner chip in a
+single fused step — no server, no metadata handshake, no bounce buffers.
+
+Key design points:
+* Bucket layout is built with the same sort/scatter kernels as the rest of
+  the engine (static shapes, traced live counts).
+* ``bucket_capacity`` bounds rows per (sender, receiver) pair; skew beyond it
+  is detected via a returned overflow count so callers can re-execute with a
+  bigger bucket, same contract as the join kernel.
+* Works identically under ``shard_map`` on a real ICI mesh or the CPU
+  ``xla_force_host_platform_device_count`` test mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..parallel.mesh import PART_AXIS
+
+
+def build_send_buffers(values, validity, part_id: jnp.ndarray,
+                       live: jnp.ndarray, n_parts: int, bucket_cap: int):
+    """Scatter rows into a [n_parts, bucket_cap] send layout.
+
+    values: pytree of [cap] arrays; part_id int32[cap]; live bool[cap].
+    Returns (send_values pytree of [n_parts, bucket_cap], send_valid
+    [n_parts, bucket_cap], overflow_count scalar).
+    """
+    cap = part_id.shape[0]
+    pid = jnp.where(live, part_id, n_parts)  # dead rows -> dropped
+    # Rank of each row within its bucket: stable sort by bucket, positions.
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    sorted_pid, perm = jax.lax.sort((pid, iota), num_keys=1, is_stable=True)
+    # Start offset of each row's bucket in sorted order.
+    boundary = jnp.concatenate([
+        jnp.ones(1, jnp.bool_), sorted_pid[1:] != sorted_pid[:-1]])
+    start_of_bucket = jnp.where(boundary, iota, 0)
+    starts = jax.lax.associative_scan(jnp.maximum, start_of_bucket)
+    rank_sorted = iota - starts
+    rank = jnp.zeros(cap, dtype=jnp.int32).at[perm].set(rank_sorted)
+
+    overflow = jnp.sum(((rank >= bucket_cap) & live).astype(jnp.int32))
+    target = jnp.where(live & (rank < bucket_cap),
+                       pid * bucket_cap + rank,
+                       n_parts * bucket_cap)
+
+    def scatter(v):
+        flat = jnp.zeros((n_parts * bucket_cap,), dtype=v.dtype)
+        flat = flat.at[target].set(v, mode="drop")
+        return flat.reshape(n_parts, bucket_cap)
+
+    send_values = jax.tree_util.tree_map(scatter, values)
+    send_valid = scatter(validity & live)
+    return send_values, send_valid, overflow
+
+
+def exchange(send_values, send_valid, axis_name: str = PART_AXIS):
+    """all_to_all along the mesh axis: row i of my send buffer goes to chip i.
+    Must run inside shard_map/pmap with ``axis_name`` bound."""
+    def a2a(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0,
+                                  tiled=False)
+    recv_values = jax.tree_util.tree_map(a2a, send_values)
+    recv_valid = a2a(send_valid)
+    return recv_values, recv_valid
+
+
+def flatten_received(recv_values, recv_valid):
+    """[n_parts, bucket_cap] received buffers -> compacted [n_parts*bucket_cap]
+    rows with a live count (rows stay grouped by sender, order deterministic)."""
+    def flat(x):
+        return x.reshape(-1)
+    values = jax.tree_util.tree_map(flat, recv_values)
+    valid = flat(recv_valid)
+    cap = valid.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    drop = (~valid).astype(jnp.int8)
+    _, perm = jax.lax.sort((drop, iota), num_keys=1, is_stable=True)
+    n_live = jnp.sum(valid.astype(jnp.int32))
+
+    def gather(x):
+        return x[perm]
+    return jax.tree_util.tree_map(gather, values), valid[perm], n_live
