@@ -1,0 +1,112 @@
+"""Bitwise expression family — the ``bitwise.scala`` analog (145 LoC,
+SURVEY.md §2.4): And/Or/Xor/Not/ShiftLeft/ShiftRight/ShiftRightUnsigned.
+
+Java shift semantics: the shift amount is masked to the operand width
+(n & 31 for int, n & 63 for long)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from .arithmetic import _np_of, _to_pa
+from .expression import BinaryExpression, UnaryExpression
+
+
+class _BitBinary(BinaryExpression):
+    @property
+    def data_type(self) -> T.DataType:
+        return T.numeric_promote(self.left.data_type, self.right.data_type)
+
+    def do_host(self, l, r):
+        lv, lval = _np_of(l)
+        rv, rval = _np_of(r)
+        validity = lval if rval is None else (
+            rval if lval is None else lval & rval)
+        np_dt = self.data_type.np_dtype
+        out = self.np_op(lv.astype(np_dt), rv.astype(np_dt))
+        return _to_pa(out, validity, self.data_type)
+
+    def do_device(self, l, r):
+        np_dt = self.data_type.np_dtype
+        return self.np_op(l.astype(np_dt), r.astype(np_dt)), None
+
+
+class BitwiseAnd(_BitBinary):
+    @staticmethod
+    def np_op(l, r):
+        return l & r
+
+
+class BitwiseOr(_BitBinary):
+    @staticmethod
+    def np_op(l, r):
+        return l | r
+
+
+class BitwiseXor(_BitBinary):
+    @staticmethod
+    def np_op(l, r):
+        return l ^ r
+
+
+class BitwiseNot(UnaryExpression):
+    @property
+    def data_type(self) -> T.DataType:
+        return self.child.data_type
+
+    def do_host(self, v):
+        vv, validity = _np_of(v)
+        return _to_pa(~vv, validity, self.data_type)
+
+    def do_device(self, data):
+        return ~data, None
+
+
+class _Shift(BinaryExpression):
+    """Shift amount is an int; masked to the value's bit width (Java)."""
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.left.data_type
+
+    def _mask(self):
+        return 63 if self.data_type is T.LONG else 31
+
+    def do_host(self, l, r):
+        lv, lval = _np_of(l)
+        rv, rval = _np_of(r)
+        validity = lval if rval is None else (
+            rval if lval is None else lval & rval)
+        sh = rv.astype(np.int64) & self._mask()
+        out = self.np_op(lv.astype(self.data_type.np_dtype), sh)
+        return _to_pa(out, validity, self.data_type)
+
+    def do_device(self, l, r):
+        sh = r.astype(jnp.int64) & self._mask()
+        return self.np_op(l.astype(self.data_type.np_dtype), sh), None
+
+
+class ShiftLeft(_Shift):
+    @staticmethod
+    def np_op(v, sh):
+        return (v << sh).astype(v.dtype)
+
+
+class ShiftRight(_Shift):
+    @staticmethod
+    def np_op(v, sh):
+        return (v >> sh).astype(v.dtype)
+
+
+class ShiftRightUnsigned(_Shift):
+    def np_op(self, v, sh):
+        if self.data_type is T.LONG:
+            xp = jnp if not isinstance(v, np.ndarray) else np
+            u = v.astype(xp.uint64) >> sh.astype(xp.uint64)
+            return u.astype(xp.int64)
+        xp = jnp if not isinstance(v, np.ndarray) else np
+        u = v.astype(xp.uint32) >> sh.astype(xp.uint32)
+        return u.astype(xp.int32)

@@ -1,0 +1,272 @@
+"""Datetime expression family — the ``datetimeExpressions.scala`` analog
+(533 LoC, SURVEY.md §2.4): Year/Month/Quarter/DayOfMonth/DayOfWeek/WeekDay/
+DayOfYear/Hour/Minute/Second/LastDay/DateAdd/DateSub/DateDiff.
+
+Dates are int32 days-since-epoch; timestamps int64 microseconds (UTC — the
+reference likewise gates non-UTC sessions off the GPU). Civil-calendar
+decomposition on device uses the standard days-from-civil algorithm in pure
+int32 arithmetic, which XLA fuses into the surrounding expression tree.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as T
+from .arithmetic import _np_of, _to_pa
+from .expression import BinaryExpression, Expression, UnaryExpression
+
+_US_PER_DAY = 86_400_000_000
+
+
+def _civil_from_days(z):
+    """days-since-epoch -> (year, month, day) via Howard Hinnant's algorithm
+    (public-domain date algorithms), vectorized int32/int64."""
+    z = z + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097
+    yoe = jnp.floor_divide(doe - jnp.floor_divide(doe, 1460)
+                           + jnp.floor_divide(doe, 36524)
+                           - jnp.floor_divide(doe, 146096), 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + jnp.floor_divide(yoe, 4)
+                 - jnp.floor_divide(yoe, 100))
+    mp = jnp.floor_divide(5 * doy + 2, 153)
+    d = doy - jnp.floor_divide(153 * mp + 2, 5) + 1
+    m = mp + jnp.where(mp < 10, 3, -9)
+    y = y + (m <= 2)
+    return y, m, d
+
+
+def _days_from_civil(y, m, d):
+    y = y - (m <= 2)
+    era = jnp.floor_divide(y, 400)
+    yoe = y - era * 400
+    mp = m + jnp.where(m > 2, -3, 9)
+    doy = jnp.floor_divide(153 * mp + 2, 5) + d - 1
+    doe = yoe * 365 + jnp.floor_divide(yoe, 4) - jnp.floor_divide(yoe, 100) + doy
+    return era * 146097 + doe - 719468
+
+
+def _days_of(data, dtype):
+    if dtype is T.DATE:
+        return data.astype(jnp.int64)
+    return jnp.floor_divide(data, _US_PER_DAY)
+
+
+class DatePart(UnaryExpression):
+    """Base for extract-style functions."""
+
+    pa_field = ""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        return getattr(pc, self.pa_field)(v).cast(pa.int32())
+
+
+class Year(DatePart):
+    pa_field = "year"
+
+    def do_device(self, data):
+        y, m, d = _civil_from_days(_days_of(data, self.child.data_type))
+        return y.astype(jnp.int32), None
+
+
+class Month(DatePart):
+    pa_field = "month"
+
+    def do_device(self, data):
+        y, m, d = _civil_from_days(_days_of(data, self.child.data_type))
+        return m.astype(jnp.int32), None
+
+
+class DayOfMonth(DatePart):
+    pa_field = "day"
+
+    def do_device(self, data):
+        y, m, d = _civil_from_days(_days_of(data, self.child.data_type))
+        return d.astype(jnp.int32), None
+
+
+class Quarter(DatePart):
+    pa_field = "quarter"
+
+    def do_device(self, data):
+        y, m, d = _civil_from_days(_days_of(data, self.child.data_type))
+        return ((m - 1) // 3 + 1).astype(jnp.int32), None
+
+
+class DayOfYear(DatePart):
+    pa_field = "day_of_year"
+
+    def do_device(self, data):
+        days = _days_of(data, self.child.data_type)
+        y, m, d = _civil_from_days(days)
+        jan1 = _days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+        return (days - jan1 + 1).astype(jnp.int32), None
+
+
+class DayOfWeek(DatePart):
+    """Spark dayofweek: 1 = Sunday ... 7 = Saturday."""
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        # pyarrow day_of_week: 0=Monday..6=Sunday -> Spark 1=Sunday..7=Saturday
+        dow = pc.day_of_week(v).cast(pa.int32())
+        shifted = pc.add(dow, 1)
+        wrapped = pc.subtract(shifted, pc.multiply(
+            pc.divide(shifted, 7), 7))
+        return pc.add(wrapped, 1).cast(pa.int32())
+
+    def do_device(self, data):
+        days = _days_of(data, self.child.data_type)
+        # 1970-01-01 was a Thursday; Sunday-based index:
+        dow = jnp.mod(days + 4, 7)  # 0=Sunday
+        return (dow + 1).astype(jnp.int32), None
+
+
+class WeekDay(DatePart):
+    """Spark weekday: 0 = Monday ... 6 = Sunday."""
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        return pc.day_of_week(v).cast(pa.int32())
+
+    def do_device(self, data):
+        days = _days_of(data, self.child.data_type)
+        return jnp.mod(days + 3, 7).astype(jnp.int32), None
+
+
+class Hour(DatePart):
+    pa_field = "hour"
+
+    def do_device(self, data):
+        us = jnp.mod(data, _US_PER_DAY)
+        return (us // 3_600_000_000).astype(jnp.int32), None
+
+
+class Minute(DatePart):
+    pa_field = "minute"
+
+    def do_device(self, data):
+        us = jnp.mod(data, _US_PER_DAY)
+        return ((us // 60_000_000) % 60).astype(jnp.int32), None
+
+
+class Second(DatePart):
+    pa_field = "second"
+
+    def do_device(self, data):
+        us = jnp.mod(data, _US_PER_DAY)
+        return ((us // 1_000_000) % 60).astype(jnp.int32), None
+
+
+class LastDay(UnaryExpression):
+    """Last day of the input date's month."""
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def do_host(self, v: pa.Array) -> pa.Array:
+        vals, validity = _np_of(v)
+        days = vals.astype("datetime64[D]").view(np.int64)
+        out = np.zeros(len(days), np.int32)
+        for i, dd in enumerate(days):
+            y, m, d = _np_civil(int(dd))
+            ny, nm = (y + 1, 1) if m == 12 else (y, m + 1)
+            out[i] = _np_days(ny, nm, 1) - 1
+        return _to_pa(out, validity, T.DATE)
+
+    def do_device(self, data):
+        days = _days_of(data, self.child.data_type)
+        y, m, d = _civil_from_days(days)
+        ny = jnp.where(m == 12, y + 1, y)
+        nm = jnp.where(m == 12, 1, m + 1)
+        first_next = _days_from_civil(ny, nm, jnp.ones_like(d))
+        return (first_next - 1).astype(jnp.int32), None
+
+
+def _np_civil(z):
+    z += 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = mp + (3 if mp < 10 else -9)
+    return y + (1 if m <= 2 else 0), m, d
+
+
+def _np_days(y, m, d):
+    y -= 1 if m <= 2 else 0
+    era = y // 400
+    yoe = y - era * 400
+    mp = m + (-3 if m > 2 else 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return era * 146097 + doe - 719468
+
+
+class DateAdd(BinaryExpression):
+    """date_add(date, n_days)."""
+
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def do_host(self, l, r):
+        lv, lval = _np_of(l)
+        rv, rval = _np_of(r)
+        days = lv.astype("datetime64[D]").view(np.int64)
+        out = (days + rv.astype(np.int64)).astype(np.int32)
+        validity = lval if rval is None else (
+            rval if lval is None else lval & rval)
+        return _to_pa(out, validity, T.DATE)
+
+    def do_device(self, l, r):
+        return (l.astype(jnp.int64) + r.astype(jnp.int64)).astype(jnp.int32), None
+
+
+class DateSub(BinaryExpression):
+    @property
+    def data_type(self):
+        return T.DATE
+
+    def do_host(self, l, r):
+        lv, lval = _np_of(l)
+        rv, rval = _np_of(r)
+        days = lv.astype("datetime64[D]").view(np.int64)
+        out = (days - rv.astype(np.int64)).astype(np.int32)
+        validity = lval if rval is None else (
+            rval if lval is None else lval & rval)
+        return _to_pa(out, validity, T.DATE)
+
+    def do_device(self, l, r):
+        return (l.astype(jnp.int64) - r.astype(jnp.int64)).astype(jnp.int32), None
+
+
+class DateDiff(BinaryExpression):
+    """datediff(end, start) in days."""
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def do_host(self, l, r):
+        lv, lval = _np_of(l)
+        rv, rval = _np_of(r)
+        ld = lv.astype("datetime64[D]").view(np.int64)
+        rd = rv.astype("datetime64[D]").view(np.int64)
+        validity = lval if rval is None else (
+            rval if lval is None else lval & rval)
+        return _to_pa((ld - rd).astype(np.int32), validity, T.INT)
+
+    def do_device(self, l, r):
+        return (l.astype(jnp.int64) - r.astype(jnp.int64)).astype(jnp.int32), None

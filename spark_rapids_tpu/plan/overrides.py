@@ -24,9 +24,12 @@ from ..config import (TpuConf, EXPLAIN, HAS_NANS, REPLACE_SORT_MERGE_JOIN,
 from ..exec import execs as E
 from ..ops import aggregates as AGG
 from ..ops import arithmetic as ARITH
+from ..ops import bitwise as BIT
 from ..ops import conditional as COND
+from ..ops import datetime as DT
 from ..ops import math as MATH
 from ..ops import predicates as PRED
+from ..ops import strings as STR
 from ..ops.cast import Cast
 from ..ops.expression import (Alias, AttributeReference, BoundReference,
                               Expression, Literal)
@@ -90,6 +93,35 @@ _expr(COND.Coalesce, tag=_string_branch_tag)
 _expr(COND.NaNvl)
 for _cls in [AGG.Min, AGG.Max, AGG.Sum, AGG.Count, AGG.Average, AGG.First,
              AGG.Last]:
+    _expr(_cls)
+
+
+def _like_tag(e: "STR.Like", conf: TpuConf) -> Optional[str]:
+    if e.simple_form() is None:
+        return "only %-wildcard prefix/suffix/contains LIKE patterns run on " \
+               "the device (reference limits RegExp similarly)"
+    return None
+
+
+def _substring_tag(e: "STR.Substring", conf: TpuConf) -> Optional[str]:
+    if not isinstance(e.children[1], Literal) or \
+            not isinstance(e.children[2], Literal):
+        return "substring with non-literal pos/len is not supported on device"
+    return None
+
+
+for _cls in [STR.Length, STR.Upper, STR.Lower, STR.StartsWith, STR.EndsWith,
+             STR.Contains, STR.ConcatStrings, STR.StringTrim,
+             STR.StringTrimLeft, STR.StringTrimRight]:
+    _expr(_cls)
+_expr(STR.Like, tag=_like_tag)
+_expr(STR.Substring, tag=_substring_tag)
+for _cls in [DT.Year, DT.Month, DT.DayOfMonth, DT.Quarter, DT.DayOfYear,
+             DT.DayOfWeek, DT.WeekDay, DT.Hour, DT.Minute, DT.Second,
+             DT.LastDay, DT.DateAdd, DT.DateSub, DT.DateDiff]:
+    _expr(_cls)
+for _cls in [BIT.BitwiseAnd, BIT.BitwiseOr, BIT.BitwiseXor, BIT.BitwiseNot,
+             BIT.ShiftLeft, BIT.ShiftRight, BIT.ShiftRightUnsigned]:
     _expr(_cls)
 
 

@@ -1,0 +1,99 @@
+"""Differential tests for the string, datetime, and bitwise families."""
+
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.data.batch import HostBatch
+from spark_rapids_tpu.ops import bitwise as B
+from spark_rapids_tpu.ops import datetime as DT
+from spark_rapids_tpu.ops import strings as S
+from spark_rapids_tpu.ops.expression import col, lit
+
+from datagen import DateGen, IntGen, StringGen, TimestampGen, gen_batch
+from test_expressions import assert_expr_equal
+
+
+def str_batch(seed=0, n=200, **kw):
+    return HostBatch(gen_batch({
+        "s": StringGen(max_len=10, **kw),
+        "t": StringGen(max_len=5, alphabet="ab "),
+    }, n=n, seed=seed))
+
+
+def dt_batch(seed=0, n=200):
+    return HostBatch(gen_batch({
+        "d": DateGen(),
+        "ts": TimestampGen(),
+        "n": IntGen(T.INT, lo=-1000, hi=1000),
+    }, n=n, seed=seed))
+
+
+class TestStrings:
+    def test_length(self):
+        assert_expr_equal(S.Length(col("s")), str_batch())
+
+    def test_upper_lower(self):
+        assert_expr_equal(S.Upper(col("s")), str_batch())
+        assert_expr_equal(S.Lower(col("s")), str_batch())
+
+    @pytest.mark.parametrize("pos,ln", [(1, 3), (2, 100), (0, 2), (-3, 2),
+                                        (5, 0)])
+    def test_substring(self, pos, ln):
+        assert_expr_equal(S.Substring(col("s"), lit(pos), lit(ln)),
+                          str_batch())
+
+    @pytest.mark.parametrize("needle", ["a", "ab", "", "zzz"])
+    def test_matchers(self, needle):
+        hb = str_batch()
+        assert_expr_equal(S.StartsWith(col("t"), needle), hb)
+        assert_expr_equal(S.EndsWith(col("t"), needle), hb)
+        assert_expr_equal(S.Contains(col("t"), needle), hb)
+
+    @pytest.mark.parametrize("pattern", ["a%", "%b", "%a%", "ab"])
+    def test_like_simple(self, pattern):
+        assert_expr_equal(S.Like(col("t"), pattern), str_batch())
+
+    def test_concat(self):
+        hb = str_batch()
+        assert_expr_equal(S.ConcatStrings(col("s"), lit("-"), col("t")), hb)
+
+    def test_trim(self):
+        hb = str_batch()
+        assert_expr_equal(S.StringTrim(col("t")), hb)
+        assert_expr_equal(S.StringTrimLeft(col("t")), hb)
+        assert_expr_equal(S.StringTrimRight(col("t")), hb)
+
+
+class TestDatetime:
+    @pytest.mark.parametrize("op", [DT.Year, DT.Month, DT.DayOfMonth,
+                                    DT.Quarter, DT.DayOfYear, DT.DayOfWeek,
+                                    DT.WeekDay, DT.LastDay])
+    def test_date_parts(self, op):
+        assert_expr_equal(op(col("d")), dt_batch())
+
+    @pytest.mark.parametrize("op", [DT.Hour, DT.Minute, DT.Second])
+    def test_time_parts(self, op):
+        assert_expr_equal(op(col("ts")), dt_batch())
+
+    def test_date_arith(self):
+        hb = dt_batch()
+        assert_expr_equal(DT.DateAdd(col("d"), lit(30)), hb)
+        assert_expr_equal(DT.DateSub(col("d"), lit(15)), hb)
+        assert_expr_equal(DT.DateDiff(col("d"), lit(0, T.DATE)), hb)
+
+
+class TestBitwise:
+    def test_logic_ops(self):
+        hb = HostBatch(gen_batch({
+            "a": IntGen(T.INT), "b": IntGen(T.INT),
+            "al": IntGen(T.LONG), "bl": IntGen(T.LONG),
+            "sh": IntGen(T.INT, lo=-70, hi=70),
+        }, n=200, seed=9))
+        assert_expr_equal(B.BitwiseAnd(col("a"), col("b")), hb)
+        assert_expr_equal(B.BitwiseOr(col("al"), col("bl")), hb)
+        assert_expr_equal(B.BitwiseXor(col("a"), col("b")), hb)
+        assert_expr_equal(B.BitwiseNot(col("a")), hb)
+        assert_expr_equal(B.ShiftLeft(col("a"), col("sh")), hb)
+        assert_expr_equal(B.ShiftRight(col("al"), col("sh")), hb)
+        assert_expr_equal(B.ShiftRightUnsigned(col("a"), col("sh")), hb)
+        assert_expr_equal(B.ShiftRightUnsigned(col("al"), col("sh")), hb)
