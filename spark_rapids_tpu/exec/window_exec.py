@@ -1,0 +1,204 @@
+"""TpuWindowExec — the ``GpuWindowExec`` analog (GpuWindowExec.scala:92).
+
+The reference evaluates each window expression with cudf rolling-window
+aggregations over partition groups. Here each window expression is evaluated
+by one fused XLA program per batch (see :mod:`..ops.kernels.window` for the
+formulation): sort once per distinct (partitionBy, orderBy), derive every
+row's frame as index arithmetic, reduce with prefix sums / sparse tables,
+scatter results back to input row order.
+
+Like TpuSortExec, window evaluation needs the whole partition in one batch
+(the reference declares ``RequireSingleBatch`` for its sort; windows get
+whole-partition data via Spark's required child ordering + exchange).
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .. import types as T
+from ..data.batch import ColumnarBatch
+from ..data.column import DeviceColumn
+from ..ops import aggregates as AGG
+from ..ops import windows as W
+from ..ops.expression import Expression
+from ..ops.kernels import rowops as KR
+from ..ops.kernels import window as KW
+from ..plan.physical import PhysicalPlan
+from .execs import TpuExec, _coalesce_device
+
+
+class TpuWindowExec(TpuExec):
+    def __init__(self, child: PhysicalPlan,
+                 window_exprs: List[Tuple[str, W.WindowExpression]],
+                 schema: T.Schema):
+        self.children = [child]
+        self.window_exprs = window_exprs
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return "TpuWindow [" + ", ".join(n for n, _ in self.window_exprs) + "]"
+
+    def execute(self, ctx):
+        child_schema = self.children[0].schema
+        bound = []
+        for name, we in self.window_exprs:
+            spec = we.spec
+            part = [e.bind(child_schema) for e in spec.partition_by]
+            orders = [(o.child.bind(child_schema), o.ascending,
+                       o.effective_nulls_first) for o in spec.order_by]
+            func = we.func.bind(child_schema) if we.func.children else we.func
+            bound.append((name, func, part, orders, spec.effective_frame()))
+        out_schema = self._schema
+
+        @jax.jit
+        def window_all(batch: ColumnarBatch) -> ColumnarBatch:
+            out_cols = list(batch.columns)
+            for name, func, part, orders, frame in bound:
+                data, valid, dtype = _eval_window(batch, func, part, orders,
+                                                  frame)
+                out_cols.append(DeviceColumn(data=data, validity=valid,
+                                             dtype=dtype))
+            return ColumnarBatch(tuple(out_cols), batch.n_rows, out_schema)
+
+        def run(part):
+            batches = [db for db in part]
+            if not batches:
+                return
+            yield window_all(_coalesce_device(batches))
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
+def _eval_window(batch: ColumnarBatch, func: Expression,
+                 part: List[Expression],
+                 orders: List[Tuple[Expression, bool, bool]],
+                 frame: W.WindowFrame):
+    cap = batch.capacity
+    n_rows = batch.n_rows
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    live = iota < n_rows
+
+    part_cols = [e.eval_device(batch) for e in part]
+    order_cols = [e.eval_device(batch) for e, _, _ in orders]
+    keys = part_cols + order_cols
+    if keys:
+        asc = [True] * len(part_cols) + [a for _, a, _ in orders]
+        nf = [True] * len(part_cols) + [n for _, _, n in orders]
+        perm = KR.sort_permutation(keys, n_rows, asc, nf)
+    else:
+        perm = iota
+
+    sorted_parts = [KR.gather_column(c, perm) for c in part_cols]
+    sorted_orders = [KR.gather_column(c, perm) for c in order_cols]
+    new_seg = KW.change_flags(sorted_parts, cap)
+    seg_start, seg_end = KW.run_bounds(new_seg, n_rows)
+    new_peer = KW.change_flags(sorted_parts + sorted_orders, cap)
+    peer_start, peer_end = KW.run_bounds(new_peer, n_rows)
+
+    # -- ranking functions (frame-independent) ------------------------------
+    if isinstance(func, W.RowNumber):
+        res = iota - seg_start + 1
+        return _scatter(res.astype(jnp.int32), live, perm, cap, T.INT)
+    if isinstance(func, W.Rank):
+        res = peer_start - seg_start + 1
+        return _scatter(res.astype(jnp.int32), live, perm, cap, T.INT)
+    if isinstance(func, W.DenseRank):
+        ps = KW.exclusive_prefix((new_peer & live).astype(jnp.int32))
+        res = ps[iota + 1] - ps[seg_start]
+        return _scatter(res.astype(jnp.int32), live, perm, cap, T.INT)
+
+    # -- frame bounds -------------------------------------------------------
+    lo, hi = _frame_bounds(frame, iota, seg_start, seg_end, peer_start,
+                           peer_end, sorted_orders, orders)
+
+    # -- windowed aggregates ------------------------------------------------
+    assert isinstance(func, W.WINDOW_AGG_TYPES), type(func)
+    child = func.children[0].eval_device(batch) if func.children else None
+    sv = KR.gather_column(child, perm) if child is not None else None
+
+    if sv is not None:
+        cnt_ps = KW.exclusive_prefix(sv.validity.astype(jnp.int64))
+        cnt = KW.range_sum(cnt_ps, lo, hi)
+    else:
+        cnt = (hi - lo).astype(jnp.int64)
+
+    if isinstance(func, AGG.Count):
+        return _scatter(cnt, live, perm, cap, T.LONG)
+    if isinstance(func, AGG.Sum):
+        acc = func.data_type  # LONG or DOUBLE (Spark's sum widening)
+        vals = jnp.where(sv.validity, sv.data, 0).astype(acc.np_dtype)
+        s = KW.range_sum(KW.exclusive_prefix(vals), lo, hi)
+        return _scatter(s, live & (cnt > 0), perm, cap, acc)
+    if isinstance(func, AGG.Average):
+        vals = jnp.where(sv.validity, sv.data, 0).astype(jnp.float64)
+        s = KW.range_sum(KW.exclusive_prefix(vals), lo, hi)
+        avg = s / jnp.maximum(cnt, 1).astype(jnp.float64)
+        return _scatter(avg, live & (cnt > 0), perm, cap, T.DOUBLE)
+    # Min / Max over the canonical total order (int64): NaN ranks greatest
+    # and -0.0 == 0.0, matching Spark instead of jnp.minimum's NaN poison.
+    is_min = isinstance(func, AGG.Min)
+    dtype = func.data_type
+    keys = KR.orderable_values(sv.data, dtype.is_floating)
+    info = jnp.iinfo(jnp.int64)
+    neutral = jnp.int64(info.max if is_min else info.min)
+    masked = jnp.where(sv.validity, keys, neutral)
+    mm_key = KW.range_min_max(KW.sparse_table(masked, is_min), lo, hi, is_min)
+    mm = KW.from_total_order(mm_key, dtype)
+    return _scatter(mm, live & (cnt > 0), perm, cap, dtype)
+
+
+def _frame_bounds(frame: W.WindowFrame, iota, seg_start, seg_end,
+                  peer_start, peer_end, sorted_orders, orders):
+    if frame.frame_type == "rows":
+        lo = seg_start if frame.lower.kind == "unbounded" else \
+            jnp.clip(iota + frame.lower.offset
+                     if frame.lower.kind == "offset" else iota,
+                     seg_start, seg_end)
+        hi = seg_end if frame.upper.kind == "unbounded" else \
+            jnp.clip((iota + frame.upper.offset
+                      if frame.upper.kind == "offset" else iota) + 1,
+                     seg_start, seg_end)
+        return lo, jnp.maximum(hi, lo)
+
+    # RANGE frame. current/unbounded bounds are peer-run boundaries; literal
+    # offsets need the single order key and a per-row binary search.
+    need_search = frame.lower.kind == "offset" or frame.upper.kind == "offset"
+    if need_search:
+        assert len(sorted_orders) == 1, \
+            "range frame with offsets requires exactly one order-by key"
+        oc = sorted_orders[0]
+        _, asc, nf = orders[0]
+        bucket, key, raw, floating = KW.order_key_arrays(oc, asc, nf)
+
+    def one(bound: W.Bound, is_lower: bool):
+        if bound.kind == "unbounded":
+            return seg_start if is_lower else seg_end
+        if bound.kind == "current":
+            return peer_start if is_lower else peer_end
+        delta = bound.offset if asc else -bound.offset
+        t_raw = KW.saturating_offset(raw, delta, floating)
+        t_key = KW.transform_target(t_raw, floating, asc)
+        # Null order values keep their own (bucket, key): their frame is the
+        # null peer run, matching Spark's null-range semantics.
+        t_key = jnp.where(oc.validity, t_key, key)
+        return KW.seg_search(bucket, key, bucket, t_key, seg_start, seg_end,
+                             left=is_lower)
+
+    lo = one(frame.lower, True)
+    hi = one(frame.upper, False)
+    return lo, jnp.maximum(hi, lo)
+
+
+def _scatter(data_sorted, valid_sorted, perm, cap, dtype: T.DataType):
+    """Scatter sorted-space results back to original row order."""
+    data = jnp.zeros(cap, data_sorted.dtype).at[perm].set(data_sorted)
+    valid = jnp.zeros(cap, jnp.bool_).at[perm].set(valid_sorted)
+    data = jnp.where(valid, data, jnp.zeros((), data.dtype))
+    return data.astype(dtype.np_dtype), valid, dtype

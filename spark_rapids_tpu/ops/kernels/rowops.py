@@ -28,13 +28,10 @@ from ...data.column import DeviceColumn, bucket_capacity
 from ..strings_util import PAD, char_matrix
 
 
-def orderable_key(col: DeviceColumn, ascending: bool = True,
-                  nulls_first: bool = True) -> jnp.ndarray:
-    """Map a fixed-width column to an int array whose ascending order equals
-    the requested SQL order (nulls placed per ``nulls_first``)."""
-    assert not col.is_string, "string sort keys expand via string_sort_keys"
-    data = col.data
-    if col.dtype.is_floating:
+def orderable_values(data: jnp.ndarray, is_floating: bool) -> jnp.ndarray:
+    """Monotone int64 transform of a raw value array: ascending int order of
+    the result equals SQL ascending order of the values (NaN last, -0 == 0)."""
+    if is_floating:
         if data.dtype == jnp.float32:
             bits = data.view(jnp.int32).astype(jnp.int64)
         else:
@@ -48,9 +45,16 @@ def orderable_key(col: DeviceColumn, ascending: bool = True,
         # IEEE total-order trick: negatives map (order-reversed) below zero,
         # positives keep their bit order. Wrapping int64 add is intended.
         int64_min = jnp.int64(-0x8000000000000000)
-        key = jnp.where(bits < 0, ~bits + int64_min, bits)
-    else:
-        key = data.astype(jnp.int64)
+        return jnp.where(bits < 0, ~bits + int64_min, bits)
+    return data.astype(jnp.int64)
+
+
+def orderable_key(col: DeviceColumn, ascending: bool = True,
+                  nulls_first: bool = True) -> jnp.ndarray:
+    """Map a fixed-width column to an int array whose ascending order equals
+    the requested SQL order (nulls placed per ``nulls_first``)."""
+    assert not col.is_string, "string sort keys expand via string_sort_keys"
+    key = orderable_values(col.data, col.dtype.is_floating)
     if not ascending:
         key = ~key  # bitwise NOT reverses order with no overflow
     null_bucket = jnp.where(col.validity, 0, -1 if nulls_first else 1)

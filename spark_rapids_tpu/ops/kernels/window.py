@@ -1,0 +1,209 @@
+"""Window frame kernels: segment scans, prefix sums, sparse tables, searches.
+
+The reference evaluates window functions with cudf rolling-window kernels
+(``GpuWindowExpression.scala:393,561``), one pass per window column. The
+TPU-native formulation here computes every row's frame *simultaneously*:
+
+* one multi-key sort puts partitions contiguous and ordered;
+* segment starts/ends come from ``lax.cummax``/``cummin`` scans;
+* ROWS frames are pure index arithmetic;
+* RANGE frames are peer-run scans, or (for literal offsets) a vectorized
+  per-row binary search — 32 gather steps instead of cudf's per-row scan;
+* sum/count over a frame = difference of exclusive prefix sums;
+* min/max over a frame = an O(n log n) sparse table (two overlapping
+  power-of-two range lookups per row).
+
+Everything is static-shaped and jit-traced; dead rows (index >= n_rows) sort
+to the end and never influence live frames.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ... import types as T
+from ...data.column import DeviceColumn
+from ..strings_util import char_matrix
+from .rowops import orderable_values
+
+
+# ---------------------------------------------------------------------------
+# Segments & peers
+# ---------------------------------------------------------------------------
+
+
+def change_flags(sorted_cols: Sequence[DeviceColumn],
+                 capacity: int) -> jnp.ndarray:
+    """bool[cap]: row i differs from row i-1 in any of the given (already
+    sorted/gathered) key columns. Row 0 is always True. With no key columns
+    nothing ever changes (a single run spanning all rows)."""
+    cap = capacity
+    diff = None
+    for c in sorted_cols:
+        if c.is_string:
+            m = char_matrix(c)
+            prev = jnp.concatenate([m[:1], m[:-1]], axis=0)
+            ne = jnp.any(m != prev, axis=1)
+        else:
+            # Compare in canonicalized total order so NaN == NaN and
+            # -0.0 == 0.0 (groupby.py does the same for its grouping keys).
+            data = orderable_values(c.data, c.dtype.is_floating)
+            prev = jnp.concatenate([data[:1], data[:-1]])
+            ne = data != prev
+        vprev = jnp.concatenate([c.validity[:1], c.validity[:-1]])
+        # Null slots carry zeroed data, so data-compare is exact; a validity
+        # flip is always a change, two nulls are equal.
+        ne = ne | (c.validity != vprev)
+        diff = ne if diff is None else (diff | ne)
+    if diff is None:
+        diff = jnp.zeros(cap, dtype=jnp.bool_)
+    first = jnp.arange(diff.shape[0], dtype=jnp.int32) == 0
+    return diff | first
+
+
+def run_bounds(new_run: jnp.ndarray, n_rows: jnp.ndarray
+               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-row [start, end) of the run each row belongs to, where ``new_run``
+    flags run starts in sorted order. Ends are clipped to ``n_rows``."""
+    cap = new_run.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    start = jax.lax.cummax(jnp.where(new_run, iota, 0))
+    nxt = jnp.where(new_run, iota, cap)
+    after = jnp.concatenate([nxt[1:], jnp.full(1, cap, jnp.int32)])
+    end = jax.lax.cummin(after, reverse=True)
+    end = jnp.minimum(end, n_rows.astype(jnp.int32))
+    return start, jnp.maximum(end, start)
+
+
+# ---------------------------------------------------------------------------
+# Range reductions
+# ---------------------------------------------------------------------------
+
+
+def exclusive_prefix(vals: jnp.ndarray) -> jnp.ndarray:
+    """[cap] -> [cap+1] exclusive prefix sums (ps[j] = sum of vals[:j])."""
+    return jnp.concatenate([jnp.zeros(1, vals.dtype),
+                            jnp.cumsum(vals, dtype=vals.dtype)])
+
+
+def range_sum(ps: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    return ps[hi] - ps[lo]
+
+
+def sparse_table(vals: jnp.ndarray, is_min: bool) -> jnp.ndarray:
+    """[L, cap] table: table[k, i] = min/max of vals[i : i + 2^k]."""
+    cap = vals.shape[0]
+    combine = jnp.minimum if is_min else jnp.maximum
+    levels = [vals]
+    shift = 1
+    while shift < cap:
+        cur = levels[-1]
+        shifted = jnp.concatenate([cur[shift:], cur[-1:].repeat(shift)])
+        levels.append(combine(cur, shifted))
+        shift <<= 1
+    return jnp.stack(levels)
+
+
+def range_min_max(table: jnp.ndarray, lo: jnp.ndarray, hi: jnp.ndarray,
+                  is_min: bool) -> jnp.ndarray:
+    """Query [lo, hi) ranges against a sparse table; undefined where hi<=lo."""
+    combine = jnp.minimum if is_min else jnp.maximum
+    span = jnp.maximum(hi - lo, 1).astype(jnp.int64)
+    # floor(log2(span)) with integer-exact correction of float rounding.
+    k = jnp.log2(span.astype(jnp.float64)).astype(jnp.int32)
+    k = jnp.where((jnp.int64(1) << (k + 1)) <= span, k + 1, k)
+    k = jnp.where((jnp.int64(1) << jnp.maximum(k, 0)) > span, k - 1, k)
+    k = jnp.clip(k, 0, table.shape[0] - 1)
+    second = jnp.maximum(hi - (jnp.int32(1) << k), lo)
+    return combine(table[k, lo], table[k, second])
+
+
+# ---------------------------------------------------------------------------
+# Binary search (RANGE frames with literal offsets)
+# ---------------------------------------------------------------------------
+
+
+def seg_search(bucket: jnp.ndarray, key: jnp.ndarray,
+               t_bucket: jnp.ndarray, t_key: jnp.ndarray,
+               lo0: jnp.ndarray, hi0: jnp.ndarray, left: bool) -> jnp.ndarray:
+    """Vectorized per-row binary search over the lexicographic (bucket, key)
+    arrays, restricted to each row's [lo0, hi0) slice. Returns the insertion
+    point (bisect_left when ``left`` else bisect_right)."""
+    cap = bucket.shape[0]
+    iters = max(cap.bit_length(), 1) + 1
+
+    def lt(b1, k1, b2, k2):
+        return (b1 < b2) | ((b1 == b2) & (k1 < k2))
+
+    def body(_, state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        midc = jnp.clip(mid, 0, cap - 1)
+        b, k = bucket[midc], key[midc]
+        if left:
+            go_right = lt(b, k, t_bucket, t_key)
+        else:
+            go_right = ~lt(t_bucket, t_key, b, k)
+        active = lo < hi
+        new_lo = jnp.where(active & go_right, mid + 1, lo)
+        new_hi = jnp.where(active & ~go_right, mid, hi)
+        return new_lo, new_hi
+
+    lo, _ = jax.lax.fori_loop(0, iters, body, (lo0, hi0))
+    return lo
+
+
+def widen_order(col: DeviceColumn) -> Tuple[jnp.ndarray, bool]:
+    """Widen an order-by column to (int64 | float64) raw values so literal
+    frame offsets can be added without dtype plumbing."""
+    if col.dtype.is_floating:
+        return col.data.astype(jnp.float64), True
+    return col.data.astype(jnp.int64), False
+
+
+def saturating_offset(vals: jnp.ndarray, offset: int,
+                      floating: bool) -> jnp.ndarray:
+    """vals + offset with int64 saturation (float addition is naturally safe)."""
+    if floating:
+        return vals + jnp.float64(offset)
+    s = vals + jnp.int64(offset)
+    i64 = jnp.iinfo(jnp.int64)
+    s = jnp.where((offset > 0) & (s < vals), i64.max, s)
+    s = jnp.where((offset < 0) & (s > vals), i64.min, s)
+    return s
+
+
+def order_key_arrays(col: DeviceColumn, ascending: bool, nulls_first: bool
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, bool]:
+    """(bucket, key, widened_raw, floating) for RANGE-offset searches: the
+    lexicographic (bucket, key) ascends exactly in sorted-row order."""
+    raw, floating = widen_order(col)
+    key = orderable_values(raw, floating)
+    if not ascending:
+        key = ~key
+    bucket = jnp.where(col.validity, 0, -1 if nulls_first else 1) \
+        .astype(jnp.int8)
+    return bucket, key, raw, floating
+
+
+def transform_target(raw_target: jnp.ndarray, floating: bool,
+                     ascending: bool) -> jnp.ndarray:
+    key = orderable_values(raw_target, floating)
+    return key if ascending else ~key
+
+
+def from_total_order(key: jnp.ndarray, dtype) -> jnp.ndarray:
+    """Invert :func:`rowops.orderable_values`: total-order int64 key back to a
+    raw value of ``dtype`` (canonicalized NaN/-0.0 come back canonical, which
+    Spark treats as equal anyway). Lets min/max run on the total order so NaN
+    ranks greatest instead of poisoning jnp.minimum."""
+    if not dtype.is_floating:
+        return key.astype(dtype.np_dtype)
+    int64_min = jnp.int64(-0x8000000000000000)
+    bits = jnp.where(key < 0, ~(key - int64_min), key)
+    if dtype.np_dtype == jnp.float32:
+        return bits.astype(jnp.int32).view(jnp.float32)
+    return bits.view(jnp.float64)

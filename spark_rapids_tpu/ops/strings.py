@@ -108,18 +108,19 @@ class Substring(Expression):
         return Substring(*children)
 
     def eval_host(self, batch: HostBatch) -> pa.Array:
-        from .expression import Literal
-        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
-        pos = self.children[1].value
-        ln = self.children[2].value
+        n = batch.num_rows
+        v = host_to_array(self.children[0].eval_host(batch), n)
+        # pos/len evaluate per-row on host (the device path requires literals
+        # and tags non-literals to fall back here, overrides._substring_tag).
+        poss = host_to_array(self.children[1].eval_host(batch), n).to_pylist()
+        lens = host_to_array(self.children[2].eval_host(batch), n).to_pylist()
         # Spark: pos 1-based; pos 0 behaves like 1; negative from end.
         out = []
-        for s in v.to_pylist():
-            if s is None:
+        for s, p, ln in zip(v.to_pylist(), poss, lens):
+            if s is None or p is None or ln is None:
                 out.append(None)
                 continue
             b = s.encode()
-            p = pos
             if p > 0:
                 start = p - 1
             elif p == 0:
@@ -131,7 +132,6 @@ class Substring(Expression):
         return pa.array(out, pa.string())
 
     def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
-        from .expression import Literal
         c = self.children[0].eval_device(batch)
         pos = self.children[1].value
         ln = max(self.children[2].value, 0)

@@ -315,6 +315,38 @@ class Union(LogicalPlan):
                          for f, n in zip(first, nullable)])
 
 
+class WindowOp(LogicalPlan):
+    """Append window-expression columns (Spark's Window logical node; the
+    physical GpuWindowExec analog is exec/window_exec.py)."""
+
+    def __init__(self, child: LogicalPlan, window_exprs):
+        from ..ops import windows as W
+        self.children = [child]
+        resolved = []
+        for name, we in window_exprs:
+            func = we.func
+            if func.children:
+                func = func.with_children(
+                    [resolve(c, child.schema) for c in func.children])
+            spec = W.WindowSpec(
+                tuple(resolve(e, child.schema) for e in we.spec.partition_by),
+                tuple(SortOrder(resolve(o.child, child.schema), o.ascending,
+                                o.nulls_first) for o in we.spec.order_by),
+                we.spec.frame)
+            resolved.append((name, W.WindowExpression(func, spec)))
+        self.window_exprs = resolved
+
+    @property
+    def schema(self) -> T.Schema:
+        fields = list(self.children[0].schema)
+        fields += [T.StructField(name, we.data_type, we.nullable)
+                   for name, we in self.window_exprs]
+        return T.Schema(fields)
+
+    def describe(self):
+        return "Window [" + ", ".join(n for n, _ in self.window_exprs) + "]"
+
+
 class Expand(LogicalPlan):
     """Multiple projections per input row (grouping sets / rollup / cube;
     GpuExpandExec, GpuExpandExec.scala:66)."""
@@ -390,9 +422,20 @@ class DataFrame:
     filter = where
 
     def with_column(self, name: str, expr: Expression) -> "DataFrame":
+        from ..ops.windows import WindowExpression
+        e = _as_expr(expr)
+        if isinstance(e, WindowExpression):
+            assert name not in self.columns, \
+                "window column must introduce a new name"
+            return DataFrame(WindowOp(self._plan, [(name, e)]), self._session)
         exprs = [col(n) for n in self.columns if n != name]
-        exprs.append(Alias(_as_expr(expr), name))
+        exprs.append(Alias(e, name))
         return DataFrame(Project(self._plan, exprs), self._session)
+
+    def with_windows(self, **name_to_window_expr) -> "DataFrame":
+        """Append several window columns in one Window node."""
+        plan = WindowOp(self._plan, list(name_to_window_expr.items()))
+        return DataFrame(plan, self._session)
 
     def group_by(self, *keys) -> GroupedData:
         return GroupedData(self, [_as_expr(k) for k in keys])

@@ -242,6 +242,59 @@ def _agg_tag(meta: ExecMeta, conf: TpuConf):
                     "order; set spark.rapids.sql.variableFloatAgg.enabled=true")
 
 
+def _window_exprs(node: "P.CpuWindowExec") -> List[Expression]:
+    from ..ops import windows as W
+    out: List[Expression] = []
+    for _, we in node.window_exprs:
+        out.extend(we.func.children)
+        out.extend(we.spec.partition_by)
+        out.extend(o.child for o in we.spec.order_by)
+    return out
+
+
+def _window_tag(meta: ExecMeta, conf: TpuConf):
+    """Gating mirrors GpuWindowExpression.tag: supported functions, literal
+    frame bounds, range frames need one orderable order-by key."""
+    from ..ops import windows as W
+    node = meta.node
+    for name, we in node.window_exprs:
+        f = we.func
+        if not isinstance(f, W.WINDOW_AGG_TYPES + W.RANKING_TYPES):
+            meta.will_not_work(
+                f"window function {type(f).__name__} is not supported on TPU")
+            continue
+        if isinstance(f, (AGG.Min, AGG.Max)) and f.children and \
+                f.children[0].data_type is T.STRING:
+            meta.will_not_work("string min/max over windows is not supported "
+                               "on the device yet")
+        if isinstance(f, (AGG.Sum, AGG.Average)) and f.children and \
+                f.children[0].data_type.is_floating and \
+                not conf.get(VARIABLE_FLOAT_AGG):
+            meta.will_not_work(
+                "windowed float sum/average can differ from CPU due to "
+                "reduction order; set "
+                "spark.rapids.sql.variableFloatAgg.enabled=true")
+        frame = we.spec.effective_frame()
+        if frame.frame_type == "range" and not isinstance(f, W.RANKING_TYPES):
+            has_offset = frame.lower.kind == "offset" or \
+                frame.upper.kind == "offset"
+            if has_offset:
+                if len(we.spec.order_by) != 1:
+                    meta.will_not_work("range frames with offsets require "
+                                       "exactly one order-by key")
+                else:
+                    okt = we.spec.order_by[0].child.data_type
+                    if okt in (T.STRING, T.BOOLEAN) or okt is T.NULL:
+                        meta.will_not_work(
+                            f"range frame offsets on {okt} order-by are not "
+                            "supported (reference limits range frames to "
+                            "timestamp order-by, GpuWindowExec.scala:92)")
+        for e in we.spec.partition_by:
+            if e.data_type not in T.DEFAULT_DEVICE_TYPES:
+                meta.will_not_work(
+                    f"partition key type {e.data_type} not supported")
+
+
 def _join_tag(meta: ExecMeta, conf: TpuConf):
     node: P.CpuJoinExec = meta.node
     if not node.left_keys:
@@ -291,7 +344,17 @@ EXEC_RULES: Dict[Type[P.PhysicalPlan], ExecRule] = {
         "Range",
         lambda n: [],
         lambda n, ch, conf: E.TpuRangeExec(n.start, n.end, n.step)),
+    P.CpuWindowExec: ExecRule(
+        "Window",
+        _window_exprs,
+        lambda n, ch, conf: _make_window(n, ch),
+        tag=_window_tag),
 }
+
+
+def _make_window(n: "P.CpuWindowExec", ch):
+    from ..exec.window_exec import TpuWindowExec
+    return TpuWindowExec(ch[0], n.window_exprs, n.schema)
 
 #: Node types that legitimately stay on CPU (host-side sources; the scan
 #: device-decode path is a later milestone, like the reference's host-read +

@@ -445,3 +445,247 @@ class CpuExpandExec(PhysicalPlan):
                     yield HostBatch(pa.RecordBatch.from_arrays(
                         arrays, schema=arrow))
         return [run(p) for p in self.children[0].execute(ctx)]
+
+
+class CpuWindowExec(PhysicalPlan):
+    """Window oracle: comparator-sorted partitions, per-row frame scans.
+
+    Deliberately naive (O(rows * frame) Python) and fully independent of the
+    device kernels — the differential harness's trusted side, playing the
+    role CPU Spark's WindowExec plays for the reference's window suites
+    (WindowFunctionSuite, window_function_test.py)."""
+
+    def __init__(self, child: PhysicalPlan, window_exprs, schema: T.Schema):
+        self.children = [child]
+        self.window_exprs = window_exprs  # List[Tuple[name, WindowExpression]]
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return "CpuWindow [" + ", ".join(n for n, _ in self.window_exprs) + "]"
+
+    def execute(self, ctx):
+        arrow = _arrow_schema(self.schema)
+
+        def run(part):
+            batches = list(part)
+            if not batches:
+                return
+            hb = concat_host(batches)
+            n = hb.num_rows
+            new_arrays = [self._eval(hb, we) for _, we in self.window_exprs]
+            arrays = list(hb.rb.columns) + new_arrays
+            arrays = [a.cast(f.type) for a, f in zip(arrays, arrow)]
+            yield HostBatch(pa.RecordBatch.from_arrays(arrays, schema=arrow))
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+    def _eval(self, hb: HostBatch, we) -> pa.Array:
+        import functools
+        import math
+
+        from ..ops import windows as W
+
+        n = hb.num_rows
+        spec = we.spec
+        part_vals = [host_to_array(e.eval_host(hb), n).to_pylist()
+                     for e in spec.partition_by]
+        order_meta = [(host_to_array(o.child.eval_host(hb), n).to_pylist(),
+                       o.ascending, o.effective_nulls_first)
+                      for o in spec.order_by]
+        child = we.func.children[0] if we.func.children else None
+        vals = host_to_array(child.eval_host(hb), n).to_pylist() \
+            if child is not None else None
+
+        def cmp_scalar(a, b):
+            # NaN sorts greatest (Spark semantics)
+            a_nan = isinstance(a, float) and math.isnan(a)
+            b_nan = isinstance(b, float) and math.isnan(b)
+            if a_nan and b_nan:
+                return 0
+            if a_nan:
+                return 1
+            if b_nan:
+                return -1
+            if a == b:
+                return 0
+            return -1 if a < b else 1
+
+        def cmp_rows(i, j):
+            for pv in part_vals:
+                a, b = pv[i], pv[j]
+                if (a is None) != (b is None):
+                    return -1 if a is None else 1
+                if a is not None:
+                    c = cmp_scalar(a, b)
+                    if c:
+                        return c
+            for ov, asc, nf in order_meta:
+                a, b = ov[i], ov[j]
+                if (a is None) != (b is None):
+                    null_cmp = -1 if nf else 1
+                    return null_cmp if a is None else -null_cmp
+                if a is not None:
+                    c = cmp_scalar(a, b)
+                    if c:
+                        return c if asc else -c
+            return 0
+
+        idx = sorted(range(n), key=functools.cmp_to_key(cmp_rows))
+
+        frame = spec.effective_frame()
+        out = [None] * n
+        s = 0
+        while s < n:
+            e = s + 1
+            while e < n and cmp_part(idx[s], idx[e], part_vals) == 0:
+                e += 1
+            self._eval_segment(idx, s, e, order_meta, frame, we, vals, out)
+            s = e
+        return pa.array(out, type=T.to_arrow_type(we.data_type))
+
+    def _eval_segment(self, idx, s, e, order_meta, frame, we, vals, out):
+        import math
+
+        from ..ops import aggregates as AGG
+        from ..ops import windows as W
+
+        def order_tuple(p):
+            # Canonicalize NaN so peer equality matches Spark (NaN == NaN).
+            return tuple(
+                ("NaN",) if isinstance(ov[idx[p]], float)
+                and math.isnan(ov[idx[p]]) else ov[idx[p]]
+                for ov, _, _ in order_meta)
+
+        def peers(p):
+            lo = p
+            while lo > s and order_tuple(lo - 1) == order_tuple(p):
+                lo -= 1
+            hi = p + 1
+            while hi < e and order_tuple(hi) == order_tuple(p):
+                hi += 1
+            return lo, hi
+
+        peer_group_no = []
+        g = 0
+        for p in range(s, e):
+            if p > s and order_tuple(p) != order_tuple(p - 1):
+                g += 1
+            peer_group_no.append(g)
+
+        for p in range(s, e):
+            i = idx[p]
+            f = we.func
+            if isinstance(f, W.RowNumber):
+                out[i] = p - s + 1
+                continue
+            if isinstance(f, W.Rank):
+                out[i] = peers(p)[0] - s + 1
+                continue
+            if isinstance(f, W.DenseRank):
+                out[i] = peer_group_no[p - s] + 1
+                continue
+            lo, hi = self._frame(p, s, e, frame, order_meta, idx, peers)
+            rows = [idx[q] for q in range(lo, hi)]
+            if isinstance(f, AGG.Count):
+                if vals is None:
+                    out[i] = len(rows)
+                else:
+                    out[i] = sum(1 for r in rows if vals[r] is not None)
+                continue
+            fv = [vals[r] for r in rows if vals[r] is not None]
+            if not fv:
+                out[i] = None
+            elif isinstance(f, AGG.Sum):
+                total = sum(fv)
+                out[i] = float(total) if f.data_type is T.DOUBLE else int(total)
+            elif isinstance(f, AGG.Average):
+                out[i] = float(sum(fv)) / len(fv)
+            elif isinstance(f, AGG.Min):
+                # NaN ranks greatest (Spark float total order).
+                out[i] = min(fv, key=_nan_great_key)
+            elif isinstance(f, AGG.Max):
+                out[i] = max(fv, key=_nan_great_key)
+            else:
+                raise NotImplementedError(type(f).__name__)
+
+    def _frame(self, p, s, e, frame, order_meta, idx, peers):
+        if frame.frame_type == "rows":
+            lo = s if frame.lower.kind == "unbounded" else \
+                max(s, min(e, p + (frame.lower.offset
+                                   if frame.lower.kind == "offset" else 0)))
+            hi = e if frame.upper.kind == "unbounded" else \
+                max(s, min(e, p + (frame.upper.offset
+                                   if frame.upper.kind == "offset" else 0) + 1))
+            return lo, max(hi, lo)
+        # RANGE
+        need_peers = frame.lower.kind == "current" or \
+            frame.upper.kind == "current"
+        plo, phi = peers(p) if need_peers else (None, None)
+        lo = s if frame.lower.kind == "unbounded" else plo
+        hi = e if frame.upper.kind == "unbounded" else phi
+        if frame.lower.kind == "offset" or frame.upper.kind == "offset":
+            ov, asc, _ = order_meta[0]
+            v = ov[idx[p]]
+            if v is None:
+                lo, hi = peers(p)
+            else:
+                def in_frame(q):
+                    vt = ov[idx[q]]
+                    if vt is None:
+                        return False
+                    if asc:
+                        lo_v = None if frame.lower.kind == "unbounded" else \
+                            (v if frame.lower.kind == "current"
+                             else v + frame.lower.offset)
+                        hi_v = None if frame.upper.kind == "unbounded" else \
+                            (v if frame.upper.kind == "current"
+                             else v + frame.upper.offset)
+                        if lo_v is not None and vt < lo_v:
+                            return False
+                        if hi_v is not None and vt > hi_v:
+                            return False
+                        return True
+                    lo_v = None if frame.upper.kind == "unbounded" else \
+                        (v if frame.upper.kind == "current"
+                         else v - frame.upper.offset)
+                    hi_v = None if frame.lower.kind == "unbounded" else \
+                        (v if frame.lower.kind == "current"
+                         else v - frame.lower.offset)
+                    if lo_v is not None and vt < lo_v:
+                        return False
+                    if hi_v is not None and vt > hi_v:
+                        return False
+                    return True
+                members = [q for q in range(s, e) if in_frame(q)]
+                if not members:
+                    # empty frame
+                    return s, s
+                lo, hi = members[0], members[-1] + 1
+        return lo, max(hi, lo)
+
+
+def _nan_great_key(v):
+    import math
+    return (1, 0.0) if isinstance(v, float) and math.isnan(v) else (0, v)
+
+
+def cmp_part(i, j, part_vals):
+    import math
+    for pv in part_vals:
+        a, b = pv[i], pv[j]
+        if (a is None) != (b is None):
+            return -1 if a is None else 1
+        if a is None:
+            continue
+        a_nan = isinstance(a, float) and math.isnan(a)
+        b_nan = isinstance(b, float) and math.isnan(b)
+        if a_nan and b_nan:
+            continue
+        if a_nan or b_nan:
+            return 1 if a_nan else -1
+        if a != b:
+            return -1 if a < b else 1
+    return 0

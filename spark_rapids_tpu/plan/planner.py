@@ -40,6 +40,9 @@ def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
     if isinstance(plan, L.Union):
         return P.CpuUnionExec([plan_physical(c) for c in plan.children],
                               plan.schema)
+    if isinstance(plan, L.WindowOp):
+        return P.CpuWindowExec(plan_physical(plan.children[0]),
+                               plan.window_exprs, plan.schema)
     if isinstance(plan, L.Expand):
         return P.CpuExpandExec(plan_physical(plan.children[0]),
                                plan.projections, plan.schema)

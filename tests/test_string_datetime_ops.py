@@ -10,6 +10,7 @@ from spark_rapids_tpu.ops import strings as S
 from spark_rapids_tpu.ops.expression import col, lit
 
 from datagen import DateGen, IntGen, StringGen, TimestampGen, gen_batch
+from harness import assert_tpu_and_cpu_are_equal
 from test_expressions import assert_expr_equal
 
 
@@ -97,3 +98,14 @@ class TestBitwise:
         assert_expr_equal(B.ShiftRight(col("al"), col("sh")), hb)
         assert_expr_equal(B.ShiftRightUnsigned(col("a"), col("sh")), hb)
         assert_expr_equal(B.ShiftRightUnsigned(col("al"), col("sh")), hb)
+
+
+def test_substring_non_literal_pos_falls_back_correctly():
+    # Non-literal pos/len is tagged off the device; the host fallback must
+    # actually evaluate per-row pos (regression: it used to assume literals).
+    from spark_rapids_tpu.ops.strings import Substring
+    data = {"s": ["hello", "world", None, "spark"], "p": [1, 2, 3, None]}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(data).with_column(
+            "x", Substring(col("s"), col("p"), lit(2))),
+        allowed_non_tpu=["CpuProjectExec"])

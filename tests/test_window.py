@@ -1,0 +1,202 @@
+"""Differential window-function tests (WindowFunctionSuite /
+window_function_test.py analog)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.ops import aggregates as AGG
+from spark_rapids_tpu.ops.expression import col
+from spark_rapids_tpu.ops.windows import (CURRENT_ROW, UNBOUNDED_FOLLOWING,
+                                          UNBOUNDED_PRECEDING, DenseRank,
+                                          Rank, RowNumber, Window, over)
+from spark_rapids_tpu.plan.logical import SortOrder
+
+from harness import assert_tpu_and_cpu_are_equal
+
+
+def _data(n=200, nulls=True, seed=0):
+    rng = np.random.default_rng(seed)
+    k = rng.integers(0, 8, n).astype(np.int64).tolist()
+    v = rng.integers(-100, 100, n).astype(np.int64).tolist()
+    t = rng.integers(0, 50, n).astype(np.int64).tolist()
+    if nulls:
+        v = [None if rng.random() < 0.15 else x for x in v]
+        k = [None if rng.random() < 0.1 else x for x in k]
+    return {"k": k, "v": v, "t": t}
+
+
+def _df(session, data):
+    return session.create_dataframe(data)
+
+
+def test_row_number():
+    data = _data()
+    w = Window.partition_by("k").order_by("t", "v")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("rn", RowNumber().over(w)))
+
+
+def test_rank_dense_rank():
+    data = _data()
+    w = Window.partition_by("k").order_by("t")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_windows(
+            rnk=Rank().over(w), drnk=DenseRank().over(w)))
+
+
+def test_running_sum_default_frame():
+    # Default frame with order-by: RANGE UNBOUNDED PRECEDING..CURRENT ROW.
+    data = _data()
+    w = Window.partition_by("k").order_by("t")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("rsum", over(AGG.Sum(col("v")), w)))
+
+
+def test_whole_partition_agg():
+    data = _data()
+    w = Window.partition_by("k")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_windows(
+            total=over(AGG.Sum(col("v")), w),
+            mn=over(AGG.Min(col("v")), w),
+            mx=over(AGG.Max(col("v")), w),
+            cnt=over(AGG.Count(col("v")), w),
+            cnt_star=over(AGG.Count(), w)))
+
+
+@pytest.mark.parametrize("lo,hi", [(-2, 2), (-5, 0), (0, 3),
+                                   (UNBOUNDED_PRECEDING, CURRENT_ROW),
+                                   (CURRENT_ROW, UNBOUNDED_FOLLOWING),
+                                   (-1, UNBOUNDED_FOLLOWING),
+                                   (UNBOUNDED_PRECEDING, 2)])
+def test_rows_frames(lo, hi):
+    data = _data()
+    w = Window.partition_by("k").order_by("t", "v").rows_between(lo, hi)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_windows(
+            s_=over(AGG.Sum(col("v")), w),
+            mn=over(AGG.Min(col("v")), w),
+            mx=over(AGG.Max(col("v")), w),
+            c=over(AGG.Count(col("v")), w)))
+
+
+def test_rows_frame_desc_order():
+    data = _data()
+    w = Window.partition_by("k") \
+        .order_by(SortOrder(col("t"), ascending=False)) \
+        .rows_between(-3, 1)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("x", over(AGG.Sum(col("v")), w)))
+
+
+@pytest.mark.parametrize("lo,hi", [(-5, 5), (-10, 0), (0, 10),
+                                   (UNBOUNDED_PRECEDING, 3),
+                                   (-3, UNBOUNDED_FOLLOWING),
+                                   (CURRENT_ROW, 4)])
+def test_range_frames(lo, hi):
+    data = _data()
+    w = Window.partition_by("k").order_by("t").range_between(lo, hi)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_windows(
+            s_=over(AGG.Sum(col("v")), w),
+            mn=over(AGG.Min(col("v")), w),
+            c=over(AGG.Count(col("v")), w)))
+
+
+def test_range_frame_desc():
+    data = _data()
+    w = Window.partition_by("k") \
+        .order_by(SortOrder(col("t"), ascending=False)) \
+        .range_between(-4, 4)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("x", over(AGG.Sum(col("v")), w)))
+
+
+def test_range_current_row_peers():
+    # Peers (equal order values) must aggregate together in RANGE frames.
+    data = {"k": [1, 1, 1, 1, 2, 2], "t": [1, 1, 2, 2, 1, 1],
+            "v": [10, 20, 30, 40, 5, 6]}
+    w = Window.partition_by("k").order_by("t") \
+        .range_between(UNBOUNDED_PRECEDING, CURRENT_ROW)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("x", over(AGG.Sum(col("v")), w)),
+        ignore_order=False)
+
+
+def test_range_frame_nulls_in_order_key():
+    data = {"k": [1] * 6, "t": [None, None, 1, 2, 2, 5],
+            "v": [1, 2, 3, 4, 5, 6]}
+    w = Window.partition_by("k").order_by("t").range_between(-1, 1)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("x", over(AGG.Sum(col("v")), w)),
+        ignore_order=False)
+
+
+def test_avg_window():
+    data = _data(nulls=False)
+    w = Window.partition_by("k").order_by("t").rows_between(-3, 3)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("a", over(AGG.Average(col("v")), w)),
+        approx=1e-12)
+
+
+def test_no_partition_by():
+    data = _data(n=60)
+    w = Window().order_by("t", "v")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("rn", RowNumber().over(w)))
+
+
+def test_string_partition_keys():
+    rng = np.random.default_rng(3)
+    names = ["alpha", "beta", "gamma", None, "delta"]
+    data = {"g": [names[i] for i in rng.integers(0, 5, 100)],
+            "v": rng.integers(0, 50, 100).astype(np.int64).tolist(),
+            "t": rng.integers(0, 20, 100).astype(np.int64).tolist()}
+    w = Window.partition_by("g").order_by("t", "v")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("rn", RowNumber().over(w)))
+
+
+def test_window_float_sum_falls_back_without_conf():
+    from spark_rapids_tpu.plan.overrides import FallbackOnTpuError
+    data = {"k": [1, 1, 2], "v": [1.5, 2.5, 3.5], "t": [1, 2, 3]}
+    w = Window.partition_by("k")
+    with pytest.raises(FallbackOnTpuError):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s, data).with_column("x", over(AGG.Sum(col("v")), w)))
+    # and runs with the conf on
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("x", over(AGG.Sum(col("v")), w)),
+        conf={"spark.rapids.sql.variableFloatAgg.enabled": True},
+        approx=1e-9)
+
+
+def test_nan_min_max_window():
+    # NaN ranks greatest in Spark's float total order: Min skips it unless
+    # the frame is all-NaN; Max returns it.
+    data = {"k": [1, 1, 1, 2, 2], "v": [5.0, float("nan"), 1.0,
+                                        float("nan"), float("nan")]}
+    w = Window.partition_by("k")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_windows(
+            mn=over(AGG.Min(col("v")), w),
+            mx=over(AGG.Max(col("v")), w)))
+
+
+def test_nan_partition_keys():
+    # NaN partition keys must group together (FloatUtils-style canonical
+    # equality), not split into singleton segments.
+    data = {"k": [1.0, float("nan"), float("nan"), 2.0, -0.0, 0.0],
+            "t": [1, 1, 2, 1, 1, 2], "v": [1, 2, 3, 4, 5, 6]}
+    w = Window.partition_by("k").order_by("t")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("rn", RowNumber().over(w)))
+
+
+def test_nan_order_key_peers():
+    data = {"k": [1] * 4, "t": [float("nan"), float("nan"), 1.0, 2.0],
+            "v": [1, 2, 3, 4]}
+    w = Window.partition_by("k").order_by("t")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).with_column("x", over(AGG.Count(col("v")), w)))
