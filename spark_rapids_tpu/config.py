@@ -173,6 +173,16 @@ MEMORY_DEBUG = conf_bool(
     "Log every device allocation/free for leak hunting "
     "(reference spark.rapids.memory.gpu.debug).")
 
+SPILL_DIR = conf_str(
+    "spark.rapids.memory.tpu.spillDir", None,
+    "Directory for the disk spill tier; defaults to a fresh temp directory "
+    "(reference uses Spark's disk block manager directories).")
+
+DEVICE_SPILL_BUDGET = conf_int(
+    "spark.rapids.memory.tpu.spillBudgetBytes", 0,
+    "Explicit device-store byte budget for spillable buffers; 0 derives it "
+    "from allocFraction of detected HBM (test hook for forcing spills).")
+
 # ---------------------------------------------------------------------------
 # Shuffle (reference RapidsConf.scala:522-618)
 # ---------------------------------------------------------------------------

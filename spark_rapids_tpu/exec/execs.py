@@ -50,6 +50,11 @@ def _bind_all(exprs: List[Expression], schema: T.Schema) -> List[Expression]:
 class TpuExec(PhysicalPlan):
     columnar = True
 
+    #: Per-child coalesce goal ("single" | "target" | None), consumed by
+    #: exec.coalesce.insert_coalesce (CoalesceGoal declaration analog,
+    #: reference GpuExec.childrenCoalesceGoal).
+    children_coalesce_goals = None
+
     def describe(self):
         return self.node_name()
 
@@ -319,6 +324,8 @@ class TpuSortExec(TpuExec):
     """Global sort requires a single batch (RequireSingleBatch, reference
     GpuSortExec.scala:54): coalesce all partitions then one device sort."""
 
+    children_coalesce_goals = ["single"]
+
     def __init__(self, child: PhysicalPlan, orders: List[SortOrder]):
         self.children = [child]
         self.orders = orders
@@ -368,6 +375,8 @@ class TpuHashAggregateExec(TpuExec):
     """Partial-per-batch aggregation with a device merge loop, mirroring the
     reference's concat + re-aggregate accumulation (aggregate.scala:330-400),
     then a final buffer-evaluation projection."""
+
+    children_coalesce_goals = ["target"]
 
     def __init__(self, child: PhysicalPlan, groupings: List[Expression],
                  aggregates: List[AGG.AggregateExpression]):

@@ -32,6 +32,8 @@ from .execs import TpuExec, _coalesce_device
 
 
 class TpuWindowExec(TpuExec):
+    children_coalesce_goals = ["single"]
+
     def __init__(self, child: PhysicalPlan,
                  window_exprs: List[Tuple[str, W.WindowExpression]],
                  schema: T.Schema):

@@ -17,12 +17,14 @@ from typing import Optional
 import jax
 
 from ..config import (CONCURRENT_TPU_TASKS, DEVICE_BACKEND,
-                      HBM_ALLOC_FRACTION, MEMORY_DEBUG, TpuConf)
+                      DEVICE_SPILL_BUDGET, HBM_ALLOC_FRACTION,
+                      HOST_SPILL_STORAGE_SIZE, MEMORY_DEBUG, SPILL_DIR,
+                      TpuConf)
 from .semaphore import TpuSemaphore
 
 
 class DeviceManager:
-    _instance: Optional["DeviceManager"] = None
+    _instances: dict = {}
     _lock = threading.Lock()
 
     def __init__(self, conf: TpuConf):
@@ -40,18 +42,36 @@ class DeviceManager:
             total = 16 << 30
         self.hbm_budget_bytes = int(total * frac)
         self.semaphore = TpuSemaphore(conf.get(CONCURRENT_TPU_TASKS))
+        # Spill catalog: the GpuShuffleEnv.initStorage chain
+        # (device -> host -> disk, GpuShuffleEnv.scala:52-69).
+        from .spill import BufferCatalog
+        explicit = conf.get(DEVICE_SPILL_BUDGET)
+        self.catalog = BufferCatalog(
+            explicit if explicit > 0 else self.hbm_budget_bytes,
+            conf.get(HOST_SPILL_STORAGE_SIZE),
+            conf.get(SPILL_DIR))
 
     @classmethod
     def get_or_create(cls, conf: TpuConf) -> "DeviceManager":
+        # One manager per distinct device/memory configuration: sessions that
+        # override spill budgets or directories (test hooks) must not silently
+        # inherit the first session's catalog.
+        key = (conf.get(DEVICE_BACKEND), conf.get(HBM_ALLOC_FRACTION),
+               conf.get(DEVICE_SPILL_BUDGET),
+               conf.get(HOST_SPILL_STORAGE_SIZE), conf.get(SPILL_DIR),
+               conf.get(CONCURRENT_TPU_TASKS))
         with cls._lock:
-            if cls._instance is None:
-                cls._instance = DeviceManager(conf)
-            return cls._instance
+            inst = cls._instances.get(key)
+            if inst is None:
+                inst = cls._instances[key] = DeviceManager(conf)
+            return inst
 
     @classmethod
     def reset(cls):
         with cls._lock:
-            cls._instance = None
+            for inst in cls._instances.values():
+                inst.catalog.close()
+            cls._instances.clear()
 
     def memory_in_use(self) -> int:
         try:

@@ -1,0 +1,317 @@
+"""Spillable buffer framework — device -> host -> disk tiers.
+
+Architectural port of the reference's spill subsystem (SURVEY.md §2.1):
+``RapidsBufferCatalog`` (RapidsBufferCatalog.scala:30) maps buffer ids to
+tiered buffers; ``RapidsBufferStore`` (RapidsBufferStore.scala:40) owns one
+tier and spills to the next via ``synchronousSpill:137-149`` in
+spill-priority order (SpillPriorities.scala:26); the device store's pressure
+callback is ``DeviceMemoryEventHandler.onAllocFailure:35-59``.
+
+TPU-native differences: XLA owns the HBM allocator and exposes no
+alloc-failure callback, so the device store enforces a *byte budget*
+(fraction of HBM, GpuDeviceManager-style) and spills synchronously when a
+registration would exceed it — pressure is handled before allocation rather
+than on allocation failure. Host interchange is Arrow IPC (the reference
+uses JCudfSerialization host buffers); the disk tier appends IPC-serialized
+batches to a shared spill file, like the reference's disk block manager
+files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import io
+import os
+import tempfile
+import threading
+from typing import Dict, Optional, Tuple
+
+import pyarrow as pa
+
+from .. import types as T
+from ..data.batch import ColumnarBatch
+from ..utils.tracing import trace_range
+
+
+# ---------------------------------------------------------------------------
+# Spill priorities (SpillPriorities.scala:26): LOWER values spill FIRST.
+# ---------------------------------------------------------------------------
+
+#: Shuffle outputs spill before anything else: they are re-fetchable and
+#: typically long-lived.
+OUTPUT_FOR_SHUFFLE_PRIORITY = -10_000_000
+#: Buffers parked by operators between batches (coalesce accumulation).
+ACTIVE_BATCHING_PRIORITY = 0
+#: Buffers an operator is actively using; spill only under extreme pressure.
+ACTIVE_ON_DECK_PRIORITY = 10_000_000
+
+
+class StorageTier:
+    DEVICE = "device"
+    HOST = "host"
+    DISK = "disk"
+
+
+@dataclasses.dataclass
+class TableMeta:
+    """What's needed to faithfully restore a batch on device (the flatbuffer
+    TableMeta analog, MetaUtils.scala:41)."""
+
+    schema: T.Schema
+    capacity: int
+    size_bytes: int
+
+
+@dataclasses.dataclass
+class _Entry:
+    buffer_id: int
+    priority: int
+    meta: TableMeta
+    tier: str
+    device_batch: Optional[ColumnarBatch] = None
+    host_batch: Optional[pa.RecordBatch] = None
+    disk_range: Optional[Tuple[int, int]] = None  # (offset, length)
+    freed: bool = False
+
+
+class SpillFile:
+    """Append-only shared spill file (RapidsDiskStore's block-manager file)."""
+
+    def __init__(self, spill_dir: Optional[str] = None):
+        self._owns_dir = spill_dir is None
+        self.dir = spill_dir or tempfile.mkdtemp(prefix="tpu_spill_")
+        os.makedirs(self.dir, exist_ok=True)
+        # Unique per catalog so concurrent catalogs (or a reused spillDir
+        # from a previous process) never interleave offsets.
+        fd, self.path = tempfile.mkstemp(prefix="spill_", suffix=".bin",
+                                         dir=self.dir)
+        os.close(fd)
+        self._offset = 0
+        self._lock = threading.Lock()
+
+    def close(self):
+        import shutil
+        try:
+            os.remove(self.path)
+        except OSError:
+            pass
+        if self._owns_dir:
+            shutil.rmtree(self.dir, ignore_errors=True)
+
+    def append(self, payload: bytes) -> Tuple[int, int]:
+        with self._lock:
+            offset = self._offset
+            with open(self.path, "ab") as f:
+                f.write(payload)
+            self._offset += len(payload)
+            return offset, len(payload)
+
+    def read(self, offset: int, length: int) -> bytes:
+        with open(self.path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+
+def _ipc_serialize(rb: pa.RecordBatch) -> bytes:
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    return sink.getvalue()
+
+
+def _ipc_deserialize(payload: bytes) -> pa.RecordBatch:
+    with pa.ipc.open_stream(io.BytesIO(payload)) as r:
+        return next(iter(r))
+
+
+class BufferCatalog:
+    """id -> tiered buffer, with budget-driven synchronous spill.
+
+    The three tiers live inside one catalog (the reference splits catalog and
+    three store objects; the chain wiring is identical —
+    GpuShuffleEnv.initStorage, GpuShuffleEnv.scala:52-69)."""
+
+    def __init__(self, device_budget_bytes: int,
+                 host_budget_bytes: int,
+                 spill_dir: Optional[str] = None):
+        self.device_budget = device_budget_bytes
+        self.host_budget = host_budget_bytes
+        self._entries: Dict[int, _Entry] = {}
+        self._device_heap = []  # (priority, buffer_id)
+        self._host_heap = []
+        self.device_bytes = 0
+        self.host_bytes = 0
+        self._next_id = 0
+        self._lock = threading.RLock()
+        self._spill_dir = spill_dir
+        self._spill_file: Optional[SpillFile] = None  # lazy: first disk spill
+        self._pinned: set = set()
+        self.metrics = {"spilled_to_host": 0, "spilled_to_disk": 0,
+                        "reloaded_from_host": 0, "reloaded_from_disk": 0}
+
+    def _disk(self) -> SpillFile:
+        if self._spill_file is None:
+            self._spill_file = SpillFile(self._spill_dir)
+        return self._spill_file
+
+    # -- registration -------------------------------------------------------
+    def register_batch(self, batch: ColumnarBatch,
+                       priority: int = ACTIVE_BATCHING_PRIORITY) -> int:
+        """Track a device batch as spillable; may synchronously spill lower-
+        priority buffers to stay within the device budget."""
+        size = batch.device_size_bytes
+        meta = TableMeta(batch.schema, batch.capacity, size)
+        with self._lock:
+            bid = self._next_id
+            self._next_id += 1
+            entry = _Entry(bid, priority, meta, StorageTier.DEVICE,
+                           device_batch=batch)
+            self._entries[bid] = entry
+            self.device_bytes += size
+            heapq.heappush(self._device_heap, (priority, bid))
+            self._ensure_device_budget()
+            return bid
+
+    # -- access -------------------------------------------------------------
+    def acquire_batch(self, buffer_id: int) -> ColumnarBatch:
+        """Return the batch on device, unspilling through the tiers if needed
+        (RapidsBufferStore.getDeviceMemoryBuffer's tier climb)."""
+        with self._lock:
+            entry = self._entries[buffer_id]
+            assert not entry.freed, f"buffer {buffer_id} already freed"
+            if entry.tier == StorageTier.DEVICE:
+                return entry.device_batch
+            if entry.tier == StorageTier.DISK:
+                payload = self._disk().read(*entry.disk_range)
+                entry.host_batch = _ipc_deserialize(payload)
+                entry.disk_range = None
+                entry.tier = StorageTier.HOST
+                self.host_bytes += entry.meta.size_bytes
+                heapq.heappush(self._host_heap, (entry.priority, buffer_id))
+                self.metrics["reloaded_from_disk"] += 1
+            # HOST -> DEVICE
+            with trace_range("spill.reload_to_device"):
+                batch = ColumnarBatch.from_arrow(entry.host_batch,
+                                                 capacity=entry.meta.capacity)
+            self._remove_host(entry)
+            entry.device_batch = batch
+            entry.tier = StorageTier.DEVICE
+            self.device_bytes += entry.meta.size_bytes
+            heapq.heappush(self._device_heap, (entry.priority, buffer_id))
+            self.metrics["reloaded_from_host"] += 1
+            self._ensure_device_budget(exclude=buffer_id)
+            return batch
+
+    def tier_of(self, buffer_id: int) -> str:
+        with self._lock:
+            return self._entries[buffer_id].tier
+
+    def free(self, buffer_id: int):
+        with self._lock:
+            entry = self._entries.pop(buffer_id, None)
+            self._pinned.discard(buffer_id)
+            if entry is None or entry.freed:
+                return
+            entry.freed = True
+            if entry.tier == StorageTier.DEVICE:
+                self.device_bytes -= entry.meta.size_bytes
+                entry.device_batch = None
+            elif entry.tier == StorageTier.HOST:
+                self.host_bytes -= entry.meta.size_bytes
+                entry.host_batch = None
+            # disk bytes leak into the shared file until catalog close, the
+            # same policy as the reference's shuffle spill files.
+
+    def pin(self, buffer_id: int):
+        """Exclude a buffer from spilling while an operator actively uses it
+        (the reference's on-deck priority bump)."""
+        with self._lock:
+            self._pinned.add(buffer_id)
+
+    def unpin(self, buffer_id: int):
+        with self._lock:
+            self._pinned.discard(buffer_id)
+
+    def close(self):
+        with self._lock:
+            self._entries.clear()
+            self._device_heap.clear()
+            self._host_heap.clear()
+            self._pinned.clear()
+            if self._spill_file is not None:
+                self._spill_file.close()
+                self._spill_file = None
+
+    # -- spilling -----------------------------------------------------------
+    def synchronous_spill(self, target_device_bytes: int):
+        """Spill device buffers (lowest priority first) until usage <= target
+        (RapidsBufferStore.synchronousSpill:137-149)."""
+        with self._lock:
+            while self.device_bytes > target_device_bytes:
+                entry = self._pop_spillable(self._device_heap,
+                                            StorageTier.DEVICE)
+                if entry is None:
+                    break  # nothing spillable
+                self._spill_device_entry(entry)
+
+    def _ensure_device_budget(self, exclude: Optional[int] = None):
+        while self.device_bytes > self.device_budget:
+            entry = self._pop_spillable(self._device_heap, StorageTier.DEVICE,
+                                        exclude=exclude)
+            if entry is None:
+                break
+            self._spill_device_entry(entry)
+        while self.host_bytes > self.host_budget:
+            entry = self._pop_spillable(self._host_heap, StorageTier.HOST)
+            if entry is None:
+                break
+            self._spill_host_entry(entry)
+
+    def _pop_spillable(self, heap, tier: str,
+                       exclude: Optional[int] = None) -> Optional[_Entry]:
+        """Pop the lowest-priority live entry still on ``tier``; stale heap
+        records (moved/freed buffers) are discarded lazily."""
+        skipped = []
+        found = None
+        while heap:
+            priority, bid = heapq.heappop(heap)
+            entry = self._entries.get(bid)
+            if entry is None or entry.freed or entry.tier != tier:
+                continue  # stale record
+            if bid == exclude or bid in self._pinned:
+                skipped.append((priority, bid))
+                continue
+            found = entry
+            break
+        for item in skipped:
+            heapq.heappush(heap, item)
+        return found
+
+    def _spill_device_entry(self, entry: _Entry):
+        with trace_range("spill.device_to_host"):
+            entry.host_batch = entry.device_batch.to_arrow()
+        entry.device_batch = None
+        entry.tier = StorageTier.HOST
+        self.device_bytes -= entry.meta.size_bytes
+        self.host_bytes += entry.meta.size_bytes
+        heapq.heappush(self._host_heap, (entry.priority, entry.buffer_id))
+        self.metrics["spilled_to_host"] += 1
+        while self.host_bytes > self.host_budget:
+            victim = self._pop_spillable(self._host_heap, StorageTier.HOST)
+            if victim is None:
+                break
+            self._spill_host_entry(victim)
+
+    def _spill_host_entry(self, entry: _Entry):
+        with trace_range("spill.host_to_disk"):
+            payload = _ipc_serialize(entry.host_batch)
+            entry.disk_range = self._disk().append(payload)
+        entry.host_batch = None
+        entry.tier = StorageTier.DISK
+        self.host_bytes -= entry.meta.size_bytes
+        self.metrics["spilled_to_disk"] += 1
+
+    def _remove_host(self, entry: _Entry):
+        entry.host_batch = None
+        self.host_bytes -= entry.meta.size_bytes

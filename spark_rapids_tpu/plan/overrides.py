@@ -393,7 +393,9 @@ class TpuOverrides:
             if self.last_explain:
                 print(self.last_explain, end="")
         converted = meta.convert(self.conf)
-        converted = insert_transitions(converted)
+        converted = insert_transitions(converted, self.conf.batch_size_rows)
+        from ..exec.coalesce import insert_coalesce
+        converted = insert_coalesce(converted, self.conf.batch_size_rows)
         if self.conf.test_enabled:
             self._assert_on_tpu(converted)
         return converted
@@ -422,7 +424,8 @@ class TpuOverrides:
                 f"ops fell back to CPU: {bad}; allowed={sorted(allowed)}")
 
 
-def insert_transitions(plan: P.PhysicalPlan) -> P.PhysicalPlan:
+def insert_transitions(plan: P.PhysicalPlan,
+                       goal_rows: int = 1 << 20) -> P.PhysicalPlan:
     """Insert HostToDevice/DeviceToHost where columnar-ness flips, and make
     the root host-side (GpuTransitionOverrides analog)."""
 
@@ -430,7 +433,7 @@ def insert_transitions(plan: P.PhysicalPlan) -> P.PhysicalPlan:
         new_children = []
         for c in fixed_children(node):
             if node.columnar and not c.columnar:
-                c = E.HostToDeviceExec(c)
+                c = E.HostToDeviceExec(c, goal_rows)
             elif not node.columnar and c.columnar:
                 c = E.DeviceToHostExec(c)
             new_children.append(c)

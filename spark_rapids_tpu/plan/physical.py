@@ -32,6 +32,8 @@ from .logical import SortOrder
 class ExecContext:
     conf: TpuConf
     metrics: dict = dataclasses.field(default_factory=dict)
+    #: spill BufferCatalog (memory/spill.py); None in bare unit tests
+    catalog: object = None
 
     def metric(self, node: str, name: str, value):
         self.metrics.setdefault(node, {})

@@ -101,7 +101,7 @@ class TpuSession:
 
     def execute(self, logical: L.LogicalPlan) -> pa.Table:
         physical = self.plan(logical)
-        ctx = P.ExecContext(self.conf)
+        ctx = P.ExecContext(self.conf, catalog=self.device_manager.catalog)
         return P.collect_partitions(physical, ctx)
 
     def explain(self, logical: L.LogicalPlan) -> str:

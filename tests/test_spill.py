@@ -1,0 +1,242 @@
+"""Spill framework + coalesce tests (RapidsDeviceMemoryStoreSuite /
+RapidsDiskStoreSuite / GpuCoalesceBatchesSuite analogs)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.data.batch import ColumnarBatch, HostBatch
+from spark_rapids_tpu.memory import spill as SP
+from spark_rapids_tpu.plan.physical import ExecContext
+
+from harness import assert_tpu_and_cpu_are_equal, cpu_session, tpu_session
+
+
+def _batch(n=100, seed=0, with_strings=True):
+    rng = np.random.default_rng(seed)
+    data = {
+        "a": [None if rng.random() < 0.2 else int(x)
+              for x in rng.integers(-1000, 1000, n)],
+        "b": rng.random(n).tolist(),
+    }
+    if with_strings:
+        words = ["alpha", "beta", None, "gamma", "delta-delta"]
+        data["s"] = [words[i] for i in rng.integers(0, 5, n)]
+    return HostBatch.from_pydict(data).to_device()
+
+
+def _assert_same(b1: ColumnarBatch, b2: ColumnarBatch):
+    t1, t2 = b1.to_arrow(), b2.to_arrow()
+    assert t1.equals(t2), f"{t1.to_pydict()} != {t2.to_pydict()}"
+
+
+class TestBufferCatalog:
+    def test_register_and_acquire_on_device(self):
+        cat = SP.BufferCatalog(1 << 30, 1 << 30)
+        b = _batch()
+        bid = cat.register_batch(b)
+        assert cat.tier_of(bid) == SP.StorageTier.DEVICE
+        assert cat.acquire_batch(bid) is b
+        cat.free(bid)
+        assert cat.device_bytes == 0
+
+    def test_budget_forces_spill_to_host(self):
+        b = _batch()
+        size = b.device_size_bytes
+        # Budget fits one batch only.
+        cat = SP.BufferCatalog(int(size * 1.5), 1 << 30)
+        bid1 = cat.register_batch(b)
+        bid2 = cat.register_batch(_batch(seed=1))
+        assert cat.tier_of(bid1) == SP.StorageTier.HOST
+        assert cat.tier_of(bid2) == SP.StorageTier.DEVICE
+        assert cat.metrics["spilled_to_host"] == 1
+        # Reload round-trips bit-exactly (incl. strings + nulls).
+        _assert_same(cat.acquire_batch(bid1), _batch())
+        assert cat.tier_of(bid1) == SP.StorageTier.DEVICE
+
+    def test_spill_chain_to_disk(self):
+        b = _batch()
+        size = b.device_size_bytes
+        cat = SP.BufferCatalog(int(size * 1.5), 1)  # host tier holds nothing
+        bid1 = cat.register_batch(b)
+        cat.register_batch(_batch(seed=1))
+        assert cat.tier_of(bid1) == SP.StorageTier.DISK
+        assert cat.metrics["spilled_to_disk"] == 1
+        _assert_same(cat.acquire_batch(bid1), _batch())
+        assert cat.tier_of(bid1) == SP.StorageTier.DEVICE
+        cat.close()
+
+    def test_spill_priority_order(self):
+        b = _batch()
+        size = b.device_size_bytes
+        cat = SP.BufferCatalog(int(size * 2.5), 1 << 30)
+        shuffle_id = cat.register_batch(b, SP.OUTPUT_FOR_SHUFFLE_PRIORITY)
+        active_id = cat.register_batch(_batch(seed=1),
+                                       SP.ACTIVE_ON_DECK_PRIORITY)
+        # Third registration exceeds budget: the shuffle buffer must go first.
+        cat.register_batch(_batch(seed=2), SP.ACTIVE_BATCHING_PRIORITY)
+        assert cat.tier_of(shuffle_id) == SP.StorageTier.HOST
+        assert cat.tier_of(active_id) == SP.StorageTier.DEVICE
+
+    def test_synchronous_spill_to_zero(self):
+        cat = SP.BufferCatalog(1 << 30, 1 << 30)
+        ids = [cat.register_batch(_batch(seed=i)) for i in range(4)]
+        cat.synchronous_spill(0)
+        assert cat.device_bytes == 0
+        for bid in ids:
+            assert cat.tier_of(bid) == SP.StorageTier.HOST
+        for i, bid in enumerate(ids):
+            _assert_same(cat.acquire_batch(bid), _batch(seed=i))
+
+    def test_free_spilled_buffer(self):
+        b = _batch()
+        cat = SP.BufferCatalog(1 << 30, 1 << 30)
+        bid = cat.register_batch(b)
+        cat.synchronous_spill(0)
+        cat.free(bid)
+        assert cat.host_bytes == 0
+        with pytest.raises(KeyError):
+            cat.acquire_batch(bid)
+
+
+class TestCoalesce:
+    def _run_coalesce(self, goal, batches, catalog=None):
+        from spark_rapids_tpu.exec.coalesce import TpuCoalesceBatchesExec
+        from spark_rapids_tpu.plan.physical import PhysicalPlan
+
+        class Src(PhysicalPlan):
+            columnar = True
+            children = ()
+
+            @property
+            def schema(self):
+                return batches[0].schema
+
+            def execute(self, ctx):
+                return [iter(batches)]
+
+        exec_ = TpuCoalesceBatchesExec(Src(), goal)
+        ctx = ExecContext(TpuConf(), catalog=catalog)
+        return [b for part in exec_.execute(ctx) for b in part]
+
+    def test_target_size_merges(self):
+        from spark_rapids_tpu.exec.coalesce import TargetSize
+        batches = [_batch(n=100, seed=i, with_strings=False)
+                   for i in range(6)]
+        out = self._run_coalesce(TargetSize(250), batches)
+        assert len(out) == 2  # 300 + 300 rows
+        assert int(out[0].n_rows) == 300
+
+    def test_require_single_batch(self):
+        from spark_rapids_tpu.exec.coalesce import RequireSingleBatch
+        batches = [_batch(n=50, seed=i) for i in range(5)]
+        out = self._run_coalesce(RequireSingleBatch(), batches)
+        assert len(out) == 1
+        assert int(out[0].n_rows) == 250
+
+    def test_coalesce_with_spilling_catalog(self):
+        # Accumulating batches spill under a tiny budget and come back for
+        # the concat — the pipeline survives memory pressure.
+        from spark_rapids_tpu.exec.coalesce import RequireSingleBatch
+        batches = [_batch(n=100, seed=i) for i in range(4)]
+        size = batches[0].device_size_bytes
+        cat = SP.BufferCatalog(int(size * 1.5), 1 << 30)
+        out = self._run_coalesce(RequireSingleBatch(), batches, catalog=cat)
+        assert len(out) == 1
+        assert int(out[0].n_rows) == 400
+        assert cat.metrics["spilled_to_host"] > 0
+        # Everything freed after flush.
+        assert not cat._entries
+
+    def test_content_preserved_through_spill(self):
+        from spark_rapids_tpu.exec.coalesce import RequireSingleBatch
+        batches = [_batch(n=60, seed=i) for i in range(3)]
+        expected = pa.Table.from_batches(
+            [b.to_arrow() for b in batches]).combine_chunks()
+        size = batches[0].device_size_bytes
+        cat = SP.BufferCatalog(int(size * 1.5), 1 << 30)
+        out = self._run_coalesce(RequireSingleBatch(), batches, catalog=cat)
+        got = pa.Table.from_batches([out[0].to_arrow()])
+        assert got.equals(expected)
+
+
+class TestPlanInsertion:
+    def test_agg_gets_target_coalesce_over_filter(self):
+        # A filter shrinks batches, so the aggregate's target goal inserts a
+        # coalesce above it...
+        s = tpu_session()
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops import predicates as P_
+        from spark_rapids_tpu.ops.expression import col, lit
+        df = s.create_dataframe({"k": [1, 2, 1], "v": [1, 2, 3]})
+        plan = s.plan(df.where(P_.LessThan(col("v"), lit(3)))
+                      .group_by(col("k")).agg(
+            AGG.AggregateExpression(AGG.Sum(col("v")), "s"))._plan)
+        assert "TpuCoalesceBatches" in plan.tree_string()
+
+    def test_no_redundant_coalesce_over_upload(self):
+        # ...but HostToDeviceExec already batches to the target, so an
+        # aggregate directly over an upload gets no extra coalesce node.
+        s = tpu_session()
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+        df = s.create_dataframe({"k": [1, 2, 1], "v": [1, 2, 3]})
+        plan = s.plan(df.group_by(col("k")).agg(
+            AGG.AggregateExpression(AGG.Sum(col("v")), "s"))._plan)
+        assert "TpuCoalesceBatches" not in plan.tree_string()
+
+    def test_sort_gets_single_batch_goal(self):
+        s = tpu_session()
+        df = s.create_dataframe({"v": [3, 1, 2]})
+        plan = s.plan(df.sort("v")._plan)
+        text = plan.tree_string()
+        assert "RequireSingleBatch" in text
+
+    def test_queries_still_differential(self):
+        # End-to-end: coalesce inserted + tiny target still bit-exact.
+        data = {"k": [i % 7 for i in range(500)],
+                "v": list(range(500))}
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(data).group_by(col("k")).agg(
+                AGG.AggregateExpression(AGG.Sum(col("v")), "s"),
+                AGG.AggregateExpression(AGG.Count(), "c")),
+            conf={"spark.rapids.sql.batchSizeRows": 100})
+
+
+class TestLifecycle:
+    def test_pinned_buffers_resist_spill(self):
+        b = _batch()
+        cat = SP.BufferCatalog(1 << 30, 1 << 30)
+        bid = cat.register_batch(b)
+        cat.pin(bid)
+        cat.synchronous_spill(0)
+        assert cat.tier_of(bid) == SP.StorageTier.DEVICE
+        cat.unpin(bid)
+        cat.synchronous_spill(0)
+        assert cat.tier_of(bid) == SP.StorageTier.HOST
+
+    def test_shared_spill_dir_no_cross_corruption(self, tmp_path):
+        # Two catalogs (or a reused dir from a prior run) must not interleave
+        # offsets in one file.
+        d = str(tmp_path)
+        cat1 = SP.BufferCatalog(1, 1, spill_dir=d)
+        cat2 = SP.BufferCatalog(1, 1, spill_dir=d)
+        id1 = cat1.register_batch(_batch(seed=1))
+        id2 = cat2.register_batch(_batch(seed=2))
+        assert cat1.tier_of(id1) == SP.StorageTier.DISK
+        assert cat2.tier_of(id2) == SP.StorageTier.DISK
+        _assert_same(cat1.acquire_batch(id1), _batch(seed=1))
+        _assert_same(cat2.acquire_batch(id2), _batch(seed=2))
+        cat1.close()
+        cat2.close()
+
+    def test_no_temp_dir_until_disk_spill(self):
+        cat = SP.BufferCatalog(1 << 30, 1 << 30)
+        assert cat._spill_file is None
+        cat.register_batch(_batch())
+        assert cat._spill_file is None
+        cat.close()
