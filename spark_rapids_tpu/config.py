@@ -183,6 +183,12 @@ DEVICE_SPILL_BUDGET = conf_int(
     "Explicit device-store byte budget for spillable buffers; 0 derives it "
     "from allocFraction of detected HBM (test hook for forcing spills).")
 
+AUTO_BROADCAST_JOIN_ROWS = conf_int(
+    "spark.rapids.sql.autoBroadcastJoinRows", 100_000,
+    "Equi joins whose build side is estimated at or below this many rows "
+    "plan as broadcast hash joins; -1 disables (row-count analog of "
+    "spark.sql.autoBroadcastJoinThreshold).")
+
 # ---------------------------------------------------------------------------
 # Shuffle (reference RapidsConf.scala:522-618)
 # ---------------------------------------------------------------------------

@@ -90,6 +90,99 @@ class Expression:
         args = ", ".join(str(c) for c in self.children)
         return f"{type(self).__name__}({args})"
 
+    # -- pyspark-style operator sugar ---------------------------------------
+    # __eq__/__ne__ stay identity-based on purpose: expression trees are
+    # compared as objects inside transform(); use .eq()/.ne() for the SQL
+    # predicates.
+    def _binop(self, cls_name: str, other, reverse: bool = False):
+        from . import arithmetic as _A
+        from . import predicates as _P
+        cls = getattr(_A, cls_name, None) or getattr(_P, cls_name)
+        if isinstance(other, bool):
+            # Almost always the `expr == expr` trap: __eq__ is identity-based
+            # (tree comparisons need it), so it yields a Python bool. Refuse
+            # rather than silently building an always-False condition.
+            raise TypeError(
+                "got a Python bool where an expression was expected — use "
+                ".eq()/.ne() for equality predicates (== compares expression "
+                "object identity), or lit(True/False) for a literal")
+        other = other if isinstance(other, Expression) else lit(other)
+        return cls(other, self) if reverse else cls(self, other)
+
+    def __add__(self, o):
+        return self._binop("Add", o)
+
+    def __radd__(self, o):
+        return self._binop("Add", o, True)
+
+    def __sub__(self, o):
+        return self._binop("Subtract", o)
+
+    def __rsub__(self, o):
+        return self._binop("Subtract", o, True)
+
+    def __mul__(self, o):
+        return self._binop("Multiply", o)
+
+    def __rmul__(self, o):
+        return self._binop("Multiply", o, True)
+
+    def __truediv__(self, o):
+        return self._binop("Divide", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("Divide", o, True)
+
+    def __mod__(self, o):
+        return self._binop("Remainder", o)
+
+    def __neg__(self):
+        from .arithmetic import UnaryMinus
+        return UnaryMinus(self)
+
+    def __lt__(self, o):
+        return self._binop("LessThan", o)
+
+    def __le__(self, o):
+        return self._binop("LessThanOrEqual", o)
+
+    def __gt__(self, o):
+        return self._binop("GreaterThan", o)
+
+    def __ge__(self, o):
+        return self._binop("GreaterThanOrEqual", o)
+
+    def __and__(self, o):
+        return self._binop("And", o)
+
+    def __or__(self, o):
+        return self._binop("Or", o)
+
+    def __invert__(self):
+        from .predicates import Not
+        return Not(self)
+
+    def eq(self, o):
+        return self._binop("EqualTo", o)
+
+    def ne(self, o):
+        return self._binop("NotEqual", o)
+
+    def is_null(self):
+        from .predicates import IsNull
+        return IsNull(self)
+
+    def is_not_null(self):
+        from .predicates import IsNotNull
+        return IsNotNull(self)
+
+    def alias(self, name: str) -> "Alias":
+        return Alias(self, name)
+
+    def cast(self, dtype) -> "Expression":
+        from .cast import Cast
+        return Cast(self, dtype)
+
 
 # ---------------------------------------------------------------------------
 # Leaves

@@ -223,6 +223,83 @@ class Aggregate(LogicalPlan):
                 + "], [" + ", ".join(a.name for a in self.aggregates) + "]")
 
 
+def split_join_condition(cond: Expression, lschema: T.Schema,
+                         rschema: T.Schema):
+    """Split a join condition into equi key pairs + residual predicate
+    (Catalyst's ExtractEquiJoinKeys analog): top-level AND conjuncts of the
+    form left_expr = right_expr become key pairs; everything else stays as a
+    residual condition over the concatenated output schema."""
+    conjuncts: List[Expression] = []
+
+    def flatten(e):
+        if isinstance(e, PRED.And):
+            flatten(e.children[0])
+            flatten(e.children[1])
+        else:
+            conjuncts.append(e)
+    flatten(cond)
+
+    lnames, rnames = set(lschema.names), set(rschema.names)
+    lk, rk, residual = [], [], []
+    for c in conjuncts:
+        if isinstance(c, PRED.EqualTo):
+            a, b = c.children
+            ar, br = set(a.references()), set(b.references())
+            if ar and br and ar <= lnames and br <= rnames:
+                lk.append(a)
+                rk.append(b)
+                continue
+            if ar and br and ar <= rnames and br <= lnames:
+                lk.append(b)
+                rk.append(a)
+                continue
+        residual.append(c)
+    res = None
+    for c in residual:
+        res = c if res is None else PRED.And(res, c)
+    return lk, rk, res
+
+
+def bind_join_condition(cond: Expression, lschema: T.Schema,
+                        rschema: T.Schema) -> Expression:
+    """Bind a join condition side-aware into pair ordinals (left columns
+    first, then right), refusing ambiguous duplicate names loudly instead of
+    silently resolving both sides to the left ordinal (we resolve by name,
+    not Catalyst expression ids)."""
+    from ..ops.expression import BoundReference
+    n_left = len(lschema)
+
+    def rewrite(e):
+        if isinstance(e, AttributeReference):
+            in_l = lschema.field_maybe(e._name) is not None
+            in_r = rschema.field_maybe(e._name) is not None
+            if in_l and in_r:
+                raise ValueError(
+                    f"column '{e._name}' exists on both join sides; rename "
+                    "one side before using it in a join condition")
+            if in_l:
+                i = lschema.index_of(e._name)
+                f = lschema[i]
+                return BoundReference(i, f.data_type, f.nullable)
+            if in_r:
+                i = rschema.index_of(e._name)
+                f = rschema[i]
+                return BoundReference(n_left + i, f.data_type, f.nullable)
+            raise KeyError(f"column '{e._name}' not found on either join side")
+        return None
+    return cond.transform(rewrite)
+
+
+def shift_bound_ordinals(e: Expression, offset: int) -> Expression:
+    from ..ops.expression import BoundReference
+
+    def rewrite(x):
+        if isinstance(x, BoundReference):
+            return BoundReference(x.ordinal + offset, x.data_type, x.nullable)
+        return None
+    return e.transform(rewrite)
+
+
 class Join(LogicalPlan):
     TYPES = ("inner", "left", "right", "full", "left_semi", "left_anti", "cross")
 
@@ -232,6 +309,9 @@ class Join(LogicalPlan):
                  condition: Optional[Expression] = None):
         if join_type not in self.TYPES:
             raise ValueError(f"unknown join type {join_type}")
+        if join_type == "cross" and (left_keys or right_keys):
+            raise ValueError("cross joins take no join keys "
+                             "(use how='inner' or drop the keys)")
         self.children = [left, right]
         self.join_type = join_type
         self.left_keys = [resolve(k, left.schema) for k in left_keys]
@@ -244,7 +324,11 @@ class Join(LogicalPlan):
             lk.append(l)
             rk.append(r)
         self.left_keys, self.right_keys = lk, rk
-        self.condition = condition  # residual non-equi condition (post-filter)
+        # Residual non-equi condition, resolved against left ++ right columns.
+        if condition is not None:
+            both = T.Schema(list(left.schema) + list(right.schema))
+            condition = resolve(condition, both)
+        self.condition = condition
 
     @property
     def schema(self) -> T.Schema:
@@ -442,16 +526,38 @@ class DataFrame:
 
     groupBy = group_by
 
-    def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
+    def join(self, other: "DataFrame", on=None,
+             how: str = "inner") -> "DataFrame":
+        if on is None:
+            plan = Join(self._plan, other._plan,
+                        "cross" if how in ("inner", "cross") else how, [], [])
+            return DataFrame(plan, self._session)
         if isinstance(on, str):
             on = [on]
         if isinstance(on, (list, tuple)) and all(isinstance(k, str) for k in on):
             lk = [col(k) for k in on]
             rk = [col(k) for k in on]
+            plan = Join(self._plan, other._plan, how, lk, rk)
+        elif isinstance(on, Expression):
+            # Arbitrary condition: extract equi pairs, keep the residual
+            # (Catalyst ExtractEquiJoinKeys behavior).
+            lk, rk, residual = split_join_condition(
+                on, self._plan.schema, other._plan.schema)
+            if not lk and how == "inner":
+                plan = Join(self._plan, other._plan, "cross", [], [],
+                            condition=residual)
+            else:
+                plan = Join(self._plan, other._plan, how, lk, rk,
+                            condition=residual)
         else:
-            raise NotImplementedError("join on expression conditions: use keys")
-        plan = Join(self._plan, other._plan, how, lk, rk)
+            raise TypeError(f"unsupported join on: {on!r}")
         return DataFrame(plan, self._session)
+
+    def cross_join(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(Join(self._plan, other._plan, "cross", [], []),
+                         self._session)
+
+    crossJoin = cross_join
 
     def sort(self, *orders) -> "DataFrame":
         so = []

@@ -296,11 +296,23 @@ def _window_tag(meta: ExecMeta, conf: TpuConf):
 
 
 def _join_tag(meta: ExecMeta, conf: TpuConf):
+    """Join-type / condition gating (GpuHashJoin.tagJoin analog,
+    GpuHashJoin.scala:29: conditions only for inner joins)."""
     node: P.CpuJoinExec = meta.node
     if not node.left_keys:
-        meta.will_not_work("non-equi joins are not supported on TPU")
-    if node.join_type == "cross":
-        meta.will_not_work("cross joins are not supported on TPU yet")
+        meta.will_not_work("hash join requires equi keys")
+    if node.condition is not None and node.join_type != "inner":
+        meta.will_not_work(
+            f"conditions are not supported for {node.join_type} joins "
+            "(reference limits join conditions to inner joins)")
+
+
+def _nlj_tag(meta: ExecMeta, conf: TpuConf):
+    node: P.CpuNestedLoopJoinExec = meta.node
+    if node.join_type not in ("cross", "inner", "left", "left_semi",
+                              "left_anti"):
+        meta.will_not_work(f"nested-loop {node.join_type} join is not "
+                           "supported on TPU")
 
 
 EXEC_RULES: Dict[Type[P.PhysicalPlan], ExecRule] = {
@@ -320,10 +332,23 @@ EXEC_RULES: Dict[Type[P.PhysicalPlan], ExecRule] = {
         tag=_agg_tag),
     P.CpuJoinExec: ExecRule(
         "ShuffledHashJoin",
-        lambda n: list(n.left_keys) + list(n.right_keys),
+        lambda n: list(n.left_keys) + list(n.right_keys)
+        + ([n.condition] if n.condition is not None else []),
         lambda n, ch, conf: E.TpuShuffledHashJoinExec(
-            ch[0], ch[1], n.join_type, n.left_keys, n.right_keys, n.schema),
+            ch[0], ch[1], n.join_type, n.left_keys, n.right_keys, n.schema,
+            n.condition),
         tag=_join_tag),
+    P.CpuBroadcastHashJoinExec: ExecRule(
+        "BroadcastHashJoin",
+        lambda n: list(n.left_keys) + list(n.right_keys)
+        + ([n.condition] if n.condition is not None else []),
+        lambda n, ch, conf: _make_broadcast_join(n, ch),
+        tag=_join_tag),
+    P.CpuNestedLoopJoinExec: ExecRule(
+        "BroadcastNestedLoopJoin",
+        lambda n: [n.condition] if n.condition is not None else [],
+        lambda n, ch, conf: _make_nlj(n, ch),
+        tag=_nlj_tag),
     P.CpuSortExec: ExecRule(
         "Sort",
         lambda n: [o.child for o in n.orders],
@@ -355,6 +380,25 @@ EXEC_RULES: Dict[Type[P.PhysicalPlan], ExecRule] = {
 def _make_window(n: "P.CpuWindowExec", ch):
     from ..exec.window_exec import TpuWindowExec
     return TpuWindowExec(ch[0], n.window_exprs, n.schema)
+
+
+def _make_broadcast_join(n: "P.CpuBroadcastHashJoinExec", ch):
+    from ..exec.joins import (TpuBroadcastExchangeExec,
+                              TpuBroadcastHashJoinExec)
+    return TpuBroadcastHashJoinExec(
+        ch[0], TpuBroadcastExchangeExec(ch[1]), n.join_type, n.left_keys,
+        n.right_keys, n.schema, n.condition)
+
+
+def _make_nlj(n: "P.CpuNestedLoopJoinExec", ch):
+    from ..exec.joins import (TpuBroadcastExchangeExec,
+                              TpuBroadcastNestedLoopJoinExec,
+                              TpuCartesianProductExec)
+    if n.join_type == "cross" and n.condition is None:
+        return TpuCartesianProductExec(ch[0], ch[1], n.schema)
+    return TpuBroadcastNestedLoopJoinExec(
+        ch[0], TpuBroadcastExchangeExec(ch[1]), n.join_type, n.condition,
+        n.schema)
 
 #: Node types that legitimately stay on CPU (host-side sources; the scan
 #: device-decode path is a later milestone, like the reference's host-read +

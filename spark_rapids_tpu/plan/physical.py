@@ -282,12 +282,14 @@ class CpuHashAggregateExec(PhysicalPlan):
 class CpuJoinExec(PhysicalPlan):
     def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
                  join_type: str, left_keys: List[Expression],
-                 right_keys: List[Expression], schema: T.Schema):
+                 right_keys: List[Expression], schema: T.Schema,
+                 condition=None):
         self.children = [left, right]
         self.join_type = join_type
         self.left_keys = left_keys
         self.right_keys = right_keys
         self._schema = schema
+        self.condition = condition  # residual non-equi predicate (inner only)
 
     @property
     def schema(self):
@@ -333,7 +335,11 @@ class CpuJoinExec(PhysicalPlan):
         arrays = [joined.column(rn).combine_chunks().cast(f.type)
                   for rn, f in zip(raw_names, out_arrow)]
         rb = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
-        return [iter([HostBatch(rb)])]
+        hb = HostBatch(rb)
+        if self.condition is not None:
+            mask = host_to_array(self.condition.eval_host(hb), hb.num_rows)
+            hb = HostBatch(rb.filter(pc.fill_null(mask, False)))
+        return [iter([hb])]
 
 
 class CpuSortExec(PhysicalPlan):
@@ -691,3 +697,105 @@ def cmp_part(i, j, part_vals):
         if a != b:
             return -1 if a < b else 1
     return 0
+
+
+class CpuBroadcastHashJoinExec(CpuJoinExec):
+    """Equi-join planned with a broadcast (small) build side — the CPU
+    compute is identical to CpuJoinExec; the distinct node lets the TPU
+    rewrite insert a broadcast exchange (BroadcastHashJoinExec analog)."""
+
+    def describe(self):
+        return f"CpuBroadcastHashJoin {self.join_type}"
+
+
+class CpuNestedLoopJoinExec(PhysicalPlan):
+    """Cross / conditional join oracle: expand the full pair grid with
+    pyarrow takes, evaluate the condition once, filter
+    (BroadcastNestedLoopJoinExec / CartesianProductExec analog)."""
+
+    def __init__(self, left: PhysicalPlan, right: PhysicalPlan,
+                 join_type: str, condition, schema: T.Schema):
+        self.children = [left, right]
+        self.join_type = join_type
+        self.condition = condition
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"CpuNestedLoopJoin {self.join_type}"
+
+    def _collect(self, plan, ctx) -> pa.Table:
+        batches = []
+        arrow = _arrow_schema(plan.schema)
+        for part in plan.execute(ctx):
+            for hb in part:
+                batches.append(hb.rb.cast(arrow))
+        return pa.Table.from_batches(batches, schema=arrow).combine_chunks()
+
+    def execute(self, ctx):
+        import numpy as np
+        left, right = self.children
+        lt = self._collect(left, ctx)
+        rt = self._collect(right, ctx)
+        out_arrow = _arrow_schema(self.schema)
+        ln, rn = lt.num_rows, rt.num_rows
+        jt = self.join_type
+
+        p_idx = np.repeat(np.arange(ln, dtype=np.int64), max(rn, 1)) \
+            if rn else np.zeros(0, np.int64)
+        b_idx = np.tile(np.arange(rn, dtype=np.int64), ln) if rn else \
+            np.zeros(0, np.int64)
+        if self.condition is not None and len(p_idx):
+            pair_arrays = [lt.column(i).take(pa.array(p_idx))
+                           for i in range(lt.num_columns)]
+            pair_arrays += [rt.column(i).take(pa.array(b_idx))
+                            for i in range(rt.num_columns)]
+            pair_schema = pa.schema(
+                [pa.field(f.name, T.to_arrow_type(f.data_type))
+                 for f in left.schema] +
+                [pa.field(f.name, T.to_arrow_type(f.data_type))
+                 for f in right.schema])
+            pair_rb = pa.RecordBatch.from_arrays(
+                [a.combine_chunks() for a in pair_arrays], schema=pair_schema)
+            mask = host_to_array(self.condition.eval_host(HostBatch(pair_rb)),
+                                 pair_rb.num_rows)
+            mask = pc.fill_null(mask, False).to_numpy(zero_copy_only=False)
+        else:
+            mask = np.ones(len(p_idx), dtype=bool)
+
+        if jt in ("left_semi", "left_anti", "left"):
+            matched = np.zeros(ln, dtype=bool)
+            if len(p_idx):
+                np.logical_or.at(matched, p_idx, mask)
+        if jt in ("left_semi", "left_anti"):
+            keep = matched if jt == "left_semi" else ~matched
+            rb = lt.filter(pa.array(keep)).combine_chunks()
+            out = pa.RecordBatch.from_arrays(
+                [rb.column(i).combine_chunks().cast(f.type)
+                 for i, f in enumerate(out_arrow)], schema=out_arrow)
+            return [iter([HostBatch(out)])]
+
+        def chunkless(a):
+            return a.combine_chunks() if isinstance(a, pa.ChunkedArray) else a
+
+        sel = np.nonzero(mask)[0]
+        arrays = [chunkless(lt.column(i).take(pa.array(p_idx[sel])))
+                  for i in range(lt.num_columns)]
+        arrays += [chunkless(rt.column(i).take(pa.array(b_idx[sel])))
+                   for i in range(rt.num_columns)]
+        if jt == "left":
+            # Unmatched probe rows pad the right side with nulls.
+            un = np.nonzero(~matched)[0]
+            if len(un):
+                tails = [chunkless(lt.column(i).take(pa.array(un)))
+                         for i in range(lt.num_columns)]
+                tails += [pa.nulls(len(un), out_arrow.field(
+                    lt.num_columns + i).type) for i in range(rt.num_columns)]
+                arrays = [pa.concat_arrays([a.cast(f.type), t.cast(f.type)])
+                          for a, t, f in zip(arrays, tails, out_arrow)]
+        arrays = [a.cast(f.type) for a, f in zip(arrays, out_arrow)]
+        rb = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
+        return [iter([HostBatch(rb)])]

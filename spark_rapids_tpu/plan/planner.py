@@ -4,15 +4,82 @@ Standalone analog of Spark's query planner: every logical node plans to its
 Cpu*Exec. The TPU rewrite then happens as a separate pass over the physical
 plan (:mod:`.overrides`), mirroring how the reference intercepts Spark's
 already-planned physical plan rather than planning itself.
+
+Join strategy selection plays Spark's role too: equi joins with a small
+(row-estimated) build side plan as broadcast hash joins, other equi joins as
+shuffled hash joins, keyless joins as nested-loop/cartesian — so the rewrite
+layer sees the same exec shapes the reference sees from Catalyst.
 """
 
 from __future__ import annotations
 
+from typing import Optional
+
+from ..config import AUTO_BROADCAST_JOIN_ROWS, DEFAULT_CONF, TpuConf
 from . import logical as L
 from . import physical as P
 
 
-def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
+def estimate_rows(plan: L.LogicalPlan) -> Optional[int]:
+    """Row-count upper bound for join-strategy selection (the stand-in for
+    Spark's logical statistics)."""
+    if isinstance(plan, L.LocalRelation):
+        return sum(rb.num_rows for rb in plan.batches)
+    if isinstance(plan, L.Range):
+        return max(0, -(-(plan.end - plan.start) // plan.step))
+    if isinstance(plan, L.Limit):
+        child = estimate_rows(plan.children[0])
+        return plan.n if child is None else min(plan.n, child)
+    if isinstance(plan, (L.Project, L.Filter, L.Sort, L.WindowOp,
+                         L.Aggregate)):
+        return estimate_rows(plan.children[0])
+    if isinstance(plan, L.Union):
+        ests = [estimate_rows(c) for c in plan.children]
+        return None if any(e is None for e in ests) else sum(ests)
+    if isinstance(plan, L.Expand):
+        child = estimate_rows(plan.children[0])
+        return None if child is None else child * len(plan.projections)
+    return None  # scans, joins: unknown
+
+
+def _plan_join(plan: L.Join, conf: TpuConf) -> P.PhysicalPlan:
+    left = plan_physical(plan.children[0], conf)
+    right = plan_physical(plan.children[1], conf)
+    if not plan.left_keys or (plan.condition is not None
+                              and plan.join_type != "inner"):
+        # Keyless joins, and any non-inner join with a residual condition:
+        # the condition must apply during matching (a post-filter after an
+        # outer/semi join is wrong), which only the nested-loop path does.
+        if plan.join_type in ("right", "full"):
+            raise NotImplementedError(
+                f"non-equi {plan.join_type} outer joins are not supported")
+        # Pre-bind side-aware: equi keys bind against their own side (right
+        # ordinals shift past the left columns), the residual binds with
+        # duplicate-name detection — name-only binding against the combined
+        # schema would silently send both sides of `id = id` to the left.
+        lsch = plan.children[0].schema
+        rsch = plan.children[1].schema
+        condition = None
+        if plan.condition is not None:
+            condition = L.bind_join_condition(plan.condition, lsch, rsch)
+        from ..ops.predicates import And, EqualTo
+        for l, r in zip(plan.left_keys, plan.right_keys):
+            eq = EqualTo(l.bind(lsch),
+                         L.shift_bound_ordinals(r.bind(rsch), len(lsch)))
+            condition = eq if condition is None else And(eq, condition)
+        return P.CpuNestedLoopJoinExec(left, right, plan.join_type,
+                                       condition, plan.schema)
+    threshold = conf.get(AUTO_BROADCAST_JOIN_ROWS)
+    build_est = estimate_rows(plan.children[1])
+    cls = P.CpuJoinExec
+    if threshold >= 0 and build_est is not None and build_est <= threshold:
+        cls = P.CpuBroadcastHashJoinExec
+    return cls(left, right, plan.join_type, plan.left_keys, plan.right_keys,
+               plan.schema, plan.condition)
+
+
+def plan_physical(plan: L.LogicalPlan,
+                  conf: TpuConf = DEFAULT_CONF) -> P.PhysicalPlan:
     if isinstance(plan, L.LocalRelation):
         return P.CpuLocalScanExec(plan.batches, plan.schema)
     if isinstance(plan, L.Range):
@@ -22,28 +89,28 @@ def plan_physical(plan: L.LogicalPlan) -> P.PhysicalPlan:
         return CpuFileScanExec(plan.fmt, plan.paths, plan.schema,
                                plan.options, plan.pushed_filters)
     if isinstance(plan, L.Project):
-        return P.CpuProjectExec(plan_physical(plan.children[0]), plan.exprs)
+        return P.CpuProjectExec(plan_physical(plan.children[0], conf),
+                                plan.exprs)
     if isinstance(plan, L.Filter):
-        return P.CpuFilterExec(plan_physical(plan.children[0]), plan.condition)
+        return P.CpuFilterExec(plan_physical(plan.children[0], conf),
+                               plan.condition)
     if isinstance(plan, L.Aggregate):
-        return P.CpuHashAggregateExec(plan_physical(plan.children[0]),
+        return P.CpuHashAggregateExec(plan_physical(plan.children[0], conf),
                                       plan.groupings, plan.aggregates)
     if isinstance(plan, L.Join):
-        return P.CpuJoinExec(plan_physical(plan.children[0]),
-                             plan_physical(plan.children[1]),
-                             plan.join_type, plan.left_keys, plan.right_keys,
-                             plan.schema)
+        return _plan_join(plan, conf)
     if isinstance(plan, L.Sort):
-        return P.CpuSortExec(plan_physical(plan.children[0]), plan.orders)
+        return P.CpuSortExec(plan_physical(plan.children[0], conf),
+                             plan.orders)
     if isinstance(plan, L.Limit):
-        return P.CpuLimitExec(plan_physical(plan.children[0]), plan.n)
+        return P.CpuLimitExec(plan_physical(plan.children[0], conf), plan.n)
     if isinstance(plan, L.Union):
-        return P.CpuUnionExec([plan_physical(c) for c in plan.children],
+        return P.CpuUnionExec([plan_physical(c, conf) for c in plan.children],
                               plan.schema)
     if isinstance(plan, L.WindowOp):
-        return P.CpuWindowExec(plan_physical(plan.children[0]),
+        return P.CpuWindowExec(plan_physical(plan.children[0], conf),
                                plan.window_exprs, plan.schema)
     if isinstance(plan, L.Expand):
-        return P.CpuExpandExec(plan_physical(plan.children[0]),
+        return P.CpuExpandExec(plan_physical(plan.children[0], conf),
                                plan.projections, plan.schema)
     raise NotImplementedError(f"no physical plan for {type(plan).__name__}")

@@ -96,7 +96,7 @@ class TpuSession:
 
     # -- execution ----------------------------------------------------------
     def plan(self, logical: L.LogicalPlan) -> P.PhysicalPlan:
-        cpu_plan = plan_physical(logical)
+        cpu_plan = plan_physical(logical, self.conf)
         return self._overrides.apply(cpu_plan)
 
     def execute(self, logical: L.LogicalPlan) -> pa.Table:

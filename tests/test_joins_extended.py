@@ -1,0 +1,181 @@
+"""Broadcast / nested-loop / cartesian join tests (BroadcastHashJoinSuite +
+the reference's join_test.py matrix analog)."""
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.ops import predicates as P_
+from spark_rapids_tpu.ops.expression import col, lit
+
+from harness import assert_tpu_and_cpu_are_equal, tpu_session
+
+
+def _fact(n=300, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"k": [None if rng.random() < 0.1 else int(x)
+                  for x in rng.integers(0, 40, n)],
+            "v": rng.integers(-100, 100, n).astype(np.int64).tolist()}
+
+
+def _dim(n=30):
+    return {"k2": [i for i in range(n)],
+            "w": [i * 10 for i in range(n)],
+            "name": [f"dim_{i}" for i in range(n)]}
+
+
+JOIN_TYPES = ["inner", "left", "right", "full", "left_semi", "left_anti"]
+
+
+@pytest.mark.parametrize("how", JOIN_TYPES)
+def test_broadcast_hash_join_types(how):
+    fact, dim = _fact(), _dim()
+    dim["k"] = dim.pop("k2")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(fact).join(
+            s.create_dataframe(dim), on="k", how=how))
+
+
+def test_broadcast_plan_shape():
+    s = tpu_session()
+    fact, dim = _fact(), _dim()
+    dim["k"] = dim.pop("k2")
+    df = s.create_dataframe(fact).join(s.create_dataframe(dim), on="k")
+    text = s.plan(df._plan).tree_string()
+    assert "TpuBroadcastHashJoin" in text
+    assert "TpuBroadcastExchange" in text
+
+
+def test_shuffled_when_broadcast_disabled():
+    s = tpu_session(**{"spark.rapids.sql.autoBroadcastJoinRows": -1})
+    fact, dim = _fact(), _dim()
+    dim["k"] = dim.pop("k2")
+    df = s.create_dataframe(fact).join(s.create_dataframe(dim), on="k")
+    text = s.plan(df._plan).tree_string()
+    assert "TpuShuffledHashJoin" in text
+    assert "TpuBroadcastExchange" not in text
+
+
+def test_cross_join():
+    a = {"x": [1, 2, 3], "s": ["a", "b", None]}
+    b = {"y": [10, 20]}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(a).cross_join(s.create_dataframe(b)))
+
+
+def test_cross_join_plan_is_cartesian():
+    s = tpu_session()
+    df = s.create_dataframe({"x": [1]}).cross_join(
+        s.create_dataframe({"y": [2]}))
+    assert "TpuCartesianProduct" in s.plan(df._plan).tree_string()
+
+
+def test_pure_condition_join():
+    # No equi keys at all: x < y.
+    a = {"x": [1, 5, 9, None]}
+    b = {"y": [4, 8]}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(a).join(
+            s.create_dataframe(b), on=P_.LessThan(col("x"), col("y"))))
+
+
+def test_equi_plus_residual_inner():
+    # k = k2 AND v < w: equi pair extracted, residual applied on device.
+    fact, dim = _fact(), _dim()
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(fact).join(
+            s.create_dataframe(dim),
+            on=P_.And(P_.EqualTo(col("k"), col("k2")),
+                      P_.LessThan(col("v"), col("w")))))
+
+
+@pytest.mark.parametrize("how", ["left", "left_semi", "left_anti"])
+def test_conditional_outer_and_existence_joins(how):
+    # Non-inner joins with residual conditions route through the
+    # nested-loop path, where the condition applies during matching.
+    fact, dim = _fact(n=80), _dim(10)
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(fact).join(
+            s.create_dataframe(dim),
+            on=P_.And(P_.EqualTo(col("k"), col("k2")),
+                      P_.GreaterThan(col("w"), lit(30))),
+            how=how))
+
+
+def test_empty_build_side():
+    a = {"x": [1, 2], "k": [1, 2]}
+    b = {"k2": [], "w": []}
+    import spark_rapids_tpu.types as T
+    schema = T.Schema([T.StructField("k2", T.LONG, True),
+                       T.StructField("w", T.LONG, True)])
+    for how in ["inner", "left", "left_anti"]:
+        assert_tpu_and_cpu_are_equal(
+            lambda s, how=how: s.create_dataframe(a).join(
+                s.create_dataframe(b, schema=schema),
+                on=P_.EqualTo(col("k"), col("k2")), how=how))
+
+
+def test_broadcast_exchange_reuse():
+    # The exchange materializes once even with two consumers.
+    from spark_rapids_tpu.exec.joins import TpuBroadcastExchangeExec
+    s = tpu_session()
+    dim = s.create_dataframe(_dim())
+    fact = s.create_dataframe(_fact())
+    dimk = {"k": _dim()["k2"], "w": _dim()["w"]}
+    df = fact.join(s.create_dataframe(dimk), on="k")
+    out1 = df.collect()
+    assert out1.num_rows > 0
+
+
+def test_string_payload_through_nlj():
+    a = {"x": [1, 2, 3]}
+    b = {"y": [1, 2], "name": ["one", None]}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(a).join(
+            s.create_dataframe(b),
+            on=P_.GreaterThanOrEqual(col("x"), col("y"))))
+
+
+def test_duplicate_name_equi_key_binds_by_side():
+    # EqualTo(id, id) with 'id' on both sides splits USING-style: left expr
+    # binds left, right expr binds right (regression: both used to bind to
+    # the left ordinal, making the key predicate a tautology).
+    l = {"id": [1, 2], "amt": [5, 6]}
+    r = {"id": [1, 2], "cap": [10, 0]}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(l).join(
+            s.create_dataframe(r),
+            on=P_.And(P_.EqualTo(col("id"), col("id")),
+                      P_.LessThan(col("amt"), col("cap"))), how="left"))
+
+
+def test_ambiguous_residual_reference_raises():
+    # A non-equi use of a both-sides name cannot be attributed; refuse loudly.
+    s = tpu_session()
+    l = s.create_dataframe({"id": [1, 2], "amt": [5, 6]})
+    r = s.create_dataframe({"id": [1, 2], "cap": [10, 0]})
+    df = l.join(r, on=P_.LessThan(col("id"), col("cap")), how="left")
+    with pytest.raises(ValueError, match="both join sides"):
+        df.collect()
+
+
+def test_eq_operator_bool_trap_raises():
+    # col == col yields a Python bool (identity); compounding it must raise,
+    # not silently build an always-false condition.
+    with pytest.raises(TypeError, match=r"\.eq\(\)"):
+        (col("amt") < col("cap")) & (col("id") == col("rid"))
+
+
+def test_keyed_cross_join_rejected():
+    s = tpu_session()
+    l = s.create_dataframe({"id": [1]})
+    r = s.create_dataframe({"id": [2]})
+    with pytest.raises(ValueError, match="cross joins take no join keys"):
+        l.join(r, on="id", how="cross")
+
+
+def test_same_key_name_string_api_still_works():
+    # join(on="k") (USING-style) is the supported same-name path.
+    l = {"k": [1, 2, 3], "v": [10, 20, 30]}
+    r = {"k": [2, 3, 4], "w": [200, 300, 400]}
+    assert_tpu_and_cpu_are_equal(
+        lambda s: s.create_dataframe(l).join(s.create_dataframe(r), on="k"))
