@@ -12,6 +12,7 @@ pushdown, column pruning, and row-group-granular chunked reads honoring
 
 from __future__ import annotations
 
+import os
 from typing import List, Optional
 
 import pyarrow as pa
@@ -31,10 +32,16 @@ def infer_schema(fmt: str, paths: List[str], options: dict) -> T.Schema:
 
 
 def _dataset(fmt: str, paths: List[str], options: dict) -> ds.Dataset:
+    # A single directory (a write target) must pass as a bare string;
+    # pyarrow rejects directories inside path lists. Default ignore_prefixes
+    # skip _SUCCESS and hidden files, like Spark's readers. hive partitioning
+    # restores partitionBy columns from key=value directory names.
+    src = paths[0] if len(paths) == 1 else paths
+    hive = "hive" if len(paths) == 1 and os.path.isdir(paths[0]) else None
     if fmt == "parquet":
-        return ds.dataset(paths, format="parquet")
+        return ds.dataset(src, format="parquet", partitioning=hive)
     if fmt == "orc":
-        return ds.dataset(paths, format="orc")
+        return ds.dataset(src, format="orc", partitioning=hive)
     if fmt == "csv":
         import pyarrow.csv as pacsv
         parse = pacsv.ParseOptions(
@@ -46,7 +53,7 @@ def _dataset(fmt: str, paths: List[str], options: dict) -> ds.Dataset:
         fmt_obj = ds.CsvFileFormat(parse_options=parse,
                                    read_options=read,
                                    convert_options=convert)
-        return ds.dataset(paths, format=fmt_obj)
+        return ds.dataset(src, format=fmt_obj)
     raise ValueError(f"unknown format {fmt}")
 
 
@@ -117,8 +124,12 @@ class CpuFileScanExec(PhysicalPlan):
         fragments = list(dataset.get_fragments())
 
         def read_fragment(frag):
+            # dataset.schema carries hive partition fields; passing it lets
+            # the fragment materialize partition columns from its
+            # partition_expression.
             scanner = ds.Scanner.from_fragment(
-                frag, columns=names, filter=filt, batch_size=max_rows)
+                frag, schema=dataset.schema, columns=names, filter=filt,
+                batch_size=max_rows)
             for rb in scanner.to_batches():
                 if rb.num_rows:
                     yield HostBatch(rb.cast(arrow_schema))

@@ -399,6 +399,77 @@ class Union(LogicalPlan):
                          for f, n in zip(first, nullable)])
 
 
+class WriteOp(LogicalPlan):
+    """Write the child to files (InsertIntoHadoopFsRelationCommand analog);
+    output is the one-row write-stats summary."""
+
+    FORMATS = ("parquet", "orc", "csv")
+
+    def __init__(self, child: LogicalPlan, fmt: str, path: str,
+                 options: dict, partition_by: List[str], mode: str):
+        if fmt not in self.FORMATS:
+            raise ValueError(
+                f"unsupported write format '{fmt}'; choose from {self.FORMATS}")
+        from ..io.writers import MODES
+        if mode not in MODES:
+            raise ValueError(f"unknown save mode '{mode}'; choose from {MODES}")
+        self.children = [child]
+        self.fmt = fmt
+        self.path = path
+        self.options = options
+        self.partition_by = list(partition_by)
+        self.mode = mode
+        for c in self.partition_by:
+            if child.schema.field_maybe(c) is None:
+                raise KeyError(f"partitionBy column '{c}' not in {child.schema}")
+
+    @property
+    def schema(self) -> T.Schema:
+        from ..io.writers import STATS_SCHEMA
+        return STATS_SCHEMA
+
+    def describe(self):
+        return f"WriteFiles {self.fmt} {self.path}"
+
+
+class DataFrameWriter:
+    """df.write builder (Spark DataFrameWriter shape)."""
+
+    def __init__(self, df: "DataFrame"):
+        self._df = df
+        self._mode = "error"
+        self._options: dict = {}
+        self._partition_by: List[str] = []
+
+    def mode(self, m: str) -> "DataFrameWriter":
+        self._mode = m
+        return self
+
+    def option(self, key: str, value) -> "DataFrameWriter":
+        self._options[key] = value
+        return self
+
+    def partition_by(self, *cols: str) -> "DataFrameWriter":
+        self._partition_by = list(cols)
+        return self
+
+    partitionBy = partition_by
+
+    def _write(self, fmt: str, path: str):
+        plan = WriteOp(self._df._plan, fmt, path, self._options,
+                       self._partition_by, self._mode)
+        return self._df._session.execute(plan)
+
+    def parquet(self, path: str):
+        return self._write("parquet", path)
+
+    def orc(self, path: str):
+        return self._write("orc", path)
+
+    def csv(self, path: str):
+        return self._write("csv", path)
+
+
 class WindowOp(LogicalPlan):
     """Append window-expression columns (Spark's Window logical node; the
     physical GpuWindowExec analog is exec/window_exec.py)."""
@@ -580,6 +651,10 @@ class DataFrame:
         return DataFrame(
             Aggregate(self._plan, [col(n) for n in self.columns], []),
             self._session)
+
+    @property
+    def write(self) -> DataFrameWriter:
+        return DataFrameWriter(self)
 
     # -- actions ------------------------------------------------------------
     def collect(self) -> pa.Table:

@@ -390,6 +390,18 @@ def _make_broadcast_join(n: "P.CpuBroadcastHashJoinExec", ch):
         n.right_keys, n.schema, n.condition)
 
 
+def _register_writer_rule():
+    from ..io.writers import CpuWriteFilesExec, TpuWriteFilesExec
+    EXEC_RULES[CpuWriteFilesExec] = ExecRule(
+        "DataWritingCommand",
+        lambda n: [],
+        lambda n, ch, conf: TpuWriteFilesExec(
+            ch[0], n.fmt, n.path, n.options, n.partition_by, n.mode))
+
+
+_register_writer_rule()
+
+
 def _make_nlj(n: "P.CpuNestedLoopJoinExec", ch):
     from ..exec.joins import (TpuBroadcastExchangeExec,
                               TpuBroadcastNestedLoopJoinExec,
@@ -458,7 +470,10 @@ class TpuOverrides:
 
         def check(node):
             name = node.node_name()
-            if not node.columnar and name not in allowed:
+            # Device-consuming host-output nodes (writers) are device execs:
+            # the real invariant is "consumes device batches".
+            consumes_device = getattr(node, "children_columnar", node.columnar)
+            if not consumes_device and name not in allowed:
                 bad.append(name)
             for c in node.children:
                 check(c)
@@ -474,11 +489,15 @@ def insert_transitions(plan: P.PhysicalPlan,
     the root host-side (GpuTransitionOverrides analog)."""
 
     def fix(node: P.PhysicalPlan) -> P.PhysicalPlan:
+        # Some nodes consume device batches but emit host output (writers:
+        # device child, host stats row); children_columnar overrides the
+        # child-side decision.
+        wants_columnar = getattr(node, "children_columnar", node.columnar)
         new_children = []
         for c in fixed_children(node):
-            if node.columnar and not c.columnar:
+            if wants_columnar and not c.columnar:
                 c = E.HostToDeviceExec(c, goal_rows)
-            elif not node.columnar and c.columnar:
+            elif not wants_columnar and c.columnar:
                 c = E.DeviceToHostExec(c)
             new_children.append(c)
         if list(new_children) != list(node.children):

@@ -107,6 +107,11 @@ def plan_physical(plan: L.LogicalPlan,
     if isinstance(plan, L.Union):
         return P.CpuUnionExec([plan_physical(c, conf) for c in plan.children],
                               plan.schema)
+    if isinstance(plan, L.WriteOp):
+        from ..io.writers import CpuWriteFilesExec
+        return CpuWriteFilesExec(plan_physical(plan.children[0], conf),
+                                 plan.fmt, plan.path, plan.options,
+                                 plan.partition_by, plan.mode)
     if isinstance(plan, L.WindowOp):
         return P.CpuWindowExec(plan_physical(plan.children[0], conf),
                                plan.window_exprs, plan.schema)

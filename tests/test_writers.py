@@ -1,0 +1,140 @@
+"""Writer framework tests (ParquetWriterSuite / writer-framework analogs)."""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from harness import cpu_session, tpu_session
+
+
+def _df(s, n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    return s.create_dataframe({
+        "k": [int(x) for x in rng.integers(0, 5, n)],
+        "v": [None if rng.random() < 0.1 else int(x)
+              for x in rng.integers(-100, 100, n)],
+        "name": [f"row_{i % 7}" for i in range(n)],
+    })
+
+
+def _read_back(s, fmt, path):
+    return getattr(s.read, fmt)(path).collect()
+
+
+ROUND_TRIP_FORMATS = ["parquet", "orc", "csv"]
+
+
+@pytest.mark.parametrize("fmt", ROUND_TRIP_FORMATS)
+def test_round_trip_matches_cpu_write(fmt, tmp_path):
+    cpu, tpu = cpu_session(), tpu_session()
+    p_cpu = str(tmp_path / f"cpu_{fmt}")
+    p_tpu = str(tmp_path / f"tpu_{fmt}")
+    stats_cpu = getattr(_df(cpu).write, fmt)(p_cpu)
+    stats_tpu = getattr(_df(tpu).write, fmt)(p_tpu)
+    assert stats_cpu.column("rows").to_pylist() == [200]
+    assert stats_tpu.column("rows").to_pylist() == [200]
+    assert os.path.exists(os.path.join(p_tpu, "_SUCCESS"))
+    back_cpu = _read_back(cpu, fmt, p_cpu).sort_by(
+        [("k", "ascending"), ("v", "ascending"), ("name", "ascending")])
+    back_tpu = _read_back(cpu, fmt, p_tpu).sort_by(
+        [("k", "ascending"), ("v", "ascending"), ("name", "ascending")])
+    assert back_cpu.equals(back_tpu)
+
+
+def test_partition_by_hive_layout(tmp_path):
+    s = tpu_session()
+    path = str(tmp_path / "hive")
+    stats = _df(s).write.partition_by("k").parquet(path)
+    dirs = sorted(d for d in os.listdir(path) if d.startswith("k="))
+    assert dirs == [f"k={i}" for i in range(5)]
+    assert stats.column("partitions").to_pylist() == [5]
+    # Partition column is in the directory, not the files.
+    one = pq.read_table(os.path.join(
+        path, "k=0", os.listdir(os.path.join(path, "k=0"))[0]))
+    assert one.schema.names == ["v", "name"]
+    # Hive-style read-back restores the partition column.
+    back = pa.Table.from_batches([b for b in __import__("pyarrow.dataset",
+                                  fromlist=["dataset"]).dataset(
+        path, format="parquet", partitioning="hive").to_table().to_batches()])
+    assert back.num_rows == 200
+
+
+def test_partition_by_device_plan(tmp_path):
+    s = tpu_session()
+    df = _df(s)
+    from spark_rapids_tpu.plan.logical import WriteOp
+    plan = s.plan(WriteOp(df._plan, "parquet", str(tmp_path / "x"), {},
+                          ["k"], "error"))
+    assert "TpuWriteFiles" in plan.tree_string()
+
+
+def test_mode_error_raises_on_existing(tmp_path):
+    s = tpu_session()
+    path = str(tmp_path / "dup")
+    _df(s).write.parquet(path)
+    with pytest.raises(FileExistsError):
+        _df(s).write.parquet(path)
+
+
+def test_mode_overwrite_and_ignore(tmp_path):
+    s = tpu_session()
+    path = str(tmp_path / "ow")
+    _df(s, n=50).write.parquet(path)
+    _df(s, n=30, seed=1).write.mode("overwrite").parquet(path)
+    assert _read_back(s, "parquet", path).num_rows == 30
+    stats = _df(s, n=99).write.mode("ignore").parquet(path)
+    assert stats.column("files").to_pylist() == [0]
+    assert _read_back(s, "parquet", path).num_rows == 30
+
+
+def test_compression_option(tmp_path):
+    s = tpu_session()
+    p1 = str(tmp_path / "zstd")
+    _df(s).write.option("compression", "zstd").parquet(p1)
+    f = [x for x in os.listdir(p1) if x.endswith(".parquet")][0]
+    meta = pq.ParquetFile(os.path.join(p1, f)).metadata
+    assert meta.row_group(0).column(0).compression == "ZSTD"
+
+
+def test_null_partition_values(tmp_path):
+    s = tpu_session()
+    path = str(tmp_path / "nulls")
+    df = s.create_dataframe({"k": [1, None, 1], "v": [1, 2, 3]})
+    df.write.partition_by("k").parquet(path)
+    assert "k=__HIVE_DEFAULT_PARTITION__" in os.listdir(path)
+
+
+def test_append_preserves_existing_data(tmp_path):
+    # Regression: deterministic filenames used to collide, silently
+    # replacing earlier files on append.
+    s = tpu_session()
+    path = str(tmp_path / "app")
+    s.create_dataframe({"v": [1, 2, 3]}).write.parquet(path)
+    s.create_dataframe({"v": [4, 5]}).write.mode("append").parquet(path)
+    back = _read_back(s, "parquet", path)
+    assert sorted(back.column("v").to_pylist()) == [1, 2, 3, 4, 5]
+
+
+def test_hive_partition_column_restored_by_reader(tmp_path):
+    # Regression: the engine's own reader used to drop partitionBy columns.
+    s = tpu_session()
+    path = str(tmp_path / "hive_rt")
+    s.create_dataframe({"k": [1, 1, 2], "v": [10, 20, 30]}) \
+        .write.partition_by("k").parquet(path)
+    back = _read_back(s, "parquet", path)
+    assert sorted(back.schema.names) == ["k", "v"]
+    got = sorted(zip(back.column("k").to_pylist(),
+                     back.column("v").to_pylist()))
+    assert got == [(1, 10), (1, 20), (2, 30)]
+
+
+def test_overwrite_replaces_plain_file(tmp_path):
+    # Regression: overwrite onto a regular file crashed in makedirs.
+    s = tpu_session()
+    path = str(tmp_path / "plainfile")
+    open(path, "w").write("junk")
+    s.create_dataframe({"v": [7]}).write.mode("overwrite").parquet(path)
+    assert _read_back(s, "parquet", path).column("v").to_pylist() == [7]
