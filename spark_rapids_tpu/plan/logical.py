@@ -399,6 +399,31 @@ class Union(LogicalPlan):
                          for f, n in zip(first, nullable)])
 
 
+class Repartition(LogicalPlan):
+    """Exchange the child's rows into n partitions (ShuffleExchange logical
+    shape): hash on keys, range on sort orders, round-robin, or single."""
+
+    def __init__(self, child: LogicalPlan, n_parts: int, mode: str,
+                 keys: Optional[List[Expression]] = None,
+                 orders: Optional[List[SortOrder]] = None):
+        assert mode in ("hash", "range", "round_robin", "single"), mode
+        if n_parts < 1:
+            raise ValueError(f"need at least 1 partition, got {n_parts}")
+        self.children = [child]
+        self.n_parts = n_parts
+        self.mode = mode
+        self.keys = [resolve(k, child.schema) for k in (keys or [])]
+        self.orders = [SortOrder(resolve(o.child, child.schema), o.ascending,
+                                 o.nulls_first) for o in (orders or [])]
+
+    @property
+    def schema(self) -> T.Schema:
+        return self.children[0].schema
+
+    def describe(self):
+        return f"Repartition {self.mode} n={self.n_parts}"
+
+
 class WriteOp(LogicalPlan):
     """Write the child to files (InsertIntoHadoopFsRelationCommand analog);
     output is the one-row write-stats summary."""
@@ -655,6 +680,23 @@ class DataFrame:
     @property
     def write(self) -> DataFrameWriter:
         return DataFrameWriter(self)
+
+    def repartition(self, n_parts: int, *cols) -> "DataFrame":
+        """Hash-repartition on columns, or round-robin without columns."""
+        if cols:
+            plan = Repartition(self._plan, n_parts, "hash",
+                               keys=[_as_expr(c) for c in cols])
+        else:
+            plan = Repartition(self._plan, n_parts, "round_robin")
+        return DataFrame(plan, self._session)
+
+    def repartition_by_range(self, n_parts: int, *orders) -> "DataFrame":
+        so = [o if isinstance(o, SortOrder) else SortOrder(_as_expr(o))
+              for o in orders]
+        return DataFrame(Repartition(self._plan, n_parts, "range", orders=so),
+                         self._session)
+
+    repartitionByRange = repartition_by_range
 
     # -- actions ------------------------------------------------------------
     def collect(self) -> pa.Table:

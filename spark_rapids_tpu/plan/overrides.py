@@ -390,6 +390,31 @@ def _make_broadcast_join(n: "P.CpuBroadcastHashJoinExec", ch):
         n.right_keys, n.schema, n.condition)
 
 
+def _shuffle_tag(meta: ExecMeta, conf: TpuConf):
+    factory = meta.node.partitioner_factory
+    if factory.mode == "range":
+        for o in factory.orders:
+            if o.child.data_type is T.STRING:
+                meta.will_not_work(
+                    "range partitioning on string keys is not supported on "
+                    "the device yet")
+
+
+def _register_shuffle_rule():
+    from ..shuffle.exchange import (CpuShuffleExchangeExec,
+                                    TpuShuffleExchangeExec)
+    EXEC_RULES[CpuShuffleExchangeExec] = ExecRule(
+        "ShuffleExchange",
+        lambda n: list(n.partitioner_factory.keys or [])
+        + [o.child for o in (n.partitioner_factory.orders or [])],
+        lambda n, ch, conf: TpuShuffleExchangeExec(
+            ch[0], n.partitioner_factory, n.n_parts),
+        tag=_shuffle_tag)
+
+
+_register_shuffle_rule()
+
+
 def _register_writer_rule():
     from ..io.writers import CpuWriteFilesExec, TpuWriteFilesExec
     EXEC_RULES[CpuWriteFilesExec] = ExecRule(

@@ -107,6 +107,13 @@ def plan_physical(plan: L.LogicalPlan,
     if isinstance(plan, L.Union):
         return P.CpuUnionExec([plan_physical(c, conf) for c in plan.children],
                               plan.schema)
+    if isinstance(plan, L.Repartition):
+        from ..shuffle.exchange import CpuShuffleExchangeExec
+        from ..shuffle.partitioners import partitioner_factory
+        factory = partitioner_factory(plan.mode, plan.n_parts,
+                                      keys=plan.keys, orders=plan.orders)
+        return CpuShuffleExchangeExec(plan_physical(plan.children[0], conf),
+                                      factory, plan.n_parts)
     if isinstance(plan, L.WriteOp):
         from ..io.writers import CpuWriteFilesExec
         return CpuWriteFilesExec(plan_physical(plan.children[0], conf),

@@ -1,0 +1,58 @@
+"""Shuffle compression codec SPI — ``TableCompressionCodec`` analog
+(TableCompressionCodec.scala:40-120; selection by
+``spark.rapids.shuffle.compression.codec``, RapidsConf.scala:604).
+
+The reference snapshot ships only the debug pass-through ``copy`` codec;
+here lz4 and zstd are real (pyarrow codecs), with ``copy`` kept as the
+debug identity."""
+
+from __future__ import annotations
+
+import pyarrow as pa
+
+
+class TableCompressionCodec:
+    name = "none"
+
+    def compress(self, payload: bytes) -> bytes:
+        raise NotImplementedError
+
+    def decompress(self, payload: bytes, uncompressed_size: int) -> bytes:
+        raise NotImplementedError
+
+
+class CopyCodec(TableCompressionCodec):
+    """Debug pass-through (CopyCompressionCodec.scala:23)."""
+
+    name = "copy"
+
+    def compress(self, payload: bytes) -> bytes:
+        return payload
+
+    def decompress(self, payload: bytes, uncompressed_size: int) -> bytes:
+        return payload
+
+
+class _ArrowCodec(TableCompressionCodec):
+    def __init__(self, arrow_name: str):
+        self.name = arrow_name
+        self._codec = pa.Codec(arrow_name)
+
+    def compress(self, payload: bytes) -> bytes:
+        buf = self._codec.compress(payload, asbytes=True)
+        return buf
+
+    def decompress(self, payload: bytes, uncompressed_size: int) -> bytes:
+        return self._codec.decompress(payload, uncompressed_size,
+                                      asbytes=True)
+
+
+def get_codec(name: str) -> TableCompressionCodec:
+    name = (name or "none").lower()
+    if name in ("none", ""):
+        return CopyCodec()
+    if name == "copy":
+        return CopyCodec()
+    if name in ("lz4", "zstd", "snappy", "gzip"):
+        return _ArrowCodec(name)
+    raise ValueError(f"unknown shuffle compression codec '{name}'")

@@ -1,0 +1,233 @@
+"""Shuffle exchange — ``GpuShuffleExchangeExecBase`` + shuffle storage.
+
+The reference's default (serializer) shuffle path evaluates a
+``GpuPartitioning`` on device, contiguous-splits the batch, and hands
+``(partitionId, batch)`` pairs to Spark's shuffle with the columnar
+serializer (GpuShuffleExchangeExec.scala:134-233); the opt-in GPU-resident
+path caches partition tables in the device store under ``ShuffleBufferId``s
+(RapidsCachingWriter, RapidsShuffleInternalManager.scala:73-149) tracked by
+``ShuffleBufferCatalog`` (ShuffleBufferCatalog.scala:50).
+
+TPU-native single-host equivalents:
+
+* partition ids are one fused device program (partitioners.py);
+* contiguousSplit = one stable device sort by partition id, then run
+  boundaries slice the downloaded batch;
+* the write side serializes each slice (Arrow IPC + codec, serializer.py)
+  into :class:`ShuffleBufferCatalog`, which keeps payloads in host memory
+  up to a budget and overflows to a spill file — the host/disk tiers of the
+  reference's store chain (the device tier belongs to the multi-chip ICI
+  path, shuffle/ici.py, where the exchange is an ``all_to_all`` collective
+  and nothing ever leaves HBM);
+* reduce-side partitions lazily deserialize + re-upload, like
+  ``HostColumnarToGpu`` after Spark's shuffle.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from ..config import SHUFFLE_COMPRESSION_CODEC
+from ..data.batch import ColumnarBatch, HostBatch
+from ..plan.physical import ExecContext, PhysicalPlan, _arrow_schema
+from ..utils.tracing import trace_range
+from .codec import get_codec
+from .serializer import deserialize_batch, serialize_batch
+
+
+class ShuffleBufferCatalog:
+    """Maps (shuffle_id, map_id, reduce_id) -> serialized shuffle blocks;
+    lifecycle mirrors ShuffleBufferCatalog.scala:50 (register on write, free
+    on shuffle unregister). Payloads overflow from host memory to a spill
+    file beyond ``host_budget_bytes``."""
+
+    def __init__(self, host_budget_bytes: int = 1 << 30,
+                 spill_dir: Optional[str] = None):
+        self.host_budget = host_budget_bytes
+        self._blocks: Dict[Tuple[int, int, int], object] = {}
+        self._host_bytes = 0
+        self._lock = threading.Lock()
+        self._spill_dir = spill_dir
+        self._spill_file = None
+        self.metrics = {"blocks": 0, "bytes_written": 0, "spilled_blocks": 0}
+
+    def _disk(self):
+        if self._spill_file is None:
+            from ..memory.spill import SpillFile
+            self._spill_file = SpillFile(self._spill_dir)
+        return self._spill_file
+
+    def add_block(self, shuffle_id: int, map_id: int, reduce_id: int,
+                  payload: bytes):
+        with self._lock:
+            key = (shuffle_id, map_id, reduce_id)
+            self.metrics["blocks"] += 1
+            self.metrics["bytes_written"] += len(payload)
+            if self._host_bytes + len(payload) > self.host_budget:
+                offset, length = self._disk().append(payload)
+                self._blocks[key] = (offset, length)
+                self.metrics["spilled_blocks"] += 1
+            else:
+                self._blocks[key] = payload
+                self._host_bytes += len(payload)
+
+    def blocks_for_reduce(self, shuffle_id: int, reduce_id: int
+                          ) -> List[bytes]:
+        with self._lock:
+            keys = sorted(k for k in self._blocks
+                          if k[0] == shuffle_id and k[2] == reduce_id)
+            out = []
+            for k in keys:
+                v = self._blocks[k]
+                if isinstance(v, tuple):
+                    out.append(self._disk().read(*v))
+                else:
+                    out.append(v)
+            return out
+
+    def unregister_shuffle(self, shuffle_id: int):
+        with self._lock:
+            for k in [k for k in self._blocks if k[0] == shuffle_id]:
+                v = self._blocks.pop(k)
+                if not isinstance(v, tuple):
+                    self._host_bytes -= len(v)
+
+    def close(self):
+        with self._lock:
+            self._blocks.clear()
+            if self._spill_file is not None:
+                self._spill_file.close()
+                self._spill_file = None
+
+
+_next_shuffle_id = [0]
+
+
+def _new_shuffle_id() -> int:
+    _next_shuffle_id[0] += 1
+    return _next_shuffle_id[0]
+
+
+class CpuShuffleExchangeExec(PhysicalPlan):
+    """Host repartitioning oracle: numpy mask split per partition."""
+
+    def __init__(self, child: PhysicalPlan, partitioner_factory,
+                 n_parts: int):
+        self.children = [child]
+        self.partitioner_factory = partitioner_factory
+        self.n_parts = n_parts
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"CpuShuffleExchange n={self.n_parts}"
+
+    def execute(self, ctx: ExecContext):
+        partitioner = self.partitioner_factory(
+            self.children[0], ctx, columnar=False)
+        outputs: List[List[HostBatch]] = [[] for _ in range(self.n_parts)]
+        arrow = _arrow_schema(self.schema)
+        for part in self.children[0].execute(ctx):
+            for hb in part:
+                if hb.num_rows == 0:
+                    continue
+                ids = partitioner.host_ids(hb)
+                for p in range(self.n_parts):
+                    mask = ids == p
+                    if mask.any():
+                        outputs[p].append(HostBatch(
+                            hb.rb.filter(pa.array(mask)).cast(arrow)))
+        return [iter(batches) for batches in outputs]
+
+
+class TpuShuffleExchangeExec(PhysicalPlan):
+    """Device repartitioning through the serializer path (see module doc)."""
+
+    columnar = True
+    children_columnar = True
+
+    def __init__(self, child: PhysicalPlan, partitioner_factory,
+                 n_parts: int):
+        self.children = [child]
+        self.partitioner_factory = partitioner_factory
+        self.n_parts = n_parts
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"TpuShuffleExchange n={self.n_parts}"
+
+    def execute(self, ctx: ExecContext):
+        import jax
+        import jax.numpy as jnp
+        from ..ops.kernels import rowops as KR
+
+        partitioner = self.partitioner_factory(
+            self.children[0], ctx, columnar=True)
+        codec = get_codec(ctx.conf.get(SHUFFLE_COMPRESSION_CODEC) or "none")
+        catalog = _shuffle_env(ctx)
+        shuffle_id = _new_shuffle_id()
+        n_parts = self.n_parts
+
+        @jax.jit
+        def partition_sort(batch: ColumnarBatch):
+            ids = partitioner.device_ids(batch)
+            live = batch.row_mask()
+            ids = jnp.where(live, ids, n_parts)
+            iota = jnp.arange(batch.capacity, dtype=jnp.int32)
+            sorted_ids, perm = jax.lax.sort((ids, iota), num_keys=1,
+                                            is_stable=True)
+            return KR.gather_batch(batch, perm, batch.n_rows), sorted_ids
+
+        # WRITE side (RapidsCachingWriter analog, host-serialized payloads).
+        map_id = 0
+        for part in self.children[0].execute(ctx):
+            for db in part:
+                if int(db.n_rows) == 0:
+                    continue
+                with trace_range("shuffle.partition_split"):
+                    sorted_batch, sorted_ids = partition_sort(db)
+                    rb = sorted_batch.to_arrow()
+                    ids_np = np.asarray(sorted_ids)[: rb.num_rows]
+                # Contiguous runs per partition id (ids are sorted).
+                starts = np.searchsorted(ids_np, np.arange(n_parts),
+                                         side="left")
+                ends = np.searchsorted(ids_np, np.arange(n_parts),
+                                       side="right")
+                for p in range(n_parts):
+                    if ends[p] > starts[p]:
+                        piece = rb.slice(starts[p], ends[p] - starts[p])
+                        with trace_range("shuffle.serialize"):
+                            payload = serialize_batch(piece, codec)
+                        catalog.add_block(shuffle_id, map_id, p, payload)
+                map_id += 1
+
+        # READ side (RapidsCachingReader analog): lazy fetch + re-upload.
+        def read_partition(p):
+            for payload in catalog.blocks_for_reduce(shuffle_id, p):
+                with trace_range("shuffle.deserialize"):
+                    _, rb = deserialize_batch(payload)
+                yield ColumnarBatch.from_arrow(rb)
+        return [read_partition(p) for p in range(self.n_parts)]
+
+
+def _shuffle_env(ctx: ExecContext) -> ShuffleBufferCatalog:
+    """Per-context shuffle storage (GpuShuffleEnv.initStorage analog)."""
+    env = getattr(ctx, "_shuffle_catalog", None)
+    if env is None:
+        from ..config import HOST_SPILL_STORAGE_SIZE, SPILL_DIR
+        env = ShuffleBufferCatalog(ctx.conf.get(HOST_SPILL_STORAGE_SIZE),
+                                   ctx.conf.get(SPILL_DIR))
+        ctx._shuffle_catalog = env
+    return env

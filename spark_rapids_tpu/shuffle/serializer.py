@@ -1,0 +1,110 @@
+"""Shuffle batch serialization + the metadata wire protocol.
+
+Two pieces of the reference live here:
+
+* ``GpuColumnarBatchSerializer`` (GpuColumnarBatchSerializer.scala:36) —
+  device batch -> host byte stream and back. The host format is Arrow IPC
+  (the JCudfSerialization stand-in), optionally compressed by the table
+  codec; deserialization is lazy host-side, re-upload happens at the
+  consumer like ``HostColumnarToGpu``.
+* The flatbuffer ``TableMeta`` protocol (ShuffleCommon.fbs, built by
+  MetaUtils.buildTableMeta:41) — a compact self-describing binary header
+  (struct-packed here) carrying schema, row count, codec and sizes, so a
+  remote peer can allocate and decode a fetched buffer without any side
+  channel.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import struct
+from typing import List, Optional, Tuple
+
+import pyarrow as pa
+
+from .. import types as T
+from ..data.batch import ColumnarBatch
+from .codec import TableCompressionCodec, get_codec
+
+_MAGIC = b"TPUS"  # header magic, version 1
+_VERSION = 1
+
+
+@dataclasses.dataclass
+class ShuffleTableMeta:
+    """Self-describing batch header (MetaUtils.buildTableMeta analog)."""
+
+    n_rows: int
+    codec: str
+    compressed_size: int
+    uncompressed_size: int
+    field_names: List[str]
+    field_types: List[str]
+    field_nullable: List[bool]
+
+    @staticmethod
+    def for_batch(rb: pa.RecordBatch, codec: str, compressed: int,
+                  uncompressed: int) -> "ShuffleTableMeta":
+        schema = T.schema_from_arrow(rb.schema)
+        return ShuffleTableMeta(
+            rb.num_rows, codec, compressed, uncompressed,
+            [f.name for f in schema], [f.data_type.name for f in schema],
+            [f.nullable for f in schema])
+
+    def encode(self) -> bytes:
+        out = io.BytesIO()
+        out.write(_MAGIC)
+        out.write(struct.pack("<HIqqqH", _VERSION, self.n_rows,
+                              self.compressed_size, self.uncompressed_size,
+                              0, len(self.field_names)))
+        codec_b = self.codec.encode()
+        out.write(struct.pack("<H", len(codec_b)))
+        out.write(codec_b)
+        for name, tname, nullable in zip(self.field_names, self.field_types,
+                                         self.field_nullable):
+            nb, tb = name.encode(), tname.encode()
+            out.write(struct.pack("<HHB", len(nb), len(tb), int(nullable)))
+            out.write(nb)
+            out.write(tb)
+        return out.getvalue()
+
+    @staticmethod
+    def decode(payload: bytes) -> Tuple["ShuffleTableMeta", int]:
+        """Returns (meta, header_length)."""
+        buf = io.BytesIO(payload)
+        assert buf.read(4) == _MAGIC, "bad shuffle metadata magic"
+        version, n_rows, csize, usize, _, n_fields = struct.unpack(
+            "<HIqqqH", buf.read(32))
+        assert version == _VERSION, version
+        (codec_len,) = struct.unpack("<H", buf.read(2))
+        codec = buf.read(codec_len).decode()
+        names, types, nullables = [], [], []
+        for _ in range(n_fields):
+            nl, tl, nullable = struct.unpack("<HHB", buf.read(5))
+            names.append(buf.read(nl).decode())
+            types.append(buf.read(tl).decode())
+            nullables.append(bool(nullable))
+        return ShuffleTableMeta(n_rows, codec, csize, usize, names, types,
+                                nullables), buf.tell()
+
+
+def serialize_batch(rb: pa.RecordBatch,
+                    codec: TableCompressionCodec) -> bytes:
+    """RecordBatch -> [meta header][codec-compressed IPC stream]."""
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, rb.schema) as w:
+        w.write_batch(rb)
+    raw = sink.getvalue()
+    compressed = codec.compress(raw)
+    meta = ShuffleTableMeta.for_batch(rb, codec.name, len(compressed),
+                                      len(raw))
+    return meta.encode() + compressed
+
+def deserialize_batch(payload: bytes) -> Tuple[ShuffleTableMeta,
+                                               pa.RecordBatch]:
+    meta, off = ShuffleTableMeta.decode(payload)
+    body = payload[off: off + meta.compressed_size]
+    raw = get_codec(meta.codec).decompress(body, meta.uncompressed_size)
+    with pa.ipc.open_stream(io.BytesIO(raw)) as r:
+        return meta, next(iter(r))
