@@ -11,12 +11,24 @@ See SURVEY.md for the capability blueprint and the mapping from each
 reference component to its TPU-native counterpart here.
 """
 
+import os
+
 import jax
 
 # The SQL type system requires real int64/float64 columns (Spark bigint /
 # double). jax disables 64-bit types by default; turn them on before any
 # array is created anywhere in the package.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: query kernels compile once per machine,
+# not once per process — the pre-compiled-kernel-library property of the
+# reference's libcudf substrate (SURVEY.md §2.10). Opt out or relocate with
+# SPARK_RAPIDS_TPU_COMPILE_CACHE=off|<dir>.
+_cache_dir = os.environ.get("SPARK_RAPIDS_TPU_COMPILE_CACHE",
+                            os.path.expanduser("~/.cache/spark_rapids_tpu"))
+if _cache_dir.lower() != "off":
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
 from .version import __version__  # noqa: E402,F401
 from . import types  # noqa: E402,F401

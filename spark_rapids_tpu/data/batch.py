@@ -15,6 +15,7 @@ one compiled program per (schema, capacity-bucket), row count fully dynamic.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import List, Optional, Sequence, Union
 
 import jax
@@ -77,9 +78,19 @@ class ColumnarBatch:
         return ColumnarBatch(cols, jnp.asarray(rb.num_rows, dtype=jnp.int32), schema)
 
     def to_arrow(self) -> pa.RecordBatch:
-        """Download to host. Syncs ``n_rows`` — only call at stage boundaries."""
+        """Download to host. Syncs ``n_rows`` — only call at stage boundaries.
+
+        Transfer discipline (the tunnel charges ~a round trip per blocking
+        read): one scalar sync for the row count, one cached shrink kernel
+        when live rows occupy a smaller capacity bucket, then ONE batched
+        ``jax.device_get`` for every buffer of every column.
+        """
         n = int(self.n_rows)
-        arrays = [c.to_arrow(n) for c in self.columns]
+        cap = bucket_capacity(max(n, 1))
+        batch = _shrink_batch(self, cap) if cap < self.capacity else self
+        host = jax.device_get([c.device_buffers() for c in batch.columns])
+        arrays = [c.arrow_from_host(bufs, n)
+                  for c, bufs in zip(batch.columns, host)]
         fields = [pa.field(f.name, T.to_arrow_type(f.data_type), f.nullable)
                   for f in self.schema]
         return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
@@ -93,6 +104,21 @@ class ColumnarBatch:
             if c.offsets is not None:
                 total += c.offsets.size * 4
         return total
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _shrink_batch(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
+    """Copy a batch into a smaller capacity bucket (>= its live rows), so
+    downloads move O(live) bytes instead of O(capacity). Rows past n_rows
+    are dead by invariant, so a front slice is sufficient."""
+    cols = []
+    for c in batch.columns:
+        if c.is_string:
+            cols.append(DeviceColumn(c.data, c.validity[:cap], c.dtype,
+                                     c.offsets[: cap + 1], c.max_bytes))
+        else:
+            cols.append(DeviceColumn(c.data[:cap], c.validity[:cap], c.dtype))
+    return ColumnarBatch(tuple(cols), batch.n_rows, batch.schema)
 
 
 @dataclasses.dataclass

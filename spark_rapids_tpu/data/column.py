@@ -185,28 +185,43 @@ class DeviceColumn:
             values.astype(dtype.np_dtype, copy=False), validity, dtype, capacity)
 
     # -- download -----------------------------------------------------------
-    def to_arrow(self, n_rows: int) -> pa.Array:
-        """Download the first ``n_rows`` live rows as a pyarrow array."""
-        validity = np.asarray(self.validity[:n_rows])
+    def device_buffers(self) -> tuple:
+        """The device arrays to download for host reassembly (batch these
+        through one ``jax.device_get`` — the tunnel charges per round trip)."""
+        if self.is_string:
+            return (self.data, self.validity, self.offsets)
+        return (self.data, self.validity)
+
+    def arrow_from_host(self, bufs: tuple, n_rows: int) -> pa.Array:
+        """Reassemble a pyarrow array from downloaded buffers (see
+        :meth:`device_buffers`). Zero-copy: the device layout IS the Arrow
+        layout (offsets + bytes, values + validity); no per-row Python."""
         if self.dtype is T.NULL:
             return pa.nulls(n_rows)
+        validity = np.ascontiguousarray(bufs[1][:n_rows])
+        all_valid = bool(validity.all())
+        null_count = 0 if all_valid else int(n_rows - validity.sum())
+        mask_buf = None if all_valid else \
+            pa.py_buffer(np.packbits(validity, bitorder="little"))
         if self.is_string:
-            offsets = np.asarray(self.offsets[: n_rows + 1]).astype(np.int64)
-            payload = np.asarray(self.data)
-            out = []
-            for i in range(n_rows):
-                if validity[i]:
-                    out.append(bytes(payload[offsets[i]: offsets[i + 1]]).decode(
-                        "utf-8", errors="replace"))
-                else:
-                    out.append(None)
-            return pa.array(out, type=pa.string())
-        values = np.asarray(self.data[:n_rows])
+            offsets = np.ascontiguousarray(bufs[2][: n_rows + 1])
+            payload = np.ascontiguousarray(bufs[0])
+            return pa.StringArray.from_buffers(
+                n_rows, pa.py_buffer(offsets), pa.py_buffer(payload),
+                mask_buf, null_count)
+        values = np.ascontiguousarray(bufs[0][:n_rows])
         arrow_type = T.to_arrow_type(self.dtype)
-        if validity.all():
-            return pa.array(values, type=arrow_type)
-        masked = [values[i].item() if validity[i] else None for i in range(n_rows)]
-        return pa.array(masked, type=arrow_type)
+        if self.dtype is T.BOOLEAN:
+            values_buf = pa.py_buffer(np.packbits(values, bitorder="little"))
+        else:
+            values_buf = pa.py_buffer(values)
+        return pa.Array.from_buffers(
+            arrow_type, n_rows, [mask_buf, values_buf], null_count)
+
+    def to_arrow(self, n_rows: int) -> pa.Array:
+        """Download the first ``n_rows`` live rows as a pyarrow array."""
+        return self.arrow_from_host(
+            jax.device_get(self.device_buffers()), n_rows)
 
 
 def _arrow_validity(arr: pa.Array) -> Optional[np.ndarray]:

@@ -92,8 +92,16 @@ class TpuCoalesceBatchesExec(TpuExec):
                 return out
 
             for db in part:
-                rows = int(db.n_rows)  # host sync, like the reference's
-                if rows == 0:          # per-batch row accounting
+                # Start the row-count download without blocking, then read
+                # it; compute for this batch was already dispatched, so the
+                # read overlaps the device work instead of adding a round
+                # trip of its own.
+                try:
+                    db.n_rows.copy_to_host_async()
+                except (AttributeError, RuntimeError):
+                    pass
+                rows = int(db.n_rows)
+                if rows == 0:
                     continue
                 if catalog is not None:
                     pending.append(catalog.register_batch(

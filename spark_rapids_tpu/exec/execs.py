@@ -21,7 +21,6 @@ Operator parity map (reference locations in SURVEY.md §2.3):
 
 from __future__ import annotations
 
-import functools
 from typing import Iterator, List, Optional
 
 import jax
@@ -40,6 +39,7 @@ from ..ops.kernels import join as KJ
 from ..ops.kernels import rowops as KR
 from ..plan.logical import SortOrder
 from ..plan.physical import ExecContext, PhysicalPlan
+from ..utils.kernel_cache import cached_kernel, kernel_key
 from ..utils.tracing import trace_range
 
 
@@ -125,6 +125,27 @@ class DeviceToHostExec(PhysicalPlan):
         return [run(p) for p in self.children[0].execute(ctx)]
 
 
+class DeviceSourceExec(TpuExec):
+    """Source over device-resident cached partitions (df.cache() analog):
+    batches were pinned in HBM by ``TpuSession.materialize`` and replay with
+    zero upload cost."""
+
+    def __init__(self, partitions, schema: T.Schema):
+        self.children = []
+        self.partitions = partitions  # List[List[ColumnarBatch]]
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"DeviceSource parts={len(self.partitions)}"
+
+    def execute(self, ctx):
+        return [iter(list(p)) for p in self.partitions]
+
+
 # ---------------------------------------------------------------------------
 # Narrow operators
 # ---------------------------------------------------------------------------
@@ -147,10 +168,13 @@ class TpuProjectExec(TpuExec):
         bound = _bind_all(self.exprs, self.children[0].schema)
         out_schema = self.schema
 
-        @jax.jit
-        def project(batch: ColumnarBatch) -> ColumnarBatch:
-            cols = tuple(e.eval_device(batch) for e in bound)
-            return batch.with_columns(cols, out_schema)
+        def build():
+            def project(batch: ColumnarBatch) -> ColumnarBatch:
+                cols = tuple(e.eval_device(batch) for e in bound)
+                return batch.with_columns(cols, out_schema)
+            return project
+        project = cached_kernel("project", kernel_key(bound, out_schema),
+                                build)
 
         def run(part):
             for db in part:
@@ -173,11 +197,13 @@ class TpuFilterExec(TpuExec):
     def execute(self, ctx):
         bound = self.condition.bind(self.children[0].schema)
 
-        @jax.jit
-        def filt(batch: ColumnarBatch) -> ColumnarBatch:
-            mask_col = bound.eval_device(batch)
-            keep = mask_col.data & mask_col.validity
-            return KR.compact(batch, keep)
+        def build():
+            def filt(batch: ColumnarBatch) -> ColumnarBatch:
+                mask_col = bound.eval_device(batch)
+                keep = mask_col.data & mask_col.validity
+                return KR.compact(batch, keep)
+            return filt
+        filt = cached_kernel("filter", kernel_key(bound), build)
 
         def run(part):
             for db in part:
@@ -293,7 +319,6 @@ class TpuExpandExec(TpuExec):
         out_schema = self._schema
 
         def make_projection(proj):
-            @jax.jit
             def project(batch):
                 cols = []
                 for e, f in zip(proj, out_schema):
@@ -306,7 +331,9 @@ class TpuExpandExec(TpuExec):
                 return batch.with_columns(tuple(cols), out_schema)
             return project
 
-        fns = [make_projection(p) for p in bound]
+        fns = [cached_kernel("expand", kernel_key(p, out_schema),
+                             lambda p=p: make_projection(p))
+               for p in bound]
 
         def run(part):
             for db in part:
@@ -340,21 +367,25 @@ class TpuSortExec(TpuExec):
         asc = [o.ascending for o in self.orders]
         nf = [o.effective_nulls_first for o in self.orders]
 
+        def build():
+            def do_sort(b):
+                keys = [e.eval_device(b) for e in key_exprs]
+                perm = KR.sort_permutation(keys, b.n_rows, asc, nf)
+                return KR.gather_batch(b, perm, b.n_rows)
+            return do_sort
+        do_sort = cached_kernel("sort", kernel_key(key_exprs, asc, nf), build)
+
         def gen():
             batches = []
             for part in self.children[0].execute(ctx):
                 batches.extend(part)
             if not batches:
                 return
-            merged = _coalesce_device(batches)
-
-            @jax.jit
-            def do_sort(b):
-                keys = [e.eval_device(b) for e in key_exprs]
-                perm = KR.sort_permutation(keys, b.n_rows, asc, nf)
-                return KR.gather_batch(b, perm, b.n_rows)
-            yield do_sort(merged)
+            yield do_sort(_coalesce_device(batches))
         return [gen()]
+
+
+_concat_jit = jax.jit(KC.concat_batches, static_argnums=(1,))
 
 
 def _coalesce_device(batches: List[ColumnarBatch]) -> ColumnarBatch:
@@ -363,7 +394,7 @@ def _coalesce_device(batches: List[ColumnarBatch]) -> ColumnarBatch:
         return batches[0]
     total = sum(int(b.n_rows) for b in batches)
     cap = bucket_capacity(max(total, 1))
-    return KC.concat_batches(batches, cap)
+    return _concat_jit(batches, cap)
 
 
 # ---------------------------------------------------------------------------
@@ -413,18 +444,25 @@ class TpuHashAggregateExec(TpuExec):
                 for a in self.aggregates]
         buf_schema = self._buffer_schema()
         n_keys = len(groupings)
+        agg_key = kernel_key(groupings, [(a.name, a.func) for a in aggs],
+                             buf_schema)
 
-        @jax.jit
-        def partial(batch: ColumnarBatch) -> ColumnarBatch:
-            return _aggregate_batch(batch, groupings, aggs, buf_schema,
-                                    n_keys, update_mode=True)
+        def build_partial():
+            def partial(batch: ColumnarBatch) -> ColumnarBatch:
+                return _aggregate_batch(batch, groupings, aggs, buf_schema,
+                                        n_keys, update_mode=True)
+            return partial
 
-        @jax.jit
-        def merge(batch: ColumnarBatch) -> ColumnarBatch:
-            key_refs = [BoundReference(i, f.data_type, f.nullable)
-                        for i, f in enumerate(buf_schema)][:n_keys]
-            return _aggregate_batch(batch, key_refs, aggs, buf_schema,
-                                    n_keys, update_mode=False)
+        def build_merge():
+            def merge(batch: ColumnarBatch) -> ColumnarBatch:
+                key_refs = [BoundReference(i, f.data_type, f.nullable)
+                            for i, f in enumerate(buf_schema)][:n_keys]
+                return _aggregate_batch(batch, key_refs, aggs, buf_schema,
+                                        n_keys, update_mode=False)
+            return merge
+
+        partial = cached_kernel("agg_partial", agg_key, build_partial)
+        merge = cached_kernel("agg_merge", agg_key, build_merge)
 
         def gen():
             state: Optional[ColumnarBatch] = None
@@ -447,19 +485,26 @@ class TpuHashAggregateExec(TpuExec):
                   ) -> ColumnarBatch:
         out_schema = self.schema
         n_keys = len(self.groupings)
+        aggregates = self.aggregates
 
-        @jax.jit
-        def final(b: ColumnarBatch) -> ColumnarBatch:
-            cols = list(b.columns[:n_keys])
-            bi = n_keys
-            for a in self.aggregates:
-                specs = a.func.buffers()
-                refs = [BoundReference(bi + j, s.dtype, True)
-                        for j, s in enumerate(specs)]
-                bi += len(specs)
-                result_expr = a.func.evaluate(refs)
-                cols.append(result_expr.eval_device(b))
-            return ColumnarBatch(tuple(cols), b.n_rows, out_schema)
+        def build():
+            def final(b: ColumnarBatch) -> ColumnarBatch:
+                cols = list(b.columns[:n_keys])
+                bi = n_keys
+                for a in aggregates:
+                    specs = a.func.buffers()
+                    refs = [BoundReference(bi + j, s.dtype, True)
+                            for j, s in enumerate(specs)]
+                    bi += len(specs)
+                    result_expr = a.func.evaluate(refs)
+                    cols.append(result_expr.eval_device(b))
+                return ColumnarBatch(tuple(cols), b.n_rows, out_schema)
+            return final
+        final = cached_kernel(
+            "agg_final",
+            kernel_key(n_keys, [(a.name, a.func) for a in aggregates],
+                       buf_schema, out_schema),
+            build)
         return final(state)
 
     def _empty_result(self) -> ColumnarBatch:
@@ -581,8 +626,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         jt = self.join_type
         out_schema = self._schema
 
-        @functools.partial(jax.jit, static_argnums=(2,))
-        def kernel(probe, build, out_cap):
+        def kernel_impl(probe, build, out_cap):
             pk = [e.eval_device(probe) for e in lkeys]
             bk = [e.eval_device(build) for e in rkeys]
             bids, pids = KJ.dense_key_ids(bk, pk, build.n_rows, probe.n_rows)
@@ -610,14 +654,21 @@ class TpuShuffledHashJoinExec(TpuExec):
             out = ColumnarBatch(tuple(pcols + bcols), n_out, out_schema)
             return (out, hits), total
 
+        kernel = cached_kernel(
+            "hash_join", kernel_key(jt, lkeys, rkeys, out_schema),
+            lambda: kernel_impl, static_argnums=(2,))
+
         post_filter = None
         if self.condition is not None:
             cond = self.condition.bind(out_schema)
 
-            @jax.jit
-            def post_filter(b):
-                mask = cond.eval_device(b)
-                return KR.compact(b, mask.data & mask.validity)
+            def build_post():
+                def post_filter(b):
+                    mask = cond.eval_device(b)
+                    return KR.compact(b, mask.data & mask.validity)
+                return post_filter
+            post_filter = cached_kernel("join_post_filter", kernel_key(cond),
+                                        build_post)
 
         def join_batch(probe, build):
             out_cap = bucket_capacity(
@@ -661,18 +712,21 @@ class TpuShuffledHashJoinExec(TpuExec):
         return [gen()]
 
     def _unmatched_build(self, build: ColumnarBatch, hit_acc) -> ColumnarBatch:
-        n_left = len(self.children[0].schema)
+        left_schema = self.children[0].schema
+        out_schema = self._schema
 
-        @jax.jit
-        def kernel(build, hits):
-            live_b = build.row_mask()
-            keep = live_b & ~hits if hits is not None else live_b
-            compacted = KR.compact(build, keep)
-            null_left = [
-                _null_col(f.data_type, build.capacity)
-                for f in self.children[0].schema]
-            cols = tuple(null_left) + compacted.columns
-            return ColumnarBatch(cols, compacted.n_rows, self._schema)
+        def builder():
+            def kernel(build, hits):
+                live_b = build.row_mask()
+                keep = live_b & ~hits if hits is not None else live_b
+                compacted = KR.compact(build, keep)
+                null_left = [_null_col(f.data_type, build.capacity)
+                             for f in left_schema]
+                cols = tuple(null_left) + compacted.columns
+                return ColumnarBatch(cols, compacted.n_rows, out_schema)
+            return kernel
+        kernel = cached_kernel("join_unmatched_build",
+                               kernel_key(left_schema, out_schema), builder)
         return kernel(build, hit_acc)
 
 

@@ -20,13 +20,10 @@ batch that XLA fuses with the condition expression — instead of looping rows.
 
 from __future__ import annotations
 
-import functools
-import io
 from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
-import pyarrow as pa
 
 from .. import types as T
 from ..data.batch import ColumnarBatch
@@ -34,6 +31,7 @@ from ..data.column import bucket_capacity
 from ..ops.expression import Expression
 from ..ops.kernels import rowops as KR
 from ..plan.physical import PhysicalPlan
+from ..utils.kernel_cache import cached_kernel, kernel_key
 from ..utils.tracing import trace_range
 from .execs import (TpuExec, TpuShuffledHashJoinExec, _bind_all,
                     _coalesce_device, _null_col, _null_extend_right)
@@ -67,14 +65,10 @@ class TpuBroadcastExchangeExec(TpuExec):
             return None
         with trace_range("broadcast.collect"):
             merged = _coalesce_device(batches)
-            # Serialize the broadcast payload (host side of the exchange) to
-            # size it; the bytes themselves are not retained — in-process,
-            # consumers share the device batch directly.
-            rb = merged.to_arrow()
-            sink = io.BytesIO()
-            with pa.ipc.new_stream(sink, rb.schema) as w:
-                w.write_batch(rb)
-            self._payload_bytes = sink.tell()
+            # Payload size from the device buffer footprint; the IPC bytes
+            # are only materialized if a multi-process transport needs them
+            # — in-process, consumers share the device batch directly.
+            self._payload_bytes = merged.device_size_bytes
         self._device_batch = merged
         return merged
 
@@ -135,8 +129,8 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             cond = self.condition.bind(
                 T.Schema(list(left.schema) + list(right.schema)))
 
-        @functools.partial(jax.jit, static_argnums=(2,))
-        def kernel(probe: ColumnarBatch, build: ColumnarBatch, out_cap: int):
+        def kernel_impl(probe: ColumnarBatch, build: ColumnarBatch,
+                        out_cap: int):
             pcap, bcap = probe.capacity, build.capacity
             n_pairs = pcap * bcap
             p_idx = jnp.repeat(jnp.arange(pcap, dtype=jnp.int32), bcap)
@@ -180,6 +174,11 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                 extra = KR.compact(probe, unmatched)
                 return (out, extra), n_match
             return (out, None), n_match
+
+        kernel = cached_kernel(
+            "nested_loop_join",
+            kernel_key(jt, cond, pair_schema, out_schema),
+            lambda: kernel_impl, static_argnums=(2,))
 
         def gen():
             build_batches = []

@@ -28,6 +28,7 @@ from ..ops.expression import Expression
 from ..ops.kernels import rowops as KR
 from ..ops.kernels import window as KW
 from ..plan.physical import PhysicalPlan
+from ..utils.kernel_cache import cached_kernel, kernel_key
 from .execs import TpuExec, _coalesce_device
 
 
@@ -60,15 +61,19 @@ class TpuWindowExec(TpuExec):
             bound.append((name, func, part, orders, spec.effective_frame()))
         out_schema = self._schema
 
-        @jax.jit
-        def window_all(batch: ColumnarBatch) -> ColumnarBatch:
-            out_cols = list(batch.columns)
-            for name, func, part, orders, frame in bound:
-                data, valid, dtype = _eval_window(batch, func, part, orders,
-                                                  frame)
-                out_cols.append(DeviceColumn(data=data, validity=valid,
-                                             dtype=dtype))
-            return ColumnarBatch(tuple(out_cols), batch.n_rows, out_schema)
+        def build():
+            def window_all(batch: ColumnarBatch) -> ColumnarBatch:
+                out_cols = list(batch.columns)
+                for name, func, part, orders, frame in bound:
+                    data, valid, dtype = _eval_window(batch, func, part,
+                                                      orders, frame)
+                    out_cols.append(DeviceColumn(data=data, validity=valid,
+                                                 dtype=dtype))
+                return ColumnarBatch(tuple(out_cols), batch.n_rows,
+                                     out_schema)
+            return window_all
+        window_all = cached_kernel("window", kernel_key(bound, out_schema),
+                                   build)
 
         def run(part):
             batches = [db for db in part]

@@ -133,6 +133,31 @@ class LocalRelation(LogicalPlan):
         return self._schema
 
 
+class CachedRelation(LogicalPlan):
+    """A materialized (cached) relation — the Spark ``df.cache()`` analog.
+
+    Under a device session the pinned partitions are device-resident
+    ``ColumnarBatch`` lists (data stays in HBM across queries, the in-memory
+    parallel of the reference's GPU-resident caches); under a CPU session
+    they are host record batches."""
+
+    def __init__(self, schema: T.Schema, device_parts=None, host_batches=None,
+                 n_rows: int = 0):
+        self.children = []
+        self._schema = schema
+        self.device_parts = device_parts  # List[List[ColumnarBatch]] | None
+        self.host_batches = host_batches  # List[pa.RecordBatch] | None
+        self.n_rows = n_rows
+
+    @property
+    def schema(self) -> T.Schema:
+        return self._schema
+
+    def describe(self):
+        kind = "device" if self.device_parts is not None else "host"
+        return f"CachedRelation[{kind}, {self.n_rows} rows]"
+
+
 class Range(LogicalPlan):
     """spark.range() analog (GpuRangeExec, basicPhysicalOperators.scala:182)."""
 
@@ -676,6 +701,15 @@ class DataFrame:
         return DataFrame(
             Aggregate(self._plan, [col(n) for n in self.columns], []),
             self._session)
+
+    def cache(self) -> "DataFrame":
+        """Materialize now and pin the result (eager Spark cache): device
+        batches stay in HBM under a device session, so later queries read
+        them with zero upload."""
+        if isinstance(self._plan, CachedRelation):
+            return self
+        return DataFrame(self._session.materialize(self._plan),
+                         self._session)
 
     @property
     def write(self) -> DataFrameWriter:

@@ -439,8 +439,10 @@ def _make_nlj(n: "P.CpuNestedLoopJoinExec", ch):
 
 #: Node types that legitimately stay on CPU (host-side sources; the scan
 #: device-decode path is a later milestone, like the reference's host-read +
-#: device-decode split).
-HOST_SOURCE_NODES = ("CpuLocalScanExec", "CpuFileScanExec")
+#: device-decode split). DeviceSourceExec is already device-resident and
+#: needs no replacement rule.
+HOST_SOURCE_NODES = ("CpuLocalScanExec", "CpuFileScanExec",
+                     "DeviceSourceExec")
 
 
 class FallbackOnTpuError(AssertionError):

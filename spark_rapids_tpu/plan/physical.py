@@ -34,10 +34,21 @@ class ExecContext:
     metrics: dict = dataclasses.field(default_factory=dict)
     #: spill BufferCatalog (memory/spill.py); None in bare unit tests
     catalog: object = None
+    #: end-of-query callbacks (shuffle unregister etc.); run by close()
+    cleanups: list = dataclasses.field(default_factory=list)
 
     def metric(self, node: str, name: str, value):
         self.metrics.setdefault(node, {})
         self.metrics[node][name] = self.metrics[node].get(name, 0) + value
+
+    def add_cleanup(self, fn: Callable[[], None]):
+        self.cleanups.append(fn)
+
+    def close(self):
+        """Run deferred cleanups (query end; TpuSession.execute's finally)."""
+        cleanups, self.cleanups = self.cleanups, []
+        for fn in reversed(cleanups):
+            fn()
 
 
 class PhysicalPlan:

@@ -25,6 +25,8 @@ def estimate_rows(plan: L.LogicalPlan) -> Optional[int]:
     Spark's logical statistics)."""
     if isinstance(plan, L.LocalRelation):
         return sum(rb.num_rows for rb in plan.batches)
+    if isinstance(plan, L.CachedRelation):
+        return plan.n_rows
     if isinstance(plan, L.Range):
         return max(0, -(-(plan.end - plan.start) // plan.step))
     if isinstance(plan, L.Limit):
@@ -82,6 +84,11 @@ def plan_physical(plan: L.LogicalPlan,
                   conf: TpuConf = DEFAULT_CONF) -> P.PhysicalPlan:
     if isinstance(plan, L.LocalRelation):
         return P.CpuLocalScanExec(plan.batches, plan.schema)
+    if isinstance(plan, L.CachedRelation):
+        if plan.device_parts is not None:
+            from ..exec.execs import DeviceSourceExec
+            return DeviceSourceExec(plan.device_parts, plan.schema)
+        return P.CpuLocalScanExec(plan.host_batches, plan.schema)
     if isinstance(plan, L.Range):
         return P.CpuRangeExec(plan.start, plan.end, plan.step)
     if isinstance(plan, L.Scan):

@@ -102,7 +102,39 @@ class TpuSession:
     def execute(self, logical: L.LogicalPlan) -> pa.Table:
         physical = self.plan(logical)
         ctx = P.ExecContext(self.conf, catalog=self.device_manager.catalog)
-        return P.collect_partitions(physical, ctx)
+        try:
+            return P.collect_partitions(physical, ctx)
+        finally:
+            ctx.close()
+
+    def materialize(self, logical: L.LogicalPlan) -> "L.CachedRelation":
+        """Execute now and pin the result (eager df.cache()). Under a
+        device session the batches stay resident in HBM."""
+        physical = self.plan(logical)
+        ctx = P.ExecContext(self.conf, catalog=self.device_manager.catalog)
+        from .exec.execs import DeviceToHostExec, HostToDeviceExec
+        try:
+            if self.conf.sql_enabled:
+                if isinstance(physical, DeviceToHostExec) \
+                        and physical.children[0].columnar:
+                    device_root = physical.children[0]
+                elif not physical.columnar:
+                    # Pure host plan (e.g. a bare table): upload so the
+                    # cache is device-resident.
+                    device_root = HostToDeviceExec(physical,
+                                                   self.conf.batch_size_rows)
+                else:
+                    device_root = physical
+                parts = [list(p) for p in device_root.execute(ctx)]
+                n = sum(int(b.n_rows) for p in parts for b in p)
+                return L.CachedRelation(logical.schema, device_parts=parts,
+                                        n_rows=n)
+            table = P.collect_partitions(physical, ctx)
+            rbs = table.combine_chunks().to_batches()
+            return L.CachedRelation(logical.schema, host_batches=rbs,
+                                    n_rows=table.num_rows)
+        finally:
+            ctx.close()
 
     def explain(self, logical: L.LogicalPlan) -> str:
         physical = self.plan(logical)

@@ -37,6 +37,7 @@ from .. import types as T
 from ..config import SHUFFLE_COMPRESSION_CODEC
 from ..data.batch import ColumnarBatch, HostBatch
 from ..plan.physical import ExecContext, PhysicalPlan, _arrow_schema
+from ..utils.kernel_cache import cached_kernel, kernel_key
 from ..utils.tracing import trace_range
 from .codec import get_codec
 from .serializer import deserialize_batch, serialize_batch
@@ -180,15 +181,21 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         shuffle_id = _new_shuffle_id()
         n_parts = self.n_parts
 
-        @jax.jit
-        def partition_sort(batch: ColumnarBatch):
-            ids = partitioner.device_ids(batch)
-            live = batch.row_mask()
-            ids = jnp.where(live, ids, n_parts)
-            iota = jnp.arange(batch.capacity, dtype=jnp.int32)
-            sorted_ids, perm = jax.lax.sort((ids, iota), num_keys=1,
-                                            is_stable=True)
-            return KR.gather_batch(batch, perm, batch.n_rows), sorted_ids
+        def build():
+            def partition_sort(batch: ColumnarBatch):
+                ids = partitioner.device_ids(batch)
+                live = batch.row_mask()
+                ids = jnp.where(live, ids, n_parts)
+                iota = jnp.arange(batch.capacity, dtype=jnp.int32)
+                sorted_ids, perm = jax.lax.sort((ids, iota), num_keys=1,
+                                                is_stable=True)
+                return KR.gather_batch(batch, perm, batch.n_rows), sorted_ids
+            return partition_sort
+        partition_sort = cached_kernel(
+            "shuffle_partition_sort",
+            kernel_key(type(partitioner).__qualname__, partitioner.__dict__,
+                       n_parts),
+            build)
 
         # WRITE side (RapidsCachingWriter analog, host-serialized payloads).
         map_id = 0
@@ -214,11 +221,23 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 map_id += 1
 
         # READ side (RapidsCachingReader analog): lazy fetch + re-upload.
+        # Blocks free once every reduce partition is drained — or at query
+        # end via the context cleanup (a limit may never start some
+        # partitions) — the unregisterShuffle lifecycle
+        # (ShuffleBufferCatalog.scala:50).
+        ctx.add_cleanup(lambda: catalog.unregister_shuffle(shuffle_id))
+        drained = {"n": 0}
+
         def read_partition(p):
-            for payload in catalog.blocks_for_reduce(shuffle_id, p):
-                with trace_range("shuffle.deserialize"):
-                    _, rb = deserialize_batch(payload)
-                yield ColumnarBatch.from_arrow(rb)
+            try:
+                for payload in catalog.blocks_for_reduce(shuffle_id, p):
+                    with trace_range("shuffle.deserialize"):
+                        _, rb = deserialize_batch(payload)
+                    yield ColumnarBatch.from_arrow(rb)
+            finally:
+                drained["n"] += 1
+                if drained["n"] == n_parts:
+                    catalog.unregister_shuffle(shuffle_id)
         return [read_partition(p) for p in range(self.n_parts)]
 
 

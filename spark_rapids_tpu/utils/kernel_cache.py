@@ -1,0 +1,97 @@
+"""Process-lifetime device-kernel cache.
+
+The reference's device compute comes from libcudf's pre-compiled kernel
+library: planning a query never compiles CUDA. The XLA analog is keeping one
+``jax.jit``-wrapped callable alive per (operator kind, bound-expression
+signature) for the life of the process, so re-planning or re-running a query
+reuses the already-compiled program — jit's own cache then specializes per
+(schema, capacity-bucket) through the batch pytree treedef.
+
+Execs must not create ``@jax.jit`` closures inside ``execute()``: a fresh
+wrapper has an empty compile cache, which recompiles the whole pipeline on
+every query run. They call :func:`cached_kernel` with a structural key built
+by :func:`kernel_key` from their bound expressions instead.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+
+_CACHE: Dict[tuple, Callable] = {}
+_LOCK = threading.Lock()
+_STATS = {"hits": 0, "misses": 0}
+
+
+def kernel_key(*parts) -> tuple:
+    """Build a hashable structural signature from expressions, schemas,
+    dtypes, dataclasses, and plain containers/primitives."""
+    return tuple(_sig_value(p) for p in parts)
+
+
+def _sig_value(v) -> tuple:
+    # Late import: expression depends on data/batch which must not import us
+    # circularly at module load.
+    from ..ops.expression import Expression
+
+    if isinstance(v, Expression):
+        return _expr_signature(v)
+    if isinstance(v, (list, tuple)):
+        return ("seq",) + tuple(_sig_value(x) for x in v)
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return (type(v).__name__, v)
+    if isinstance(v, frozenset):
+        return ("fset",) + tuple(sorted(map(_sig_value, v)))
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return (type(v).__qualname__,) + tuple(
+            (f.name, _sig_value(getattr(v, f.name)))
+            for f in dataclasses.fields(v))
+    if isinstance(v, dict):
+        return ("dict",) + tuple(
+            (k, _sig_value(x)) for k, x in sorted(v.items()))
+    return ("repr", type(v).__qualname__, repr(v))
+
+
+def _expr_signature(e) -> tuple:
+    extras = tuple(
+        (k, _sig_value(v)) for k, v in sorted(e.__dict__.items())
+        if k != "children")
+    return ("expr", type(e).__qualname__, extras,
+            tuple(_expr_signature(c) for c in e.children))
+
+
+def cached_kernel(kind: str, key: tuple, builder: Callable[[], Callable],
+                  static_argnums: Optional[Tuple[int, ...]] = None
+                  ) -> Callable:
+    """Return the process-wide jitted kernel for (kind, key), building and
+    wrapping ``builder()`` in ``jax.jit`` on first use."""
+    k = (kind, key)
+    with _LOCK:
+        fn = _CACHE.get(k)
+        if fn is not None:
+            _STATS["hits"] += 1
+            return fn
+    raw = builder()
+    jitted = jax.jit(raw) if static_argnums is None else \
+        jax.jit(raw, static_argnums=static_argnums)
+    with _LOCK:
+        fn = _CACHE.setdefault(k, jitted)
+        if fn is jitted:
+            _STATS["misses"] += 1
+        else:
+            _STATS["hits"] += 1
+    return fn
+
+
+def cache_stats() -> dict:
+    with _LOCK:
+        return dict(_STATS, entries=len(_CACHE))
+
+
+def clear_cache() -> None:
+    with _LOCK:
+        _CACHE.clear()
+        _STATS["hits"] = _STATS["misses"] = 0

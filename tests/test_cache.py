@@ -1,0 +1,65 @@
+"""df.cache() — materialized relations (Spark cache analog; the reference
+covers caching via integration_tests cache_test.py)."""
+
+import numpy as np
+
+from spark_rapids_tpu.plan.logical import CachedRelation
+
+from harness import assert_tpu_and_cpu_are_equal, cpu_session, tpu_session
+
+
+def _data(n=1000):
+    rng = np.random.default_rng(3)
+    return {
+        "k": rng.integers(0, 10, n).astype(np.int64).tolist(),
+        "v": rng.integers(-100, 100, n).astype(np.int64).tolist(),
+    }
+
+
+def test_cached_matches_uncached_device():
+    s = tpu_session()
+    df = s.create_dataframe(_data())
+    cached = df.cache()
+    assert isinstance(cached._plan, CachedRelation)
+    # Device session pins device-resident partitions.
+    assert cached._plan.device_parts is not None
+    assert cached._plan.n_rows == 1000
+    assert df.collect().to_pydict() == cached.collect().to_pydict()
+
+
+def test_cached_matches_uncached_cpu():
+    s = cpu_session()
+    df = s.create_dataframe(_data())
+    cached = df.cache()
+    assert cached._plan.host_batches is not None
+    assert df.collect().to_pydict() == cached.collect().to_pydict()
+
+
+def test_cache_is_idempotent():
+    s = tpu_session()
+    cached = s.create_dataframe(_data()).cache()
+    assert cached.cache() is cached
+
+
+def test_query_over_cached_differential():
+    from spark_rapids_tpu.ops import aggregates as AGG
+    from spark_rapids_tpu.ops.expression import col
+
+    def q(session):
+        df = session.create_dataframe(_data()).cache()
+        return (df.where(col("v") > 0)
+                  .group_by(col("k"))
+                  .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s"),
+                       AGG.AggregateExpression(AGG.Count(), "c")))
+    assert_tpu_and_cpu_are_equal(q)
+
+
+def test_cached_query_result_device():
+    """Caching a query (not just a table) pins the computed result."""
+    from spark_rapids_tpu.ops.expression import col
+    s = tpu_session()
+    df = s.create_dataframe(_data()).where(col("v") > 0).cache()
+    assert df._plan.device_parts is not None
+    expected = [v for v in _data()["v"] if v > 0]
+    got = df.collect().to_pydict()["v"]
+    assert sorted(got) == sorted(expected)
