@@ -525,18 +525,30 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
                      buf_schema: T.Schema, n_keys: int,
                      update_mode: bool) -> ColumnarBatch:
     """One grouping pass. update_mode: inputs are raw rows (evaluate agg
-    children, apply update ops). merge mode: inputs are buffer columns."""
+    children, apply update ops). merge mode: inputs are buffer columns.
+
+    Runs in sorted space (KG.sorted_groups): segments are contiguous runs
+    reduced by prefix sums / segmented scans — no XLA scatters, which are
+    the slow ops on TPU."""
     capacity = batch.capacity
     live = batch.row_mask()
+    iota = jnp.arange(capacity, dtype=jnp.int32)
     keys = [e.eval_device(batch) for e in key_exprs]
     if keys:
-        seg, n_groups, firsts = KG.group_ids(keys, batch.n_rows)
-        key_cols = KG.gather_group_keys(keys, firsts, n_groups)
+        layout = KG.sorted_groups(keys, batch.n_rows)
+        key_cols = KG.group_key_columns(keys, layout)
     else:
-        seg = jnp.zeros(capacity, dtype=jnp.int32)
         n_groups = jnp.minimum(batch.n_rows, 1).astype(jnp.int32)
+        layout = KG.GroupLayout(
+            perm=iota,
+            starts=jnp.zeros(capacity, jnp.int32),
+            ends=jnp.where(iota == 0, batch.n_rows.astype(jnp.int32), 0),
+            n_groups=n_groups,
+            group_live=iota < n_groups,
+            live_sorted=live,
+            boundary=(iota == 0) & (batch.n_rows > 0))
         key_cols = []
-    group_live = jnp.arange(capacity, dtype=jnp.int32) < n_groups
+    group_live = layout.group_live
 
     out_cols = list(key_cols)
     bi = n_keys
@@ -559,8 +571,10 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
                 values = c.data
                 validity = c.validity
                 op = spec.merge_op
-            result, counts = KG.segment_reduce(values, validity, seg,
-                                               capacity, op, live)
+            v_sorted = values[layout.perm]
+            val_sorted = validity[layout.perm]
+            result, counts = KG.sorted_segment_reduce(v_sorted, val_sorted,
+                                                      layout, op)
             if spec.from_count:
                 data = counts if op == "count" else result
                 validity_out = group_live
@@ -570,7 +584,7 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
             out_cols.append(make_column(data.astype(spec.dtype.np_dtype),
                                         validity_out, spec.dtype))
         bi += len(specs)
-    return ColumnarBatch(tuple(out_cols), n_groups, buf_schema)
+    return ColumnarBatch(tuple(out_cols), layout.n_groups, buf_schema)
 
 
 # ---------------------------------------------------------------------------

@@ -19,6 +19,7 @@ partial/final mode split (``aggregate.scala:259-450``).
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Sequence, Tuple
 
 import jax
@@ -27,7 +28,8 @@ import jax.numpy as jnp
 from ... import types as T
 from ...data.column import DeviceColumn
 from ..strings_util import char_matrix
-from .rowops import gather_column, orderable_key, sort_permutation, string_sort_keys
+from .rowops import (gather_column, orderable_key, orderable_values,
+                     sort_permutation, string_sort_keys)
 
 
 def _equal_adjacent(col: DeviceColumn, perm: jnp.ndarray) -> jnp.ndarray:
@@ -73,6 +75,143 @@ def group_ids(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray
     firsts = jnp.zeros(capacity, dtype=jnp.int32).at[seg_sorted].max(
         jnp.where(is_boundary, perm, 0))
     return seg, n_groups, firsts
+
+
+# ---------------------------------------------------------------------------
+# Sorted-space groupby (scatter-free)
+# ---------------------------------------------------------------------------
+#
+# On TPU, XLA scatters (segment_sum / .at[].set) are an order of magnitude
+# slower than sorts and scans. The fast path therefore never scatters: it
+# stays in sorted space, where segments are contiguous runs, and uses
+#   * one lexicographic sort for the permutation,
+#   * one cheap extra sort to compact segment-start positions to the front
+#     (replacing the classic scatter-by-permutation),
+#   * prefix sums / segmented associative scans for the reductions,
+#   * small gathers at segment boundaries for the dense per-group outputs.
+
+
+@dataclasses.dataclass
+class GroupLayout:
+    """Sorted-space segmentation of a batch by its group keys."""
+
+    perm: jnp.ndarray          # int32[cap] sorted position -> original row
+    starts: jnp.ndarray        # int32[cap] group g's first sorted position
+    ends: jnp.ndarray          # int32[cap] group g's end (exclusive)
+    n_groups: jnp.ndarray      # int32 scalar
+    group_live: jnp.ndarray    # bool[cap] g < n_groups
+    live_sorted: jnp.ndarray   # bool[cap] sorted position is a live row
+    boundary: jnp.ndarray      # bool[cap] sorted position starts a segment
+
+
+def sorted_groups(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray
+                  ) -> GroupLayout:
+    capacity = keys[0].capacity
+    perm = sort_permutation(keys, n_rows)
+    eq = jnp.ones(capacity, dtype=jnp.bool_)
+    for k in keys:
+        eq = eq & _equal_adjacent(k, perm)
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    live_sorted = iota < n_rows
+    boundary = (~eq | (iota == 0)) & live_sorted
+    n_groups = jnp.sum(boundary.astype(jnp.int32))
+    # Compact boundary positions to the front with a sort, not a scatter.
+    _, starts = jax.lax.sort(
+        (jnp.where(boundary, 0, 1).astype(jnp.int8), iota),
+        num_keys=1, is_stable=True)
+    group_live = iota < n_groups
+    nxt = jnp.concatenate([starts[1:], jnp.zeros(1, jnp.int32)])
+    ends = jnp.where(iota == n_groups - 1, n_rows.astype(jnp.int32), nxt)
+    ends = jnp.where(group_live, ends, starts)
+    return GroupLayout(perm=perm, starts=starts, ends=ends,
+                       n_groups=n_groups, group_live=group_live,
+                       live_sorted=live_sorted, boundary=boundary)
+
+
+def _prefix_range(prefix: jnp.ndarray, layout: GroupLayout) -> jnp.ndarray:
+    """Per-group difference of an inclusive prefix array: out[g] =
+    prefix[ends[g]-1] - prefix[starts[g]-1]."""
+    cap = prefix.shape[0]
+    hi = prefix[jnp.clip(layout.ends - 1, 0, cap - 1)]
+    lo_idx = layout.starts - 1
+    lo = jnp.where(lo_idx >= 0, prefix[jnp.clip(lo_idx, 0, cap - 1)],
+                   jnp.zeros((), prefix.dtype))
+    return jnp.where(layout.group_live, hi - lo, jnp.zeros((), prefix.dtype))
+
+
+def _segmented_scan(op, neutral, values: jnp.ndarray, contrib: jnp.ndarray,
+                    boundary: jnp.ndarray) -> jnp.ndarray:
+    """Within-segment running reduction (reset at boundaries)."""
+    masked = jnp.where(contrib, values, neutral)
+
+    def combine(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, op(va, vb))
+    _, out = jax.lax.associative_scan(combine, (boundary, masked))
+    return out
+
+
+def _at_segment_ends(scanned: jnp.ndarray, layout: GroupLayout) -> jnp.ndarray:
+    cap = scanned.shape[0]
+    return scanned[jnp.clip(layout.ends - 1, 0, cap - 1)]
+
+
+def sorted_segment_reduce(values: jnp.ndarray, validity: jnp.ndarray,
+                          layout: GroupLayout, op: str
+                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Reduce SORTED-space ``values`` per contiguous segment. Returns
+    (result[cap], valid-contribution count[cap]) in dense group order."""
+    contrib = validity & layout.live_sorted
+    counts = _prefix_range(jnp.cumsum(contrib.astype(jnp.int64)), layout)
+    cap = values.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    if op == "count":
+        out = counts
+    elif op == "sum":
+        if jnp.issubdtype(values.dtype, jnp.floating):
+            # Segmented scan: no cross-segment accumulation, so no
+            # cancellation error from a global prefix sum.
+            s = _segmented_scan(jnp.add, jnp.zeros((), values.dtype),
+                                values, contrib, layout.boundary)
+            out = _at_segment_ends(s, layout)
+        else:
+            masked = jnp.where(contrib, values, 0)
+            out = _prefix_range(jnp.cumsum(masked), layout)
+    elif op in ("min", "max", "first", "last"):
+        # One more sort puts each segment's answer at its start position:
+        # sort by (group, invalid-last, order key) carrying the values, then
+        # read at layout.starts. A sort is ~20x cheaper than a segmented
+        # scan on TPU.
+        gid = jnp.cumsum(layout.boundary.astype(jnp.int32)) - 1
+        rank = jnp.where(contrib, 0, 1).astype(jnp.int8)
+        operands = [gid, rank]
+        if op in ("min", "max"):
+            floating = jnp.issubdtype(values.dtype, jnp.floating)
+            k = orderable_values(values, floating)
+            operands.append(~k if op == "max" else k)
+        elif op == "last":
+            operands.append(-iota)
+        # "first": stable sort keeps original order among valid rows.
+        sorted_all = jax.lax.sort(tuple(operands) + (values,),
+                                  num_keys=len(operands), is_stable=True)
+        s_v = sorted_all[-1]
+        out = s_v[jnp.clip(layout.starts, 0, cap - 1)]
+    else:
+        raise ValueError(op)
+    return out, counts
+
+
+def gather_sorted(col_data: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
+    return col_data[perm]
+
+
+def group_key_columns(keys: Sequence[DeviceColumn], layout: GroupLayout
+                      ) -> List[DeviceColumn]:
+    """Dense group-key output columns (group g's key from its first row)."""
+    cap = keys[0].capacity
+    orig_starts = layout.perm[jnp.clip(layout.starts, 0, cap - 1)]
+    return [gather_column(k, orig_starts, layout.group_live) for k in keys]
 
 
 def segment_reduce(values: jnp.ndarray, validity: jnp.ndarray,

@@ -70,17 +70,51 @@ def dense_key_ids(build_keys: Sequence[DeviceColumn],
     sorted_ops = jax.lax.sort(tuple(operands) + (iota,),
                               num_keys=len(operands), is_stable=True)
     perm = sorted_ops[-1]
-    keys_sorted = [o[perm] for o in operands]
+    # The sort already returns every key operand in sorted order — no
+    # post-sort gathers needed.
+    keys_sorted = sorted_ops[:-1]
     eq = jnp.ones(total, dtype=jnp.bool_)
     for o in keys_sorted:
         prev = jnp.concatenate([o[:1], o[:-1]])
         eq = eq & (o == prev)
-    usable_sorted = usable[perm]
+    usable_sorted = keys_sorted[0] == 0
     boundary = (~eq | (iota == 0)) & usable_sorted
     ids_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
     ids_sorted = jnp.where(usable_sorted, jnp.maximum(ids_sorted, 0), -1)
-    ids = jnp.zeros(total, dtype=jnp.int32).at[perm].set(ids_sorted)
+    # Invert the permutation with a second sort instead of a scatter —
+    # scatters are the slow ops on TPU, sorts are cheap.
+    _, ids = jax.lax.sort((perm, ids_sorted), num_keys=1, is_stable=True)
     return ids[:cap_b], ids[cap_b:]
+
+
+def merge_rank(reference: jnp.ndarray, queries: jnp.ndarray,
+               inclusive: bool) -> jnp.ndarray:
+    """For each query value q (any order), the count of reference elements
+    with r < q (or r <= q when ``inclusive``). ``reference`` must be sorted.
+
+    This is searchsorted computed by sort-merge: XLA lowers searchsorted to
+    ~log2(n) dependent gather rounds (slow on TPU), while two extra sorts +
+    a prefix sum are cheap.
+    """
+    n_ref, n_q = reference.shape[0], queries.shape[0]
+    ids = jnp.concatenate([reference, queries])
+    # Tie order decides inclusivity: reference-first counts equals.
+    ref_side = 0 if inclusive else 1
+    side = jnp.concatenate([
+        jnp.full(n_ref, ref_side, jnp.int8),
+        jnp.full(n_q, 1 - ref_side, jnp.int8)])
+    qidx = jnp.concatenate([jnp.zeros(n_ref, jnp.int32),
+                            jnp.arange(n_q, dtype=jnp.int32)])
+    is_ref = jnp.concatenate([jnp.ones(n_ref, jnp.int8),
+                              jnp.zeros(n_q, jnp.int8)])
+    s_id, s_side, s_qidx, s_isref = jax.lax.sort(
+        (ids, side, qidx, is_ref), num_keys=2, is_stable=True)
+    ref_prefix = jnp.cumsum(s_isref.astype(jnp.int32))
+    cnt_at_pos = ref_prefix - s_isref  # refs strictly before this position
+    # Route counts back to query order: queries first, ordered by index.
+    _, _, q_cnt = jax.lax.sort((s_isref, s_qidx, cnt_at_pos), num_keys=2,
+                               is_stable=True)
+    return q_cnt[:n_q]
 
 
 def match_ranges(build_ids: jnp.ndarray, probe_ids: jnp.ndarray,
@@ -93,8 +127,8 @@ def match_ranges(build_ids: jnp.ndarray, probe_ids: jnp.ndarray,
         (jnp.where(build_ids < 0, jnp.int32(2 ** 31 - 1), build_ids), iota),
         num_keys=1, is_stable=True)
     valid_probe = probe_ids >= 0
-    lo = jnp.searchsorted(sorted_ids, probe_ids, side="left")
-    hi = jnp.searchsorted(sorted_ids, probe_ids, side="right")
+    lo = merge_rank(sorted_ids, probe_ids, inclusive=False)
+    hi = merge_rank(sorted_ids, probe_ids, inclusive=True)
     counts = jnp.where(valid_probe, hi - lo, 0).astype(jnp.int32)
     return lo.astype(jnp.int32), counts, build_perm, sorted_ids
 
@@ -111,7 +145,7 @@ def expand_matches(lo: jnp.ndarray, counts: jnp.ndarray,
     total = offsets[-1]
     starts = offsets - counts
     k = jnp.arange(out_capacity, dtype=jnp.int32)
-    probe_idx = jnp.searchsorted(offsets, k, side="right").astype(jnp.int32)
+    probe_idx = merge_rank(offsets, k, inclusive=True).astype(jnp.int32)
     safe_probe = jnp.clip(probe_idx, 0, counts.shape[0] - 1)
     within = k - starts[safe_probe]
     build_sorted_pos = lo[safe_probe] + within
