@@ -75,12 +75,17 @@ class TpuWindowExec(TpuExec):
         window_all = cached_kernel("window", kernel_key(bound, out_schema),
                                    build)
 
-        def run(part):
-            batches = [db for db in part]
+        def run(parts):
+            # Windows require whole window partitions; the child's physical
+            # partitioning is arbitrary (e.g. round-robin repartition), so
+            # collect ALL partitions before evaluating — the global-sort
+            # pattern. Spark gets this via ClusteredDistribution(partitionBy)
+            # + an exchange; a distributed mesh plan re-introduces that.
+            batches = [db for part in parts for db in part]
             if not batches:
                 return
             yield window_all(_coalesce_device(batches))
-        return [run(p) for p in self.children[0].execute(ctx)]
+        return [run(self.children[0].execute(ctx))]
 
 
 def _eval_window(batch: ColumnarBatch, func: Expression,

@@ -53,7 +53,10 @@ def _dataset(fmt: str, paths: List[str], options: dict) -> ds.Dataset:
         fmt_obj = ds.CsvFileFormat(parse_options=parse,
                                    read_options=read,
                                    convert_options=convert)
-        return ds.dataset(src, format=fmt_obj)
+        # hive partitioning here too: a partitionBy CSV write read back
+        # through this reader must restore the partition columns rather
+        # than silently dropping them.
+        return ds.dataset(src, format=fmt_obj, partitioning=hive)
     raise ValueError(f"unknown format {fmt}")
 
 

@@ -86,12 +86,21 @@ def _write_one(data, fmt: str, path: str, options: Dict) -> int:
     return os.path.getsize(path)
 
 
+#: Characters Spark escapes in partition directory names
+#: (ExternalCatalogUtils.escapePathName): controls + these ASCII specials.
+_ESCAPE_CHARS = set('"#%\'*/:=?\\{[]^\x7f') | {chr(c) for c in range(0x20)}
+
+
+def _escape_path_name(s: str) -> str:
+    return "".join(f"%{ord(c):02X}" if c in _ESCAPE_CHARS else c for c in s)
+
+
 def _partition_dir_value(v) -> str:
     if v is None:
         return "__HIVE_DEFAULT_PARTITION__"
     if isinstance(v, bool):
         return str(v).lower()
-    return str(v)
+    return _escape_path_name(str(v))
 
 
 def prepare_target(path: str, mode: str) -> bool:

@@ -489,8 +489,10 @@ class CpuWindowExec(PhysicalPlan):
     def execute(self, ctx):
         arrow = _arrow_schema(self.schema)
 
-        def run(part):
-            batches = list(part)
+        def run(parts):
+            # Collect ALL child partitions: window partitions must not be
+            # split across physical partitions (same contract as TpuWindowExec).
+            batches = [hb for part in parts for hb in part]
             if not batches:
                 return
             hb = concat_host(batches)
@@ -499,7 +501,7 @@ class CpuWindowExec(PhysicalPlan):
             arrays = list(hb.rb.columns) + new_arrays
             arrays = [a.cast(f.type) for a, f in zip(arrays, arrow)]
             yield HostBatch(pa.RecordBatch.from_arrays(arrays, schema=arrow))
-        return [run(p) for p in self.children[0].execute(ctx)]
+        return [run(self.children[0].execute(ctx))]
 
     def _eval(self, hb: HostBatch, we) -> pa.Array:
         import functools

@@ -249,4 +249,7 @@ def _shuffle_env(ctx: ExecContext) -> ShuffleBufferCatalog:
         env = ShuffleBufferCatalog(ctx.conf.get(HOST_SPILL_STORAGE_SIZE),
                                    ctx.conf.get(SPILL_DIR))
         ctx._shuffle_catalog = env
+        # Query-end teardown: free any still-pinned blocks and delete the
+        # spill file so long sessions don't accumulate host memory/disk.
+        ctx.add_cleanup(env.close)
     return env

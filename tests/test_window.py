@@ -200,3 +200,23 @@ def test_nan_order_key_peers():
     w = Window.partition_by("k").order_by("t")
     assert_tpu_and_cpu_are_equal(
         lambda s: _df(s, data).with_column("x", over(AGG.Count(col("v")), w)))
+
+
+def test_window_over_repartitioned_child():
+    # Regression (round-1 advisor, high): a repartitioned child used to
+    # split window partitions across physical partitions, producing
+    # per-slice partial results on BOTH the CPU oracle and the device.
+    data = {"k": [1] * 8 + [2] * 4, "t": list(range(12)),
+            "v": [1] * 12}
+    w = Window.partition_by("k")
+    assert_tpu_and_cpu_are_equal(
+        lambda s: _df(s, data).repartition(3)
+        .with_column("total", over(AGG.Sum(col("v")), w)))
+    # Verify the absolute value too (not just CPU==TPU, since both shared
+    # the bug): every k=1 row must see the full partition sum of 8.
+    from harness import tpu_session
+    out = _df(tpu_session(), data).repartition(3).with_column(
+        "total", over(AGG.Sum(col("v")), w)).collect()
+    got = dict(zip(out.column("k").to_pylist(),
+                   out.column("total").to_pylist()))
+    assert got == {1: 8, 2: 4}

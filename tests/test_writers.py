@@ -138,3 +138,33 @@ def test_overwrite_replaces_plain_file(tmp_path):
     open(path, "w").write("junk")
     s.create_dataframe({"v": [7]}).write.mode("overwrite").parquet(path)
     assert _read_back(s, "parquet", path).column("v").to_pylist() == [7]
+
+
+def test_partition_values_with_special_chars_round_trip(tmp_path):
+    # Regression (round-1 advisor): '/', '=', '%' in partition values used
+    # to corrupt the hive layout; Spark escapes via escapePathName.
+    s = tpu_session()
+    path = str(tmp_path / "esc")
+    vals = ["a/b", "x=y", "p%q", "plain"]
+    df = s.create_dataframe({"k": vals, "v": [1, 2, 3, 4]})
+    df.write.partition_by("k").parquet(path)
+    dirs = sorted(d for d in os.listdir(path) if d.startswith("k="))
+    assert "k=a%2Fb" in dirs and "k=x%3Dy" in dirs and "k=p%25q" in dirs
+    back = _read_back(s, "parquet", path)
+    got = sorted(zip(back.column("k").to_pylist(),
+                     back.column("v").to_pylist()))
+    assert got == sorted(zip(vals, [1, 2, 3, 4]))
+
+
+def test_csv_partition_by_round_trip(tmp_path):
+    # Regression (round-1 advisor): CSV hive reads silently dropped the
+    # partition column.
+    s = tpu_session()
+    path = str(tmp_path / "csv_hive")
+    s.create_dataframe({"k": [1, 1, 2], "v": [10, 20, 30]}) \
+        .write.partition_by("k").csv(path)
+    back = _read_back(s, "csv", path)
+    assert sorted(back.schema.names) == ["k", "v"]
+    got = sorted(zip(back.column("k").to_pylist(),
+                     back.column("v").to_pylist()))
+    assert got == [(1, 10), (1, 20), (2, 30)]
