@@ -229,6 +229,17 @@ TPU_JOIN_OUTPUT_GROWTH = conf_float(
     "Initial output-capacity estimate for joins as a multiple of the probe "
     "side; joins re-execute with a larger bucket on overflow.")
 
+TPU_COLLECT_GUESS_ROWS = conf_int(
+    "spark.rapids.tpu.collect.guessRows", 8192,
+    "Row-capacity guess for the single-round-trip result download of a fused "
+    "query: results at most this large come back in ONE device->host "
+    "transfer; larger results pay a second, bandwidth-bound transfer.")
+
+TPU_FUSION_ENABLED = conf_bool(
+    "spark.rapids.tpu.fusion.enabled", True,
+    "Trace an entire device plan into one compiled XLA program (whole-stage "
+    "fusion): one dispatch and one device->host transfer per query.")
+
 DEVICE_BACKEND = conf_str(
     "spark.rapids.tpu.backend", None,
     "Force a jax backend for device execution (tpu/cpu). Default: jax default.",
@@ -284,6 +295,14 @@ class TpuConf:
     @property
     def shuffle_partitions(self) -> int:
         return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def collect_guess_rows(self) -> int:
+        return self.get(TPU_COLLECT_GUESS_ROWS)
+
+    @property
+    def fusion_enabled(self) -> bool:
+        return self.get(TPU_FUSION_ENABLED)
 
     def is_operator_enabled(self, conf_key: str, incompat: bool, disabled_by_default: bool) -> bool:
         """Three-state per-operator gating (reference RapidsMeta.tagForGpu:195-210)."""

@@ -66,13 +66,19 @@ class TpuCoalesceBatchesExec(TpuExec):
         target = None if single else self.goal.rows
 
         def run(part):
+            # Accumulation is accounted by CAPACITY, not live rows: capacity
+            # is static (known without a device->host sync), and rows <=
+            # capacity so the goal is still met. The old int(n_rows) read
+            # here cost one tunnel round trip per batch — the single most
+            # expensive operation on the critical path — and made the exec
+            # untraceable under whole-stage fusion.
             pending: List[int] = []    # catalog buffer ids
             direct: List[ColumnarBatch] = []  # no-catalog fallback
-            pending_rows = 0
+            pending_cap = 0
 
             def flush():
-                nonlocal pending_rows
-                if catalog is not None:
+                nonlocal pending_cap
+                if pending:
                     # Pin first so acquiring one buffer can't evict another
                     # buffer of this same flush (on-deck semantics).
                     for b in pending:
@@ -88,28 +94,19 @@ class TpuCoalesceBatchesExec(TpuExec):
                     catalog.free(b)
                 pending.clear()
                 direct.clear()
-                pending_rows = 0
+                pending_cap = 0
                 return out
 
             for db in part:
-                # Start the row-count download without blocking, then read
-                # it; compute for this batch was already dispatched, so the
-                # read overlaps the device work instead of adding a round
-                # trip of its own.
-                try:
-                    db.n_rows.copy_to_host_async()
-                except (AttributeError, RuntimeError):
-                    pass
-                rows = int(db.n_rows)
-                if rows == 0:
+                if db.capacity == 0:
                     continue
-                if catalog is not None:
+                if catalog is not None and not ctx.in_fusion:
                     pending.append(catalog.register_batch(
                         db, SP.ACTIVE_BATCHING_PRIORITY))
                 else:
                     direct.append(db)
-                pending_rows += rows
-                if not single and pending_rows >= target:
+                pending_cap += db.capacity
+                if not single and pending_cap >= target:
                     out = flush()
                     if out is not None:
                         yield out

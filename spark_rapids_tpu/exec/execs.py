@@ -272,6 +272,18 @@ class TpuLimitExec(TpuExec):
 
     def execute(self, ctx):
         def gen():
+            if ctx.in_fusion:
+                # Traced path: the running remainder is a device scalar so
+                # no host sync interrupts the fused program. Loses the
+                # early-exit, which fusion (a materialized, finite batch
+                # list) does not need.
+                remaining = jnp.asarray(self.n, jnp.int32)
+                for part in self.children[0].execute(ctx):
+                    for db in part:
+                        take = jnp.minimum(db.n_rows, remaining)
+                        yield _truncate(db, take)
+                        remaining = remaining - take
+                return
             remaining = self.n
             for part in self.children[0].execute(ctx):
                 for db in part:
@@ -370,8 +382,7 @@ class TpuSortExec(TpuExec):
         def build():
             def do_sort(b):
                 keys = [e.eval_device(b) for e in key_exprs]
-                perm = KR.sort_permutation(keys, b.n_rows, asc, nf)
-                return KR.gather_batch(b, perm, b.n_rows)
+                return KR.sort_batch_by_columns(b, keys, asc, nf)
             return do_sort
         do_sort = cached_kernel("sort", kernel_key(key_exprs, asc, nf), build)
 
@@ -389,10 +400,15 @@ _concat_jit = jax.jit(KC.concat_batches, static_argnums=(1,))
 
 
 def _coalesce_device(batches: List[ColumnarBatch]) -> ColumnarBatch:
-    """Concat device batches, sizing output by synced total rows."""
+    """Concat device batches, sizing output by the (static) sum of input
+    capacities. Live rows <= capacity, so the bound is safe, and unlike the
+    true row total it needs no device->host sync — which keeps concat off the
+    tunnel's ~100ms round-trip path and traceable under whole-stage fusion.
+    The output is at most one capacity bucket larger than a row-exact concat.
+    """
     if len(batches) == 1:
         return batches[0]
-    total = sum(int(b.n_rows) for b in batches)
+    total = sum(b.capacity for b in batches)
     cap = bucket_capacity(max(total, 1))
     return _concat_jit(batches, cap)
 
@@ -465,17 +481,36 @@ class TpuHashAggregateExec(TpuExec):
         merge = cached_kernel("agg_merge", agg_key, build_merge)
 
         def gen():
-            state: Optional[ColumnarBatch] = None
+            # Merge-sort-style reduction stack: merge two partials only when
+            # the newer one has caught up in capacity. With capacity-sum
+            # concat sizing (no row-count syncs), a linear state-accumulator
+            # would re-sort the whole accumulated capacity per batch —
+            # O(N^2); the tree keeps total merge work O(N log N).
+            stack: List[ColumnarBatch] = []
+
+            def push(b: ColumnarBatch):
+                stack.append(b)
+                while len(stack) >= 2 and \
+                        stack[-1].capacity >= stack[-2].capacity:
+                    b2, b1 = stack.pop(), stack.pop()
+                    stack.append(merge(_coalesce_device([b1, b2])))
+
             for part in self.children[0].execute(ctx):
                 for db in part:
-                    p = partial(db)
-                    if state is None:
-                        state = p
-                    else:
-                        both = _coalesce_device([state, p])
-                        state = merge(both)
-            if state is None or (not self.groupings
-                                 and int(state.n_rows) == 0):
+                    push(partial(db))
+            state: Optional[ColumnarBatch] = None
+            if stack:
+                state = stack.pop()
+                while stack:
+                    state = merge(_coalesce_device([stack.pop(), state]))
+            if state is None:
+                # No input batches at all — statically known, no sync.
+                # Grouped agg of nothing is nothing; global agg is the
+                # count-0 row. With >=1 input batch the global-agg kernel
+                # itself always emits exactly one group (even for zero live
+                # rows), so no row-count sync is ever needed here.
+                if self.groupings:
+                    return
                 yield self._empty_result()
                 return
             yield self._finalize(state, buf_schema)
@@ -527,30 +562,15 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
     """One grouping pass. update_mode: inputs are raw rows (evaluate agg
     children, apply update ops). merge mode: inputs are buffer columns.
 
-    Runs in sorted space (KG.sorted_groups): segments are contiguous runs
-    reduced by prefix sums / segmented scans — no XLA scatters, which are
-    the slow ops on TPU."""
+    Grouped path: KG.grouped_aggregate — TWO sorts carrying all inputs +
+    segmented prefix scans; no per-column gathers, no scatters (both are
+    extra full memory passes on TPU). Global path: plain fused masked
+    reductions, always emitting exactly one group so emptiness never needs
+    a host sync."""
     capacity = batch.capacity
     live = batch.row_mask()
-    iota = jnp.arange(capacity, dtype=jnp.int32)
     keys = [e.eval_device(batch) for e in key_exprs]
-    if keys:
-        layout = KG.sorted_groups(keys, batch.n_rows)
-        key_cols = KG.group_key_columns(keys, layout)
-    else:
-        n_groups = jnp.minimum(batch.n_rows, 1).astype(jnp.int32)
-        layout = KG.GroupLayout(
-            perm=iota,
-            starts=jnp.zeros(capacity, jnp.int32),
-            ends=jnp.where(iota == 0, batch.n_rows.astype(jnp.int32), 0),
-            n_groups=n_groups,
-            group_live=iota < n_groups,
-            live_sorted=live,
-            boundary=(iota == 0) & (batch.n_rows > 0))
-        key_cols = []
-    group_live = layout.group_live
-
-    out_cols = list(key_cols)
+    inputs = []  # (values, validity, op, spec)
     bi = n_keys
     for a in aggs:
         specs = a.func.buffers()
@@ -571,20 +591,26 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
                 values = c.data
                 validity = c.validity
                 op = spec.merge_op
-            v_sorted = values[layout.perm]
-            val_sorted = validity[layout.perm]
-            result, counts = KG.sorted_segment_reduce(v_sorted, val_sorted,
-                                                      layout, op)
-            if spec.from_count:
-                data = counts if op == "count" else result
-                validity_out = group_live
-            else:
-                data = result
-                validity_out = (counts > 0) & group_live
-            out_cols.append(make_column(data.astype(spec.dtype.np_dtype),
-                                        validity_out, spec.dtype))
+            inputs.append((values, validity, op, spec))
         bi += len(specs)
-    return ColumnarBatch(tuple(out_cols), layout.n_groups, buf_schema)
+    triples = [(v, val, op) for v, val, op, _ in inputs]
+    if keys:
+        key_cols, results, n_groups, group_live = KG.grouped_aggregate(
+            keys, batch.n_rows, triples)
+    else:
+        key_cols, results, n_groups, group_live = KG.global_aggregate(
+            capacity, live, triples)
+    out_cols = list(key_cols)
+    for (_, _, op, spec), (result, counts) in zip(inputs, results):
+        if spec.from_count:
+            data = counts if op == "count" else result
+            validity_out = group_live
+        else:
+            data = result
+            validity_out = (counts > 0) & group_live
+        out_cols.append(make_column(data.astype(spec.dtype.np_dtype),
+                                    validity_out, spec.dtype))
+    return ColumnarBatch(tuple(out_cols), n_groups, buf_schema)
 
 
 # ---------------------------------------------------------------------------
@@ -685,15 +711,25 @@ class TpuShuffledHashJoinExec(TpuExec):
                                         build_post)
 
         def join_batch(probe, build):
+            # Optimistic output sizing: allocate from the probe capacity and
+            # defer the real match-count check to a device-side flag the
+            # session reads ONCE per query (TpuSession.execute retry loop).
+            # The old int(total) here cost a ~100ms tunnel round trip per
+            # probe batch and broke whole-stage fusion tracing.
             out_cap = bucket_capacity(
-                max(int(probe.capacity * self.growth), 128))
+                max(int(probe.capacity * self.growth * ctx.join_growth), 128))
             if jt in ("left_semi", "left_anti"):
                 out, hits = kernel(probe, build, out_cap)
                 return ColumnarBatch(out.columns, out.n_rows, out_schema), hits
             (out, hits), total = kernel(probe, build, out_cap)
-            t = int(total)
-            if t > out_cap:
-                (out, hits), _ = kernel(probe, build, bucket_capacity(t))
+            if ctx.eager_overflow:
+                # Exact resize with a per-batch sync: for side-effecting
+                # plans (writes) and the retry ladder's guaranteed rung.
+                t = int(total)
+                if t > out_cap:
+                    (out, hits), _ = kernel(probe, build, bucket_capacity(t))
+            else:
+                ctx.overflow_flags.append(total > out_cap)
             if post_filter is not None:
                 out = post_filter(out)
             return out, hits

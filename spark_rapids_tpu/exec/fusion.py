@@ -1,0 +1,211 @@
+"""Whole-stage fusion — the TPU answer to Spark's ``WholeStageCodegenExec``.
+
+The reference leans on Spark's whole-stage codegen for CPU operators and on
+libcudf's pre-compiled kernels for GPU ones (SURVEY.md §2.10): a query still
+dispatches one kernel per operator per batch. Under XLA the natural unit is
+larger. Every device operator in this engine is already a pure traced
+function over batch pytrees, so an entire device subtree
+(source -> filter -> project -> join -> aggregate) can be traced ONCE into a
+single jitted program. XLA then fuses across operator boundaries, and —
+decisive on a high-latency host<->TPU link — the host dispatches ONE program
+and performs ONE device->host transfer per query instead of one per
+operator-batch.
+
+Contract:
+
+* :func:`fusable` — True when the plan root is ``DeviceToHostExec`` over a
+  columnar subtree. Non-whitelisted *columnar* subtrees (window, broadcast
+  exchange, shuffle, scans...) become fusion BOUNDARIES: they execute
+  eagerly outside the trace and feed the fused program as traced inputs, so
+  fusion degrades gracefully instead of turning off.
+* The fused callable is cached per structural plan signature (expression
+  trees, schemas, static params — the :mod:`..utils.kernel_cache`
+  discipline); ``jax.jit`` re-specializes per input capacity bucket through
+  the pytree avals, so re-running a query never recompiles.
+* Results return through ONE ``jax.device_get`` of ``(n_rows, overflow
+  flags, guess-shrunk batch)``. If the result had more rows than the guess
+  bucket, the full batch (still device-resident) downloads in a second
+  round trip — the price only large collects pay.
+* Join overflow flags ride the same transfer; ``TpuSession.execute``
+  re-runs the query with a larger ``join_growth`` when one trips.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, _shrink_batch
+from ..data.column import bucket_capacity
+from ..plan.physical import ExecContext
+from ..utils.kernel_cache import _sig_value
+from .coalesce import TpuCoalesceBatchesExec
+from .execs import (DeviceToHostExec, TpuExec, TpuExpandExec, TpuFilterExec,
+                    TpuHashAggregateExec, TpuLimitExec, TpuProjectExec,
+                    TpuShuffledHashJoinExec, TpuSortExec, TpuUnionExec,
+                    _coalesce_device)
+
+
+class _NotFusable(Exception):
+    pass
+
+
+class FusedInputExec(TpuExec):
+    """Leaf of a fused plan: replays pre-materialized device batches from
+    ``ctx.fused_inputs`` — the fused program's traced arguments."""
+
+    def __init__(self, index: int, schema: T.Schema):
+        self.children = []
+        self.index = index
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"FusedInput #{self.index}"
+
+    def execute(self, ctx):
+        return [iter(list(p)) for p in ctx.fused_inputs[self.index]]
+
+
+#: Execs whose execute() path is fully traceable (no host syncs, no host
+#: data): these are inlined into the fused program. Everything else columnar
+#: becomes a boundary input.
+_INLINE = (TpuProjectExec, TpuFilterExec, TpuHashAggregateExec, TpuSortExec,
+           TpuShuffledHashJoinExec, TpuCoalesceBatchesExec, TpuExpandExec,
+           TpuUnionExec, TpuLimitExec, FusedInputExec)
+
+
+def _is_boundary(p) -> bool:
+    if isinstance(p, _INLINE):
+        return False
+    return bool(getattr(p, "columnar", False))
+
+
+def _split(plan, boundaries: List) -> TpuExec:
+    """Rebuild the device subtree with every boundary subtree replaced by a
+    :class:`FusedInputExec` leaf; boundary nodes append to ``boundaries`` in
+    deterministic traversal order (the fused program's argument order)."""
+    if _is_boundary(plan):
+        boundaries.append(plan)
+        return FusedInputExec(len(boundaries) - 1, plan.schema)
+    if not isinstance(plan, _INLINE):
+        raise _NotFusable(type(plan).__name__)
+    kids = [_split(c, boundaries) for c in plan.children]
+    return plan.with_children(kids) if kids else plan
+
+
+def fusable(root) -> bool:
+    if not isinstance(root, DeviceToHostExec):
+        return False
+    child = root.children[0]
+    if not getattr(child, "columnar", False):
+        return False
+    try:
+        _split(child, [])
+    except _NotFusable:
+        return False
+    return True
+
+
+_SKIP_ATTRS = frozenset({"children", "partitions"})
+
+
+def _plan_sig(p) -> tuple:
+    """Structural signature of a fused plan: node types + static params
+    (expressions, schemas, goals) — NOT input shapes, which jax.jit keys on
+    itself through the argument avals."""
+    extras = tuple(sorted(
+        (k, _sig_value(v)) for k, v in vars(p).items()
+        if k not in _SKIP_ATTRS))
+    return (type(p).__name__, extras,
+            tuple(_plan_sig(c) for c in p.children))
+
+
+_FUSED_CACHE = {}
+
+
+def clear_fused_cache() -> None:
+    _FUSED_CACHE.clear()
+
+
+def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int):
+    def run(inputs):
+        ictx = ExecContext(conf, catalog=None)
+        ictx.join_growth = join_growth
+        ictx.fused_inputs = inputs
+        ictx.in_fusion = True
+        outs = []
+        for part in fused_plan.execute(ictx):
+            outs.extend(part)
+        flags = (jnp.stack(ictx.overflow_flags) if ictx.overflow_flags
+                 else jnp.zeros((0,), jnp.bool_))
+        if not outs:
+            # Statically empty (no batches at all) — no device work needed.
+            return (None, flags, None), None
+        batch = _coalesce_device(outs)
+        guess_cap = min(batch.capacity, bucket_capacity(guess_rows))
+        shrunk = _shrink_batch(batch, guess_cap) \
+            if guess_cap < batch.capacity else batch
+        # The head triple is the single downloaded transfer; the full batch
+        # stays device-resident for the (rare) guess-miss second pass.
+        return (batch.n_rows, flags, shrunk), batch
+    return jax.jit(run)
+
+
+def fused_collect(root: DeviceToHostExec, ctx: ExecContext
+                  ) -> Tuple[Optional[pa.Table], bool]:
+    """Run a fusable plan as one compiled program.
+
+    Returns ``(table, overflowed)``; ``table`` is None when a join's
+    deferred overflow check tripped and the caller must retry with a larger
+    ``ctx.join_growth``."""
+    device_plan = root.children[0]
+    boundaries: List = []
+    fused_plan = _split(device_plan, boundaries)
+    guess_rows = ctx.conf.collect_guess_rows
+    sig = (_plan_sig(fused_plan), float(ctx.join_growth), guess_rows)
+    fn = _FUSED_CACHE.get(sig)
+    if fn is None:
+        fn = _build_fused(fused_plan, ctx.conf, ctx.join_growth, guess_rows)
+        _FUSED_CACHE[sig] = fn
+    # Boundary subtrees run eagerly (uploads, windows, shuffles, ...); their
+    # materialized batches are the fused program's positional arguments.
+    inputs = tuple(tuple(tuple(p) for p in b.execute(ctx))
+                   for b in boundaries)
+    head, full = fn(inputs)
+    n_rows_np, flags_np, shrunk_np = jax.device_get(head)  # ONE round trip
+    if flags_np.size and bool(np.any(flags_np)):
+        return None, True
+    arrow_schema = T.schema_to_arrow(root.schema)
+    if n_rows_np is None:
+        return pa.Table.from_batches([], schema=arrow_schema), False
+    n = int(n_rows_np)
+    if n <= shrunk_np.capacity:
+        arrays = [c.arrow_from_host(c.device_buffers(), n)
+                  for c in shrunk_np.columns]
+    else:
+        # Guess miss: download the full device-resident batch, shrunk to the
+        # now-known row bucket (second round trip; bandwidth-bound anyway).
+        cap = bucket_capacity(n)
+        fb = _shrink_batch(full, cap) if cap < full.capacity else full
+        host = jax.device_get([c.device_buffers() for c in fb.columns])
+        arrays = [c.arrow_from_host(bufs, n)
+                  for c, bufs in zip(fb.columns, host)]
+    rb = pa.RecordBatch.from_arrays(arrays, schema=arrow_schema)
+    return pa.Table.from_batches([rb]).cast(arrow_schema), False
+
+
+def any_overflow(ctx: ExecContext) -> bool:
+    """One deferred check for the non-fused streaming path: a single stacked
+    download instead of the per-join-batch syncs it replaced."""
+    if not ctx.overflow_flags:
+        return False
+    return bool(jax.device_get(jnp.any(jnp.stack(ctx.overflow_flags))))

@@ -203,14 +203,22 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                         yield ColumnarBatch(out.columns, out.n_rows,
                                             out_schema)
                         continue
-                    out_cap = bucket_capacity(probe.capacity)
+                    # Optimistic sizing + deferred overflow flag — same
+                    # no-sync discipline as TpuShuffledHashJoinExec; the
+                    # session retries with a larger ctx.join_growth if the
+                    # pair count exceeded the allocation.
+                    out_cap = bucket_capacity(
+                        max(int(probe.capacity * ctx.join_growth), 128))
                     (out, extra), n_match = kernel(probe, build, out_cap)
-                    t = int(n_match)
-                    if t > out_cap:
-                        (out, extra), _ = kernel(probe, build,
-                                                 bucket_capacity(t))
+                    if ctx.eager_overflow:
+                        t = int(n_match)
+                        if t > out_cap:
+                            (out, extra), _ = kernel(probe, build,
+                                                     bucket_capacity(t))
+                    else:
+                        ctx.overflow_flags.append(n_match > out_cap)
                     yield out
-                    if extra is not None and int(extra.n_rows):
+                    if extra is not None:
                         yield _null_extend_right(extra, out_schema, n_right)
         return [gen()]
 

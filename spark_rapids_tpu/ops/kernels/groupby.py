@@ -78,65 +78,8 @@ def group_ids(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray
 
 
 # ---------------------------------------------------------------------------
-# Sorted-space groupby (scatter-free)
+# Sorted-space grouped aggregation (scatter-free)
 # ---------------------------------------------------------------------------
-#
-# On TPU, XLA scatters (segment_sum / .at[].set) are an order of magnitude
-# slower than sorts and scans. The fast path therefore never scatters: it
-# stays in sorted space, where segments are contiguous runs, and uses
-#   * one lexicographic sort for the permutation,
-#   * one cheap extra sort to compact segment-start positions to the front
-#     (replacing the classic scatter-by-permutation),
-#   * prefix sums / segmented associative scans for the reductions,
-#   * small gathers at segment boundaries for the dense per-group outputs.
-
-
-@dataclasses.dataclass
-class GroupLayout:
-    """Sorted-space segmentation of a batch by its group keys."""
-
-    perm: jnp.ndarray          # int32[cap] sorted position -> original row
-    starts: jnp.ndarray        # int32[cap] group g's first sorted position
-    ends: jnp.ndarray          # int32[cap] group g's end (exclusive)
-    n_groups: jnp.ndarray      # int32 scalar
-    group_live: jnp.ndarray    # bool[cap] g < n_groups
-    live_sorted: jnp.ndarray   # bool[cap] sorted position is a live row
-    boundary: jnp.ndarray      # bool[cap] sorted position starts a segment
-
-
-def sorted_groups(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray
-                  ) -> GroupLayout:
-    capacity = keys[0].capacity
-    perm = sort_permutation(keys, n_rows)
-    eq = jnp.ones(capacity, dtype=jnp.bool_)
-    for k in keys:
-        eq = eq & _equal_adjacent(k, perm)
-    iota = jnp.arange(capacity, dtype=jnp.int32)
-    live_sorted = iota < n_rows
-    boundary = (~eq | (iota == 0)) & live_sorted
-    n_groups = jnp.sum(boundary.astype(jnp.int32))
-    # Compact boundary positions to the front with a sort, not a scatter.
-    _, starts = jax.lax.sort(
-        (jnp.where(boundary, 0, 1).astype(jnp.int8), iota),
-        num_keys=1, is_stable=True)
-    group_live = iota < n_groups
-    nxt = jnp.concatenate([starts[1:], jnp.zeros(1, jnp.int32)])
-    ends = jnp.where(iota == n_groups - 1, n_rows.astype(jnp.int32), nxt)
-    ends = jnp.where(group_live, ends, starts)
-    return GroupLayout(perm=perm, starts=starts, ends=ends,
-                       n_groups=n_groups, group_live=group_live,
-                       live_sorted=live_sorted, boundary=boundary)
-
-
-def _prefix_range(prefix: jnp.ndarray, layout: GroupLayout) -> jnp.ndarray:
-    """Per-group difference of an inclusive prefix array: out[g] =
-    prefix[ends[g]-1] - prefix[starts[g]-1]."""
-    cap = prefix.shape[0]
-    hi = prefix[jnp.clip(layout.ends - 1, 0, cap - 1)]
-    lo_idx = layout.starts - 1
-    lo = jnp.where(lo_idx >= 0, prefix[jnp.clip(lo_idx, 0, cap - 1)],
-                   jnp.zeros((), prefix.dtype))
-    return jnp.where(layout.group_live, hi - lo, jnp.zeros((), prefix.dtype))
 
 
 def _segmented_scan(op, neutral, values: jnp.ndarray, contrib: jnp.ndarray,
@@ -152,66 +95,220 @@ def _segmented_scan(op, neutral, values: jnp.ndarray, contrib: jnp.ndarray,
     return out
 
 
-def _at_segment_ends(scanned: jnp.ndarray, layout: GroupLayout) -> jnp.ndarray:
-    cap = scanned.shape[0]
-    return scanned[jnp.clip(layout.ends - 1, 0, cap - 1)]
+def _minmax_strip_nan(values: jnp.ndarray, op: str) -> jnp.ndarray:
+    """Spark float semantics prep for min/max (FloatUtils.scala:84): NaN
+    orders greatest and -0.0 == 0.0. Replace NaN with the op's neutral so a
+    plain min/max reduction sees through it; :func:`_minmax_reinstate_nan`
+    puts NaN back where it is the true answer."""
+    repl = jnp.asarray(-jnp.inf if op == "max" else jnp.inf, values.dtype)
+    v = jnp.where(jnp.isnan(values), repl, values)
+    return jnp.where(v == 0, jnp.zeros((), v.dtype), v)
 
 
-def sorted_segment_reduce(values: jnp.ndarray, validity: jnp.ndarray,
-                          layout: GroupLayout, op: str
-                          ) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Reduce SORTED-space ``values`` per contiguous segment. Returns
-    (result[cap], valid-contribution count[cap]) in dense group order."""
-    contrib = validity & layout.live_sorted
-    counts = _prefix_range(jnp.cumsum(contrib.astype(jnp.int64)), layout)
-    cap = values.shape[0]
-    iota = jnp.arange(cap, dtype=jnp.int32)
-    if op == "count":
-        out = counts
-    elif op == "sum":
-        if jnp.issubdtype(values.dtype, jnp.floating):
-            # Segmented scan: no cross-segment accumulation, so no
-            # cancellation error from a global prefix sum.
-            s = _segmented_scan(jnp.add, jnp.zeros((), values.dtype),
-                                values, contrib, layout.boundary)
-            out = _at_segment_ends(s, layout)
+def _minmax_reinstate_nan(res: jnp.ndarray, nan_cnt: jnp.ndarray,
+                          cnt: jnp.ndarray, op: str) -> jnp.ndarray:
+    """max is NaN when ANY contribution was NaN (NaN is greatest); min is
+    NaN only when ALL contributions were."""
+    has_nan = (nan_cnt > 0) if op == "max" else (nan_cnt == cnt)
+    return jnp.where(has_nan & (cnt > 0), jnp.asarray(jnp.nan, res.dtype),
+                     res)
+
+
+def _first_last_comb(pick_last: bool):
+    """Associative combiner for segmented first/last-valid-value scans;
+    payload is (segment-start flag, has-valid, value)."""
+
+    def comb(a, b):
+        fa, ha, va = a
+        fb, hb, vb = b
+        h = jnp.where(fb, hb, ha | hb)
+        if pick_last:
+            v = jnp.where(fb, vb, jnp.where(hb, vb, va))
         else:
-            masked = jnp.where(contrib, values, 0)
-            out = _prefix_range(jnp.cumsum(masked), layout)
-    elif op in ("min", "max", "first", "last"):
-        # One more sort puts each segment's answer at its start position:
-        # sort by (group, invalid-last, order key) carrying the values, then
-        # read at layout.starts. A sort is ~20x cheaper than a segmented
-        # scan on TPU.
-        gid = jnp.cumsum(layout.boundary.astype(jnp.int32)) - 1
-        rank = jnp.where(contrib, 0, 1).astype(jnp.int8)
-        operands = [gid, rank]
-        if op in ("min", "max"):
-            floating = jnp.issubdtype(values.dtype, jnp.floating)
-            k = orderable_values(values, floating)
-            operands.append(~k if op == "max" else k)
+            v = jnp.where(fb, vb, jnp.where(ha, va, vb))
+        return fa | fb, h, v
+    return comb
+
+
+def _scan_results_at_positions(values: jnp.ndarray, validity: jnp.ndarray,
+                               live_sorted: jnp.ndarray, boundary: jnp.ndarray,
+                               op: str) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Segmented running (result, valid-count) over SORTED rows; each
+    segment's answer sits at its last position. All prefix scans — no
+    reordering passes."""
+    contrib = validity & live_sorted
+    ones = jnp.ones(values.shape[0], jnp.int64)
+    cnt = _segmented_scan(jnp.add, jnp.zeros((), jnp.int64), ones, contrib,
+                          boundary)
+    if op == "count":
+        return cnt, cnt
+    if op == "sum":
+        res = _segmented_scan(jnp.add, jnp.zeros((), values.dtype),
+                              values, contrib, boundary)
+        return res, cnt
+    if op in ("min", "max"):
+        floating = jnp.issubdtype(values.dtype, jnp.floating)
+        v = _minmax_strip_nan(values, op) if floating else values
+        fn = jnp.minimum if op == "min" else jnp.maximum
+        neutral = _max_value(v.dtype) if op == "min" else _min_value(v.dtype)
+        res = _segmented_scan(fn, neutral, v, contrib, boundary)
+        if floating:
+            nan_scan = _segmented_scan(jnp.add, jnp.zeros((), jnp.int64),
+                                       ones, jnp.isnan(values) & contrib,
+                                       boundary)
+            res = _minmax_reinstate_nan(res, nan_scan, cnt, op)
+        return res, cnt
+    if op in ("first", "last"):
+        _, _, res = jax.lax.associative_scan(
+            _first_last_comb(op == "last"), (boundary, contrib, values))
+        return res, cnt
+    raise ValueError(op)
+
+
+def grouped_aggregate(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray,
+                      inputs: Sequence[Tuple[jnp.ndarray, jnp.ndarray, str]]
+                      ) -> Tuple[List[DeviceColumn],
+                                 List[Tuple[jnp.ndarray, jnp.ndarray]],
+                                 jnp.ndarray, jnp.ndarray]:
+    """Whole grouped aggregation in TWO sorts + prefix scans.
+
+    1. ONE grouping sort over the key operands CARRYING key buffers and
+       every aggregation input as payload (a separate gather per column
+       would each cost another full pass).
+    2. Segmented scans per input (bandwidth-bound, effectively free);
+       each segment's answer lands on its last row.
+    3. ONE compaction sort moving segment-end rows to the front in group
+       order, carrying group keys and all results.
+
+    ``inputs`` is a list of (values[cap], validity[cap], op). Returns
+    (key_columns, [(result[cap], counts[cap])], n_groups, group_live) as
+    dense group rows.
+    """
+    capacity = keys[0].capacity
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    live = iota < n_rows
+    # -- sort 1: group rows, carrying everything --------------------------
+    operands: List[jnp.ndarray] = [jnp.where(live, 0, 1).astype(jnp.int8)]
+    for k in keys:
+        if k.is_string:
+            operands.extend(string_sort_keys(k))
+        else:
+            key, nb = orderable_key(k)
+            operands.append(nb)
+            operands.append(key)
+    payload: List[jnp.ndarray] = []
+    for k in keys:
+        if not k.is_string:
+            payload.append(k.data)
+            payload.append(k.validity)
+    for v, val, _ in inputs:
+        payload.append(v)
+        payload.append(val)
+    has_strings = any(k.is_string for k in keys)
+    if has_strings:
+        payload.append(iota)
+    sorted_all = jax.lax.sort(tuple(operands) + tuple(payload),
+                              num_keys=len(operands), is_stable=True)
+    n_ops = len(operands)
+    key_ops_sorted = sorted_all[1:n_ops]  # live-bucket excluded: equal for live
+    rest = list(sorted_all[n_ops:])
+    skeys: List[Optional[Tuple[jnp.ndarray, jnp.ndarray]]] = []
+    for k in keys:
+        if k.is_string:
+            skeys.append(None)
+        else:
+            skeys.append((rest.pop(0), rest.pop(0)))
+    sin = [(rest.pop(0), rest.pop(0), op) for (_, _, op) in inputs]
+    perm = rest.pop(0) if has_strings else None
+    # -- segment structure ------------------------------------------------
+    eq = jnp.ones(capacity, dtype=jnp.bool_)
+    for o in key_ops_sorted:
+        prev = jnp.concatenate([o[:1], o[:-1]])
+        eq = eq & (o == prev)
+    live_sorted = live  # dead rows sank to the end under the live bucket
+    boundary = (~eq | (iota == 0)) & live_sorted
+    n_groups = jnp.sum(boundary.astype(jnp.int32))
+    nxt = jnp.concatenate([boundary[1:], jnp.ones(1, jnp.bool_)])
+    is_end = live_sorted & (nxt | (iota + 1 == n_rows))
+    # -- per-input scans --------------------------------------------------
+    results_at = [_scan_results_at_positions(v, val, live_sorted, boundary, op)
+                  for v, val, op in sin]
+    # -- sort 2: compact segment ends to dense group rows -----------------
+    payload2: List[jnp.ndarray] = []
+    for sk in skeys:
+        if sk is not None:
+            payload2.extend(sk)
+    for res, cnt in results_at:
+        payload2.append(res)
+        payload2.append(cnt)
+    if has_strings:
+        payload2.append(perm)
+    sorted2 = jax.lax.sort(
+        (jnp.where(is_end, 0, 1).astype(jnp.int8),) + tuple(payload2),
+        num_keys=1, is_stable=True)
+    out = list(sorted2[1:])
+    group_live = iota < n_groups
+    key_cols: List[Optional[DeviceColumn]] = []
+    for k, sk in zip(keys, skeys):
+        if sk is None:
+            key_cols.append(None)
+            continue
+        data, validity = out.pop(0), out.pop(0)
+        validity = validity & group_live
+        data = jnp.where(validity, data, jnp.zeros((), data.dtype))
+        key_cols.append(DeviceColumn(data=data, validity=validity,
+                                     dtype=k.dtype))
+    results = [(out.pop(0), out.pop(0)) for _ in sin]
+    if has_strings:
+        perm2 = out.pop(0)
+        for i, k in enumerate(keys):
+            if k.is_string:
+                key_cols[i] = gather_column(k, perm2, group_live)
+    return key_cols, results, n_groups, group_live
+
+
+def global_aggregate(capacity: int, live: jnp.ndarray,
+                     inputs: Sequence[Tuple[jnp.ndarray, jnp.ndarray, str]]
+                     ) -> Tuple[List[DeviceColumn],
+                                List[Tuple[jnp.ndarray, jnp.ndarray]],
+                                jnp.ndarray, jnp.ndarray]:
+    """Global (no keys) aggregation: plain masked whole-array reductions,
+    fully fused by XLA — no sorts at all. Always emits exactly ONE group
+    (count 0 / null values over empty input), so callers never need a
+    row-count sync to special-case emptiness."""
+    iota = jnp.arange(capacity, dtype=jnp.int32)
+    results = []
+    for v, val, op in inputs:
+        contrib = val & live
+        cnt = jnp.sum(contrib.astype(jnp.int64))
+        if op == "count":
+            res = cnt
+        elif op == "sum":
+            res = jnp.sum(jnp.where(contrib, v, jnp.zeros((), v.dtype)))
+        elif op in ("min", "max"):
+            floating = jnp.issubdtype(v.dtype, jnp.floating)
+            vv = _minmax_strip_nan(v, op) if floating else v
+            neutral = _max_value(vv.dtype) if op == "min" \
+                else _min_value(vv.dtype)
+            masked = jnp.where(contrib, vv, neutral)
+            res = jnp.min(masked) if op == "min" else jnp.max(masked)
+            if floating:
+                nan_cnt = jnp.sum((jnp.isnan(v) & contrib).astype(jnp.int64))
+                res = _minmax_reinstate_nan(res, nan_cnt, cnt, op)
+        elif op == "first":
+            idx = jnp.argmax(contrib).astype(jnp.int32)
+            res = v[idx]
         elif op == "last":
-            operands.append(-iota)
-        # "first": stable sort keeps original order among valid rows.
-        sorted_all = jax.lax.sort(tuple(operands) + (values,),
-                                  num_keys=len(operands), is_stable=True)
-        s_v = sorted_all[-1]
-        out = s_v[jnp.clip(layout.starts, 0, cap - 1)]
-    else:
-        raise ValueError(op)
-    return out, counts
-
-
-def gather_sorted(col_data: jnp.ndarray, perm: jnp.ndarray) -> jnp.ndarray:
-    return col_data[perm]
-
-
-def group_key_columns(keys: Sequence[DeviceColumn], layout: GroupLayout
-                      ) -> List[DeviceColumn]:
-    """Dense group-key output columns (group g's key from its first row)."""
-    cap = keys[0].capacity
-    orig_starts = layout.perm[jnp.clip(layout.starts, 0, cap - 1)]
-    return [gather_column(k, orig_starts, layout.group_live) for k in keys]
+            idx = capacity - 1 - jnp.argmax(contrib[::-1]).astype(jnp.int32)
+            res = v[jnp.clip(idx, 0, capacity - 1)]
+        else:
+            raise ValueError(op)
+        dense_res = jnp.where(iota == 0, res,
+                              jnp.zeros((), res.dtype)).astype(v.dtype) \
+            if op != "count" else jnp.where(iota == 0, res, 0)
+        dense_cnt = jnp.where(iota == 0, cnt, 0)
+        results.append((dense_res, dense_cnt))
+    return [], results, jnp.asarray(1, jnp.int32), iota < 1
 
 
 def segment_reduce(values: jnp.ndarray, validity: jnp.ndarray,

@@ -87,6 +87,46 @@ def dense_key_ids(build_keys: Sequence[DeviceColumn],
     return ids[:cap_b], ids[cap_b:]
 
 
+def merge_rank_pair(reference: jnp.ndarray, queries: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """For each query q: (count of refs < q, count of refs <= q) in ONE
+    merge. ``reference`` must be sorted ascending.
+
+    Two ``lax.sort`` passes total (merge + route-back) instead of the four a
+    pair of :func:`merge_rank` calls costs; the within-run bookkeeping is
+    segmented prefix scans, which are effectively free on TPU (bandwidth
+    bound, no reordering)."""
+    n_ref, n_q = reference.shape[0], queries.shape[0]
+    total = n_ref + n_q
+    ids = jnp.concatenate([reference, queries])
+    is_ref = jnp.concatenate([jnp.ones(n_ref, jnp.int8),
+                              jnp.zeros(n_q, jnp.int8)])
+    qidx = jnp.concatenate([jnp.zeros(n_ref, jnp.int32),
+                            jnp.arange(n_q, dtype=jnp.int32)])
+    # refs sort before queries within an equal-value run.
+    side = (1 - is_ref).astype(jnp.int8)
+    s_id, _, s_qidx, s_isref = jax.lax.sort(
+        (ids, side, qidx, is_ref), num_keys=2, is_stable=True)
+    iota = jnp.arange(total, dtype=jnp.int32)
+    ref_incl = jnp.cumsum(s_isref.astype(jnp.int32))  # refs at-or-before pos
+    # Because refs precede queries in a run, a query position's inclusive
+    # ref prefix already counts every equal ref: hi = ref_incl.
+    # lo = refs strictly before the run = (exclusive ref prefix) at run
+    # start, broadcast across the run by a segmented first-value scan.
+    prev = jnp.concatenate([s_id[:1], s_id[:-1]])
+    run_start = (s_id != prev) | (iota == 0)
+    lo_at = ref_incl - s_isref.astype(jnp.int32)
+
+    def comb(a, b):
+        fa, va = a
+        fb, vb = b
+        return fa | fb, jnp.where(fb, vb, va)
+    _, lo_run = jax.lax.associative_scan(comb, (run_start, lo_at))
+    _, _, lo_q, hi_q = jax.lax.sort((s_isref, s_qidx, lo_run, ref_incl),
+                                    num_keys=2, is_stable=True)
+    return lo_q[:n_q], hi_q[:n_q]
+
+
 def merge_rank(reference: jnp.ndarray, queries: jnp.ndarray,
                inclusive: bool) -> jnp.ndarray:
     """For each query value q (any order), the count of reference elements
@@ -127,8 +167,7 @@ def match_ranges(build_ids: jnp.ndarray, probe_ids: jnp.ndarray,
         (jnp.where(build_ids < 0, jnp.int32(2 ** 31 - 1), build_ids), iota),
         num_keys=1, is_stable=True)
     valid_probe = probe_ids >= 0
-    lo = merge_rank(sorted_ids, probe_ids, inclusive=False)
-    hi = merge_rank(sorted_ids, probe_ids, inclusive=True)
+    lo, hi = merge_rank_pair(sorted_ids, probe_ids)
     counts = jnp.where(valid_probe, hi - lo, 0).astype(jnp.int32)
     return lo.astype(jnp.int32), counts, build_perm, sorted_ids
 

@@ -145,19 +145,72 @@ def gather_batch(batch: ColumnarBatch, indices: jnp.ndarray,
     return ColumnarBatch(cols, new_n_rows.astype(jnp.int32), batch.schema)
 
 
+def _permute_by_sort(batch: ColumnarBatch, key_operands: List[jnp.ndarray],
+                     new_n_rows: jnp.ndarray) -> ColumnarBatch:
+    """Reorder a batch by sorting on ``key_operands``, CARRYING every
+    fixed-width column's buffers as extra sort operands. One ``lax.sort``
+    pass moves all the data — the separate per-column gathers this replaces
+    each cost another full memory pass on TPU. String columns (variable
+    width) still gather through the carried permutation."""
+    cap = batch.capacity
+    live_out = jnp.arange(cap, dtype=jnp.int32) < new_n_rows
+    payload: List[jnp.ndarray] = []
+    fixed_cols = []
+    has_strings = any(c.is_string for c in batch.columns)
+    for i, c in enumerate(batch.columns):
+        if not c.is_string:
+            payload.append(c.data)
+            payload.append(c.validity)
+            fixed_cols.append(i)
+    if has_strings:
+        payload.append(jnp.arange(cap, dtype=jnp.int32))  # perm for strings
+    sorted_all = jax.lax.sort(tuple(key_operands) + tuple(payload),
+                              num_keys=len(key_operands), is_stable=True)
+    out = list(sorted_all[len(key_operands):])
+    perm = out.pop() if has_strings else None
+    cols: List[Optional[DeviceColumn]] = [None] * len(batch.columns)
+    for j, i in enumerate(fixed_cols):
+        data, validity = out[2 * j], out[2 * j + 1]
+        validity = validity & live_out
+        data = jnp.where(validity, data, jnp.zeros((), data.dtype))
+        cols[i] = DeviceColumn(data=data, validity=validity,
+                               dtype=batch.columns[i].dtype)
+    for i, c in enumerate(batch.columns):
+        if c.is_string:
+            cols[i] = gather_column(c, perm, live_out)
+    return ColumnarBatch(tuple(cols), new_n_rows.astype(jnp.int32),
+                         batch.schema)
+
+
 def compact(batch: ColumnarBatch, keep: jnp.ndarray) -> ColumnarBatch:
     """Filter: move kept rows to the front, shrink n_rows. ``keep`` is a
     bool[capacity] mask (already False for dead/invalid-predicate rows)."""
     keep = keep & batch.row_mask()
     n_kept = jnp.sum(keep.astype(jnp.int32))
     drop = (~keep).astype(jnp.int8)
-    iota = jnp.arange(batch.capacity, dtype=jnp.int32)
-    _, perm = jax.lax.sort((drop, iota), num_keys=1, is_stable=True)
-    return gather_batch(batch, perm, n_kept)
+    return _permute_by_sort(batch, [drop], n_kept)
+
+
+def sort_batch_by_columns(batch: ColumnarBatch,
+                          keys: Sequence[DeviceColumn],
+                          ascending: Sequence[bool],
+                          nulls_first: Sequence[bool]) -> ColumnarBatch:
+    """Sort a batch by evaluated key columns, carrying payload through the
+    one sort (see :func:`_permute_by_sort`)."""
+    capacity = batch.capacity
+    live = jnp.arange(capacity, dtype=jnp.int32) < batch.n_rows
+    operands: List[jnp.ndarray] = [jnp.where(live, 0, 1).astype(jnp.int8)]
+    for k, a, n in zip(keys, ascending, nulls_first):
+        if k.is_string:
+            operands.extend(string_sort_keys(k, a, n))
+        else:
+            key, null_bucket = orderable_key(k, a, n)
+            operands.append(null_bucket)
+            operands.append(key)
+    return _permute_by_sort(batch, operands, batch.n_rows)
 
 
 def sort_batch(batch: ColumnarBatch, key_ordinals: Sequence[int],
                ascending: Sequence[bool], nulls_first: Sequence[bool]) -> ColumnarBatch:
     keys = [batch.columns[i] for i in key_ordinals]
-    perm = sort_permutation(keys, batch.n_rows, ascending, nulls_first)
-    return gather_batch(batch, perm, batch.n_rows)
+    return sort_batch_by_columns(batch, keys, ascending, nulls_first)

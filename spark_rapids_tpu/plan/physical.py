@@ -36,6 +36,25 @@ class ExecContext:
     catalog: object = None
     #: end-of-query callbacks (shuffle unregister etc.); run by close()
     cleanups: list = dataclasses.field(default_factory=list)
+    #: Multiplier applied to optimistic join output capacities. Joins size
+    #: their output from the probe capacity WITHOUT syncing the real match
+    #: count (the device->host round trip is the expensive resource); when
+    #: a query's deferred overflow check trips, the session re-runs it with
+    #: a larger growth (TpuSession.execute retry loop).
+    join_growth: float = 1.0
+    #: Deferred device-side overflow checks (bool scalars) appended by joins.
+    #: Checked ONCE per query after execution — no per-batch host syncs.
+    overflow_flags: list = dataclasses.field(default_factory=list)
+    #: True = joins sync the exact match count per probe batch and resize
+    #: exactly (one round trip per batch, can never overflow). Used for
+    #: side-effecting plans (writes) and as the guaranteed last rung of the
+    #: session's deferred-overflow retry ladder.
+    eager_overflow: bool = False
+    #: Whole-stage fusion input override: FusedInputExec index -> partitions.
+    fused_inputs: Optional[list] = None
+    #: True while executing under a whole-stage fusion trace: execs must not
+    #: force host syncs (int(n_rows)) or touch the spill catalog.
+    in_fusion: bool = False
 
     def metric(self, node: str, name: str, value):
         self.metrics.setdefault(node, {})

@@ -100,42 +100,91 @@ class TpuSession:
         return self._overrides.apply(cpu_plan)
 
     def execute(self, logical: L.LogicalPlan) -> pa.Table:
+        """Plan + run. Joins size their output optimistically with a
+        deferred device-side overflow flag (no per-batch host syncs); when a
+        flag trips the query re-runs with a larger ``join_growth`` — the
+        rare path fan-out joins pay so everything else stays round-trip
+        free. Fusable device plans run as ONE compiled program
+        (exec/fusion.py)."""
+        from .exec import fusion
         physical = self.plan(logical)
-        ctx = P.ExecContext(self.conf, catalog=self.device_manager.catalog)
-        try:
-            return P.collect_partitions(physical, ctx)
-        finally:
-            ctx.close()
+        # Write plans are side-effecting: a discard-and-retry would commit
+        # truncated files first. They use the eager per-batch exact-resize
+        # join path instead (one sync per probe batch — writes are IO-bound
+        # anyway). The eager path is also the guaranteed final rung of the
+        # retry ladder, so arbitrary fan-out always terminates exactly.
+        eager_only = _contains_write(physical)
+        attempts = [("eager", 1.0)] if eager_only else \
+            [("deferred", 1.0), ("deferred", 8.0), ("deferred", 64.0),
+             ("eager", 1.0)]
+        for mode, growth in attempts:
+            ctx = P.ExecContext(self.conf, catalog=self.device_manager.catalog)
+            ctx.join_growth = growth
+            ctx.eager_overflow = mode == "eager"
+            try:
+                if mode == "deferred" and self.conf.sql_enabled \
+                        and self.conf.fusion_enabled \
+                        and fusion.fusable(physical):
+                    table, overflowed = fusion.fused_collect(physical, ctx)
+                    # Boundary subtrees (windows, broadcasts, ...) executed
+                    # eagerly with THIS ctx: their deferred flags must gate
+                    # the result too.
+                    overflowed = overflowed or fusion.any_overflow(ctx)
+                else:
+                    table = P.collect_partitions(physical, ctx)
+                    overflowed = fusion.any_overflow(ctx)
+            finally:
+                ctx.close()
+            if not overflowed:
+                return table
+        raise AssertionError("unreachable: eager join path cannot overflow")
 
     def materialize(self, logical: L.LogicalPlan) -> "L.CachedRelation":
         """Execute now and pin the result (eager df.cache()). Under a
         device session the batches stay resident in HBM."""
+        from .exec import fusion
         physical = self.plan(logical)
-        ctx = P.ExecContext(self.conf, catalog=self.device_manager.catalog)
         from .exec.execs import DeviceToHostExec, HostToDeviceExec
-        try:
-            if self.conf.sql_enabled:
-                if isinstance(physical, DeviceToHostExec) \
-                        and physical.children[0].columnar:
-                    device_root = physical.children[0]
-                elif not physical.columnar:
-                    # Pure host plan (e.g. a bare table): upload so the
-                    # cache is device-resident.
-                    device_root = HostToDeviceExec(physical,
-                                                   self.conf.batch_size_rows)
-                else:
-                    device_root = physical
-                parts = [list(p) for p in device_root.execute(ctx)]
-                n = sum(int(b.n_rows) for p in parts for b in p)
-                return L.CachedRelation(logical.schema, device_parts=parts,
-                                        n_rows=n)
-            table = P.collect_partitions(physical, ctx)
-            rbs = table.combine_chunks().to_batches()
-            return L.CachedRelation(logical.schema, host_batches=rbs,
-                                    n_rows=table.num_rows)
-        finally:
-            ctx.close()
+        attempts = [("deferred", 1.0), ("deferred", 8.0), ("deferred", 64.0),
+                    ("eager", 1.0)]
+        for mode, growth in attempts:
+            ctx = P.ExecContext(self.conf,
+                                catalog=self.device_manager.catalog)
+            ctx.join_growth = growth
+            ctx.eager_overflow = mode == "eager"
+            try:
+                if self.conf.sql_enabled:
+                    if isinstance(physical, DeviceToHostExec) \
+                            and physical.children[0].columnar:
+                        device_root = physical.children[0]
+                    elif not physical.columnar:
+                        # Pure host plan (e.g. a bare table): upload so the
+                        # cache is device-resident.
+                        device_root = HostToDeviceExec(
+                            physical, self.conf.batch_size_rows)
+                    else:
+                        device_root = physical
+                    parts = [list(p) for p in device_root.execute(ctx)]
+                    if fusion.any_overflow(ctx):
+                        continue
+                    n = sum(int(b.n_rows) for p in parts for b in p)
+                    return L.CachedRelation(logical.schema,
+                                            device_parts=parts, n_rows=n)
+                table = P.collect_partitions(physical, ctx)
+                rbs = table.combine_chunks().to_batches()
+                return L.CachedRelation(logical.schema, host_batches=rbs,
+                                        n_rows=table.num_rows)
+            finally:
+                ctx.close()
+        raise AssertionError("unreachable: eager join path cannot overflow")
 
     def explain(self, logical: L.LogicalPlan) -> str:
         physical = self.plan(logical)
         return physical.tree_string()
+
+
+def _contains_write(plan: P.PhysicalPlan) -> bool:
+    from .io.writers import _WriteFilesBase
+    if isinstance(plan, _WriteFilesBase):
+        return True
+    return any(_contains_write(c) for c in plan.children)

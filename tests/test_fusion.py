@@ -1,0 +1,196 @@
+"""Whole-stage fusion tests: the fused single-program path must be
+row-identical to the streaming path and to the CPU oracle, the deferred
+join-overflow retry must kick in for fan-out joins, and re-running a fused
+query must not recompile (exec/fusion.py)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.exec import fusion
+from spark_rapids_tpu.ops import aggregates as AGG
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops.arithmetic import Add, Multiply
+from spark_rapids_tpu.ops.expression import col, lit
+from spark_rapids_tpu.session import TpuSession
+
+from harness import _canonical_rows
+
+
+def canonical_rows(table):
+    return sorted(_canonical_rows(table))
+
+
+def _sessions():
+    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+    fused = TpuSession({"spark.rapids.sql.enabled": True})
+    streamed = TpuSession({"spark.rapids.sql.enabled": True,
+                           "spark.rapids.tpu.fusion.enabled": False})
+    return cpu, fused, streamed
+
+
+def _data(n=5000, seed=0):
+    rng = np.random.default_rng(seed)
+    return pa.RecordBatch.from_pydict({
+        "k": rng.integers(0, 50, n).astype(np.int64),
+        "v": rng.integers(-100, 100, n).astype(np.int64),
+        "x": rng.normal(size=n),
+    })
+
+
+def _assert_same(q_builder):
+    cpu, fused, streamed = _sessions()
+    results = [q_builder(s).collect() for s in (cpu, fused, streamed)]
+    base = canonical_rows(results[0])
+    assert canonical_rows(results[1]) == base, "fused != CPU oracle"
+    assert canonical_rows(results[2]) == base, "streamed != CPU oracle"
+
+
+class TestFusedEquivalence:
+    def test_filter_project_agg(self):
+        rb = _data()
+
+        def q(s):
+            return (s.create_dataframe(rb).cache()
+                    .where(P.GreaterThan(col("v"), lit(0)))
+                    .with_column("v2", Multiply(col("v"), lit(3)))
+                    .group_by(col("k"))
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("v2")), "s"),
+                         AGG.AggregateExpression(AGG.Count(), "c")))
+        _assert_same(q)
+
+    def test_join_agg(self):
+        fact = _data(4000, seed=1)
+        dim = pa.RecordBatch.from_pydict({
+            "k": np.arange(50, dtype=np.int64),
+            "cat": (np.arange(50, dtype=np.int64) % 7),
+        })
+
+        def q(s):
+            f = s.create_dataframe(fact).cache()
+            d = s.create_dataframe(dim).cache()
+            return (f.join(d, on="k", how="inner")
+                    .group_by(col("cat"))
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "sv")))
+        _assert_same(q)
+
+    def test_sort_limit(self):
+        rb = _data(2000, seed=2)
+
+        def q(s):
+            return (s.create_dataframe(rb).cache()
+                    .sort(col("v"))
+                    .limit(17))
+        cpu, fused, streamed = _sessions()
+        res = [q(s).collect() for s in (cpu, fused, streamed)]
+        # Sorted prefix: compare ordered rows, not multisets.
+        a = list(zip(*[res[0].column(i).to_pylist() for i in range(3)]))
+        b = list(zip(*[res[1].column(i).to_pylist() for i in range(3)]))
+        c = list(zip(*[res[2].column(i).to_pylist() for i in range(3)]))
+        assert [r[1] for r in a] == [r[1] for r in b] == [r[1] for r in c]
+        assert len(b) == 17
+
+    def test_global_agg_empty_input(self):
+        rb = pa.RecordBatch.from_pydict(
+            {"v": np.asarray([], dtype=np.int64)})
+
+        def q(s):
+            return (s.create_dataframe(rb).cache()
+                    .group_by()
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s"),
+                         AGG.AggregateExpression(AGG.Count(), "c")))
+        _assert_same(q)
+
+    def test_grouped_agg_all_filtered(self):
+        rb = _data(500, seed=3)
+
+        def q(s):
+            return (s.create_dataframe(rb).cache()
+                    .where(P.GreaterThan(col("v"), lit(10_000)))  # none pass
+                    .group_by(col("k"))
+                    .agg(AGG.AggregateExpression(AGG.Count(), "c")))
+        _assert_same(q)
+
+    def test_uncached_input_fuses_through_upload_boundary(self):
+        # LocalRelation -> HostToDevice is a fusion boundary source; the
+        # device subtree above it still fuses.
+        rb = _data(1000, seed=4)
+
+        def q(s):
+            return (s.create_dataframe(rb)
+                    .with_column("y", Add(col("v"), lit(1)))
+                    .group_by(col("k"))
+                    .agg(AGG.AggregateExpression(AGG.Max(col("y")), "m")))
+        _assert_same(q)
+
+
+class TestOverflowRetry:
+    def test_fanout_join_overflows_and_retries(self):
+        # Every probe row matches 64 build rows: output is 64x the probe
+        # capacity, far beyond the optimistic growth-1 allocation, so the
+        # deferred flag must trip and the session must retry larger.
+        n = 1024
+        probe = pa.RecordBatch.from_pydict({
+            "k": np.zeros(n, dtype=np.int64),
+            "v": np.arange(n, dtype=np.int64),
+        })
+        build = pa.RecordBatch.from_pydict({
+            "k": np.zeros(64, dtype=np.int64),
+            "w": np.arange(64, dtype=np.int64),
+        })
+        cpu, fused, streamed = _sessions()
+
+        def q(s):
+            p = s.create_dataframe(probe).cache()
+            b = s.create_dataframe(build).cache()
+            return (p.join(b, on="k", how="inner")
+                    .group_by()
+                    .agg(AGG.AggregateExpression(AGG.Count(), "c"),
+                         AGG.AggregateExpression(AGG.Sum(col("w")), "sw")))
+        res = [q(s).collect() for s in (cpu, fused, streamed)]
+        base = canonical_rows(res[0])
+        assert base[0][0] == n * 64
+        assert canonical_rows(res[1]) == base
+        assert canonical_rows(res[2]) == base
+
+
+class TestWriteEagerJoin:
+    def test_fanout_join_write_is_exact(self, tmp_path):
+        # Side-effecting plans must NOT use discard-and-retry overflow
+        # handling (the first run would commit truncated files): writes take
+        # the eager exact-resize join path instead.
+        import pyarrow.dataset as ds
+        n = 512
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        p = s.create_dataframe({"k": [0] * n, "v": list(range(n))}).cache()
+        b = s.create_dataframe({"k": [0] * 32, "w": list(range(32))}).cache()
+        out = str(tmp_path / "out")
+        p.join(b, on="k", how="inner").select(col("v"), col("w")) \
+            .write.parquet(out)
+        got = ds.dataset(out, format="parquet").to_table()
+        assert got.num_rows == n * 32
+
+
+class TestFusionCache:
+    def test_rerun_hits_fused_cache(self):
+        rb = _data(1500, seed=5)
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        df = s.create_dataframe(rb).cache()
+
+        def q():
+            return (df.where(P.GreaterThan(col("v"), lit(0)))
+                    .group_by(col("k"))
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s")))
+        q().collect()
+        n_entries = len(fusion._FUSED_CACHE)
+        q().collect()
+        assert len(fusion._FUSED_CACHE) == n_entries, \
+            "re-running an identical query must reuse the fused program"
+
+    def test_fusable_detection(self):
+        rb = _data(100, seed=6)
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        df = s.create_dataframe(rb).cache()
+        plan = s.plan(df.where(P.GreaterThan(col("v"), lit(0)))._plan)
+        assert fusion.fusable(plan)

@@ -125,9 +125,14 @@ class TestCoalesce:
         from spark_rapids_tpu.exec.coalesce import TargetSize
         batches = [_batch(n=100, seed=i, with_strings=False)
                    for i in range(6)]
+        # Coalesce accounts by CAPACITY (128-bucket for 100 rows), not live
+        # rows — capacity is static, so accumulation needs no device sync.
+        # 6 batches of capacity 128 against a target of 250 flush in pairs.
         out = self._run_coalesce(TargetSize(250), batches)
-        assert len(out) == 2  # 300 + 300 rows
-        assert int(out[0].n_rows) == 300
+        assert len(out) == 3
+        assert int(out[0].n_rows) == 200
+        total = sum(int(b.n_rows) for b in out)
+        assert total == 600
 
     def test_require_single_batch(self):
         from spark_rapids_tpu.exec.coalesce import RequireSingleBatch
