@@ -240,6 +240,14 @@ TPU_FUSION_ENABLED = conf_bool(
     "Trace an entire device plan into one compiled XLA program (whole-stage "
     "fusion): one dispatch and one device->host transfer per query.")
 
+TPU_MESH_ENABLED = conf_bool(
+    "spark.rapids.tpu.mesh.enabled", False,
+    "Run mesh-capable queries as ONE SPMD program over all devices "
+    "(jax.sharding.Mesh): sources shard row-wise, aggregate/join "
+    "boundaries exchange over ICI via all_to_all (exec/mesh.py). The "
+    "engine-integrated form of the reference's GPU-resident shuffle "
+    "manager.")
+
 DEVICE_BACKEND = conf_str(
     "spark.rapids.tpu.backend", None,
     "Force a jax backend for device execution (tpu/cpu). Default: jax default.",
@@ -303,6 +311,10 @@ class TpuConf:
     @property
     def fusion_enabled(self) -> bool:
         return self.get(TPU_FUSION_ENABLED)
+
+    @property
+    def mesh_enabled(self) -> bool:
+        return self.get(TPU_MESH_ENABLED)
 
     def is_operator_enabled(self, conf_key: str, incompat: bool, disabled_by_default: bool) -> bool:
         """Three-state per-operator gating (reference RapidsMeta.tagForGpu:195-210)."""

@@ -518,28 +518,8 @@ class TpuHashAggregateExec(TpuExec):
 
     def _finalize(self, state: ColumnarBatch, buf_schema: T.Schema
                   ) -> ColumnarBatch:
-        out_schema = self.schema
-        n_keys = len(self.groupings)
-        aggregates = self.aggregates
-
-        def build():
-            def final(b: ColumnarBatch) -> ColumnarBatch:
-                cols = list(b.columns[:n_keys])
-                bi = n_keys
-                for a in aggregates:
-                    specs = a.func.buffers()
-                    refs = [BoundReference(bi + j, s.dtype, True)
-                            for j, s in enumerate(specs)]
-                    bi += len(specs)
-                    result_expr = a.func.evaluate(refs)
-                    cols.append(result_expr.eval_device(b))
-                return ColumnarBatch(tuple(cols), b.n_rows, out_schema)
-            return final
-        final = cached_kernel(
-            "agg_final",
-            kernel_key(n_keys, [(a.name, a.func) for a in aggregates],
-                       buf_schema, out_schema),
-            build)
+        final = finalize_agg_kernel(len(self.groupings), self.aggregates,
+                                    buf_schema, self.schema)
         return final(state)
 
     def _empty_result(self) -> ColumnarBatch:
@@ -553,6 +533,30 @@ class TpuHashAggregateExec(TpuExec):
         rb = pa.RecordBatch.from_arrays(
             arrays, schema=T.schema_to_arrow(self.schema))
         return ColumnarBatch.from_arrow(rb)
+
+
+def finalize_agg_kernel(n_keys: int, aggregates: List[AGG.AggregateExpression],
+                        buf_schema: T.Schema, out_schema: T.Schema):
+    """Cached buffer-evaluation projection (agg result-expression pass);
+    shared by the streaming exec and the SPMD mesh path."""
+    def build():
+        def final(b: ColumnarBatch) -> ColumnarBatch:
+            cols = list(b.columns[:n_keys])
+            bi = n_keys
+            for a in aggregates:
+                specs = a.func.buffers()
+                refs = [BoundReference(bi + j, s.dtype, True)
+                        for j, s in enumerate(specs)]
+                bi += len(specs)
+                result_expr = a.func.evaluate(refs)
+                cols.append(result_expr.eval_device(b))
+            return ColumnarBatch(tuple(cols), b.n_rows, out_schema)
+        return final
+    return cached_kernel(
+        "agg_final",
+        kernel_key(n_keys, [(a.name, a.func) for a in aggregates],
+                   buf_schema, out_schema),
+        build)
 
 
 def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
@@ -618,6 +622,79 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
 # ---------------------------------------------------------------------------
 
 
+def hash_join_kernel(jt: str, lkeys: List[Expression],
+                     rkeys: List[Expression], out_schema: T.Schema):
+    """Process-cached local equi-join kernel ``(probe, build, out_cap)``.
+
+    Shared by the streaming exec and the SPMD mesh path (exec/mesh.py):
+    both are, per shard, exactly this local join. Semantics per join type:
+    semi/anti return a compacted probe; left/full expand unmatched probe
+    rows with nulls; full also returns the build-side hit mask for the
+    caller's unmatched-build pass."""
+    def kernel_impl(probe, build, out_cap):
+        pk = [e.eval_device(probe) for e in lkeys]
+        bk = [e.eval_device(build) for e in rkeys]
+        bids, pids = KJ.dense_key_ids(bk, pk, build.n_rows, probe.n_rows)
+        lo, counts, perm, sorted_ids = KJ.match_ranges(bids, pids)
+        live_p = probe.row_mask()
+        counts = jnp.where(live_p, counts, 0)
+        matched = counts > 0
+        hits = None
+        if jt == "full":
+            hits = KJ.build_hit_mask(bids, sorted_ids, pids, probe.n_rows)
+        if jt in ("left_semi", "left_anti"):
+            keep = matched if jt == "left_semi" else (~matched & live_p)
+            return KR.compact(probe, keep), hits
+        exp_counts = counts
+        if jt in ("left", "full"):
+            exp_counts = KJ.left_outer_counts(counts, live_p)
+        p_idx, b_idx, n_out, total = KJ.expand_matches(
+            lo, exp_counts, perm, out_cap)
+        real = matched[p_idx]
+        out_live = jnp.arange(out_cap, dtype=jnp.int32) < n_out
+        pcols = [KR.gather_column(c, p_idx, out_live)
+                 for c in probe.columns]
+        bcols = [KR.gather_column(c, b_idx, out_live & real)
+                 for c in build.columns]
+        out = ColumnarBatch(tuple(pcols + bcols), n_out, out_schema)
+        return (out, hits), total
+
+    return cached_kernel(
+        "hash_join", kernel_key(jt, lkeys, rkeys, out_schema),
+        lambda: kernel_impl, static_argnums=(2,))
+
+
+def join_post_filter(condition: Optional[Expression], out_schema: T.Schema):
+    """Cached residual-condition filter applied to join output rows."""
+    if condition is None:
+        return None
+    cond = condition.bind(out_schema)
+
+    def build_post():
+        def post_filter(b):
+            mask = cond.eval_device(b)
+            return KR.compact(b, mask.data & mask.validity)
+        return post_filter
+    return cached_kernel("join_post_filter", kernel_key(cond), build_post)
+
+
+def unmatched_build_kernel(left_schema: T.Schema, out_schema: T.Schema):
+    """Cached full-outer tail: unmatched build rows null-extended on the
+    left (shared by the streaming exec and the mesh path)."""
+    def builder():
+        def kernel(build, hits):
+            live_b = build.row_mask()
+            keep = live_b & ~hits if hits is not None else live_b
+            compacted = KR.compact(build, keep)
+            null_left = [_null_col(f.data_type, build.capacity)
+                         for f in left_schema]
+            cols = tuple(null_left) + compacted.columns
+            return ColumnarBatch(cols, compacted.n_rows, out_schema)
+        return kernel
+    return cached_kernel("join_unmatched_build",
+                         kernel_key(left_schema, out_schema), builder)
+
+
 class TpuShuffledHashJoinExec(TpuExec):
     """Equi-join: build side fully concatenated on device, probe side
     streamed (GpuShuffledHashJoinExec/GpuHashJoin analog). Also covers the
@@ -665,50 +742,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         rkeys = _bind_all(self.right_keys, right.schema)
         jt = self.join_type
         out_schema = self._schema
-
-        def kernel_impl(probe, build, out_cap):
-            pk = [e.eval_device(probe) for e in lkeys]
-            bk = [e.eval_device(build) for e in rkeys]
-            bids, pids = KJ.dense_key_ids(bk, pk, build.n_rows, probe.n_rows)
-            lo, counts, perm, sorted_ids = KJ.match_ranges(bids, pids)
-            live_p = probe.row_mask()
-            counts = jnp.where(live_p, counts, 0)
-            matched = counts > 0
-            hits = None
-            if jt == "full":
-                hits = KJ.build_hit_mask(bids, sorted_ids, pids, probe.n_rows)
-            if jt in ("left_semi", "left_anti"):
-                keep = matched if jt == "left_semi" else (~matched & live_p)
-                return KR.compact(probe, keep), hits
-            exp_counts = counts
-            if jt in ("left", "full"):
-                exp_counts = KJ.left_outer_counts(counts, live_p)
-            p_idx, b_idx, n_out, total = KJ.expand_matches(
-                lo, exp_counts, perm, out_cap)
-            real = matched[p_idx]
-            out_live = jnp.arange(out_cap, dtype=jnp.int32) < n_out
-            pcols = [KR.gather_column(c, p_idx, out_live)
-                     for c in probe.columns]
-            bcols = [KR.gather_column(c, b_idx, out_live & real)
-                     for c in build.columns]
-            out = ColumnarBatch(tuple(pcols + bcols), n_out, out_schema)
-            return (out, hits), total
-
-        kernel = cached_kernel(
-            "hash_join", kernel_key(jt, lkeys, rkeys, out_schema),
-            lambda: kernel_impl, static_argnums=(2,))
-
-        post_filter = None
-        if self.condition is not None:
-            cond = self.condition.bind(out_schema)
-
-            def build_post():
-                def post_filter(b):
-                    mask = cond.eval_device(b)
-                    return KR.compact(b, mask.data & mask.validity)
-                return post_filter
-            post_filter = cached_kernel("join_post_filter", kernel_key(cond),
-                                        build_post)
+        kernel = hash_join_kernel(jt, lkeys, rkeys, out_schema)
+        post_filter = join_post_filter(self.condition, out_schema)
 
         def join_batch(probe, build):
             # Optimistic output sizing: allocate from the probe capacity and
@@ -762,21 +797,7 @@ class TpuShuffledHashJoinExec(TpuExec):
         return [gen()]
 
     def _unmatched_build(self, build: ColumnarBatch, hit_acc) -> ColumnarBatch:
-        left_schema = self.children[0].schema
-        out_schema = self._schema
-
-        def builder():
-            def kernel(build, hits):
-                live_b = build.row_mask()
-                keep = live_b & ~hits if hits is not None else live_b
-                compacted = KR.compact(build, keep)
-                null_left = [_null_col(f.data_type, build.capacity)
-                             for f in left_schema]
-                cols = tuple(null_left) + compacted.columns
-                return ColumnarBatch(cols, compacted.n_rows, out_schema)
-            return kernel
-        kernel = cached_kernel("join_unmatched_build",
-                               kernel_key(left_schema, out_schema), builder)
+        kernel = unmatched_build_kernel(self.children[0].schema, self._schema)
         return kernel(build, hit_acc)
 
 

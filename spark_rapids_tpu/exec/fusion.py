@@ -43,7 +43,7 @@ from .. import types as T
 from ..data.batch import ColumnarBatch, _shrink_batch
 from ..data.column import bucket_capacity
 from ..plan.physical import ExecContext
-from ..utils.kernel_cache import _sig_value
+from ..utils.kernel_cache import plan_signature as _plan_sig
 from .coalesce import TpuCoalesceBatchesExec
 from .execs import (DeviceToHostExec, TpuExec, TpuExpandExec, TpuFilterExec,
                     TpuHashAggregateExec, TpuLimitExec, TpuProjectExec,
@@ -113,20 +113,6 @@ def fusable(root) -> bool:
     except _NotFusable:
         return False
     return True
-
-
-_SKIP_ATTRS = frozenset({"children", "partitions"})
-
-
-def _plan_sig(p) -> tuple:
-    """Structural signature of a fused plan: node types + static params
-    (expressions, schemas, goals) — NOT input shapes, which jax.jit keys on
-    itself through the argument avals."""
-    extras = tuple(sorted(
-        (k, _sig_value(v)) for k, v in vars(p).items()
-        if k not in _SKIP_ATTRS))
-    return (type(p).__name__, extras,
-            tuple(_plan_sig(c) for c in p.children))
 
 
 _FUSED_CACHE = {}

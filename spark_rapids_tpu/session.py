@@ -123,6 +123,10 @@ class TpuSession:
             ctx.eager_overflow = mode == "eager"
             try:
                 if mode == "deferred" and self.conf.sql_enabled \
+                        and self.conf.mesh_enabled \
+                        and _mesh().mesh_capable(physical, self.conf):
+                    table, overflowed = _mesh().mesh_collect(physical, ctx)
+                elif mode == "deferred" and self.conf.sql_enabled \
                         and self.conf.fusion_enabled \
                         and fusion.fusable(physical):
                     table, overflowed = fusion.fused_collect(physical, ctx)
@@ -181,6 +185,11 @@ class TpuSession:
     def explain(self, logical: L.LogicalPlan) -> str:
         physical = self.plan(logical)
         return physical.tree_string()
+
+
+def _mesh():
+    from .exec import mesh
+    return mesh
 
 
 def _contains_write(plan: P.PhysicalPlan) -> bool:

@@ -63,6 +63,22 @@ def _expr_signature(e) -> tuple:
             tuple(_expr_signature(c) for c in e.children))
 
 
+#: Exec attributes that are per-instance data, not structure.
+PLAN_SIG_SKIP_ATTRS = frozenset({"children", "partitions"})
+
+
+def plan_signature(p) -> tuple:
+    """Structural signature of a physical plan: node types + static params
+    (expressions, schemas, goals) — NOT input shapes, which jax.jit keys on
+    itself through argument avals. Shared by the whole-stage fusion and
+    mesh SPMD caches."""
+    extras = tuple(sorted(
+        (k, _sig_value(v)) for k, v in vars(p).items()
+        if k not in PLAN_SIG_SKIP_ATTRS))
+    return (type(p).__name__, extras,
+            tuple(plan_signature(c) for c in p.children))
+
+
 def cached_kernel(kind: str, key: tuple, builder: Callable[[], Callable],
                   static_argnums: Optional[Tuple[int, ...]] = None
                   ) -> Callable:

@@ -1,0 +1,301 @@
+"""TPC-H-like workload: generated tables + query builders.
+
+The reference ships TPC-H-shaped benchmark harnesses
+(``integration_tests/.../tpch/TpchLikeSpark.scala:290+``) and a TPCxBB-like
+suite (``TpcxbbLikeSpark.scala``) whose bar chart is the project's headline
+result. This module is the standalone analog: seeded generators produce
+TPC-H-shaped tables at a requested row scale, and each ``qN`` builder
+returns a DataFrame expressing the TPC-H query's shape through the public
+API. ``xbb_score`` is the TPCxBB q05-shaped logistic-regression scoring
+query (``TpcxbbLikeSpark.scala`` q05 builds a logistic model over clicks),
+which exercises the float math path TPUs exist for.
+
+Used both as differential tests (tests/test_tpch.py) and as the bench
+suite (bench.py reports the geomean, matching BASELINE.md's geomean
+metric).
+
+Dates are int32 days-since-epoch (Spark's DATE representation); decimals
+use DOUBLE, the reference's pre-decimal configuration.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from ..ops import aggregates as A
+from ..ops import predicates as P
+from ..ops.arithmetic import Add, Multiply, Subtract
+from ..ops.conditional import If
+from ..ops.expression import col, lit
+from ..ops.math import Exp
+from ..ops.strings import StartsWith
+from ..plan.logical import SortOrder
+from .. import types as T
+
+# days-since-epoch for the date literals the queries use
+D_1994_01_01 = 8766
+D_1995_01_01 = 9131
+D_1995_03_15 = 9204
+D_1995_09_01 = 9374
+D_1995_10_01 = 9404
+D_1998_09_02 = 10471
+
+_FLAGS = np.array(["A", "N", "R"])
+_STATUS = np.array(["F", "O"])
+_SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+                      "MACHINERY"])
+_MODES = np.array(["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP", "TRUCK"])
+_PRIORITIES = np.array(["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
+                        "5-LOW"])
+_TYPES = np.array(["PROMO BRUSHED", "PROMO BURNISHED", "STANDARD POLISHED",
+                   "SMALL PLATED", "MEDIUM ANODIZED", "ECONOMY BRUSHED"])
+_NATIONS = np.array(["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT",
+                     "ETHIOPIA", "FRANCE", "GERMANY", "INDIA", "INDONESIA",
+                     "IRAN", "IRAQ", "JAPAN", "JORDAN", "KENYA", "MOROCCO",
+                     "MOZAMBIQUE", "PERU", "CHINA", "ROMANIA", "SAUDI ARABIA",
+                     "VIETNAM", "RUSSIA", "UNITED KINGDOM", "UNITED STATES"])
+
+
+def gen_tables(lineitem_rows: int = 1 << 20, seed: int = 42) -> dict:
+    """TPC-H-shaped tables as pyarrow RecordBatches, scaled off the
+    lineitem row count (other tables keep roughly TPC-H's relative sizes)."""
+    rng = np.random.default_rng(seed)
+    n_li = lineitem_rows
+    n_ord = max(n_li // 4, 64)
+    n_cust = max(n_li // 40, 32)
+    n_supp = max(n_li // 600, 8)
+    n_part = max(n_li // 30, 32)
+
+    def date(lo, hi, n):
+        return rng.integers(lo, hi, n).astype(np.int32)
+
+    orderkeys = rng.integers(0, n_ord, n_li).astype(np.int64)
+    shipdate = date(8400, 10700, n_li)
+    lineitem = pa.RecordBatch.from_pydict({
+        "l_orderkey": orderkeys,
+        "l_partkey": rng.integers(0, n_part, n_li).astype(np.int64),
+        "l_suppkey": rng.integers(0, n_supp, n_li).astype(np.int64),
+        "l_quantity": rng.integers(1, 51, n_li).astype(np.float64),
+        "l_extendedprice": np.round(rng.uniform(900, 105000, n_li), 2),
+        "l_discount": np.round(rng.uniform(0.0, 0.1, n_li), 2),
+        "l_tax": np.round(rng.uniform(0.0, 0.08, n_li), 2),
+        "l_returnflag": _FLAGS[rng.integers(0, 3, n_li)],
+        "l_linestatus": _STATUS[rng.integers(0, 2, n_li)],
+        "l_shipdate": shipdate.view(np.int32),
+        "l_commitdate": (shipdate + rng.integers(-30, 30, n_li)).astype(np.int32),
+        "l_receiptdate": (shipdate + rng.integers(1, 31, n_li)).astype(np.int32),
+        "l_shipmode": _MODES[rng.integers(0, len(_MODES), n_li)],
+    }, schema=pa.schema([
+        ("l_orderkey", pa.int64()), ("l_partkey", pa.int64()),
+        ("l_suppkey", pa.int64()), ("l_quantity", pa.float64()),
+        ("l_extendedprice", pa.float64()), ("l_discount", pa.float64()),
+        ("l_tax", pa.float64()), ("l_returnflag", pa.string()),
+        ("l_linestatus", pa.string()), ("l_shipdate", pa.date32()),
+        ("l_commitdate", pa.date32()), ("l_receiptdate", pa.date32()),
+        ("l_shipmode", pa.string()),
+    ]))
+    orders = pa.RecordBatch.from_pydict({
+        "o_orderkey": np.arange(n_ord, dtype=np.int64),
+        "o_custkey": rng.integers(0, n_cust, n_ord).astype(np.int64),
+        "o_orderdate": date(8300, 10600, n_ord),
+        "o_orderpriority": _PRIORITIES[rng.integers(0, 5, n_ord)],
+        "o_totalprice": np.round(rng.uniform(1000, 500000, n_ord), 2),
+    }, schema=pa.schema([
+        ("o_orderkey", pa.int64()), ("o_custkey", pa.int64()),
+        ("o_orderdate", pa.date32()), ("o_orderpriority", pa.string()),
+        ("o_totalprice", pa.float64()),
+    ]))
+    customer = pa.RecordBatch.from_pydict({
+        "c_custkey": np.arange(n_cust, dtype=np.int64),
+        "c_mktsegment": _SEGMENTS[rng.integers(0, 5, n_cust)],
+        "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int64),
+    }, schema=pa.schema([
+        ("c_custkey", pa.int64()), ("c_mktsegment", pa.string()),
+        ("c_nationkey", pa.int64()),
+    ]))
+    supplier = pa.RecordBatch.from_pydict({
+        "s_suppkey": np.arange(n_supp, dtype=np.int64),
+        "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int64),
+    }, schema=pa.schema([
+        ("s_suppkey", pa.int64()), ("s_nationkey", pa.int64()),
+    ]))
+    part = pa.RecordBatch.from_pydict({
+        "p_partkey": np.arange(n_part, dtype=np.int64),
+        "p_type": _TYPES[rng.integers(0, len(_TYPES), n_part)],
+    }, schema=pa.schema([
+        ("p_partkey", pa.int64()), ("p_type", pa.string()),
+    ]))
+    nation = pa.RecordBatch.from_pydict({
+        "n_nationkey": np.arange(25, dtype=np.int64),
+        "n_name": _NATIONS,
+        "n_regionkey": (np.arange(25) % 5).astype(np.int64),
+    }, schema=pa.schema([
+        ("n_nationkey", pa.int64()), ("n_name", pa.string()),
+        ("n_regionkey", pa.int64()),
+    ]))
+    return {"lineitem": lineitem, "orders": orders, "customer": customer,
+            "supplier": supplier, "part": part, "nation": nation}
+
+
+def load(session, tables: dict, cache: bool = True) -> dict:
+    dfs = {}
+    for name, rb in tables.items():
+        df = session.create_dataframe(rb)
+        dfs[name] = df.cache() if cache else df
+    return dfs
+
+
+def _rev():
+    return Multiply(col("l_extendedprice"),
+                    Subtract(lit(1.0), col("l_discount")))
+
+
+def q1(t):
+    """Pricing summary report (TpchLikeSpark.scala Q1)."""
+    return (t["lineitem"]
+            .where(P.LessThanOrEqual(col("l_shipdate"),
+                                     lit(D_1998_09_02, T.DATE)))
+            .with_column("disc_price", _rev())
+            .with_column("charge",
+                         Multiply(_rev(), Add(lit(1.0), col("l_tax"))))
+            .group_by(col("l_returnflag"), col("l_linestatus"))
+            .agg(A.AggregateExpression(A.Sum(col("l_quantity")), "sum_qty"),
+                 A.AggregateExpression(A.Sum(col("l_extendedprice")),
+                                       "sum_base_price"),
+                 A.AggregateExpression(A.Sum(col("disc_price")),
+                                       "sum_disc_price"),
+                 A.AggregateExpression(A.Sum(col("charge")), "sum_charge"),
+                 A.AggregateExpression(A.Average(col("l_quantity")),
+                                       "avg_qty"),
+                 A.AggregateExpression(A.Average(col("l_discount")),
+                                       "avg_disc"),
+                 A.AggregateExpression(A.Count(), "count_order")))
+
+
+def q3(t):
+    """Shipping priority (Q3): 3-way join, grouped revenue, top-10."""
+    cust = t["customer"].where(
+        P.EqualTo(col("c_mktsegment"), lit("BUILDING")))
+    orders = t["orders"].where(
+        P.LessThan(col("o_orderdate"), lit(D_1995_03_15, T.DATE)))
+    li = t["lineitem"].where(
+        P.GreaterThan(col("l_shipdate"), lit(D_1995_03_15, T.DATE)))
+    return (cust
+            .join(orders, on=P.EqualTo(col("c_custkey"), col("o_custkey")),
+                  how="inner")
+            .join(li, on=P.EqualTo(col("o_orderkey"), col("l_orderkey")),
+                  how="inner")
+            .with_column("revenue", _rev())
+            .group_by(col("o_orderkey"), col("o_orderdate"))
+            .agg(A.AggregateExpression(A.Sum(col("revenue")), "revenue"))
+            .sort(SortOrder(col("revenue"), ascending=False))
+            .limit(10))
+
+
+def q5(t):
+    """Local supplier volume (Q5): 5-way join, group by nation."""
+    orders = t["orders"].where(P.And(
+        P.GreaterThanOrEqual(col("o_orderdate"), lit(D_1994_01_01, T.DATE)),
+        P.LessThan(col("o_orderdate"), lit(D_1995_01_01, T.DATE))))
+    return (t["customer"]
+            .join(orders, on=P.EqualTo(col("c_custkey"), col("o_custkey")),
+                  how="inner")
+            .join(t["lineitem"],
+                  on=P.EqualTo(col("o_orderkey"), col("l_orderkey")),
+                  how="inner")
+            .join(t["supplier"],
+                  on=P.EqualTo(col("l_suppkey"), col("s_suppkey")),
+                  how="inner")
+            .join(t["nation"],
+                  on=P.EqualTo(col("s_nationkey"), col("n_nationkey")),
+                  how="inner")
+            .with_column("revenue", _rev())
+            .group_by(col("n_name"))
+            .agg(A.AggregateExpression(A.Sum(col("revenue")), "revenue")))
+
+
+def q6(t):
+    """Forecasting revenue change (Q6): selective filter + global sum."""
+    li = t["lineitem"].where(P.And(P.And(P.And(
+        P.GreaterThanOrEqual(col("l_shipdate"), lit(D_1994_01_01, T.DATE)),
+        P.LessThan(col("l_shipdate"), lit(D_1995_01_01, T.DATE))),
+        P.And(P.GreaterThanOrEqual(col("l_discount"), lit(0.05)),
+              P.LessThanOrEqual(col("l_discount"), lit(0.07)))),
+        P.LessThan(col("l_quantity"), lit(24.0))))
+    return (li.with_column("rev",
+                           Multiply(col("l_extendedprice"),
+                                    col("l_discount")))
+            .group_by()
+            .agg(A.AggregateExpression(A.Sum(col("rev")), "revenue")))
+
+
+def q12(t):
+    """Shipping modes & order priority (Q12): join + conditional sums."""
+    li = t["lineitem"].where(P.And(P.And(
+        P.Or(P.EqualTo(col("l_shipmode"), lit("MAIL")),
+             P.EqualTo(col("l_shipmode"), lit("SHIP"))),
+        P.And(P.LessThan(col("l_commitdate"), col("l_receiptdate")),
+              P.LessThan(col("l_shipdate"), col("l_commitdate")))),
+        P.And(P.GreaterThanOrEqual(col("l_receiptdate"),
+                                   lit(D_1994_01_01, T.DATE)),
+              P.LessThan(col("l_receiptdate"), lit(D_1995_01_01, T.DATE)))))
+    high = If(P.Or(P.EqualTo(col("o_orderpriority"), lit("1-URGENT")),
+                   P.EqualTo(col("o_orderpriority"), lit("2-HIGH"))),
+              lit(1), lit(0))
+    low = If(P.And(P.NotEqual(col("o_orderpriority"), lit("1-URGENT")),
+                   P.NotEqual(col("o_orderpriority"), lit("2-HIGH"))),
+             lit(1), lit(0))
+    return (t["orders"]
+            .join(li, on=P.EqualTo(col("o_orderkey"), col("l_orderkey")),
+                  how="inner")
+            .with_column("high_line", high)
+            .with_column("low_line", low)
+            .group_by(col("l_shipmode"))
+            .agg(A.AggregateExpression(A.Sum(col("high_line")),
+                                       "high_line_count"),
+                 A.AggregateExpression(A.Sum(col("low_line")),
+                                       "low_line_count")))
+
+
+def q14(t):
+    """Promotion effect (Q14): join + conditional global ratio."""
+    li = t["lineitem"].where(P.And(
+        P.GreaterThanOrEqual(col("l_shipdate"), lit(D_1995_09_01, T.DATE)),
+        P.LessThan(col("l_shipdate"), lit(D_1995_10_01, T.DATE))))
+    promo = If(StartsWith(col("p_type"), "PROMO"), _rev(), lit(0.0))
+    return (t["part"]
+            .join(li, on=P.EqualTo(col("p_partkey"), col("l_partkey")),
+                  how="inner")
+            .with_column("promo_rev", promo)
+            .with_column("rev", _rev())
+            .group_by()
+            .agg(A.AggregateExpression(A.Sum(col("promo_rev")), "promo"),
+                 A.AggregateExpression(A.Sum(col("rev")), "total")))
+
+
+def xbb_score(t):
+    """TPCxBB q05-shaped logistic scoring (TpcxbbLikeSpark.scala q05 trains
+    a logistic model): sigmoid of a linear feature combination per line
+    item, averaged per return flag — the float-math-heavy shape that runs
+    on the VPU at bandwidth speed."""
+    z = Add(Add(Multiply(col("l_quantity"), lit(0.37)),
+                Multiply(col("l_extendedprice"), lit(-0.00021))),
+            Add(Multiply(col("l_discount"), lit(14.2)),
+                Multiply(col("l_tax"), lit(-7.1))))
+    sigmoid = Divide_safe(z)
+    return (t["lineitem"]
+            .with_column("score", sigmoid)
+            .group_by(col("l_returnflag"))
+            .agg(A.AggregateExpression(A.Average(col("score")), "avg_score"),
+                 A.AggregateExpression(A.Max(col("score")), "max_score"),
+                 A.AggregateExpression(A.Count(), "n")))
+
+
+def Divide_safe(z):
+    from ..ops.arithmetic import Divide, UnaryMinus
+    return Divide(lit(1.0), Add(lit(1.0), Exp(UnaryMinus(z))))
+
+
+QUERIES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6, "q12": q12, "q14": q14,
+           "xbb_score": xbb_score}
