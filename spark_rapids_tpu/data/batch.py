@@ -103,6 +103,8 @@ class ColumnarBatch:
             total += c.validity.size
             if c.offsets is not None:
                 total += c.offsets.size * 4
+            if c.codes is not None:
+                total += c.codes.size * 4
         return total
 
 
@@ -113,7 +115,10 @@ def _shrink_batch(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
     are dead by invariant, so a front slice is sufficient."""
     cols = []
     for c in batch.columns:
-        if c.is_string:
+        if c.is_dict:
+            cols.append(c.replace_rows(c.validity[:cap],
+                                       codes=c.codes[:cap]))
+        elif c.is_string:
             cols.append(DeviceColumn(c.data, c.validity[:cap], c.dtype,
                                      c.offsets[: cap + 1], c.max_bytes))
         else:

@@ -55,37 +55,61 @@ def bucket_capacity(n: int, min_capacity: int = LANE) -> int:
 class DeviceColumn:
     """One column of one device batch.
 
-    For fixed-width types, ``data`` has shape ``[capacity]``. For strings,
-    ``data`` is the ``uint8`` byte payload, ``offsets`` is ``int32[capacity+1]``
-    and for entries past the live row count offsets are clamped to the last
-    valid offset.
+    For fixed-width types, ``data`` has shape ``[capacity]``. Strings come
+    in two layouts:
+
+    * **flat**: ``data`` is the ``uint8`` byte payload, ``offsets`` is
+      ``int32[capacity+1]`` (Arrow layout); offsets past the live row count
+      clamp to the last valid offset.
+    * **dictionary-encoded** (``codes is not None``): ``codes`` is
+      ``int32[capacity]`` indexing a small dictionary whose entries live in
+      ``data``/``offsets`` (``int32[n_dict+1]``). This is the TPU-native
+      string representation: row rearrangement (filters, sorts, joins,
+      shuffles) moves ONE int32 lane instead of a char matrix, and when
+      ``dict_sorted`` (entries unique + bytewise ascending — the upload
+      default) code ORDER and EQUALITY coincide with string order and
+      equality, so sorts and group-bys use codes directly. cudf gets the
+      same wins from its dictionary category type; here it also keeps XLA
+      programs narrow.
     """
 
     data: jax.Array
     validity: jax.Array  # bool[capacity]
     dtype: T.DataType
-    offsets: Optional[jax.Array] = None  # int32[capacity + 1], strings only
+    offsets: Optional[jax.Array] = None  # int32 offsets (see class doc)
     #: Static upper bound on any single string's byte length (strings only).
     #: Host-known at upload; device string kernels use it to bound the padded
     #: char-matrix width. Propagates through string ops (substr keeps it,
     #: concat sums it).
     max_bytes: int = 0
+    #: int32[capacity] dictionary codes (dict-encoded strings only).
+    codes: Optional[jax.Array] = None
+    #: True when the dictionary is unique + sorted ascending (static).
+    dict_sorted: bool = False
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
         if self.offsets is None:
-            return (self.data, self.validity), (self.dtype, False, 0)
-        return (self.data, self.validity, self.offsets), (self.dtype, True, self.max_bytes)
+            return (self.data, self.validity), (self.dtype, 0, 0)
+        if self.codes is None:
+            return ((self.data, self.validity, self.offsets),
+                    (self.dtype, 1, self.max_bytes))
+        return ((self.data, self.validity, self.offsets, self.codes),
+                (self.dtype, 3 if self.dict_sorted else 2, self.max_bytes))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        dtype, has_offsets, max_bytes = aux
-        if has_offsets:
+        dtype, kind, max_bytes = aux
+        if kind == 0:
+            data, validity = children
+            return cls(data=data, validity=validity, dtype=dtype)
+        if kind == 1:
             data, validity, offsets = children
-            return cls(data=data, validity=validity, dtype=dtype, offsets=offsets,
-                       max_bytes=max_bytes)
-        data, validity = children
-        return cls(data=data, validity=validity, dtype=dtype, offsets=None)
+            return cls(data=data, validity=validity, dtype=dtype,
+                       offsets=offsets, max_bytes=max_bytes)
+        data, validity, offsets, codes = children
+        return cls(data=data, validity=validity, dtype=dtype, offsets=offsets,
+                   max_bytes=max_bytes, codes=codes, dict_sorted=kind == 3)
 
     # -- properties ---------------------------------------------------------
     @property
@@ -93,10 +117,21 @@ class DeviceColumn:
         return self.offsets is not None
 
     @property
+    def is_dict(self) -> bool:
+        return self.codes is not None
+
+    @property
     def capacity(self) -> int:
+        if self.codes is not None:
+            return int(self.codes.shape[0])
         if self.is_string:
             return int(self.offsets.shape[0]) - 1
         return int(self.data.shape[0])
+
+    @property
+    def dict_size(self) -> int:
+        assert self.is_dict
+        return int(self.offsets.shape[0]) - 1
 
     @property
     def byte_capacity(self) -> int:
@@ -151,21 +186,7 @@ class DeviceColumn:
         dtype = T.from_arrow_type(arr.type)
         arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
         if dtype is T.STRING:
-            arr = arr.cast(pa.string())
-            validity = _arrow_validity(arr)
-            offsets = np.asarray(arr.buffers()[1], dtype=np.uint8).view(np.int32)
-            offsets = offsets[arr.offset: arr.offset + len(arr) + 1].copy()
-            base = offsets[0]
-            offsets -= base
-            payload_buf = arr.buffers()[2]
-            if payload_buf is None:
-                payload = np.zeros(0, dtype=np.uint8)
-            else:
-                payload = np.asarray(payload_buf, dtype=np.uint8)[
-                    base: base + offsets[-1]]
-            # Null slots may have nonzero extent in arrow; normalize so hashes
-            # of null rows are deterministic.
-            return DeviceColumn.string_from_host(offsets, payload, validity, capacity)
+            return DeviceColumn.dict_string_from_arrow(arr, capacity)
         if dtype is T.NULL:
             return DeviceColumn.from_numpy(
                 np.zeros(len(arr), dtype=np.int8),
@@ -184,10 +205,66 @@ class DeviceColumn:
         return DeviceColumn.from_numpy(
             values.astype(dtype.np_dtype, copy=False), validity, dtype, capacity)
 
+    @staticmethod
+    def dict_string_from_arrow(arr: pa.Array, capacity: int
+                               ) -> "DeviceColumn":
+        """Upload a string array dictionary-encoded: codes[capacity] into a
+        SORTED unique dictionary, so code order/equality match string
+        order/equality on device."""
+        import pyarrow.compute as pc
+        arr = arr.cast(pa.string())
+        validity = _arrow_validity(arr)
+        d = pc.dictionary_encode(arr)
+        entries = d.dictionary  # unique, appearance order
+        codes = d.indices.fill_null(0).to_numpy(zero_copy_only=False) \
+            .astype(np.int32)
+        vals = entries.to_pylist()
+        order = np.argsort(np.asarray(
+            [v.encode() for v in vals], dtype=object), kind="stable") \
+            if vals else np.zeros(0, np.int64)
+        rank = np.empty(len(vals), dtype=np.int32)
+        rank[order] = np.arange(len(vals), dtype=np.int32)
+        codes = rank[codes] if len(vals) else codes
+        sorted_vals = [vals[i] for i in order]
+        raw = [v.encode() for v in sorted_vals] or [b""]
+        n_dict = len(raw)
+        lens = np.asarray([len(b) for b in raw], dtype=np.int32)
+        offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+        payload = np.frombuffer(b"".join(raw), dtype=np.uint8) \
+            if offsets[-1] else np.zeros(0, np.uint8)
+        byte_cap = bucket_capacity(max(int(offsets[-1]), 1))
+        buf = np.zeros(byte_cap, np.uint8)
+        buf[: offsets[-1]] = payload
+        code_buf = np.zeros(capacity, np.int32)
+        code_buf[: len(codes)] = codes
+        mask = np.zeros(capacity, np.bool_)
+        if validity is None:
+            mask[: len(arr)] = True
+        else:
+            mask[: len(arr)] = validity
+            code_buf[: len(codes)] = np.where(validity, codes, 0)
+        max_bytes = bucket_capacity(int(lens.max()) if n_dict else 1, 8)
+        return DeviceColumn(
+            data=jnp.asarray(buf), validity=jnp.asarray(mask),
+            dtype=T.STRING, offsets=jnp.asarray(offsets),
+            max_bytes=max_bytes, codes=jnp.asarray(code_buf),
+            dict_sorted=True)
+
+    def replace_rows(self, validity, data=None, codes=None) -> "DeviceColumn":
+        """Same column with row-level arrays swapped (dict buffers kept)."""
+        return DeviceColumn(
+            data=self.data if data is None else data,
+            validity=validity, dtype=self.dtype, offsets=self.offsets,
+            max_bytes=self.max_bytes,
+            codes=self.codes if codes is None else codes,
+            dict_sorted=self.dict_sorted)
+
     # -- download -----------------------------------------------------------
     def device_buffers(self) -> tuple:
         """The device arrays to download for host reassembly (batch these
         through one ``jax.device_get`` — the tunnel charges per round trip)."""
+        if self.is_dict:
+            return (self.data, self.validity, self.offsets, self.codes)
         if self.is_string:
             return (self.data, self.validity, self.offsets)
         return (self.data, self.validity)
@@ -203,6 +280,20 @@ class DeviceColumn:
         null_count = 0 if all_valid else int(n_rows - validity.sum())
         mask_buf = None if all_valid else \
             pa.py_buffer(np.packbits(validity, bitorder="little"))
+        if self.is_dict:
+            payload, _, offsets, codes = bufs
+            n_dict = len(offsets) - 1
+            entries = pa.StringArray.from_buffers(
+                n_dict, pa.py_buffer(np.ascontiguousarray(offsets)),
+                pa.py_buffer(np.ascontiguousarray(
+                    payload[: offsets[-1]])), None, 0)
+            idx = pa.Array.from_buffers(
+                pa.int32(), n_rows,
+                [mask_buf, pa.py_buffer(np.ascontiguousarray(
+                    np.clip(codes[:n_rows], 0, max(n_dict - 1, 0))))],
+                null_count)
+            return pa.DictionaryArray.from_arrays(idx, entries) \
+                .cast(pa.string())
         if self.is_string:
             offsets = np.ascontiguousarray(bufs[2][: n_rows + 1])
             payload = np.ascontiguousarray(bufs[0])
@@ -233,12 +324,15 @@ def _arrow_validity(arr: pa.Array) -> Optional[np.ndarray]:
 def null_column(dtype: T.DataType, capacity: int) -> DeviceColumn:
     """An all-null column of the given type (used for outer-join padding)."""
     if dtype is T.STRING:
+        # Dict-encoded: one empty dictionary entry, all codes 0, all null.
         return DeviceColumn(
-            data=jnp.zeros(LANE, dtype=jnp.uint8),
+            data=jnp.zeros(8, dtype=jnp.uint8),
             validity=jnp.zeros(capacity, dtype=jnp.bool_),
             dtype=T.STRING,
-            offsets=jnp.zeros(capacity + 1, dtype=jnp.int32),
-            max_bytes=8)
+            offsets=jnp.zeros(2, dtype=jnp.int32),
+            max_bytes=8,
+            codes=jnp.zeros(capacity, dtype=jnp.int32),
+            dict_sorted=True)
     return DeviceColumn(
         data=jnp.zeros(capacity, dtype=dtype.np_dtype),
         validity=jnp.zeros(capacity, dtype=jnp.bool_),
@@ -252,20 +346,22 @@ def scalar_column(value, dtype: T.DataType, capacity: int,
     if value is None:
         return null_column(dtype, capacity)
     if dtype is T.STRING:
+        # Dict-encoded: ONE dictionary entry, every live row points at it —
+        # O(1) payload instead of a capacity-wide tiled buffer.
         raw = np.frombuffer(str(value).encode("utf-8"), dtype=np.uint8)
         ln = len(raw)
-        byte_cap = bucket_capacity(max(ln, 1) * capacity)
+        byte_cap = bucket_capacity(max(ln, 1), 8)
         payload = np.zeros(byte_cap, dtype=np.uint8)
-        if ln:
-            payload[: ln * capacity] = np.tile(raw, capacity)
-        offsets = np.arange(capacity + 1, dtype=np.int64) * ln
+        payload[:ln] = raw
         valid = jnp.arange(capacity) < n_rows
         return DeviceColumn(
             data=jnp.asarray(payload),
             validity=valid,
             dtype=T.STRING,
-            offsets=jnp.asarray(offsets.astype(np.int32)),
-            max_bytes=bucket_capacity(max(ln, 1), 8))
+            offsets=jnp.asarray(np.asarray([0, ln], np.int32)),
+            max_bytes=bucket_capacity(max(ln, 1), 8),
+            codes=jnp.zeros(capacity, dtype=jnp.int32),
+            dict_sorted=True)
     valid = jnp.arange(capacity) < n_rows
     data = jnp.where(valid, jnp.asarray(value, dtype=dtype.np_dtype), 0)
     return DeviceColumn(data=data.astype(dtype.np_dtype), validity=valid, dtype=dtype)

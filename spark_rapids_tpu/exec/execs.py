@@ -307,7 +307,7 @@ def _truncate(db: ColumnarBatch, take) -> ColumnarBatch:
     for c in db.columns:
         v = c.validity & live
         if c.is_string:
-            cols.append(DeviceColumn(c.data, v, c.dtype, c.offsets, c.max_bytes))
+            cols.append(c.replace_rows(v))
         else:
             cols.append(DeviceColumn(
                 jnp.where(v, c.data, jnp.zeros((), c.data.dtype)), v, c.dtype))

@@ -124,6 +124,8 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
         return lambda env, flags: env[idx]
 
     if isinstance(node, TpuProjectExec):
+        _require(all(f.data_type is not T.STRING for f in node.schema),
+                 "string-producing projection over the mesh")
         child = _compile(node.children[0], sources, n_parts, bucket_growth,
                          conf)
         bound = _bind_all(node.exprs, node.children[0].schema)
@@ -284,6 +286,9 @@ def _replicate(batch: ColumnarBatch) -> ColumnarBatch:
 
 def mesh_capable(root, conf) -> bool:
     if not isinstance(root, DeviceToHostExec):
+        return False
+    # Result reassembly downloads (data, validity) pairs only.
+    if any(f.data_type is T.STRING for f in root.schema):
         return False
     sig = ("mesh_capable", _plan_sig(root.children[0]))
     cached = _MESH_CACHE.get(sig)

@@ -1,0 +1,8 @@
+"""ML integration: zero-copy export of query results to JAX trainers
+(the ml-integration / ColumnarRdd surface of the reference)."""
+
+from .export import (feature_matrix, predict_logistic,
+                     train_logistic_regression)
+
+__all__ = ["feature_matrix", "train_logistic_regression",
+           "predict_logistic"]

@@ -43,7 +43,15 @@ class If(Expression):
         f = self.children[2].eval_device(batch)
         take_true = p.data & p.validity
         if t.is_string:
-            raise NotImplementedError("string If lowers via select kernel later")
+            from .strings_util import PAD, char_matrix
+            from .kernels.rowops import strings_from_matrix
+            w = max(t.max_bytes, f.max_bytes, 1)
+            mt = char_matrix(t, w)
+            mf = char_matrix(f, w)
+            validity = jnp.where(take_true, t.validity, f.validity)
+            m = jnp.where(take_true[:, None], mt, mf)
+            m = jnp.where(validity[:, None], m, PAD)
+            return strings_from_matrix(m, validity, w)
         data = jnp.where(take_true, t.data, f.data)
         validity = jnp.where(take_true, t.validity, f.validity)
         return make_column(data, validity, self.data_type)
@@ -84,6 +92,27 @@ class CaseWhen(Expression):
         return result
 
     def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        if self.data_type is T.STRING:
+            from .strings_util import PAD, char_matrix
+            from .kernels.rowops import strings_from_matrix
+            vals = [val.eval_device(batch) for _, val in self.branches]
+            els = self.else_value.eval_device(batch) \
+                if self.else_value is not None else None
+            w = max([v.max_bytes for v in vals]
+                    + ([els.max_bytes] if els is not None else []) + [1])
+            if els is not None:
+                m, validity = char_matrix(els, w), els.validity
+            else:
+                m = jnp.full((batch.capacity, w), PAD, jnp.int16)
+                validity = jnp.zeros(batch.capacity, jnp.bool_)
+            for (cond, _), v in zip(reversed(self.branches),
+                                    reversed(vals)):
+                c = cond.eval_device(batch)
+                take = c.data & c.validity
+                m = jnp.where(take[:, None], char_matrix(v, w), m)
+                validity = jnp.where(take, v.validity, validity)
+            m = jnp.where(validity[:, None], m, PAD)
+            return strings_from_matrix(m, validity, w)
         if self.else_value is not None:
             acc = self.else_value.eval_device(batch)
             data, validity = acc.data, acc.validity
@@ -120,6 +149,18 @@ class Coalesce(Expression):
 
     def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
         cols = [c.eval_device(batch) for c in self.children]
+        if self.data_type is T.STRING:
+            from .strings_util import PAD, char_matrix
+            from .kernels.rowops import strings_from_matrix
+            w = max([c.max_bytes for c in cols] + [1])
+            m = char_matrix(cols[0], w)
+            validity = cols[0].validity
+            for c in cols[1:]:
+                take_next = ~validity & c.validity
+                m = jnp.where(take_next[:, None], char_matrix(c, w), m)
+                validity = validity | c.validity
+            m = jnp.where(validity[:, None], m, PAD)
+            return strings_from_matrix(m, validity, w)
         data = cols[0].data
         validity = cols[0].validity
         for c in cols[1:]:

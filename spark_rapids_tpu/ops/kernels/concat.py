@@ -23,6 +23,9 @@ def concat_columns(cols: List[DeviceColumn], n_rows_list, out_capacity: int,
                    total_rows) -> DeviceColumn:
     dtype = cols[0].dtype
     live_out = jnp.arange(out_capacity, dtype=jnp.int32) < total_rows
+    if cols[0].is_string and all(c.is_dict for c in cols):
+        return _concat_dict_columns(cols, n_rows_list, out_capacity,
+                                    live_out)
     if cols[0].is_string:
         w = max(max(c.max_bytes for c in cols), 1)
         offset = jnp.zeros((), jnp.int32)
@@ -55,6 +58,51 @@ def concat_columns(cols: List[DeviceColumn], n_rows_list, out_capacity: int,
     out_valid = out_valid & live_out
     return DeviceColumn(data=jnp.where(out_valid, out_data, jnp.zeros((), out_data.dtype)),
                         validity=out_valid, dtype=dtype)
+
+
+def _concat_dict_columns(cols: List[DeviceColumn], n_rows_list,
+                         out_capacity: int, live_out) -> DeviceColumn:
+    """Concat dictionary-encoded string columns: scatter the int32 code
+    lanes like fixed-width data and append the dictionaries side by side
+    (each dict entry keeps its exact offsets; entries of dict i shift by
+    the STATIC byte-capacity prefix, codes by the static dict-size prefix).
+    No dedupe — the merged dictionary loses the sorted/unique property, so
+    downstream falls back to char-matrix comparisons (still correct)."""
+    import jax
+
+    out_codes = jnp.zeros(out_capacity, dtype=jnp.int32)
+    out_valid = jnp.zeros(out_capacity, dtype=jnp.bool_)
+    offset = jnp.zeros((), jnp.int32)
+    code_base = 0
+    for c, n in zip(cols, n_rows_list):
+        idx = jnp.arange(c.capacity, dtype=jnp.int32)
+        live = idx < n
+        target = jnp.where(live, idx + offset, out_capacity)
+        out_codes = out_codes.at[target].set(
+            jnp.where(live & c.validity, c.codes + code_base, 0),
+            mode="drop")
+        out_valid = out_valid.at[target].set(c.validity & live, mode="drop")
+        offset = offset + n
+        code_base += c.dict_size
+    out_valid = out_valid & live_out
+    out_codes = jnp.where(out_valid, out_codes, 0)
+    # Dictionary payloads pack contiguously at their running valid-byte
+    # offset (traced): each write's zero-padding tail is overwritten by the
+    # next dict's payload, keeping every entry's [offset, next) span exact.
+    total_byte_cap = sum(c.byte_capacity for c in cols)
+    payload = jnp.zeros(total_byte_cap, jnp.uint8)
+    pos = jnp.zeros((), jnp.int32)
+    offs = []
+    for c in cols:
+        payload = jax.lax.dynamic_update_slice(payload, c.data, (pos,))
+        offs.append(c.offsets[:-1] + pos)
+        pos = pos + c.offsets[-1]
+    offs.append(pos.reshape(1))
+    return DeviceColumn(
+        data=payload, validity=out_valid, dtype=cols[0].dtype,
+        offsets=jnp.concatenate(offs),
+        max_bytes=max(c.max_bytes for c in cols),
+        codes=out_codes, dict_sorted=False)
 
 
 def concat_batches(batches: List[ColumnarBatch],

@@ -98,63 +98,52 @@ def merge_rank_pair(reference: jnp.ndarray, queries: jnp.ndarray
     bound, no reordering)."""
     n_ref, n_q = reference.shape[0], queries.shape[0]
     total = n_ref + n_q
-    ids = jnp.concatenate([reference, queries])
-    is_ref = jnp.concatenate([jnp.ones(n_ref, jnp.int8),
-                              jnp.zeros(n_q, jnp.int8)])
+    ids = jnp.concatenate([reference, queries]).astype(jnp.int64)
+    is_ref = jnp.concatenate([jnp.ones(n_ref, jnp.int32),
+                              jnp.zeros(n_q, jnp.int32)])
     qidx = jnp.concatenate([jnp.zeros(n_ref, jnp.int32),
                             jnp.arange(n_q, dtype=jnp.int32)])
-    # refs sort before queries within an equal-value run.
-    side = (1 - is_ref).astype(jnp.int8)
-    s_id, _, s_qidx, s_isref = jax.lax.sort(
-        (ids, side, qidx, is_ref), num_keys=2, is_stable=True)
+    # Operands PACK into two int64 lanes: TPU compile cost explodes with
+    # sort operand count, and (id, side) ordering == (2*id + side)
+    # ordering. refs sort before queries within an equal-value run.
+    side = 1 - is_ref
+    key = ids * 2 + side.astype(jnp.int64)
+    pay = qidx.astype(jnp.int64) * 2 + is_ref.astype(jnp.int64)
+    s_key, s_pay = jax.lax.sort((key, pay), num_keys=1, is_stable=True)
+    s_isref = (s_pay & 1).astype(jnp.int32)
+    s_qidx = (s_pay >> 1).astype(jnp.int32)
+    s_id = s_key >> 1
     iota = jnp.arange(total, dtype=jnp.int32)
-    ref_incl = jnp.cumsum(s_isref.astype(jnp.int32))  # refs at-or-before pos
+    ref_incl = jnp.cumsum(s_isref)  # refs at-or-before pos
     # Because refs precede queries in a run, a query position's inclusive
     # ref prefix already counts every equal ref: hi = ref_incl.
     # lo = refs strictly before the run = (exclusive ref prefix) at run
-    # start, broadcast across the run by a segmented first-value scan.
+    # start, broadcast across the run by a cummax over start-marked values.
     prev = jnp.concatenate([s_id[:1], s_id[:-1]])
     run_start = (s_id != prev) | (iota == 0)
-    lo_at = ref_incl - s_isref.astype(jnp.int32)
-
-    def comb(a, b):
-        fa, va = a
-        fb, vb = b
-        return fa | fb, jnp.where(fb, vb, va)
-    _, lo_run = jax.lax.associative_scan(comb, (run_start, lo_at))
-    _, _, lo_q, hi_q = jax.lax.sort((s_isref, s_qidx, lo_run, ref_incl),
-                                    num_keys=2, is_stable=True)
-    return lo_q[:n_q], hi_q[:n_q]
+    lo_at = ref_incl - s_isref
+    # Within a run lo_at is constant at the run start and can only grow as
+    # refs accumulate; broadcasting the run-start value = running max of
+    # (value where start else -1) ... but lo_at is nondecreasing globally,
+    # so the run-start broadcast is simply a cummax of masked values.
+    lo_run = jax.lax.cummax(jnp.where(run_start, lo_at, -1))
+    # route back: queries (isref=0) first by index, carrying (lo, hi) packed.
+    back_key = s_isref.astype(jnp.int64) * (1 << 32) \
+        + s_qidx.astype(jnp.int64)
+    back_pay = lo_run.astype(jnp.int64) * (1 << 32) + ref_incl.astype(jnp.int64)
+    _, got = jax.lax.sort((back_key, back_pay), num_keys=1, is_stable=True)
+    lo_q = (got[:n_q] >> 32).astype(jnp.int32)
+    hi_q = (got[:n_q] & 0xFFFFFFFF).astype(jnp.int32)
+    return lo_q, hi_q
 
 
 def merge_rank(reference: jnp.ndarray, queries: jnp.ndarray,
                inclusive: bool) -> jnp.ndarray:
     """For each query value q (any order), the count of reference elements
     with r < q (or r <= q when ``inclusive``). ``reference`` must be sorted.
-
-    This is searchsorted computed by sort-merge: XLA lowers searchsorted to
-    ~log2(n) dependent gather rounds (slow on TPU), while two extra sorts +
-    a prefix sum are cheap.
-    """
-    n_ref, n_q = reference.shape[0], queries.shape[0]
-    ids = jnp.concatenate([reference, queries])
-    # Tie order decides inclusivity: reference-first counts equals.
-    ref_side = 0 if inclusive else 1
-    side = jnp.concatenate([
-        jnp.full(n_ref, ref_side, jnp.int8),
-        jnp.full(n_q, 1 - ref_side, jnp.int8)])
-    qidx = jnp.concatenate([jnp.zeros(n_ref, jnp.int32),
-                            jnp.arange(n_q, dtype=jnp.int32)])
-    is_ref = jnp.concatenate([jnp.ones(n_ref, jnp.int8),
-                              jnp.zeros(n_q, jnp.int8)])
-    s_id, s_side, s_qidx, s_isref = jax.lax.sort(
-        (ids, side, qidx, is_ref), num_keys=2, is_stable=True)
-    ref_prefix = jnp.cumsum(s_isref.astype(jnp.int32))
-    cnt_at_pos = ref_prefix - s_isref  # refs strictly before this position
-    # Route counts back to query order: queries first, ordered by index.
-    _, _, q_cnt = jax.lax.sort((s_isref, s_qidx, cnt_at_pos), num_keys=2,
-                               is_stable=True)
-    return q_cnt[:n_q]
+    Computed by the packed two-sort merge of :func:`merge_rank_pair`."""
+    lo, hi = merge_rank_pair(reference, queries)
+    return hi if inclusive else lo
 
 
 def match_ranges(build_ids: jnp.ndarray, probe_ids: jnp.ndarray,

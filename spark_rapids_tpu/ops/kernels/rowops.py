@@ -63,12 +63,21 @@ def orderable_key(col: DeviceColumn, ascending: bool = True,
 
 def string_sort_keys(col: DeviceColumn, ascending: bool = True,
                      nulls_first: bool = True) -> List[jnp.ndarray]:
-    """Expand a string column into per-char int16 sort operands."""
+    """Sort operands for a string column.
+
+    Sorted-dictionary columns sort by their int32 CODES (code order ==
+    byte order by construction) — one narrow operand. Anything else
+    expands to per-char int16 operands."""
+    null_bucket = jnp.where(col.validity, 0, -1 if nulls_first else 1)
+    if col.is_dict and col.dict_sorted:
+        key = jnp.where(col.validity, col.codes, 0)
+        if not ascending:
+            key = -key - 1
+        return [null_bucket.astype(jnp.int8), key]
     m = char_matrix(col)
     cols = [m[:, i] for i in range(m.shape[1])]
     if not ascending:
         cols = [-(c.astype(jnp.int32) + 1) for c in cols]
-    null_bucket = jnp.where(col.validity, 0, -1 if nulls_first else 1)
     return [null_bucket.astype(jnp.int8)] + cols
 
 
@@ -108,7 +117,11 @@ def gather_column(col: DeviceColumn, indices: jnp.ndarray,
     if not col.is_string:
         data = jnp.where(validity, col.data[safe], jnp.zeros((), col.data.dtype))
         return DeviceColumn(data=data, validity=validity, dtype=col.dtype)
-    # Strings: gather rows of the char matrix, then rebuild offsets+payload.
+    if col.is_dict:
+        # Move one int32 lane; the dictionary rides along untouched.
+        codes = jnp.where(validity, col.codes[safe], 0)
+        return col.replace_rows(validity, codes=codes)
+    # Flat strings: gather rows of the char matrix, rebuild offsets+payload.
     m = char_matrix(col)[safe]  # [out_cap, W]
     m = jnp.where(validity[:, None], m, PAD)
     return strings_from_matrix(m, validity, col.max_bytes)
@@ -150,39 +163,57 @@ def gather_batch(batch: ColumnarBatch, indices: jnp.ndarray,
     return ColumnarBatch(cols, new_n_rows.astype(jnp.int32), batch.schema)
 
 
+#: Max extra sort operands before switching from payload-carrying to
+#: argsort + gathers. Carrying saves a full gather pass per column at run
+#: time, but TPU compile cost grows superlinearly with sort operand count
+#: (2-operand 1M sort ~20s, 18-operand ~15min on the remote helper).
+_CARRY_LIMIT = 4
+
+
 def _permute_by_sort(batch: ColumnarBatch, key_operands: List[jnp.ndarray],
                      new_n_rows: jnp.ndarray) -> ColumnarBatch:
-    """Reorder a batch by sorting on ``key_operands``, CARRYING every
-    fixed-width column's buffers as extra sort operands. One ``lax.sort``
-    pass moves all the data — the separate per-column gathers this replaces
-    each cost another full memory pass on TPU. String columns (variable
-    width) still gather through the carried permutation."""
+    """Reorder a batch by sorting on ``key_operands``. Narrow batches carry
+    their buffers through the sort (zero extra passes); wide ones sort a
+    permutation and gather (bounded compile cost — see _CARRY_LIMIT)."""
     cap = batch.capacity
     live_out = jnp.arange(cap, dtype=jnp.int32) < new_n_rows
     payload: List[jnp.ndarray] = []
-    fixed_cols = []
-    has_strings = any(c.is_string for c in batch.columns)
+    carried = []  # (col index, is_dict)
+    has_flat_strings = any(c.is_string and not c.is_dict
+                           for c in batch.columns)
     for i, c in enumerate(batch.columns):
         if not c.is_string:
             payload.append(c.data)
             payload.append(c.validity)
-            fixed_cols.append(i)
-    if has_strings:
-        payload.append(jnp.arange(cap, dtype=jnp.int32))  # perm for strings
+            carried.append((i, False))
+        elif c.is_dict:
+            # Dict strings ride the sort as their int32 code lane.
+            payload.append(c.codes)
+            payload.append(c.validity)
+            carried.append((i, True))
+    if has_flat_strings or len(payload) > _CARRY_LIMIT:
+        # Wide batch: permutation sort + per-column gathers.
+        sorted_all = jax.lax.sort(
+            tuple(key_operands) + (jnp.arange(cap, dtype=jnp.int32),),
+            num_keys=len(key_operands), is_stable=True)
+        perm = sorted_all[-1]
+        cols = tuple(gather_column(c, perm, live_out)
+                     for c in batch.columns)
+        return ColumnarBatch(cols, new_n_rows.astype(jnp.int32),
+                             batch.schema)
     sorted_all = jax.lax.sort(tuple(key_operands) + tuple(payload),
                               num_keys=len(key_operands), is_stable=True)
     out = list(sorted_all[len(key_operands):])
-    perm = out.pop() if has_strings else None
     cols: List[Optional[DeviceColumn]] = [None] * len(batch.columns)
-    for j, i in enumerate(fixed_cols):
+    for j, (i, is_dict) in enumerate(carried):
         data, validity = out[2 * j], out[2 * j + 1]
         validity = validity & live_out
         data = jnp.where(validity, data, jnp.zeros((), data.dtype))
-        cols[i] = DeviceColumn(data=data, validity=validity,
-                               dtype=batch.columns[i].dtype)
-    for i, c in enumerate(batch.columns):
-        if c.is_string:
-            cols[i] = gather_column(c, perm, live_out)
+        if is_dict:
+            cols[i] = batch.columns[i].replace_rows(validity, codes=data)
+        else:
+            cols[i] = DeviceColumn(data=data, validity=validity,
+                                   dtype=batch.columns[i].dtype)
     return ColumnarBatch(tuple(cols), new_n_rows.astype(jnp.int32),
                          batch.schema)
 

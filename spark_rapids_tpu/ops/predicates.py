@@ -278,7 +278,19 @@ class In(Expression):
         non_null = [x for x in self.values if x is not None]
         has_null = len(non_null) != len(self.values)
         if c.is_string:
-            raise NotImplementedError("IN on strings runs via dictionary codes")
+            from .strings_util import PAD, char_matrix
+            needles = [str(x).encode("utf-8") for x in non_null]
+            w = max([c.max_bytes, 1] + [len(b) for b in needles])
+            m = char_matrix(c, w)
+            found = jnp.zeros(c.capacity, dtype=jnp.bool_)
+            for b in needles:
+                chars = np.frombuffer(b, dtype=np.uint8).astype(np.int16)
+                row = np.full(w, PAD, dtype=np.int16)
+                row[: len(chars)] = chars
+                found = found | jnp.all(m == jnp.asarray(row)[None, :],
+                                        axis=1)
+            validity = c.validity & (found | (not has_null))
+            return make_column(found, validity, T.BOOLEAN)
         found = jnp.zeros_like(c.validity)
         for x in non_null:
             found = found | (c.data == jnp.asarray(x, dtype=c.data.dtype))

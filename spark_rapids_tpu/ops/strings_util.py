@@ -25,21 +25,36 @@ PAD = -1
 
 
 def char_matrix(col: DeviceColumn, width: int = None) -> jnp.ndarray:
-    """[capacity, W] int16; row i holds string i's bytes, PAD past its end."""
+    """[capacity, W] int16; row i holds string i's bytes, PAD past its end.
+
+    Dictionary-encoded columns build the small [n_dict, W] matrix once and
+    gather rows by code — O(dict) char work instead of O(capacity)."""
     assert col.is_string
     w = width or max(col.max_bytes, 1)
-    starts = col.offsets[:-1]
-    ends = col.offsets[1:]
+    if col.is_dict:
+        dm = _matrix_from_offsets(col.data, col.offsets, w)
+        safe = jnp.clip(col.codes, 0, dm.shape[0] - 1)
+        return dm[safe]
+    return _matrix_from_offsets(col.data, col.offsets, w)
+
+
+def _matrix_from_offsets(payload: jnp.ndarray, offsets: jnp.ndarray,
+                         w: int) -> jnp.ndarray:
+    starts = offsets[:-1]
+    ends = offsets[1:]
     pos = starts[:, None] + jnp.arange(w, dtype=jnp.int32)[None, :]
     in_range = pos < ends[:, None]
-    byte_cap = col.data.shape[0]
-    chars = col.data[jnp.clip(pos, 0, byte_cap - 1)].astype(jnp.int16)
+    byte_cap = payload.shape[0]
+    chars = payload[jnp.clip(pos, 0, byte_cap - 1)].astype(jnp.int16)
     return jnp.where(in_range, chars, PAD)
 
 
 def lengths(col: DeviceColumn) -> jnp.ndarray:
     """Byte length per row, int32[capacity]."""
-    return col.offsets[1:] - col.offsets[:-1]
+    per = col.offsets[1:] - col.offsets[:-1]
+    if col.is_dict:
+        return per[jnp.clip(col.codes, 0, per.shape[0] - 1)]
+    return per
 
 
 def device_string_compare(op: str, l: DeviceColumn, r: DeviceColumn) -> jnp.ndarray:

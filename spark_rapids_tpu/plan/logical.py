@@ -736,6 +736,14 @@ class DataFrame:
     def collect(self) -> pa.Table:
         return self._session.execute(self._plan)
 
+    def to_device_batches(self):
+        """HBM-resident result batches for zero-copy ML handoff — the
+        ``ColumnarRdd.convert`` analog (reference ColumnarRdd.scala:41-49).
+        Requires ``spark.rapids.sql.exportColumnarRdd`` (the reference's
+        gate, RapidsConf.scala:329). Returns List[ColumnarBatch]; feed to
+        :func:`spark_rapids_tpu.ml.feature_matrix`."""
+        return self._session.collect_device(self._plan)
+
     def to_pandas(self):
         return self.collect().to_pandas()
 

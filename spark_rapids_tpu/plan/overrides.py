@@ -50,18 +50,6 @@ class ExprRule:
     tag: Optional[Callable[[Expression, TpuConf], Optional[str]]] = None
 
 
-def _in_tag(e: Expression, conf: TpuConf) -> Optional[str]:
-    if e.children[0].data_type is T.STRING:
-        return "IN on string values is not supported on the device yet"
-    return None
-
-
-def _string_branch_tag(e: Expression, conf: TpuConf) -> Optional[str]:
-    if e.data_type is T.STRING:
-        return "string-producing conditionals are not supported on the device yet"
-    return None
-
-
 EXPR_RULES: Dict[Type[Expression], ExprRule] = {}
 
 
@@ -80,16 +68,16 @@ for _cls in [PRED.EqualTo, PRED.NotEqual, PRED.LessThan, PRED.LessThanOrEqual,
              PRED.And, PRED.Or, PRED.Not, PRED.IsNull, PRED.IsNotNull,
              PRED.IsNaN]:
     _expr(_cls)
-_expr(PRED.In, tag=_in_tag)
+_expr(PRED.In)
 for _cls in [MATH.Sin, MATH.Cos, MATH.Tan, MATH.Asin, MATH.Acos, MATH.Atan,
              MATH.Sinh, MATH.Cosh, MATH.Tanh, MATH.Exp, MATH.Expm1, MATH.Log,
              MATH.Log2, MATH.Log10, MATH.Log1p, MATH.Sqrt, MATH.Cbrt,
              MATH.Rint, MATH.Signum, MATH.ToDegrees, MATH.ToRadians,
              MATH.Floor, MATH.Ceil, MATH.Pow, MATH.Atan2]:
     _expr(_cls)
-_expr(COND.If, tag=_string_branch_tag)
-_expr(COND.CaseWhen, tag=_string_branch_tag)
-_expr(COND.Coalesce, tag=_string_branch_tag)
+_expr(COND.If)
+_expr(COND.CaseWhen)
+_expr(COND.Coalesce)
 _expr(COND.NaNvl)
 for _cls in [AGG.Min, AGG.Max, AGG.Sum, AGG.Count, AGG.Average, AGG.First,
              AGG.Last]:

@@ -96,8 +96,44 @@ class TpuSession:
 
     # -- execution ----------------------------------------------------------
     def plan(self, logical: L.LogicalPlan) -> P.PhysicalPlan:
-        cpu_plan = plan_physical(logical, self.conf)
+        from .plan.optimizer import prune_columns
+        cpu_plan = plan_physical(prune_columns(logical), self.conf)
         return self._overrides.apply(cpu_plan)
+
+    #: Deferred-overflow retry ladder: optimistic join/exchange sizing with
+    #: growing buckets, ending in the eager exact-resize rung that can
+    #: never overflow.
+    _ATTEMPTS = (("deferred", 1.0), ("deferred", 8.0), ("deferred", 64.0),
+                 ("eager", 1.0))
+
+    def _run_with_retries(self, fn, eager_only: bool = False):
+        """Run ``fn(ctx, mode) -> (result, overflowed)`` through the retry
+        ladder; return the first non-overflowed result."""
+        attempts = (("eager", 1.0),) if eager_only else self._ATTEMPTS
+        for mode, growth in attempts:
+            ctx = P.ExecContext(self.conf,
+                                catalog=self.device_manager.catalog)
+            ctx.join_growth = growth
+            ctx.eager_overflow = mode == "eager"
+            try:
+                result, overflowed = fn(ctx, mode)
+            finally:
+                ctx.close()
+            if not overflowed:
+                return result
+        raise AssertionError("unreachable: eager join path cannot overflow")
+
+    def _device_root(self, physical: P.PhysicalPlan) -> P.PhysicalPlan:
+        """The columnar subtree to execute device-side; pure host plans
+        (e.g. a bare local table) get an upload so results are
+        device-resident."""
+        from .exec.execs import DeviceToHostExec, HostToDeviceExec
+        if isinstance(physical, DeviceToHostExec) \
+                and physical.children[0].columnar:
+            return physical.children[0]
+        if not physical.columnar:
+            return HostToDeviceExec(physical, self.conf.batch_size_rows)
+        return physical
 
     def execute(self, logical: L.LogicalPlan) -> pa.Table:
         """Plan + run. Joins size their output optimistically with a
@@ -105,82 +141,79 @@ class TpuSession:
         flag trips the query re-runs with a larger ``join_growth`` — the
         rare path fan-out joins pay so everything else stays round-trip
         free. Fusable device plans run as ONE compiled program
-        (exec/fusion.py)."""
+        (exec/fusion.py); mesh-capable plans as one SPMD program
+        (exec/mesh.py)."""
         from .exec import fusion
         physical = self.plan(logical)
+
+        def run(ctx, mode):
+            if mode == "deferred" and self.conf.sql_enabled \
+                    and self.conf.mesh_enabled \
+                    and _mesh().mesh_capable(physical, self.conf):
+                return _mesh().mesh_collect(physical, ctx)
+            if mode == "deferred" and self.conf.sql_enabled \
+                    and self.conf.fusion_enabled \
+                    and fusion.fusable(physical):
+                table, overflowed = fusion.fused_collect(physical, ctx)
+                # Boundary subtrees (windows, broadcasts, ...) executed
+                # eagerly with THIS ctx: their deferred flags gate too.
+                return table, overflowed or fusion.any_overflow(ctx)
+            table = P.collect_partitions(physical, ctx)
+            return table, fusion.any_overflow(ctx)
         # Write plans are side-effecting: a discard-and-retry would commit
-        # truncated files first. They use the eager per-batch exact-resize
-        # join path instead (one sync per probe batch — writes are IO-bound
-        # anyway). The eager path is also the guaranteed final rung of the
-        # retry ladder, so arbitrary fan-out always terminates exactly.
-        eager_only = _contains_write(physical)
-        attempts = [("eager", 1.0)] if eager_only else \
-            [("deferred", 1.0), ("deferred", 8.0), ("deferred", 64.0),
-             ("eager", 1.0)]
-        for mode, growth in attempts:
-            ctx = P.ExecContext(self.conf, catalog=self.device_manager.catalog)
-            ctx.join_growth = growth
-            ctx.eager_overflow = mode == "eager"
-            try:
-                if mode == "deferred" and self.conf.sql_enabled \
-                        and self.conf.mesh_enabled \
-                        and _mesh().mesh_capable(physical, self.conf):
-                    table, overflowed = _mesh().mesh_collect(physical, ctx)
-                elif mode == "deferred" and self.conf.sql_enabled \
-                        and self.conf.fusion_enabled \
-                        and fusion.fusable(physical):
-                    table, overflowed = fusion.fused_collect(physical, ctx)
-                    # Boundary subtrees (windows, broadcasts, ...) executed
-                    # eagerly with THIS ctx: their deferred flags must gate
-                    # the result too.
-                    overflowed = overflowed or fusion.any_overflow(ctx)
-                else:
-                    table = P.collect_partitions(physical, ctx)
-                    overflowed = fusion.any_overflow(ctx)
-            finally:
-                ctx.close()
-            if not overflowed:
-                return table
-        raise AssertionError("unreachable: eager join path cannot overflow")
+        # truncated files first, so they always use the eager exact-resize
+        # join path (writes are IO-bound anyway).
+        return self._run_with_retries(run,
+                                      eager_only=_contains_write(physical))
 
     def materialize(self, logical: L.LogicalPlan) -> "L.CachedRelation":
         """Execute now and pin the result (eager df.cache()). Under a
         device session the batches stay resident in HBM."""
         from .exec import fusion
         physical = self.plan(logical)
-        from .exec.execs import DeviceToHostExec, HostToDeviceExec
-        attempts = [("deferred", 1.0), ("deferred", 8.0), ("deferred", 64.0),
-                    ("eager", 1.0)]
-        for mode, growth in attempts:
+        if not self.conf.sql_enabled:
             ctx = P.ExecContext(self.conf,
                                 catalog=self.device_manager.catalog)
-            ctx.join_growth = growth
-            ctx.eager_overflow = mode == "eager"
             try:
-                if self.conf.sql_enabled:
-                    if isinstance(physical, DeviceToHostExec) \
-                            and physical.children[0].columnar:
-                        device_root = physical.children[0]
-                    elif not physical.columnar:
-                        # Pure host plan (e.g. a bare table): upload so the
-                        # cache is device-resident.
-                        device_root = HostToDeviceExec(
-                            physical, self.conf.batch_size_rows)
-                    else:
-                        device_root = physical
-                    parts = [list(p) for p in device_root.execute(ctx)]
-                    if fusion.any_overflow(ctx):
-                        continue
-                    n = sum(int(b.n_rows) for p in parts for b in p)
-                    return L.CachedRelation(logical.schema,
-                                            device_parts=parts, n_rows=n)
                 table = P.collect_partitions(physical, ctx)
-                rbs = table.combine_chunks().to_batches()
-                return L.CachedRelation(logical.schema, host_batches=rbs,
-                                        n_rows=table.num_rows)
             finally:
                 ctx.close()
-        raise AssertionError("unreachable: eager join path cannot overflow")
+            rbs = table.combine_chunks().to_batches()
+            return L.CachedRelation(logical.schema, host_batches=rbs,
+                                    n_rows=table.num_rows)
+        device_root = self._device_root(physical)
+
+        def run(ctx, mode):
+            parts = [list(p) for p in device_root.execute(ctx)]
+            if fusion.any_overflow(ctx):
+                return None, True
+            n = sum(int(b.n_rows) for p in parts for b in p)
+            return L.CachedRelation(logical.schema, device_parts=parts,
+                                    n_rows=n), False
+        return self._run_with_retries(run)
+
+    def collect_device(self, logical: L.LogicalPlan) -> List:
+        """Execute and return HBM-resident ColumnarBatches with NO host
+        transfer (zero-copy ML export; ColumnarRdd.scala:41-49 analog).
+        Gated like the reference by spark.rapids.sql.exportColumnarRdd."""
+        from .config import EXPORT_COLUMNAR_RDD
+        from .exec import fusion
+        if not self.conf.get(EXPORT_COLUMNAR_RDD):
+            raise RuntimeError(
+                "device-batch export requires "
+                "spark.rapids.sql.exportColumnarRdd=true "
+                "(reference RapidsConf.scala:329)")
+        if not self.conf.sql_enabled:
+            raise RuntimeError("device-batch export needs a TPU session "
+                               "(spark.rapids.sql.enabled)")
+        device_root = self._device_root(self.plan(logical))
+
+        def run(ctx, mode):
+            parts = [list(p) for p in device_root.execute(ctx)]
+            if fusion.any_overflow(ctx):
+                return None, True
+            return [b for p in parts for b in p], False
+        return self._run_with_retries(run)
 
     def explain(self, logical: L.LogicalPlan) -> str:
         physical = self.plan(logical)

@@ -153,9 +153,9 @@ def spark_hash_columns_device(cols: Sequence[DeviceColumn],
     h = jnp.full(n, jnp.uint32(seed & 0xFFFFFFFF), dtype=jnp.uint32)
     for c in cols:
         if c.is_string:
+            from ..ops.strings_util import lengths as str_lengths
             m = char_matrix(c)
-            lengths = c.offsets[1:] - c.offsets[:-1]
-            nh = murmur3_bytes_rows(jnp, m, lengths, h)
+            nh = murmur3_bytes_rows(jnp, m, str_lengths(c), h)
             h = jnp.where(c.validity, nh, h)
         else:
             h = hash_column(jnp, c.data, c.validity, c.dtype, h)

@@ -1,0 +1,98 @@
+"""User-defined functions: bytecode compilation with Python fallback.
+
+``udf(fn)`` wraps a Python function. Calling the wrapper on column
+expressions tries :func:`.compiler.compile_udf` — translating the
+function's bytecode into this engine's expression tree so it fuses into
+the device program (the ``udf-compiler`` design,
+``udf-compiler/.../Plugin.scala:28``) — and on :class:`CompileError` falls
+back to a :class:`PythonUDF` expression that runs the original function
+row-wise on the CPU path, exactly like the reference keeps the original
+UDF when translation fails (``Plugin.scala:36-94``). PythonUDF has no
+device rule registered, so TpuOverrides keeps its enclosing operator on
+the CPU with a readable reason.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import pyarrow as pa
+
+from .. import types as T
+from ..data.batch import HostBatch
+from ..ops.expression import Expression, host_to_array
+from .compiler import CompileError, compile_udf
+
+__all__ = ["udf", "TpuUDF", "PythonUDF", "CompileError", "compile_udf"]
+
+
+class PythonUDF(Expression):
+    """Fallback: evaluate the original Python function row-wise on host.
+
+    Deliberately has NO ExprRule and no ``eval_device``: the overrides pass
+    reports "expression PythonUDF is not supported on TPU" and the
+    enclosing operator stays on the CPU path (the reference's untranslated
+    UDF behaves the same way on the GPU plan)."""
+
+    def __init__(self, fn: Callable, children: List[Expression],
+                 return_type: T.DataType, reason: str = ""):
+        self.fn = fn
+        self.children = list(children)
+        self._return_type = return_type
+        #: why bytecode compilation fell back (for explain output).
+        self.reason = reason
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._return_type
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    @property
+    def name(self) -> str:
+        return getattr(self.fn, "__name__", "udf")
+
+    def with_children(self, children):
+        return PythonUDF(self.fn, children, self._return_type, self.reason)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        cols = [host_to_array(c.eval_host(batch), batch.num_rows).to_pylist()
+                for c in self.children]
+        out = [self.fn(*vals) for vals in zip(*cols)]
+        return pa.array(out, type=T.to_arrow_type(self._return_type))
+
+
+class TpuUDF:
+    """The object ``udf()`` returns; calling it builds the expression."""
+
+    def __init__(self, fn: Callable, return_type: Optional[T.DataType]):
+        self.fn = fn
+        self.return_type = return_type
+        #: after the first call: "" if compiled, else the fallback reason.
+        self.fallback_reason: Optional[str] = None
+
+    def __call__(self, *cols) -> Expression:
+        from ..ops.expression import col as col_
+        exprs = [c if isinstance(c, Expression) else col_(c) for c in cols]
+        try:
+            compiled = compile_udf(self.fn, exprs)
+            self.fallback_reason = ""
+            return compiled
+        except CompileError as e:
+            self.fallback_reason = str(e)
+            if self.return_type is None:
+                raise TypeError(
+                    f"UDF {getattr(self.fn, '__name__', '?')!r} is not "
+                    f"bytecode-compilable ({e}) and has no return_type for "
+                    "the Python fallback — pass udf(fn, return_type=...)")
+            return PythonUDF(self.fn, exprs, self.return_type, str(e))
+
+
+def udf(fn: Optional[Callable] = None,
+        return_type: Optional[T.DataType] = None):
+    """Wrap a Python function as a UDF (decorator or direct form)."""
+    if fn is None:
+        return lambda f: TpuUDF(f, return_type)
+    return TpuUDF(fn, return_type)

@@ -44,23 +44,13 @@ def _data(n=20_000, seed=0, nulls=False):
     return rb
 
 
-def _rows(t):
-    return sorted(zip(*[t.column(i).to_pylist()
-                        for i in range(t.num_columns)]), key=str)
-
-
 def _assert_match(q):
+    from spark_rapids_tpu.workloads.compare import rows, rows_match
     cpu, mesh = _sessions()
     rc = q(cpu).collect()
     rm = q(mesh).collect()
-    ra, rb = _rows(rc), _rows(rm)
-    assert len(ra) == len(rb)
-    for a, b in zip(ra, rb):
-        for va, vb in zip(a, b):
-            if isinstance(va, float) and isinstance(vb, float):
-                assert va == pytest.approx(vb, rel=1e-9, abs=1e-9)
-            else:
-                assert va == vb, (a, b)
+    assert rows_match(rows(rm), rows(rc), rel_tol=1e-9, abs_tol=1e-9), \
+        (rows(rm)[:5], rows(rc)[:5])
 
 
 class TestMeshCapability:

@@ -213,16 +213,28 @@ class TestRange:
 
 class TestFallbackDetection:
     def test_unsupported_expr_falls_back(self):
+        from spark_rapids_tpu import types as T
         from spark_rapids_tpu.plan.overrides import FallbackOnTpuError
-        # IN on strings is tagged unsupported -> filter falls back; test mode
+        from spark_rapids_tpu.udf import PythonUDF
+
+        # A PythonUDF has no device rule -> project falls back; test mode
         # makes that an error unless allowed.
         def q(s):
-            return s.create_dataframe(small_table()).where(
-                P.In(col("s"), ["a", "b"]))
+            expr = PythonUDF(
+                lambda v: None if v is None else v + 1,
+                [col("v")], T.LONG, reason="test")
+            return s.create_dataframe(small_table()).with_column("r", expr)
         with pytest.raises(FallbackOnTpuError):
             q(tpu_session()).collect()
         assert_tpu_and_cpu_are_equal(
-            q, allowed_non_tpu=["CpuFilterExec"])
+            q, allowed_non_tpu=["CpuProjectExec"])
+
+    def test_string_in_runs_on_device(self):
+        # Was a documented fallback (VERDICT #6); now device-supported.
+        def q(s):
+            return s.create_dataframe(small_table()).where(
+                P.In(col("s"), ["a", "b"]))
+        assert_tpu_and_cpu_are_equal(q)
 
     def test_explain_output(self, capsys):
         s = tpu_session(**{"spark.rapids.sql.explain": "ALL"})
