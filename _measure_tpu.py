@@ -1,0 +1,22 @@
+import sys
+import time
+
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.workloads import tpch
+
+tables = tpch.gen_tables(1 << 20, seed=42)
+tpu = TpuSession({'spark.rapids.sql.enabled': True,
+                  'spark.rapids.sql.variableFloatAgg.enabled': True})
+t0 = time.perf_counter()
+tpu_t = tpch.load(tpu, tables)
+print('load+upload: %.1fs' % (time.perf_counter() - t0), flush=True)
+names = sys.argv[1:] or sorted(tpch.QUERIES)
+for name in names:
+    q = tpch.QUERIES[name]
+    t0 = time.perf_counter()
+    r = q(tpu_t).collect()
+    print(name, 'warmup %.1fs' % (time.perf_counter() - t0), r.num_rows,
+          'rows', flush=True)
+    t0 = time.perf_counter()
+    q(tpu_t).collect()
+    print(name, 'run %.2fs' % (time.perf_counter() - t0), flush=True)

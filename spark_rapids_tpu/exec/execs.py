@@ -47,6 +47,18 @@ def _bind_all(exprs: List[Expression], schema: T.Schema) -> List[Expression]:
     return [e.bind(schema) for e in exprs]
 
 
+def _tick(ctx, name: str, t0: float) -> float:
+    """Record one output batch + host-side dispatch time for an exec
+    (GpuExec.scala:25-52's NUM_OUTPUT_BATCHES / OP_TIME analog — dispatch
+    wall time only: device execution is async and row counts would cost a
+    tunnel round trip)."""
+    import time as _time
+    now = _time.perf_counter()
+    ctx.metric(name, "numOutputBatches", 1)
+    ctx.metric(name, "opTimeMs", (now - t0) * 1000.0)
+    return now
+
+
 class TpuExec(PhysicalPlan):
     columnar = True
 
@@ -118,10 +130,17 @@ class DeviceToHostExec(PhysicalPlan):
         return self.children[0].schema
 
     def execute(self, ctx):
+        name = self.node_name()
+
         def run(part):
             for db in part:
                 with trace_range("DeviceToHost.download"):
-                    yield HostBatch.from_device(db)
+                    hb = HostBatch.from_device(db)
+                # The download already synced the row count — the one place
+                # row metrics are free (GpuExec.NUM_OUTPUT_ROWS analog).
+                ctx.metric(name, "numOutputRows", hb.num_rows)
+                ctx.metric(name, "numOutputBatches", 1)
+                yield hb
         return [run(p) for p in self.children[0].execute(ctx)]
 
 
@@ -165,8 +184,34 @@ class TpuProjectExec(TpuExec):
         return "TpuProject [" + ", ".join(e.name for e in self.exprs) + "]"
 
     def execute(self, ctx):
+        from ..ops import nondeterministic as ND
         bound = _bind_all(self.exprs, self.children[0].schema)
         out_schema = self.schema
+        nondet = any(ND.has_nondeterministic(e) for e in bound)
+
+        if nondet:
+            # Partition id and the running row offset enter the kernel as
+            # TRACED arguments so one compile serves every partition/batch
+            # (the reference's GpuSparkPartitionID reads TaskContext; here
+            # the exec threads the same facts through eval_context).
+            def build_nd():
+                def project_nd(batch: ColumnarBatch, row_base, pid
+                               ) -> ColumnarBatch:
+                    with ND.eval_context(pid, row_base):
+                        cols = tuple(e.eval_device(batch) for e in bound)
+                    return batch.with_columns(cols, out_schema)
+                return project_nd
+            project_nd = cached_kernel(
+                "project_nd", kernel_key(bound, out_schema), build_nd)
+
+            def run_nd(part, pidx):
+                row_base = jnp.asarray(0, jnp.int64)
+                pid = jnp.asarray(pidx, jnp.int32)
+                for db in part:
+                    yield project_nd(db, row_base, pid)
+                    row_base = row_base + db.n_rows.astype(jnp.int64)
+            return [run_nd(p, i)
+                    for i, p in enumerate(self.children[0].execute(ctx))]
 
         def build():
             def project(batch: ColumnarBatch) -> ColumnarBatch:
@@ -177,8 +222,12 @@ class TpuProjectExec(TpuExec):
                                 build)
 
         def run(part):
+            import time as _time
+            t0 = _time.perf_counter()
             for db in part:
-                yield project(db)
+                out = project(db)
+                t0 = _tick(ctx, "TpuProject", t0)
+                yield out
         return [run(p) for p in self.children[0].execute(ctx)]
 
 
@@ -206,8 +255,12 @@ class TpuFilterExec(TpuExec):
         filt = cached_kernel("filter", kernel_key(bound), build)
 
         def run(part):
+            import time as _time
+            t0 = _time.perf_counter()
             for db in part:
-                yield filt(db)
+                out = filt(db)
+                t0 = _tick(ctx, "TpuFilter", t0)
+                yield out
         return [run(p) for p in self.children[0].execute(ctx)]
 
 
@@ -387,13 +440,43 @@ class TpuSortExec(TpuExec):
         do_sort = cached_kernel("sort", kernel_key(key_exprs, asc, nf), build)
 
         def gen():
-            batches = []
-            for part in self.children[0].execute(ctx):
-                batches.extend(part)
-            if not batches:
+            merged = _accumulate_spillable(self.children[0], ctx, "sort")
+            if merged is None:
                 return
-            yield do_sort(_coalesce_device(batches))
+            ctx.metric(self.node_name(), "numOutputBatches", 1)
+            yield do_sort(merged)
         return [gen()]
+
+
+def _accumulate_spillable(child: PhysicalPlan, ctx,
+                          label: str) -> Optional[ColumnarBatch]:
+    """Collect ALL of a child's batches into one, registering each with the
+    spill catalog while accumulating so memory pressure can push earlier
+    batches to host/disk (the reference makes join build sides and sort
+    inputs spillable the same way, RapidsBufferStore.scala:40). Under
+    whole-stage fusion tracing the catalog is bypassed (tracers cannot move
+    hosts)."""
+    from ..memory import spill as SP
+    catalog = getattr(ctx, "catalog", None)
+    use_catalog = catalog is not None and not ctx.in_fusion
+    if not use_catalog:
+        batches = [b for part in child.execute(ctx) for b in part]
+        return _coalesce_device(batches) if batches else None
+    ids = []
+    for part in child.execute(ctx):
+        for db in part:
+            ids.append(catalog.register_batch(
+                db, SP.ACTIVE_BATCHING_PRIORITY))
+    if not ids:
+        return None
+    with trace_range(f"{label}.assemble"):
+        for b in ids:
+            catalog.pin(b)
+        batches = [catalog.acquire_batch(b) for b in ids]
+        out = _coalesce_device(batches)
+        for b in ids:
+            catalog.free(b)
+    return out
 
 
 _concat_jit = jax.jit(KC.concat_batches, static_argnums=(1,))
@@ -511,8 +594,10 @@ class TpuHashAggregateExec(TpuExec):
                 # rows), so no row-count sync is ever needed here.
                 if self.groupings:
                     return
+                ctx.metric("TpuHashAggregate", "numOutputBatches", 1)
                 yield self._empty_result()
                 return
+            ctx.metric("TpuHashAggregate", "numOutputBatches", 1)
             yield self._finalize(state, buf_schema)
         return [gen()]
 
@@ -770,11 +855,7 @@ class TpuShuffledHashJoinExec(TpuExec):
             return out, hits
 
         def gen():
-            build_batches = []
-            for part in right.execute(ctx):
-                build_batches.extend(part)
-            build = _coalesce_device(build_batches) if build_batches else None
-
+            build = _accumulate_spillable(right, ctx, "join.build")
             hit_acc = None
             for part in left.execute(ctx):
                 for probe in part:

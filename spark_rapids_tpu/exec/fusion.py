@@ -47,8 +47,7 @@ from ..utils.kernel_cache import plan_signature as _plan_sig
 from .coalesce import TpuCoalesceBatchesExec
 from .execs import (DeviceToHostExec, TpuExec, TpuExpandExec, TpuFilterExec,
                     TpuHashAggregateExec, TpuLimitExec, TpuProjectExec,
-                    TpuShuffledHashJoinExec, TpuSortExec, TpuUnionExec,
-                    _coalesce_device)
+                    TpuUnionExec, _coalesce_device)
 
 
 class _NotFusable(Exception):
@@ -77,9 +76,13 @@ class FusedInputExec(TpuExec):
 
 #: Execs whose execute() path is fully traceable (no host syncs, no host
 #: data): these are inlined into the fused program. Everything else columnar
-#: becomes a boundary input.
-_INLINE = (TpuProjectExec, TpuFilterExec, TpuHashAggregateExec, TpuSortExec,
-           TpuShuffledHashJoinExec, TpuCoalesceBatchesExec, TpuExpandExec,
+#: becomes a boundary input. Joins are deliberately NOT inlined: a fused
+#: multi-join program accumulates enough lax.sort stages to exhaust the
+#: remote TPU compile helper; as boundaries they run through their own
+#: process-cached (and persistently disk-cached) kernels that amortize
+#: across queries.
+_INLINE = (TpuProjectExec, TpuFilterExec, TpuHashAggregateExec,
+           TpuCoalesceBatchesExec, TpuExpandExec,
            TpuUnionExec, TpuLimitExec, FusedInputExec)
 
 

@@ -47,6 +47,7 @@ class TpuBroadcastExchangeExec(TpuExec):
     def __init__(self, child: PhysicalPlan):
         self.children = [child]
         self._device_batch: Optional[ColumnarBatch] = None
+        self._buffer_id: Optional[int] = None
         self._payload_bytes = 0
         self._empty = False
 
@@ -55,7 +56,14 @@ class TpuBroadcastExchangeExec(TpuExec):
         return self.children[0].schema
 
     def broadcast_batch(self, ctx) -> Optional[ColumnarBatch]:
-        if self._device_batch is not None or self._empty:
+        if self._empty:
+            return None
+        catalog = getattr(ctx, "catalog", None)
+        if self._buffer_id is not None and catalog is not None:
+            # Cached in the spill catalog: may restore from host/disk if
+            # memory pressure pushed it out between consumers.
+            return catalog.acquire_batch(self._buffer_id)
+        if self._device_batch is not None:
             return self._device_batch
         batches = []
         for part in self.children[0].execute(ctx):
@@ -69,6 +77,20 @@ class TpuBroadcastExchangeExec(TpuExec):
             # are only materialized if a multi-process transport needs them
             # — in-process, consumers share the device batch directly.
             self._payload_bytes = merged.device_size_bytes
+        ctx.metric(self.node_name(), "dataSize", self._payload_bytes)
+        if catalog is not None and not ctx.in_fusion:
+            from ..memory import spill as SP
+            bid = catalog.register_batch(merged, SP.ACTIVE_ON_DECK_PRIORITY)
+            self._buffer_id = bid
+
+            def _release():
+                # The exchange node dies with the query; free its catalog
+                # entry at query end or the session-lifetime catalog leaks
+                # one build table per broadcast query.
+                catalog.free(bid)
+                self._buffer_id = None
+            ctx.add_cleanup(_release)
+            return catalog.acquire_batch(bid)
         self._device_batch = merged
         return merged
 

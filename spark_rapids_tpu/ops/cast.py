@@ -17,13 +17,106 @@ reference).
 
 from __future__ import annotations
 
+import datetime as _dt
+
 import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 
 from .. import types as T
+from ..data.column import DeviceColumn
 from .arithmetic import _np_of, _to_pa
 from .expression import Expression, UnaryExpression
+
+
+def _host_from_string(v: pa.Array, to: T.DataType) -> pa.Array:
+    """CPU-oracle string parsing with Spark non-ANSI semantics (invalid ->
+    null), mirroring the device kernels in cast_string.py."""
+    vals = v.to_pylist()
+    out = []
+    for s in vals:
+        if s is None:
+            out.append(None)
+            continue
+        s = s.strip()
+        try:
+            # Python int()/float() accept '_' separators and non-ASCII
+            # digits; Spark and the device kernels do not.
+            if to.is_integral or to.name in ("float", "double"):
+                if "_" in s or not s.isascii():
+                    out.append(None)
+                    continue
+            if to.is_integral:
+                x = int(s)
+                lo, hi = _INT_BOUNDS[to.name]
+                out.append(x if lo <= x <= hi else None)
+            elif to.name in ("float", "double"):
+                low = s.lower()
+                if low in ("nan", "infinity", "inf", "-infinity", "-inf",
+                           "+infinity", "+inf"):
+                    out.append(None)  # device kernel rejects word forms
+                else:
+                    out.append(float(s))
+            elif to is T.BOOLEAN:
+                low = s.lower()
+                if low in ("true", "t", "yes", "y", "1"):
+                    out.append(True)
+                elif low in ("false", "f", "no", "n", "0"):
+                    out.append(False)
+                else:
+                    out.append(None)
+            elif to is T.DATE:
+                out.append(_dt.date.fromisoformat(_pad_iso_date(s)))
+            elif to is T.TIMESTAMP:
+                out.append(_parse_ts_host(s))
+            else:
+                raise NotImplementedError(str(to))
+        except (ValueError, OverflowError):
+            out.append(None)
+    return pa.array(out, type=T.to_arrow_type(to))
+
+
+import re as _re
+
+_DATE_RE = _re.compile(r"^(\d{4})-(\d{1,2})-(\d{1,2})$")
+
+
+def _pad_iso_date(s: str) -> str:
+    m = _DATE_RE.match(s)
+    if not m:
+        # Python 3.11+ fromisoformat accepts compact "yyyymmdd"; Spark's
+        # cast does not — force a parse failure.
+        raise ValueError(f"not a yyyy-MM-dd date: {s!r}")
+    return f"{m.group(1)}-{int(m.group(2)):02d}-{int(m.group(3)):02d}"
+
+
+def _parse_ts_host(s: str):
+    if " " in s or "T" in s:
+        sep = " " if " " in s else "T"
+        d, t = s.split(sep, 1)
+        return _dt.datetime.fromisoformat(_pad_iso_date(d) + "T" + t)
+    return _dt.datetime.combine(_dt.date.fromisoformat(_pad_iso_date(s)),
+                                _dt.time())
+
+
+def _host_to_string(v: pa.Array, src: T.DataType) -> pa.Array:
+    vals = v.to_pylist()
+    out = []
+    for x in vals:
+        if x is None:
+            out.append(None)
+        elif src is T.BOOLEAN:
+            out.append("true" if x else "false")
+        elif src is T.DATE:
+            out.append(x.isoformat())
+        elif src is T.TIMESTAMP:
+            s = x.strftime("%Y-%m-%d %H:%M:%S")
+            if x.microsecond:
+                s += (".%06d" % x.microsecond).rstrip("0")
+            out.append(s)
+        else:
+            out.append(str(x))
+    return pa.array(out, type=pa.string())
 
 _INT_BOUNDS = {
     "tinyint": (-(2 ** 7), 2 ** 7 - 1),
@@ -46,6 +139,93 @@ class Cast(UnaryExpression):
 
     def with_children(self, children):
         return Cast(children[0], self.to)
+
+    def eval_host(self, batch):
+        src = self.child.data_type
+        if src is T.STRING and self.to is not T.STRING:
+            from .expression import host_to_array
+            v = host_to_array(self.child.eval_host(batch), batch.num_rows)
+            return _host_from_string(v, self.to)
+        if self.to is T.STRING and src is not T.STRING:
+            from .expression import host_to_array
+            v = host_to_array(self.child.eval_host(batch), batch.num_rows)
+            return _host_to_string(v, src)
+        return super().eval_host(batch)
+
+    def eval_device(self, batch):
+        src = self.child.data_type
+        if src is T.STRING and self.to is not T.STRING:
+            from . import cast_string as CS
+            from .expression import make_column
+            from .strings_util import char_matrix
+            c = self.child.eval_device(batch)
+            parse = {
+                "bigint": CS.parse_long_matrix, "int": CS.parse_long_matrix,
+                "smallint": CS.parse_long_matrix,
+                "tinyint": CS.parse_long_matrix,
+                "float": CS.parse_double_matrix,
+                "double": CS.parse_double_matrix,
+                "date": CS.parse_date_matrix,
+                "timestamp": CS.parse_timestamp_matrix,
+                "boolean": CS.parse_bool_matrix,
+            }.get(self.to.name)
+            if parse is None:
+                raise NotImplementedError(f"cast string->{self.to}")
+            if c.is_dict:
+                # Parse the small dictionary once, gather by code.
+                dm = char_matrix(DeviceColumn(
+                    data=c.data, validity=jnp.ones(c.dict_size, jnp.bool_),
+                    dtype=T.STRING, offsets=c.offsets,
+                    max_bytes=c.max_bytes))
+                vals_d, ok_d = parse(dm)
+                safe = jnp.clip(c.codes, 0, c.dict_size - 1)
+                vals, ok = vals_d[safe], ok_d[safe]
+            else:
+                vals, ok = parse(char_matrix(c))
+            if self.to.is_integral and self.to is not T.LONG:
+                # Spark parses string->integral at target width: out of
+                # range -> null (not the numeric cast's Java wrap).
+                lo, hi = _INT_BOUNDS[self.to.name]
+                ok = ok & (vals >= lo) & (vals <= hi)
+                vals = _jnp_cast(vals, T.LONG, self.to)
+            elif self.to is T.FLOAT:
+                vals = vals.astype(jnp.float32)
+            elif self.to.name in ("float", "double") \
+                    and vals.dtype != self.to.np_dtype:
+                vals = vals.astype(self.to.np_dtype)
+            validity = c.validity & ok
+            data = jnp.where(validity, vals.astype(self.to.np_dtype),
+                             jnp.zeros((), self.to.np_dtype))
+            return make_column(data, validity, self.to)
+        if self.to is T.STRING and src is not T.STRING:
+            from . import cast_string as CS
+            from .kernels.rowops import strings_from_matrix
+            from .strings_util import PAD
+            c = self.child.eval_device(batch)
+            if src is T.BOOLEAN:
+                # Two-entry dictionary: O(1) payload.
+                import numpy as _np
+                payload = _np.frombuffer(b"falsetrue", dtype=_np.uint8)
+                buf = _np.zeros(16, _np.uint8)
+                buf[:9] = payload
+                return DeviceColumn(
+                    data=jnp.asarray(buf), validity=c.validity,
+                    dtype=T.STRING,
+                    offsets=jnp.asarray(_np.array([0, 5, 9], _np.int32)),
+                    max_bytes=8,
+                    codes=jnp.where(c.validity, c.data.astype(jnp.int32), 0),
+                    dict_sorted=True)
+            if src.is_integral:
+                m = CS.format_long_matrix(c.data.astype(jnp.int64))
+            elif src is T.DATE:
+                m = CS.format_date_matrix(c.data)
+            elif src is T.TIMESTAMP:
+                m = CS.format_timestamp_matrix(c.data)
+            else:
+                raise NotImplementedError(f"cast {src}->string")
+            m = jnp.where(c.validity[:, None], m, PAD)
+            return strings_from_matrix(m, c.validity, m.shape[1])
+        return super().eval_device(batch)
 
     def do_host(self, v: pa.Array) -> pa.Array:
         src = T.from_arrow_type(v.type)

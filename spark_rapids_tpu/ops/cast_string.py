@@ -1,0 +1,355 @@
+"""String <-> numeric/date/timestamp/boolean casts — the rest of GpuCast.
+
+The reference's cast matrix (``GpuCast.scala:79,181``) covers these with
+conf gates on the inexact paths (``RapidsConf.scala:395-425``); the same
+gates exist here (castFloatToString / castStringToFloat /
+castStringToTimestamp, config.py). Device kernels parse/format through the
+char-matrix representation; DICTIONARY-encoded inputs evaluate on the
+small dictionary and gather by code, so a 1M-row cast costs O(dict).
+
+Semantics are Spark non-ANSI: invalid input -> null, integral overflow ->
+null for string sources. Digits parse/format with static per-width loops
+(W is the column's static max_bytes bound), which XLA unrolls into pure
+vector code.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..data.column import DeviceColumn, bucket_capacity
+from .strings_util import PAD, char_matrix, lengths
+
+_LONG_MAX_F = 9.223372036854775e18
+
+
+def _digit(m, j):
+    c = m[:, j]
+    return (c >= ord("0")) & (c <= ord("9")), (c - ord("0")).astype(jnp.int64)
+
+
+def _trimmed(m: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Spark's cast trims whitespace: return (matrix', lengths') with
+    leading/trailing ASCII whitespace replaced by PAD and content shifted
+    to column 0."""
+    ws = (m == ord(" ")) | (m == ord("\t")) | (m == ord("\n")) \
+        | (m == ord("\r"))
+    content = (m != PAD) & ~ws
+    n, w = m.shape
+    idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    first = jnp.min(jnp.where(content, idx, w), axis=1)
+    last = jnp.max(jnp.where(content, idx, -1), axis=1)
+    shift = first[:, None]
+    src = jnp.clip(idx + shift, 0, w - 1)
+    shifted = jnp.take_along_axis(m, src, axis=1)
+    new_len = jnp.maximum(last - first + 1, 0)
+    keep = idx < new_len[:, None]
+    return jnp.where(keep, shifted, PAD), new_len.astype(jnp.int32)
+
+
+_I64_MAX_DIGITS = [int(c) for c in "9223372036854775807"]
+_I64_MIN_DIGITS = [int(c) for c in "9223372036854775808"]
+
+
+def parse_long_matrix(m: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """[N] int64 values + [N] bool validity from trimmed char rows.
+
+    Overflow -> null (Spark non-ANSI), decided EXACTLY: <=18 significant
+    digits always fit; 19 compare lexicographically against the int64
+    bound (sign-dependent); >=20 overflow. The wrapped int64 accumulator
+    is correct for every accepted value including INT64_MIN."""
+    m, ln = _trimmed(m)
+    n, w = m.shape
+    neg = m[:, 0] == ord("-")
+    plus = m[:, 0] == ord("+")
+    start = (neg | plus).astype(jnp.int32)
+    n_digits = ln - start
+    acc = jnp.zeros(n, jnp.int64)
+    all_digits = jnp.ones(n, jnp.bool_)
+    for j in range(w):
+        in_num = (j >= start) & (j < ln)
+        is_d, d = _digit(m, j)
+        all_digits = all_digits & (~in_num | is_d)
+        acc = jnp.where(in_num & is_d, acc * 10 + d, acc)
+    valid = (n_digits >= 1) & all_digits & (ln > 0)
+    # significant digits: from the first nonzero digit
+    idxw = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_num_m = (idxw >= start[:, None]) & (idxw < ln[:, None])
+    nonzero = in_num_m & (m != ord("0"))
+    fs = jnp.min(jnp.where(nonzero, idxw, w), axis=1)
+    has_nz = fs < w
+    sig = jnp.where(has_nz, ln - fs, 1)
+    decided = jnp.zeros(n, jnp.bool_)
+    le19 = jnp.ones(n, jnp.bool_)
+    for k in range(19):
+        pos = jnp.clip(fs + k, 0, w - 1)[:, None]
+        ck = (jnp.take_along_axis(m, pos, axis=1)[:, 0]
+              - ord("0")).astype(jnp.int32)
+        bk = jnp.where(neg, _I64_MIN_DIGITS[k], _I64_MAX_DIGITS[k])
+        lt = ~decided & (ck < bk)
+        gt = ~decided & (ck > bk)
+        le19 = jnp.where(gt, False, le19)
+        decided = decided | lt | gt
+    valid = valid & ((sig <= 18) | ((sig == 19) & le19))
+    out = jnp.where(neg, -acc, acc)
+    return out, valid
+
+
+def parse_double_matrix(m: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Decimal/exponent float parse: [sign] D* [. D*] [eE [sign] D+], at
+    least one mantissa digit ("Infinity"/"NaN" words are not accepted).
+    Returns ([N] float64, [N] bool)."""
+    m, ln = _trimmed(m)
+    n, w = m.shape
+    neg = m[:, 0] == ord("-")
+    plus = m[:, 0] == ord("+")
+    start = (neg | plus).astype(jnp.int32)
+    idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    in_row = idx < ln[:, None]
+    is_dot = (m == ord(".")) & in_row
+    is_e = ((m == ord("e")) | (m == ord("E"))) & in_row
+    dot_pos = jnp.min(jnp.where(is_dot, idx, w), axis=1)
+    e_pos = jnp.min(jnp.where(is_e, idx, w), axis=1)
+    n_dots = jnp.sum(is_dot.astype(jnp.int32), axis=1)
+    n_es = jnp.sum(is_e.astype(jnp.int32), axis=1)
+    ok = (n_dots <= 1) & (n_es <= 1) & ((n_dots == 0) | (dot_pos < e_pos))
+    has_e = e_pos < w
+    e_sign_col = jnp.clip(e_pos + 1, 0, w - 1)[:, None]
+    e_sign_c = jnp.take_along_axis(m, e_sign_col, axis=1)[:, 0]
+    e_neg = has_e & (e_sign_c == ord("-"))
+    e_plus = has_e & (e_sign_c == ord("+"))
+    exp_start = e_pos + 1 + (e_neg | e_plus).astype(jnp.int32)
+    mant = jnp.zeros(n, jnp.float64)
+    frac_scale = jnp.ones(n, jnp.float64)
+    mant_digits = jnp.zeros(n, jnp.int32)
+    exp_acc = jnp.zeros(n, jnp.int64)
+    exp_digits = jnp.zeros(n, jnp.int32)
+    for j in range(w):
+        jj = jnp.full(n, j, jnp.int32)
+        in_num = (jj >= start) & (jj < ln)
+        is_d, d = _digit(m, j)
+        df = d.astype(jnp.float64)
+        in_int = in_num & (jj < dot_pos) & (jj < e_pos)
+        in_frac = in_num & (jj > dot_pos) & (jj < e_pos)
+        in_exp = in_num & (jj >= exp_start) & has_e
+        mant = jnp.where(in_int & is_d, mant * 10 + df, mant)
+        frac_scale = jnp.where(in_frac & is_d, frac_scale * 10, frac_scale)
+        mant = jnp.where(in_frac & is_d, mant + df / frac_scale, mant)
+        mant_digits = mant_digits + ((in_int | in_frac) & is_d)
+        exp_acc = jnp.where(in_exp & is_d, exp_acc * 10 + d, exp_acc)
+        exp_digits = exp_digits + (in_exp & is_d)
+        legal = is_d | (jj == dot_pos) | (jj == e_pos) \
+            | ((jj == e_pos + 1) & (e_neg | e_plus))
+        ok = ok & (~in_num | legal)
+    valid = ok & (mant_digits >= 1) & (~has_e | (exp_digits >= 1)) & (ln > 0)
+    exp = jnp.where(e_neg, -exp_acc, exp_acc)
+    exp = jnp.clip(exp, -400, 400).astype(jnp.float64)
+    out = jnp.where(neg, -mant, mant) * jnp.power(10.0, exp)
+    return out, valid
+
+
+def format_long_matrix(v: jnp.ndarray) -> jnp.ndarray:
+    """int64 -> char matrix [N, 20], left-aligned, PAD-terminated."""
+    n = v.shape[0]
+    w = 20
+    neg = v < 0
+    # abs in uint-safe form: int64 min magnitude fits when accumulated in
+    # float for digit count, exact via per-digit divmod on the negative.
+    mag = jnp.where(neg, -v, v)  # int64 min wraps; handled below via digits
+    digits = []
+    rest = mag
+    for _ in range(w - 1):
+        digits.append((rest % 10).astype(jnp.int16))
+        rest = rest // 10
+    dm = jnp.stack(digits[::-1], axis=1)  # [N, 19] most-significant first
+    nz = dm != 0
+    idx = jnp.arange(w - 1, dtype=jnp.int32)[None, :]
+    first_nz = jnp.min(jnp.where(nz, idx, w - 1), axis=1)
+    ndig = (w - 1) - first_nz
+    ndig = jnp.maximum(ndig, 1)  # "0"
+    chars = (dm + ord("0")).astype(jnp.int16)
+    # left-align: row i starts at first digit (or sign)
+    total = ndig + neg.astype(jnp.int32)
+    out_idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+    src = out_idx - neg.astype(jnp.int32)[:, None] + first_nz[:, None]
+    src_c = jnp.take_along_axis(chars, jnp.clip(src, 0, w - 2), axis=1)
+    out = jnp.where(out_idx == 0, jnp.where(neg[:, None], ord("-"), src_c),
+                    src_c).astype(jnp.int16)
+    out = jnp.where(out_idx < total[:, None], out, PAD)
+    # INT64_MIN: -v wraps, so the digit loop extracted garbage — overwrite
+    # those rows with the constant representation.
+    i64_min = jnp.int64(-9223372036854775807 - 1)
+    min_row = np.full(w, PAD, np.int16)
+    min_txt = b"-9223372036854775808"
+    min_row[: len(min_txt)] = np.frombuffer(min_txt, np.uint8)
+    return jnp.where((v == i64_min)[:, None], jnp.asarray(min_row)[None, :],
+                     out)
+
+
+def format_date_matrix(days: jnp.ndarray) -> jnp.ndarray:
+    """date32 -> 'yyyy-MM-dd' char matrix [N, 10]."""
+    from .datetime import _civil_from_days
+    y, mo, d = _civil_from_days(days.astype(jnp.int64))
+
+    def dig(x, p):
+        return ((x // p) % 10 + ord("0")).astype(jnp.int16)
+    cols = [dig(y, 1000), dig(y, 100), dig(y, 10), dig(y, 1),
+            jnp.full_like(y, ord("-")).astype(jnp.int16),
+            dig(mo, 10), dig(mo, 1),
+            jnp.full_like(y, ord("-")).astype(jnp.int16),
+            dig(d, 10), dig(d, 1)]
+    return jnp.stack(cols, axis=1)
+
+
+def parse_date_matrix(m: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """'yyyy-MM-dd' / 'yyyy-M-d' -> (days int32, valid)."""
+    from .datetime import _days_from_civil
+    m, ln = _trimmed(m)
+    y, mo, d, pos_after, ok = _parse_ymd(m, ln)
+    valid = ok & (pos_after == ln)
+    days = _days_from_civil(y, mo, d)
+    return days.astype(jnp.int32), valid
+
+
+def _parse_int_run(m, start, max_digits):
+    """Parse up to max_digits digits from per-row ``start``: returns
+    (value int64, n_digits, next_pos)."""
+    n, w = m.shape
+    acc = jnp.zeros(n, jnp.int64)
+    cnt = jnp.zeros(n, jnp.int32)
+    for k in range(max_digits):
+        pos = jnp.clip(start + k, 0, w - 1)
+        c = jnp.take_along_axis(m, pos[:, None], axis=1)[:, 0]
+        is_d = (c >= ord("0")) & (c <= ord("9")) & (start + k < w) \
+            & (cnt == k)
+        acc = jnp.where(is_d, acc * 10 + (c - ord("0")).astype(jnp.int64),
+                        acc)
+        cnt = jnp.where(is_d, cnt + 1, cnt)
+    return acc, cnt, start + cnt
+
+
+def _expect_char(m, pos, ch):
+    c = jnp.take_along_axis(m, jnp.clip(pos, 0, m.shape[1] - 1)[:, None],
+                            axis=1)[:, 0]
+    return c == ord(ch)
+
+
+def _parse_ymd(m, ln):
+    y, yd, p = _parse_int_run(m, jnp.zeros(m.shape[0], jnp.int32), 4)
+    ok = (yd == 4) & _expect_char(m, p, "-")
+    mo, md, p2 = _parse_int_run(m, p + 1, 2)
+    ok = ok & (md >= 1) & _expect_char(m, p2, "-")
+    d, dd, p3 = _parse_int_run(m, p2 + 1, 2)
+    ok = ok & (dd >= 1) & (mo >= 1) & (mo <= 12) & (d >= 1) & (d <= 31)
+    return y, mo, d, p3, ok
+
+
+def parse_timestamp_matrix(m: jnp.ndarray
+                           ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """'yyyy-MM-dd[ HH:mm:ss[.f{1..6}]]' -> (micros int64, valid)."""
+    from .datetime import _days_from_civil
+    m, ln = _trimmed(m)
+    n, w = m.shape
+    y, mo, d, p, ok = _parse_ymd(m, ln)
+    days = _days_from_civil(y, mo, d)
+    date_only = ok & (p == ln)
+    sep_ok = _expect_char(m, p, " ") | _expect_char(m, p, "T")
+    hh, hd, p1 = _parse_int_run(m, p + 1, 2)
+    ok_h = ok & sep_ok & (hd >= 1) & (hh < 24)
+    hour_only = ok_h & (p1 == ln)
+    has_min = _expect_char(m, p1, ":")
+    mi, mid, p2 = _parse_int_run(m, p1 + 1, 2)
+    ok_m = ok_h & has_min & (mid >= 1) & (mi < 60)
+    min_only = ok_m & (p2 == ln)
+    has_sec = _expect_char(m, p2, ":")
+    ss, sd, p3 = _parse_int_run(m, p2 + 1, 2)
+    ok_s = ok_m & has_sec & (sd >= 1) & (ss < 60)
+    has_frac = _expect_char(m, p3, ".")
+    fr, fd, p4 = _parse_int_run(m, p3 + 1, 6)
+    # scale fraction to microseconds by digit count
+    scale = jnp.power(10.0, (6 - fd).astype(jnp.float64)).astype(jnp.int64)
+    micros_frac = jnp.where(has_frac, fr * scale, 0)
+    end = jnp.where(has_frac, p4, p3)
+    full_ok = ok_s & (end == ln) & (~has_frac | (fd >= 1))
+    mi = jnp.where(ok_m, mi, 0)
+    ss = jnp.where(ok_s, ss, 0)
+    micros = days.astype(jnp.int64) * 86_400_000_000 \
+        + hh * 3_600_000_000 + mi * 60_000_000 + ss * 1_000_000 \
+        + jnp.where(full_ok, micros_frac, 0)
+    date_micros = days.astype(jnp.int64) * 86_400_000_000
+    valid = date_only | hour_only | min_only | full_ok
+    return jnp.where(date_only, date_micros, micros), valid
+
+
+def format_timestamp_matrix(us: jnp.ndarray) -> jnp.ndarray:
+    """micros -> 'yyyy-MM-dd HH:mm:ss[.ffffff]' (trailing zeros trimmed),
+    char matrix [N, 26]."""
+    from .datetime import _civil_from_days
+    days = jnp.floor_divide(us, 86_400_000_000)
+    rem = us - days * 86_400_000_000
+    y, mo, d = _civil_from_days(days)
+    hh = rem // 3_600_000_000
+    mi = (rem // 60_000_000) % 60
+    ss = (rem // 1_000_000) % 60
+    frac = rem % 1_000_000
+
+    def dig(x, p):
+        return ((x // p) % 10 + ord("0")).astype(jnp.int16)
+
+    def lit(ch):
+        return jnp.full(us.shape[0], ord(ch), jnp.int16)
+    cols = [dig(y, 1000), dig(y, 100), dig(y, 10), dig(y, 1), lit("-"),
+            dig(mo, 10), dig(mo, 1), lit("-"), dig(d, 10), dig(d, 1),
+            lit(" "), dig(hh, 10), dig(hh, 1), lit(":"),
+            dig(mi, 10), dig(mi, 1), lit(":"), dig(ss, 10), dig(ss, 1),
+            lit("."),
+            dig(frac, 100000), dig(frac, 10000), dig(frac, 1000),
+            dig(frac, 100), dig(frac, 10), dig(frac, 1)]
+    m = jnp.stack(cols, axis=1)
+    # Trim: no frac -> length 19; else 20 + digits up to last nonzero.
+    idx = jnp.arange(26, dtype=jnp.int32)[None, :]
+    frac_digits = jnp.where(
+        frac == 0, 0,
+        6 - _trailing_zeros6(frac))
+    total = jnp.where(frac == 0, 19, 20 + frac_digits)
+    return jnp.where(idx < total[:, None], m, PAD)
+
+
+def _trailing_zeros6(frac: jnp.ndarray) -> jnp.ndarray:
+    tz = jnp.zeros(frac.shape[0], jnp.int32)
+    rest = frac
+    done = frac == 0
+    for _ in range(6):
+        is_z = (rest % 10 == 0) & ~done
+        tz = tz + is_z
+        done = done | ~is_z
+        rest = jnp.where(is_z, rest // 10, rest)
+    return tz
+
+
+_TRUE_WORDS = ("true", "t", "yes", "y", "1")
+_FALSE_WORDS = ("false", "f", "no", "n", "0")
+
+
+def parse_bool_matrix(m: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    m, ln = _trimmed(m)
+    lower = jnp.where((m >= ord("A")) & (m <= ord("Z")), m + 32, m)
+
+    def word_eq(word: str):
+        w = m.shape[1]
+        if len(word) > w:
+            return jnp.zeros(m.shape[0], jnp.bool_)
+        row = np.full(w, PAD, np.int16)
+        row[: len(word)] = [ord(c) for c in word]
+        return jnp.all(lower == jnp.asarray(row)[None, :], axis=1)
+    is_true = jnp.zeros(m.shape[0], jnp.bool_)
+    is_false = jnp.zeros(m.shape[0], jnp.bool_)
+    for wd in _TRUE_WORDS:
+        is_true = is_true | word_eq(wd)
+    for wd in _FALSE_WORDS:
+        is_false = is_false | word_eq(wd)
+    return is_true, is_true | is_false

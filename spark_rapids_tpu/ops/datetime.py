@@ -270,3 +270,121 @@ class DateDiff(BinaryExpression):
 
     def do_device(self, l, r):
         return (l.astype(jnp.int64) - r.astype(jnp.int64)).astype(jnp.int32), None
+
+
+_DEFAULT_TS_FMT = "yyyy-MM-dd HH:mm:ss"
+
+
+class UnixTimestamp(Expression):
+    """unix_timestamp(col[, fmt]) -> seconds since epoch (bigint).
+
+    Timestamp and date inputs convert directly; string inputs parse with
+    the DEFAULT pattern only (``yyyy-MM-dd HH:mm:ss``; other patterns are
+    tagged unsupported and fall back, the reference's fixed-format stance
+    for GpuUnixTimestamp)."""
+
+    def __init__(self, child: Expression, fmt: str = _DEFAULT_TS_FMT):
+        self.children = [child]
+        self.fmt = fmt
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    def with_children(self, children):
+        return UnixTimestamp(children[0], self.fmt)
+
+    @property
+    def is_default_format(self) -> bool:
+        return self.fmt == _DEFAULT_TS_FMT
+
+    def eval_host(self, batch):
+        from .expression import host_to_array
+        src = self.children[0].data_type
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        if src is T.TIMESTAMP:
+            # Floor division (Spark floorDiv): Arrow's integer divide
+            # truncates toward zero, wrong for pre-epoch timestamps.
+            us = v.cast(pa.int64()).cast(pa.float64())
+            return pc.floor(pc.divide(us, 1_000_000.0)).cast(pa.int64())
+        if src is T.DATE:
+            days = v.cast(pa.int32()).cast(pa.int64())
+            return pc.multiply(days, 86400)
+        # string: parse via the Cast oracle then convert
+        from .cast import _host_from_string
+        ts = _host_from_string(v, T.TIMESTAMP)
+        us = ts.cast(pa.timestamp("us")).cast(pa.int64()).cast(pa.float64())
+        secs = pc.floor(pc.divide(us, 1_000_000.0)).cast(pa.int64())
+        return pc.if_else(pc.is_valid(secs), secs,
+                          pa.nulls(batch.num_rows, pa.int64()))
+
+    def eval_device(self, batch):
+        from .expression import make_column
+        src = self.children[0].data_type
+        c = self.children[0].eval_device(batch)
+        if src is T.TIMESTAMP:
+            secs = jnp.floor_divide(c.data, 1_000_000)
+            return make_column(secs, c.validity, T.LONG)
+        if src is T.DATE:
+            return make_column(c.data.astype(jnp.int64) * 86400,
+                               c.validity, T.LONG)
+        from .cast_string import parse_timestamp_matrix
+        from .strings_util import char_matrix
+        if c.is_dict:
+            from ..data.column import DeviceColumn as _DC
+            dm = char_matrix(_DC(
+                data=c.data, validity=jnp.ones(c.dict_size, jnp.bool_),
+                dtype=T.STRING, offsets=c.offsets, max_bytes=c.max_bytes))
+            us_d, ok_d = parse_timestamp_matrix(dm)
+            safe = jnp.clip(c.codes, 0, c.dict_size - 1)
+            us, ok = us_d[safe], ok_d[safe]
+        else:
+            us, ok = parse_timestamp_matrix(char_matrix(c))
+        validity = c.validity & ok
+        secs = jnp.where(validity, jnp.floor_divide(us, 1_000_000), 0)
+        return make_column(secs, validity, T.LONG)
+
+
+class FromUnixTime(Expression):
+    """from_unixtime(seconds[, fmt]) -> formatted string (default pattern
+    only, like the reference's GpuFromUnixTime)."""
+
+    def __init__(self, child: Expression, fmt: str = _DEFAULT_TS_FMT):
+        self.children = [child]
+        self.fmt = fmt
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return FromUnixTime(children[0], self.fmt)
+
+    @property
+    def is_default_format(self) -> bool:
+        return self.fmt == _DEFAULT_TS_FMT
+
+    def eval_host(self, batch):
+        from .expression import host_to_array
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        secs = v.cast(pa.int64()).to_pylist()
+        import datetime as _dt
+        out = []
+        for s in secs:
+            if s is None:
+                out.append(None)
+            else:
+                out.append(
+                    _dt.datetime.fromtimestamp(s, _dt.timezone.utc)
+                    .strftime("%Y-%m-%d %H:%M:%S"))
+        return pa.array(out, type=pa.string())
+
+    def eval_device(self, batch):
+        from .cast_string import format_timestamp_matrix
+        from .kernels.rowops import strings_from_matrix
+        from .strings_util import PAD
+        c = self.children[0].eval_device(batch)
+        us = c.data.astype(jnp.int64) * 1_000_000
+        m = format_timestamp_matrix(us)
+        m = jnp.where(c.validity[:, None], m, PAD)
+        return strings_from_matrix(m, c.validity, 32)

@@ -41,10 +41,14 @@ def _equal_adjacent(col: DeviceColumn, perm: jnp.ndarray) -> jnp.ndarray:
         prev = jnp.concatenate([m[:1], m[:-1]], axis=0)
         data_eq = jnp.all(m == prev, axis=1)
     else:
-        key, _ = orderable_key(col)  # canonicalizes NaN/-0.0
+        # (bucket, key) pair equality: NaN rides the bucket with a zeroed
+        # key and -0.0 canonicalizes, so this is Spark grouping equality.
+        key, nb = orderable_key(col)
         k = key[perm]
+        b = nb[perm]
         kprev = jnp.concatenate([k[:1], k[:-1]])
-        data_eq = k == kprev
+        bprev = jnp.concatenate([b[:1], b[:-1]])
+        data_eq = (k == kprev) & (b == bprev)
     both_null = ~sorted_validity & ~vprev
     return (data_eq & sorted_validity & vprev) | both_null
 
@@ -128,17 +132,36 @@ def grouped_aggregate(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray,
     iota = jnp.arange(capacity, dtype=jnp.int32)
     live = iota < n_rows
     # -- ONE narrow grouping argsort --------------------------------------
-    operands: List[jnp.ndarray] = [jnp.where(live, 0, 1).astype(jnp.int8)]
-    for k in keys:
+    # Grouping needs equal keys ADJACENT and dead rows at the end — any
+    # total order does. So every per-key null bucket folds into ONE leading
+    # bucket operand (equality is preserved: the bucket encodes the full
+    # null pattern): sort operand count = n_keys + 2, and TPU compile cost
+    # grows superlinearly with operand count.
+    # The dead-row marker must dominate any live bucket sum: live buckets
+    # reach at most 6 * sum(7^i) < 7^n_keys, so 7^n_keys is a safe marker
+    # (int64 holds it up to 22 keys; more grouping keys than that would be
+    # pathological, so fall back to an unpacked bucket per key).
+    packed = len(keys) <= 20
+    dead_marker = 7 ** len(keys) if packed else 1
+    bucket = jnp.where(live, 0, dead_marker).astype(jnp.int64)
+    key_operands: List[jnp.ndarray] = []
+    for i, k in enumerate(keys):
         if k.is_string:
-            operands.extend(string_sort_keys(k))
+            ops = string_sort_keys(k)
+            nb = ops[0]
+            per_key = list(ops[1:])
         else:
             key, nb = orderable_key(k)
-            operands.append(nb)
-            operands.append(key)
+            per_key = [key]
+        if packed:
+            bucket = bucket + (nb.astype(jnp.int64) + 3) * (7 ** i)
+        else:
+            key_operands.append(nb.astype(jnp.int8))
+        key_operands.extend(per_key)
+    operands = [bucket] + key_operands
     sorted_all = jax.lax.sort(tuple(operands) + (iota,),
                               num_keys=len(operands), is_stable=True)
-    key_ops_sorted = sorted_all[1:-1]  # live bucket out; equal for live rows
+    key_ops_sorted = sorted_all[:-1]  # bucket participates in equality
     perm = sorted_all[-1]
     # -- segment structure (compare + cumsum: single-op HLO) --------------
     eq = jnp.ones(capacity, dtype=jnp.bool_)

@@ -60,8 +60,12 @@ def dense_key_ids(build_keys: Sequence[DeviceColumn],
             m = jnp.concatenate([mb, mp], axis=0)
             operands.extend(m[:, i] for i in range(w))
         else:
-            kb, _ = orderable_key(b)
-            kp, _ = orderable_key(p)
+            kb, nbb = orderable_key(b)
+            kp, nbp = orderable_key(p)
+            # The bucket rides along so NaN keys (zeroed, bucket 2) stay
+            # distinct from real 0.0 while NaN == NaN joins (Spark
+            # normalizes NaN for join keys).
+            operands.append(jnp.concatenate([nbb, nbp]))
             operands.append(jnp.concatenate([kb, kp]))
     usable = live & ~null_key
     # Unusable rows sort to the end and never start/join a group.

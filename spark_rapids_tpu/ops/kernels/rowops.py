@@ -51,13 +51,30 @@ def orderable_values(data: jnp.ndarray, is_floating: bool) -> jnp.ndarray:
 
 def orderable_key(col: DeviceColumn, ascending: bool = True,
                   nulls_first: bool = True) -> jnp.ndarray:
-    """Map a fixed-width column to an int array whose ascending order equals
-    the requested SQL order (nulls placed per ``nulls_first``)."""
+    """(key, bucket) whose lexicographic (bucket, key) ascending order is
+    the requested SQL order.
+
+    Floats stay FLOAT: feeding a float->int bitcast into ``lax.sort``
+    crashes this TPU toolchain's compiler, so NaN ordering (greatest, per
+    Spark) and null placement ride the BUCKET instead: nulls are +/-3, NaN
+    +/-2 (descending puts NaN first), plain values 0. -0.0 canonicalizes
+    to 0.0 and NaN keys zero so (bucket, key) equality == Spark grouping
+    equality. Callers MUST use the bucket as a more-significant sort
+    operand than the key."""
     assert not col.is_string, "string sort keys expand via string_sort_keys"
-    key = orderable_values(col.data, col.dtype.is_floating)
+    if col.dtype.is_floating:
+        v = col.data
+        nan = jnp.isnan(v)
+        v = jnp.where(nan, jnp.zeros((), v.dtype), v)
+        v = jnp.where(v == 0, jnp.zeros((), v.dtype), v)
+        key = v if ascending else -v
+        bucket = jnp.where(nan, 2 if ascending else -2, 0)
+        bucket = jnp.where(col.validity, bucket, -3 if nulls_first else 3)
+        return key, bucket.astype(jnp.int8)
+    key = col.data
     if not ascending:
         key = ~key  # bitwise NOT reverses order with no overflow
-    null_bucket = jnp.where(col.validity, 0, -1 if nulls_first else 1)
+    null_bucket = jnp.where(col.validity, 0, -3 if nulls_first else 3)
     return key, null_bucket.astype(jnp.int8)
 
 

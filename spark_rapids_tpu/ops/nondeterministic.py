@@ -1,0 +1,179 @@
+"""Nondeterministic expressions — the ``GpuRandomExpressions`` family.
+
+Rand / SparkPartitionID / MonotonicallyIncreasingID
+(``GpuRandomExpressions.scala:75``, ``GpuSparkPartitionID``,
+``GpuMonotonicallyIncreasingID``). Evaluation context (partition index and
+the running row offset within the partition) is threaded by the PROJECT
+execs through :func:`eval_context` — the analog of the reference reading
+``TaskContext.partitionId()``.
+
+Rand here is hash-counter based (murmur-mixed (seed, partition, global
+row)): deterministic, uniform, identical on the CPU and device paths — but
+NOT Spark's XORShiftRandom sequence. The reference's Rand has the same
+stance (nondeterministic expressions are replaced without sequence
+compatibility)."""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, HostBatch
+from ..data.column import DeviceColumn
+from .expression import Expression, make_column
+
+_CTX = threading.local()
+
+
+class eval_context:
+    """Project execs set this around expression evaluation; nested use is
+    not needed (projections don't nest)."""
+
+    def __init__(self, partition_id: int, row_base):
+        self.partition_id = partition_id
+        self.row_base = row_base  # int (host path) or int64 scalar (device)
+
+    def __enter__(self):
+        _CTX.current = self
+        return self
+
+    def __exit__(self, *exc):
+        _CTX.current = None
+
+
+def _current() -> "eval_context":
+    ctx = getattr(_CTX, "current", None)
+    return ctx if ctx is not None else eval_context(0, 0)
+
+
+class Rand(Expression):
+    """rand(seed): uniform [0, 1) per row."""
+
+    def __init__(self, seed: int = 0):
+        self.children = []
+        self.seed = int(seed)
+
+    @property
+    def data_type(self):
+        return T.DOUBLE
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return Rand(self.seed)
+
+    def _salt(self, partition_id: int) -> int:
+        return (self.seed * 0x9E3779B97F4A7C15
+                + partition_id * 0xD1B54A32D192ED03) & 0xFFFFFFFFFFFFFFFF
+
+    def _bits_np(self, n: int) -> np.ndarray:
+        ctx = _current()
+        idx = np.arange(n, dtype=np.uint64) + np.uint64(int(ctx.row_base))
+        x = idx ^ np.uint64(self._salt(ctx.partition_id))
+        x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+        x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+        x = x ^ (x >> np.uint64(31))
+        return x
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        bits = self._bits_np(batch.num_rows)
+        vals = (bits >> np.uint64(11)).astype(np.float64) / float(1 << 53)
+        return pa.array(vals)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        # ctx values may be TRACERS (the project exec passes them as kernel
+        # arguments so one compile serves every partition/batch); all math
+        # below is traced-compatible and matches the uint64 host path
+        # bit-for-bit via int64 wraparound + arithmetic-shift masking.
+        ctx = _current()
+        n = batch.capacity
+        base = jnp.asarray(ctx.row_base, jnp.int64)
+        idx = jnp.arange(n, dtype=jnp.int64) + base
+
+        def s64(u):
+            return u - (1 << 64) if u >= (1 << 63) else u
+        seed_term = s64((self.seed * 0x9E3779B97F4A7C15)
+                        & 0xFFFFFFFFFFFFFFFF)
+        salt = jnp.asarray(seed_term, jnp.int64) \
+            + jnp.asarray(ctx.partition_id, jnp.int64) \
+            * jnp.asarray(s64(0xD1B54A32D192ED03), jnp.int64)
+        x = idx ^ salt
+        x = (x ^ ((x >> 30) & 0x3FFFFFFFF)) * (-4658895280553007687)
+        x = (x ^ ((x >> 27) & 0x1FFFFFFFFF)) * (-7723592293110705685)
+        x = x ^ ((x >> 31) & 0x1FFFFFFFF)
+        # top 53 bits -> [0, 1)
+        bits53 = (x >> 11) & ((1 << 53) - 1)
+        vals = bits53.astype(jnp.float64) / float(1 << 53)
+        return make_column(vals, batch.row_mask(), T.DOUBLE)
+
+
+class SparkPartitionID(Expression):
+    """spark_partition_id()."""
+
+    def __init__(self):
+        self.children = []
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return SparkPartitionID()
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        pid = _current().partition_id
+        return pa.array(np.full(batch.num_rows, pid, np.int32))
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        pid = jnp.asarray(_current().partition_id, jnp.int32)
+        data = jnp.broadcast_to(pid, (batch.capacity,))
+        return make_column(data, batch.row_mask(), T.INT)
+
+
+class MonotonicallyIncreasingID(Expression):
+    """monotonically_increasing_id(): (partition << 33) + row-in-partition
+    (Spark's exact layout)."""
+
+    def __init__(self):
+        self.children = []
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return MonotonicallyIncreasingID()
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        ctx = _current()
+        base = (ctx.partition_id << 33) + int(ctx.row_base)
+        return pa.array(base + np.arange(batch.num_rows, dtype=np.int64))
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        ctx = _current()
+        base = jnp.asarray(ctx.row_base, jnp.int64) \
+            + (jnp.asarray(ctx.partition_id, jnp.int64) << 33)
+        data = base + jnp.arange(batch.capacity, dtype=jnp.int64)
+        data = jnp.where(batch.row_mask(), data, 0)
+        return make_column(data, batch.row_mask(), T.LONG)
+
+
+def has_nondeterministic(expr) -> bool:
+    if isinstance(expr, (Rand, SparkPartitionID, MonotonicallyIncreasingID)):
+        return True
+    return any(has_nondeterministic(c) for c in expr.children)

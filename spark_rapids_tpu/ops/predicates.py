@@ -296,3 +296,39 @@ class In(Expression):
             found = found | (c.data == jnp.asarray(x, dtype=c.data.dtype))
         validity = c.validity & (found | (not has_null))
         return make_column(found, validity, T.BOOLEAN)
+
+
+class AtLeastNNonNulls(Expression):
+    """at_least_n_non_nulls(n, e1, e2, ...) — used by df.na.drop
+    (reference GpuAtLeastNNonNulls in nullExpressions.scala)."""
+
+    def __init__(self, n: int, *children: Expression):
+        self.n = int(n)
+        self.children = list(children)
+
+    @property
+    def data_type(self):
+        return T.BOOLEAN
+
+    @property
+    def nullable(self):
+        return False
+
+    def with_children(self, children):
+        return AtLeastNNonNulls(self.n, *children)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        import numpy as np
+        from .expression import host_to_array
+        count = np.zeros(batch.num_rows, np.int32)
+        for c in self.children:
+            v = host_to_array(c.eval_host(batch), batch.num_rows)
+            count += np.asarray(v.is_valid()).astype(np.int32)
+        return pa.array(count >= self.n)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        count = jnp.zeros(batch.capacity, jnp.int32)
+        for c in self.children:
+            col = c.eval_device(batch)
+            count = count + col.validity.astype(jnp.int32)
+        return make_column(count >= self.n, batch.row_mask(), T.BOOLEAN)

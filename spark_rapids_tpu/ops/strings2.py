@@ -1,0 +1,478 @@
+"""String function family, part 2 — the rest of ``stringFunctions.scala``.
+
+Covers Replace, LPad/RPad, Locate, InitCap, SubstringIndex, Reverse,
+StringRepeat, and literal-pattern RegExpReplace (the reference's
+``GpuStringReplace``/``GpuStringLocate``/``GpuInitCap`` etc.,
+``stringFunctions.scala:862``). All device kernels run over char matrices
+and route through :func:`..strings_util.map_string_column`, so
+dictionary-encoded columns (the upload default) transform their SMALL
+dictionary once and keep their codes — a 1M-row replace costs O(dict).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, HostBatch
+from ..data.column import DeviceColumn, bucket_capacity
+from .expression import Expression, host_to_array, make_column
+from .kernels.rowops import strings_from_matrix
+from .strings_util import PAD, char_matrix, lengths, map_string_column
+
+
+def _needle_rows(m: jnp.ndarray, needle: bytes):
+    """raw[i, j] = needle matches at byte position j of row i."""
+    n, w = m.shape
+    ls = len(needle)
+    if ls == 0 or ls > w:
+        return jnp.zeros((n, w), jnp.bool_)
+    ok = jnp.ones((n, w), jnp.bool_)
+    idx = jnp.arange(w, dtype=jnp.int32)
+    for k, ch in enumerate(needle):
+        shifted = jnp.take(m, jnp.clip(idx + k, 0, w - 1), axis=1)
+        ok = ok & (shifted == ch) & ((idx + k) < w)[None, :]
+    return ok
+
+
+class _StringUnaryBase(Expression):
+    @property
+    def data_type(self):
+        return T.STRING
+
+
+class StringReplace(Expression):
+    """replace(str, search, replace) with literal search/replace
+    (GpuStringReplace: the reference also requires literals)."""
+
+    def __init__(self, child: Expression, search: str, replace: str):
+        self.children = [child]
+        self.search = search
+        self.replace = replace
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return StringReplace(children[0], self.search, self.replace)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        return pc.replace_substring(v, pattern=self.search,
+                                    replacement=self.replace)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+        search = self.search.encode()
+        rep = np.frombuffer(self.replace.encode(), np.uint8).astype(np.int16)
+
+        def xform(col: DeviceColumn) -> DeviceColumn:
+            m = char_matrix(col)
+            ln = lengths(col)
+            n, w = m.shape
+            ls, lr = len(search), len(rep)
+            if ls == 0 or ls > w:
+                return strings_from_matrix(m, col.validity, col.max_bytes)
+            raw = _needle_rows(m, search) & \
+                ((jnp.arange(w, dtype=jnp.int32)[None, :] + ls)
+                 <= ln[:, None])
+            # left-to-right non-overlapping match starts
+            blocked_until = jnp.zeros(n, jnp.int32)
+            starts = []
+            for j in range(w):
+                s = raw[:, j] & (j >= blocked_until)
+                blocked_until = jnp.where(s, j + ls, blocked_until)
+                starts.append(s)
+            start_m = jnp.stack(starts, axis=1)  # [n, w]
+            # positions covered by a match but not its start contribute 0
+            cover = jnp.zeros(n, jnp.int32)
+            covered = []
+            for j in range(w):
+                is_cov = j < cover
+                cover = jnp.where(start_m[:, j], j + ls, cover)
+                covered.append(is_cov)
+            covered_m = jnp.stack(covered, axis=1)
+            contrib = jnp.where(start_m, lr,
+                                jnp.where(covered_m, 0, 1)).astype(jnp.int32)
+            in_str = jnp.arange(w, dtype=jnp.int32)[None, :] < ln[:, None]
+            contrib = jnp.where(in_str, contrib, 0)
+            out_pos = jnp.cumsum(contrib, axis=1) - contrib  # exclusive
+            out_len = jnp.sum(contrib, axis=1)
+            w_out = w if lr <= ls else w + (w // max(ls, 1)) * (lr - ls)
+            out = jnp.full((n, w_out), PAD, jnp.int16)
+            oidx = jnp.arange(w_out, dtype=jnp.int32)[None, :]
+            for j in range(w):
+                pos_j = out_pos[:, j][:, None]
+                cj = contrib[:, j][:, None]
+                sel = (oidx >= pos_j) & (oidx < pos_j + cj)
+                if lr:
+                    rep_char = jnp.take(
+                        jnp.asarray(rep),
+                        jnp.clip(oidx - pos_j, 0, lr - 1), axis=0)
+                else:
+                    rep_char = jnp.zeros_like(oidx, dtype=jnp.int16)
+                val = jnp.where(start_m[:, j][:, None], rep_char,
+                                m[:, j][:, None])
+                out = jnp.where(sel, val, out)
+            live = oidx < out_len[:, None]
+            out = jnp.where(live, out, PAD)
+            return strings_from_matrix(
+                jnp.where(col.validity[:, None], out, PAD), col.validity,
+                bucket_capacity(w_out, 8))
+        return map_string_column(c, xform)
+
+
+class RegExpReplace(Expression):
+    """regexp_replace with a LITERAL (regex-metachar-free) pattern lowers
+    to StringReplace, like the reference's GpuStringReplace rule for
+    GpuRegExpReplace (conditionalsToStringReplace). Patterns with real
+    regex syntax are tagged unsupported and fall back."""
+
+    _META = re.compile(r"[.^$*+?{}\[\]\\|()]")
+
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        self.children = [child]
+        self.pattern = pattern
+        self.replacement = replacement
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    @property
+    def is_literal_pattern(self) -> bool:
+        return not self._META.search(self.pattern)
+
+    def with_children(self, children):
+        return RegExpReplace(children[0], self.pattern, self.replacement)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        return pc.replace_substring_regex(v, pattern=self.pattern,
+                                          replacement=self.replacement)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        if not self.is_literal_pattern:
+            raise NotImplementedError("regex patterns run on CPU")
+        return StringReplace(self.children[0], self.pattern,
+                             self.replacement).eval_device(batch)
+
+
+class _Pad(Expression):
+    left = True
+
+    def __init__(self, child: Expression, length: int, pad: str = " "):
+        self.children = [child]
+        self.length = int(length)
+        self.pad = pad
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return type(self)(children[0], self.length, self.pad)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        out = []
+        for s in v.to_pylist():
+            if s is None:
+                out.append(None)
+            elif len(s) >= self.length or not self.pad:
+                out.append(s[: max(self.length, 0)])
+            else:
+                need = self.length - len(s)
+                pad = (self.pad * (need // len(self.pad) + 1))[:need]
+                out.append(pad + s if self.left else s + pad)
+        return pa.array(out, type=pa.string())
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+        target = self.length
+        pad = np.frombuffer((self.pad or " ").encode(), np.uint8) \
+            .astype(np.int16)
+
+        def xform(col: DeviceColumn) -> DeviceColumn:
+            m = char_matrix(col)
+            ln = jnp.minimum(lengths(col), m.shape[1])
+            n, w = m.shape
+            oidx = jnp.arange(max(target, 1), dtype=jnp.int32)[None, :]
+            if not pad.size:
+                # Empty pad: Spark just truncates, never extends.
+                out_len = jnp.minimum(ln, target)
+                s_char = jnp.take_along_axis(
+                    m, jnp.clip(oidx, 0, w - 1), axis=1) if w else m
+                out = jnp.where(oidx < out_len[:, None], s_char, PAD)
+                return strings_from_matrix(
+                    jnp.where(col.validity[:, None], out, PAD),
+                    col.validity, bucket_capacity(max(target, 1), 8))
+            pad_n = jnp.maximum(target - ln, 0)
+            if self.left:
+                src = oidx - pad_n[:, None]
+                in_pad = oidx < pad_n[:, None]
+            else:
+                src = oidx
+                in_pad = (oidx >= ln[:, None]) & (oidx < target)
+            s_char = jnp.take_along_axis(
+                m, jnp.clip(src, 0, w - 1), axis=1) if w else m
+            p_char = jnp.take(jnp.asarray(pad),
+                              (oidx if self.left
+                               else oidx - ln[:, None]) % len(pad), axis=0)
+            val = jnp.where(in_pad, p_char, s_char)
+            out = jnp.where(oidx < target, val, PAD)
+            return strings_from_matrix(
+                jnp.where(col.validity[:, None], out, PAD), col.validity,
+                bucket_capacity(max(target, 1), 8))
+        return map_string_column(c, xform)
+
+
+class LPad(_Pad):
+    left = True
+
+
+class RPad(_Pad):
+    left = False
+
+
+class StringLocate(Expression):
+    """locate(substr, str[, pos]) — 1-based first occurrence at/after pos,
+    0 when absent, null on null input (byte positions)."""
+
+    def __init__(self, substr: str, child: Expression, pos: int = 1):
+        self.children = [child]
+        self.substr = substr
+        self.pos = int(pos)
+
+    @property
+    def data_type(self):
+        return T.INT
+
+    def with_children(self, children):
+        return StringLocate(self.substr, children[0], self.pos)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        if self.pos < 1:
+            zeros = pa.array(np.zeros(batch.num_rows, np.int32))
+            return pc.if_else(pc.is_valid(v), zeros,
+                              pa.nulls(batch.num_rows, pa.int32()))
+        found = pc.find_substring(
+            pc.utf8_slice_codeunits(v, self.pos - 1, 2 ** 30),
+            pattern=self.substr)
+        res = pc.if_else(pc.equal(found, -1), 0,
+                         pc.add(found, self.pos))
+        return res.cast(pa.int32())
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+        needle = self.substr.encode()
+        m = char_matrix(c)
+        ln = lengths(c)
+        n, w = m.shape
+        if self.pos < 1:
+            return make_column(jnp.zeros(c.capacity, jnp.int32),
+                               c.validity, T.INT)
+        if len(needle) == 0:
+            res = jnp.full(c.capacity, self.pos, jnp.int32)
+            return make_column(res, c.validity, T.INT)
+        raw = _needle_rows(m, needle)
+        idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+        ok = raw & ((idx + len(needle)) <= ln[:, None]) \
+            & (idx >= self.pos - 1)
+        first = jnp.min(jnp.where(ok, idx, w), axis=1)
+        res = jnp.where(first < w, first + 1, 0).astype(jnp.int32)
+        return make_column(res, c.validity, T.INT)
+
+
+class InitCap(_StringUnaryBase):
+    """initcap: first letter of each whitespace-separated word upper,
+    rest lower (ASCII)."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def with_children(self, children):
+        return InitCap(children[0])
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        vals = v.to_pylist()
+        out = []
+        for s in vals:
+            if s is None:
+                out.append(None)
+            else:
+                out.append(" ".join(
+                    p[:1].upper() + p[1:].lower() if p else p
+                    for p in s.split(" ")))
+        return pa.array(out, type=pa.string())
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+
+        def xform(col: DeviceColumn) -> DeviceColumn:
+            m = char_matrix(col)
+            is_lower = (m >= ord("a")) & (m <= ord("z"))
+            is_upper = (m >= ord("A")) & (m <= ord("Z"))
+            sep = m == ord(" ")
+            prev_sep = jnp.concatenate(
+                [jnp.ones((m.shape[0], 1), jnp.bool_), sep[:, :-1]], axis=1)
+            up = jnp.where(prev_sep & is_lower, m - 32, m)
+            down = jnp.where(~prev_sep & is_upper, up + 32, up)
+            return strings_from_matrix(down.astype(jnp.int16), col.validity,
+                                       col.max_bytes)
+        return map_string_column(c, xform)
+
+
+class SubstringIndex(Expression):
+    """substring_index(str, delim, count): prefix before the count-th
+    delimiter (count>0) or suffix after the |count|-th-from-end (count<0);
+    whole string when fewer delimiters."""
+
+    def __init__(self, child: Expression, delim: str, count: int):
+        self.children = [child]
+        self.delim = delim
+        self.count = int(count)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return SubstringIndex(children[0], self.delim, self.count)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        vals = v.to_pylist()
+        out = []
+        for s in vals:
+            if s is None:
+                out.append(None)
+            elif not self.delim or self.count == 0:
+                out.append("")
+            elif self.count > 0:
+                out.append(self.delim.join(
+                    s.split(self.delim)[: self.count]))
+            else:
+                out.append(self.delim.join(
+                    s.split(self.delim)[self.count:]))
+        return pa.array(out, type=pa.string())
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+        delim = self.delim.encode()
+        count = self.count
+
+        def xform(col: DeviceColumn) -> DeviceColumn:
+            m = char_matrix(col)
+            ln = lengths(col)
+            n, w = m.shape
+            if not delim or count == 0:
+                empty = jnp.full((n, 1), PAD, jnp.int16)
+                return strings_from_matrix(empty, col.validity, 8)
+            ld = len(delim)
+            idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+            raw = _needle_rows(m, delim) & ((idx + ld) <= ln[:, None])
+            # non-overlapping occurrences, left to right
+            blocked = jnp.zeros(n, jnp.int32)
+            occs = []
+            for j in range(w):
+                s = raw[:, j] & (j >= blocked)
+                blocked = jnp.where(s, j + ld, blocked)
+                occs.append(s)
+            occ = jnp.stack(occs, axis=1)
+            occ_cum = jnp.cumsum(occ.astype(jnp.int32), axis=1)
+            total = occ_cum[:, -1]
+            if count > 0:
+                kth = jnp.min(jnp.where(occ & (occ_cum == count), idx, w),
+                              axis=1)
+                new_len = jnp.where(total >= count, kth, ln)
+                shifted = m
+            else:
+                target = total + count + 1  # occurrence index to cut AFTER
+                kth = jnp.min(
+                    jnp.where(occ & (occ_cum == target[:, None]), idx, w),
+                    axis=1)
+                start = jnp.where(total >= -count, kth + ld, 0)
+                src = jnp.clip(idx + start[:, None], 0, w - 1)
+                shifted = jnp.take_along_axis(m, src, axis=1)
+                new_len = ln - start
+            live = idx < new_len[:, None]
+            out = jnp.where(live, shifted, PAD)
+            return strings_from_matrix(
+                jnp.where(col.validity[:, None], out, PAD), col.validity,
+                col.max_bytes)
+        return map_string_column(c, xform)
+
+
+class Reverse(_StringUnaryBase):
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    def with_children(self, children):
+        return Reverse(children[0])
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        return pc.binary_reverse(v.cast(pa.binary())).cast(pa.string())
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+
+        def xform(col: DeviceColumn) -> DeviceColumn:
+            m = char_matrix(col)
+            ln = lengths(col)
+            n, w = m.shape
+            idx = jnp.arange(w, dtype=jnp.int32)[None, :]
+            src = jnp.clip(ln[:, None] - 1 - idx, 0, w - 1)
+            rev = jnp.take_along_axis(m, src, axis=1)
+            live = idx < ln[:, None]
+            return strings_from_matrix(jnp.where(live, rev, PAD),
+                                       col.validity, col.max_bytes)
+        return map_string_column(c, xform)
+
+
+class StringRepeat(Expression):
+    """repeat(str, n) with a literal n."""
+
+    def __init__(self, child: Expression, n: int):
+        self.children = [child]
+        self.n = max(int(n), 0)
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    def with_children(self, children):
+        return StringRepeat(children[0], self.n)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        return pc.binary_repeat(v.cast(pa.binary()), self.n) \
+            .cast(pa.string())
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        c = self.children[0].eval_device(batch)
+        reps = self.n
+
+        def xform(col: DeviceColumn) -> DeviceColumn:
+            m = char_matrix(col)
+            ln = lengths(col)
+            n, w = m.shape
+            w_out = max(w * reps, 1)
+            idx = jnp.arange(w_out, dtype=jnp.int32)[None, :]
+            src = idx % jnp.maximum(ln[:, None], 1)
+            out = jnp.take_along_axis(m, jnp.clip(src, 0, w - 1), axis=1)
+            live = idx < (ln * reps)[:, None]
+            return strings_from_matrix(jnp.where(live, out, PAD),
+                                       col.validity,
+                                       bucket_capacity(w_out, 8))
+        return map_string_column(c, xform)

@@ -49,6 +49,27 @@ def _matrix_from_offsets(payload: jnp.ndarray, offsets: jnp.ndarray,
     return jnp.where(in_range, chars, PAD)
 
 
+def map_string_column(col: DeviceColumn, fn) -> DeviceColumn:
+    """Apply a string->string transform ``fn(flat_col) -> flat_col``.
+
+    Dictionary-encoded inputs transform their (small) DICTIONARY once and
+    keep the codes — a 1M-row replace/pad/initcap costs O(dict). The
+    result dictionary loses the sorted/unique property (fn may collide or
+    reorder entries), so downstream falls back to char comparisons."""
+    import jax.numpy as _jnp
+    if col.is_dict:
+        dcol = DeviceColumn(
+            data=col.data,
+            validity=_jnp.ones(col.dict_size, _jnp.bool_),
+            dtype=col.dtype, offsets=col.offsets, max_bytes=col.max_bytes)
+        out = fn(dcol)
+        return DeviceColumn(
+            data=out.data, validity=col.validity, dtype=col.dtype,
+            offsets=out.offsets, max_bytes=out.max_bytes,
+            codes=col.codes, dict_sorted=False)
+    return fn(col)
+
+
 def lengths(col: DeviceColumn) -> jnp.ndarray:
     """Byte length per row, int32[capacity]."""
     per = col.offsets[1:] - col.offsets[:-1]

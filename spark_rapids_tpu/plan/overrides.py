@@ -57,8 +57,30 @@ def _expr(cls, name=None, incompat=False, disabled=False, tag=None):
     EXPR_RULES[cls] = ExprRule(name or cls.__name__, incompat, disabled, tag)
 
 
-for _cls in [AttributeReference, BoundReference, Literal, Alias, Cast]:
+def _cast_tag(e: Expression, conf: TpuConf) -> Optional[str]:
+    """Conf gates on the inexact cast paths (reference GpuCast checks via
+    RapidsConf.scala:395-425)."""
+    from ..config import CAST_STRING_TO_FLOAT, CAST_STRING_TO_TIMESTAMP
+    src = e.child.data_type
+    to = e.to
+    if src is T.STRING and to.name in ("float", "double") \
+            and not conf.get(CAST_STRING_TO_FLOAT):
+        return ("string->float cast differs on edge cases; set "
+                "spark.rapids.sql.castStringToFloat.enabled=true")
+    if src is T.STRING and to is T.TIMESTAMP \
+            and not conf.get(CAST_STRING_TO_TIMESTAMP):
+        return ("string->timestamp cast supports fixed formats only; set "
+                "spark.rapids.sql.castStringToTimestamp.enabled=true")
+    if src.name in ("float", "double") and to is T.STRING:
+        # Java shortest-roundtrip float formatting has no device kernel.
+        return ("float->string cast is not supported on the device "
+                "(reference gates it behind castFloatToString)")
+    return None
+
+
+for _cls in [AttributeReference, BoundReference, Literal, Alias]:
     _expr(_cls)
+_expr(Cast, tag=_cast_tag)
 for _cls in [ARITH.Add, ARITH.Subtract, ARITH.Multiply, ARITH.Divide,
              ARITH.IntegralDivide, ARITH.Remainder, ARITH.Pmod,
              ARITH.UnaryMinus, ARITH.Abs]:
@@ -111,6 +133,39 @@ for _cls in [DT.Year, DT.Month, DT.DayOfMonth, DT.Quarter, DT.DayOfYear,
 for _cls in [BIT.BitwiseAnd, BIT.BitwiseOr, BIT.BitwiseXor, BIT.BitwiseNot,
              BIT.ShiftLeft, BIT.ShiftRight, BIT.ShiftRightUnsigned]:
     _expr(_cls)
+
+from ..ops import nondeterministic as ND  # noqa: E402
+from ..ops import strings2 as STR2  # noqa: E402
+
+for _cls in [STR2.StringReplace, STR2.LPad, STR2.RPad, STR2.StringLocate,
+             STR2.InitCap, STR2.SubstringIndex, STR2.Reverse,
+             STR2.StringRepeat]:
+    _expr(_cls)
+
+
+def _regexp_tag(e: "STR2.RegExpReplace", conf: TpuConf) -> Optional[str]:
+    if not e.is_literal_pattern:
+        return ("regexp_replace with regex metacharacters runs on CPU "
+                "(the reference lowers only literal patterns, "
+                "GpuStringReplace rule)")
+    return None
+
+
+_expr(STR2.RegExpReplace, tag=_regexp_tag)
+for _cls in [ND.Rand, ND.SparkPartitionID, ND.MonotonicallyIncreasingID]:
+    _expr(_cls)
+_expr(PRED.AtLeastNNonNulls)
+
+
+def _unix_ts_tag(e, conf: TpuConf) -> Optional[str]:
+    if not e.is_default_format:
+        return ("only the default 'yyyy-MM-dd HH:mm:ss' pattern runs on "
+                "the device (reference fixed-format stance)")
+    return None
+
+
+_expr(DT.UnixTimestamp, tag=_unix_ts_tag)
+_expr(DT.FromUnixTime, tag=_unix_ts_tag)
 
 
 # ---------------------------------------------------------------------------

@@ -196,14 +196,31 @@ class CpuProjectExec(PhysicalPlan):
 
     def execute(self, ctx):
         arrow = _arrow_schema(self.schema)
+        from ..ops import nondeterministic as ND
+        nondet = any(ND.has_nondeterministic(e) for e in self.exprs)
 
-        def run(part):
+        def run(part, pidx):
+            row_base = 0
+            for hb in part:
+                with ND.eval_context(pidx, row_base):
+                    arrays = [
+                        host_to_array(e.eval_host(hb),
+                                      hb.num_rows).cast(f.type)
+                        for e, f in zip(self.exprs, arrow)]
+                row_base += hb.num_rows
+                yield HostBatch(pa.RecordBatch.from_arrays(arrays,
+                                                           schema=arrow))
+
+        def run_plain(part):
             for hb in part:
                 arrays = [
                     host_to_array(e.eval_host(hb), hb.num_rows).cast(f.type)
                     for e, f in zip(self.exprs, arrow)]
                 yield HostBatch(pa.RecordBatch.from_arrays(arrays, schema=arrow))
-        return [run(p) for p in self.children[0].execute(ctx)]
+        parts = self.children[0].execute(ctx)
+        if nondet:
+            return [run(p, i) for i, p in enumerate(parts)]
+        return [run_plain(p) for p in parts]
 
 
 class CpuFilterExec(PhysicalPlan):

@@ -108,17 +108,30 @@ class TpuSession:
 
     def _run_with_retries(self, fn, eager_only: bool = False):
         """Run ``fn(ctx, mode) -> (result, overflowed)`` through the retry
-        ladder; return the first non-overflowed result."""
+        ladder; return the first non-overflowed result. The axon remote
+        compile helper occasionally fails transiently (worker-hostname env
+        races, helper restarts); those retry in place."""
         attempts = (("eager", 1.0),) if eager_only else self._ATTEMPTS
         for mode, growth in attempts:
-            ctx = P.ExecContext(self.conf,
-                                catalog=self.device_manager.catalog)
-            ctx.join_growth = growth
-            ctx.eager_overflow = mode == "eager"
-            try:
-                result, overflowed = fn(ctx, mode)
-            finally:
-                ctx.close()
+            for compile_try in range(3):
+                ctx = P.ExecContext(self.conf,
+                                    catalog=self.device_manager.catalog)
+                ctx.join_growth = growth
+                ctx.eager_overflow = mode == "eager"
+                try:
+                    # Task admission: bound concurrent queries holding the
+                    # device (GpuSemaphore.acquireIfNecessary analog; conf
+                    # spark.rapids.sql.concurrentTpuTasks).
+                    with self.device_manager.semaphore:
+                        result, overflowed = fn(ctx, mode)
+                    break
+                except Exception as e:  # noqa: BLE001 - filtered below
+                    transient = "remote_compile" in str(e) \
+                        or "tpu_compile_helper" in str(e)
+                    if not transient or compile_try == 2:
+                        raise
+                finally:
+                    ctx.close()
             if not overflowed:
                 return result
         raise AssertionError("unreachable: eager join path cannot overflow")

@@ -1,0 +1,180 @@
+"""Differential tests for the VERDICT-#6 expression push: string function
+family part 2, Unix time conversions, nondeterministic expressions, and
+AtLeastNNonNulls."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops import strings2 as S2
+from spark_rapids_tpu.ops.datetime import FromUnixTime, UnixTimestamp
+from spark_rapids_tpu.ops.expression import col
+from spark_rapids_tpu.ops.nondeterministic import (
+    MonotonicallyIncreasingID, Rand, SparkPartitionID)
+
+from harness import assert_tpu_and_cpu_are_equal
+
+STRS = ["hello world", "aXbXcXd", "", "X", "XXX", "no matches here",
+        None, "  padded  ", "tail X", "X head", "ab", "overlapXXXover"]
+
+
+def _df(s):
+    return s.create_dataframe({"s": STRS})
+
+
+class TestStringFunctions2:
+    def test_replace(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", S2.StringReplace(col("s"), "X", "++")).select(col("r")))
+
+    def test_replace_shrinking(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", S2.StringReplace(col("s"), "ll", "")).select(col("r")))
+
+    def test_regexp_replace_literal(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", S2.RegExpReplace(col("s"), "X", "_")).select(col("r")))
+
+    def test_regexp_replace_regex_falls_back(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", S2.RegExpReplace(col("s"), "[lX]+", "_"))
+            .select(col("r")),
+            allowed_non_tpu=["CpuProjectExec"])
+
+    @pytest.mark.parametrize("cls", [S2.LPad, S2.RPad])
+    def test_pad(self, cls):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", cls(col("s"), 8, "*-")).select(col("r")))
+
+    def test_pad_truncates(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", S2.LPad(col("s"), 3, "z")).select(col("r")))
+
+    def test_locate(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", S2.StringLocate("X", col("s"))).select(col("r")))
+
+    def test_locate_from_pos(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", S2.StringLocate("X", col("s"), 3)).select(col("r")))
+
+    def test_initcap(self):
+        data = ["hello world", "ALL CAPS", "miXed CaSe words", "", None,
+                " leading", "a b c"]
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe({"s": data}).with_column(
+                "r", S2.InitCap(col("s"))).select(col("r")))
+
+    @pytest.mark.parametrize("count", [1, 2, -1, -2, 0, 5])
+    def test_substring_index(self, count):
+        data = ["a.b.c.d", "nodots", ".", "a.", ".b", "", None, "x.y"]
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe({"s": data}).with_column(
+                "r", S2.SubstringIndex(col("s"), ".", count))
+            .select(col("r")))
+
+    def test_reverse(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", S2.Reverse(col("s"))).select(col("r")))
+
+    def test_repeat(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).with_column(
+                "r", S2.StringRepeat(col("s"), 2)).select(col("r")))
+
+
+class TestUnixTime:
+    def test_unix_timestamp_of_timestamp(self):
+        us = pa.array([0, 1_700_000_000_123_456, -5_000_000, None],
+                      type=pa.int64()).cast(pa.timestamp("us"))
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(
+                pa.RecordBatch.from_arrays([us], names=["t"]))
+            .with_column("r", UnixTimestamp(col("t"))).select(col("r")))
+
+    def test_unix_timestamp_of_date(self):
+        d = pa.array([0, 19000, None, -200], type=pa.int32()) \
+            .cast(pa.date32())
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(
+                pa.RecordBatch.from_arrays([d], names=["t"]))
+            .with_column("r", UnixTimestamp(col("t"))).select(col("r")))
+
+    def test_unix_timestamp_of_string(self):
+        data = ["2024-01-31 12:34:56", "1970-01-01 00:00:00", "garbage",
+                None, "2033-05-18 03:33:20"]
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe({"t": data})
+            .with_column("r", UnixTimestamp(col("t"))).select(col("r")))
+
+    def test_from_unixtime(self):
+        data = [0, 1_700_000_000, 86399, None, 2_000_000_000]
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe({"t": data})
+            .with_column("r", FromUnixTime(col("t"))).select(col("r")))
+
+    def test_nondefault_format_falls_back(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe({"t": [0, 100]})
+            .with_column("r", FromUnixTime(col("t"), "yyyy"))
+            .select(col("r")),
+            allowed_non_tpu=["CpuProjectExec"])
+
+
+class TestNondeterministic:
+    def test_rand_cpu_tpu_identical(self):
+        # Hash-counter Rand: deterministic and identical across paths
+        # (documented: distribution-compatible, not Spark's sequence).
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe({"a": list(range(500))})
+            .with_column("r", Rand(seed=42)).select(col("r")))
+
+    def test_rand_distribution(self):
+        from spark_rapids_tpu.session import TpuSession
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        vals = (s.create_dataframe({"a": list(range(20_000))})
+                .with_column("r", Rand(7)).select(col("r"))
+                .collect().column("r").to_pylist())
+        arr = np.asarray(vals)
+        assert 0.0 <= arr.min() and arr.max() < 1.0
+        assert abs(arr.mean() - 0.5) < 0.02
+        assert len(np.unique(arr)) > 19_900
+
+    def test_partition_id_and_monotonic_id(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe({"a": list(range(100))})
+            .with_column("p", SparkPartitionID())
+            .with_column("m", MonotonicallyIncreasingID())
+            .select(col("p"), col("m")))
+
+    def test_monotonic_id_unique(self):
+        from spark_rapids_tpu.session import TpuSession
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        vals = (s.create_dataframe({"a": list(range(5000))})
+                .with_column("m", MonotonicallyIncreasingID())
+                .select(col("m")).collect().column("m").to_pylist())
+        assert len(set(vals)) == 5000
+
+
+class TestAtLeastNNonNulls:
+    def test_na_drop_shape(self):
+        data = {
+            "a": [1, None, 3, None, 5],
+            "b": [1.0, 2.0, None, None, 5.0],
+            "c": ["x", None, None, None, "y"],
+        }
+        for n in (1, 2, 3):
+            assert_tpu_and_cpu_are_equal(
+                lambda s, n=n: s.create_dataframe(data).where(
+                    P.AtLeastNNonNulls(n, col("a"), col("b"), col("c"))))

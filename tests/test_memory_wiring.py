@@ -1,0 +1,102 @@
+"""VERDICT #7 wiring tests: the semaphore gates query execution, execs
+record metrics, and the memory hazards (join build side, sort concat,
+broadcast cache) are registered with the spill catalog so a tiny device
+budget forces real spills without breaking results."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import DEVICE_SPILL_BUDGET
+from spark_rapids_tpu.ops import aggregates as AGG
+from spark_rapids_tpu.ops.expression import col
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.workloads.compare import rows, rows_match
+
+
+def _tiny_budget_session():
+    # ~64KB device budget: a few hundred KB of build batches MUST spill.
+    return TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.memory.tpu.spillBudgetBytes": 65536,
+                       "spark.rapids.tpu.fusion.enabled": False})
+
+
+def _join_query(s, n=20_000, m=6_000):
+    rng = np.random.default_rng(3)
+    probe = pa.RecordBatch.from_pydict({
+        "k": rng.integers(0, m, n).astype(np.int64),
+        "v": rng.integers(0, 100, n).astype(np.int64),
+    })
+    # Build side in many small batches so accumulation spills.
+    builds = [pa.RecordBatch.from_pydict({
+        "k": np.arange(i, m, 8, dtype=np.int64),
+        "w": np.arange(i, m, 8, dtype=np.int64) * 10,
+    }) for i in range(8)]
+    p = s.create_dataframe(probe)
+    b = s.create_dataframe(pa.Table.from_batches(builds))
+    return (p.join(b, on="k", how="inner")
+            .select(col("v"), col("w"))
+            .group_by()
+            .agg(AGG.AggregateExpression(AGG.Count(), "c"),
+                 AGG.AggregateExpression(AGG.Sum(col("w")), "sw")))
+
+
+class TestSpillUnderPressure:
+    def test_join_build_spills_and_passes(self):
+        s = _tiny_budget_session()
+        cpu = TpuSession({"spark.rapids.sql.enabled": False})
+        got = _join_query(s).collect()
+        want = _join_query(cpu).collect()
+        assert rows_match(rows(got), rows(want))
+        stats = s.device_manager.catalog.metrics
+        assert stats["spilled_to_host"] > 0, stats
+
+    def test_sort_input_spills_and_passes(self):
+        from spark_rapids_tpu.plan.logical import SortOrder
+        s = _tiny_budget_session()
+        cpu = TpuSession({"spark.rapids.sql.enabled": False})
+        rng = np.random.default_rng(4)
+        batches = [pa.RecordBatch.from_pydict(
+            {"v": rng.integers(0, 10**6, 4000).astype(np.int64)})
+            for _ in range(6)]
+        tbl = pa.Table.from_batches(batches)
+
+        def q(sess):
+            return sess.create_dataframe(tbl).sort(SortOrder(col("v")))
+        got = q(s).collect().column("v").to_pylist()
+        want = q(cpu).collect().column("v").to_pylist()
+        assert got == want
+
+
+class TestMetrics:
+    def test_metrics_recorded(self):
+        from spark_rapids_tpu.plan import physical as P
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.tpu.fusion.enabled": False})
+        df = (s.create_dataframe({"k": [1, 2, 3] * 100,
+                                  "v": list(range(300))})
+              .where(col("v") > 10)
+              .group_by(col("k"))
+              .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "sv")))
+        physical = s.plan(df._plan)
+        ctx = P.ExecContext(s.conf, catalog=s.device_manager.catalog)
+        P.collect_partitions(physical, ctx)
+        names = set(ctx.metrics)
+        assert any("Filter" in n for n in names), names
+        assert any("HashAggregate" in n for n in names), names
+        d2h = [m for n, m in ctx.metrics.items() if "DeviceToHost" in n]
+        assert d2h and d2h[0]["numOutputRows"] == 3
+        flt = [m for n, m in ctx.metrics.items() if n == "TpuFilter"]
+        assert flt and flt[0]["numOutputBatches"] >= 1
+        assert "opTimeMs" in flt[0]
+
+
+class TestSemaphore:
+    def test_semaphore_cycles_cleanly(self):
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.concurrentTpuTasks": 1})
+        df = s.create_dataframe({"a": [1, 2, 3]})
+        for _ in range(3):
+            df.collect()
+        sem = s.device_manager.semaphore
+        assert sem._sem._value == sem.max_concurrent
