@@ -97,15 +97,7 @@ class ColumnarBatch:
 
     @property
     def device_size_bytes(self) -> int:
-        total = 0
-        for c in self.columns:
-            total += c.data.size * c.data.dtype.itemsize
-            total += c.validity.size
-            if c.offsets is not None:
-                total += c.offsets.size * 4
-            if c.codes is not None:
-                total += c.codes.size * 4
-        return total
+        return sum(c.size_bytes for c in self.columns)
 
 
 @functools.partial(jax.jit, static_argnums=(1,))
@@ -113,17 +105,8 @@ def _shrink_batch(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
     """Copy a batch into a smaller capacity bucket (>= its live rows), so
     downloads move O(live) bytes instead of O(capacity). Rows past n_rows
     are dead by invariant, so a front slice is sufficient."""
-    cols = []
-    for c in batch.columns:
-        if c.is_dict:
-            cols.append(c.replace_rows(c.validity[:cap],
-                                       codes=c.codes[:cap]))
-        elif c.is_string:
-            cols.append(DeviceColumn(c.data, c.validity[:cap], c.dtype,
-                                     c.offsets[: cap + 1], c.max_bytes))
-        else:
-            cols.append(DeviceColumn(c.data[:cap], c.validity[:cap], c.dtype))
-    return ColumnarBatch(tuple(cols), batch.n_rows, batch.schema)
+    return ColumnarBatch(tuple(c.head(cap) for c in batch.columns),
+                         batch.n_rows, batch.schema)
 
 
 @dataclasses.dataclass

@@ -86,9 +86,23 @@ class DeviceColumn:
     codes: Optional[jax.Array] = None
     #: True when the dictionary is unique + sorted ascending (static).
     dict_sorted: bool = False
+    #: ARRAY columns (padded-ragged layout, see types.ArrayType): ``data``
+    #: is ``[capacity, max_len]`` element values, ``elem_validity`` the
+    #: matching element mask, ``lengths`` int32[capacity] live lengths.
+    elem_validity: Optional[jax.Array] = None
+    lengths: Optional[jax.Array] = None
+    #: STRUCT columns (column-shredded, see types.StructType): one child
+    #: DeviceColumn per field; ``data`` is unused, ``validity`` is the
+    #: struct-level null lane.
+    children: tuple = ()
 
     # -- pytree protocol ----------------------------------------------------
     def tree_flatten(self):
+        if self.children:
+            return ((self.validity, self.children), (self.dtype, 5, 0))
+        if self.lengths is not None:
+            return ((self.data, self.validity, self.elem_validity,
+                     self.lengths), (self.dtype, 4, 0))
         if self.offsets is None:
             return (self.data, self.validity), (self.dtype, 0, 0)
         if self.codes is None:
@@ -100,6 +114,14 @@ class DeviceColumn:
     @classmethod
     def tree_unflatten(cls, aux, children):
         dtype, kind, max_bytes = aux
+        if kind == 5:
+            validity, kids = children
+            return cls(data=None, validity=validity, dtype=dtype,
+                       children=tuple(kids))
+        if kind == 4:
+            data, validity, elem_validity, lengths = children
+            return cls(data=data, validity=validity, dtype=dtype,
+                       elem_validity=elem_validity, lengths=lengths)
         if kind == 0:
             data, validity = children
             return cls(data=data, validity=validity, dtype=dtype)
@@ -121,7 +143,26 @@ class DeviceColumn:
         return self.codes is not None
 
     @property
+    def is_array(self) -> bool:
+        return self.lengths is not None
+
+    @property
+    def is_struct(self) -> bool:
+        return bool(self.children)
+
+    @property
+    def is_complex(self) -> bool:
+        return self.is_array or self.is_struct
+
+    @property
+    def max_len(self) -> int:
+        assert self.is_array
+        return int(self.data.shape[1])
+
+    @property
     def capacity(self) -> int:
+        if self.children:
+            return int(self.validity.shape[0])
         if self.codes is not None:
             return int(self.codes.shape[0])
         if self.is_string:
@@ -137,6 +178,23 @@ class DeviceColumn:
     def byte_capacity(self) -> int:
         assert self.is_string
         return int(self.data.shape[0])
+
+    @property
+    def size_bytes(self) -> int:
+        total = self.validity.size
+        if self.data is not None:
+            total += self.data.size * self.data.dtype.itemsize
+        if self.offsets is not None:
+            total += self.offsets.size * 4
+        if self.codes is not None:
+            total += self.codes.size * 4
+        if self.elem_validity is not None:
+            total += self.elem_validity.size
+        if self.lengths is not None:
+            total += self.lengths.size * 4
+        for c in self.children:
+            total += c.size_bytes
+        return total
 
     # -- constructors -------------------------------------------------------
     @staticmethod
@@ -185,25 +243,68 @@ class DeviceColumn:
         JCudfSerialization host buffers in the reference)."""
         dtype = T.from_arrow_type(arr.type)
         arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        if isinstance(dtype, T.ArrayType):
+            return DeviceColumn.array_from_arrow(arr, dtype, capacity)
+        if isinstance(dtype, T.StructType):
+            validity = _arrow_validity(arr)
+            mask = np.zeros(capacity, dtype=np.bool_)
+            mask[: len(arr)] = True if validity is None else validity
+            kids = tuple(DeviceColumn.from_arrow(arr.field(i), capacity)
+                         for i in range(arr.type.num_fields))
+            return DeviceColumn(data=None, validity=jnp.asarray(mask),
+                                dtype=dtype, children=kids)
         if dtype is T.STRING:
             return DeviceColumn.dict_string_from_arrow(arr, capacity)
         if dtype is T.NULL:
             return DeviceColumn.from_numpy(
                 np.zeros(len(arr), dtype=np.int8),
                 np.zeros(len(arr), dtype=np.bool_), T.NULL, capacity)
-        if dtype is T.TIMESTAMP:
-            arr = arr.cast(pa.timestamp("us"))
+        values, validity = _fixed_np_from_arrow(arr, dtype)
+        return DeviceColumn.from_numpy(values, validity, dtype, capacity)
+
+    @staticmethod
+    def array_from_arrow(arr: pa.Array, dtype: "T.ArrayType",
+                         capacity: int) -> "DeviceColumn":
+        """Upload a pyarrow list array in the padded-ragged device layout:
+        ``[capacity, max_len]`` element matrix + element mask + length lane
+        (see types.ArrayType). max_len buckets to a power of two so jit
+        programs are shared across close array sizes."""
+        if pa.types.is_large_list(arr.type):
+            arr = arr.cast(pa.list_(arr.type.value_type))
+        n = len(arr)
         validity = _arrow_validity(arr)
-        # Null slots get a deterministic zero so padded/invalid data never
-        # perturbs hashes or reductions.
-        filled = arr.fill_null(False if dtype is T.BOOLEAN else 0) \
-            if arr.null_count else arr
-        values = filled.to_numpy(zero_copy_only=False)
-        if values.dtype.kind == "M":  # datetime64 from date32/timestamp
-            unit = "D" if dtype is T.DATE else "us"
-            values = values.astype(f"datetime64[{unit}]").view(np.int64)
-        return DeviceColumn.from_numpy(
-            values.astype(dtype.np_dtype, copy=False), validity, dtype, capacity)
+        offs = np.asarray(arr.offsets.to_numpy(zero_copy_only=False),
+                          dtype=np.int64)
+        lens = np.diff(offs)
+        if validity is not None:
+            lens = np.where(validity, lens, 0)
+        max_len = _pow2(int(lens.max()) if n and lens.size else 1)
+        child_vals, child_valid = _fixed_np_from_arrow(
+            arr.values, dtype.element_type)
+        if child_valid is None:
+            child_valid = np.ones(len(child_vals), dtype=np.bool_)
+        # Pad the flat child by one zero slot so out-of-range gathers are safe.
+        child_vals = np.concatenate(
+            [child_vals, np.zeros(1, child_vals.dtype)])
+        child_valid = np.concatenate([child_valid, np.zeros(1, np.bool_)])
+        j = np.arange(max_len, dtype=np.int64)[None, :]
+        idx = offs[:n, None] + j                     # [n, max_len]
+        in_row = j < lens[:, None]
+        idx = np.where(in_row, idx, len(child_vals) - 1)
+        data = np.zeros((capacity, max_len), dtype=child_vals.dtype)
+        emask = np.zeros((capacity, max_len), dtype=np.bool_)
+        data[:n] = np.where(in_row, child_vals[idx],
+                            np.zeros((), child_vals.dtype))
+        emask[:n] = in_row & child_valid[idx]
+        data[:n] = np.where(emask[:n], data[:n],
+                            np.zeros((), child_vals.dtype))
+        lengths = np.zeros(capacity, dtype=np.int32)
+        lengths[:n] = lens.astype(np.int32)
+        mask = np.zeros(capacity, dtype=np.bool_)
+        mask[:n] = True if validity is None else validity
+        return DeviceColumn(
+            data=jnp.asarray(data), validity=jnp.asarray(mask), dtype=dtype,
+            elem_validity=jnp.asarray(emask), lengths=jnp.asarray(lengths))
 
     @staticmethod
     def dict_string_from_arrow(arr: pa.Array, capacity: int
@@ -250,6 +351,26 @@ class DeviceColumn:
             max_bytes=max_bytes, codes=jnp.asarray(code_buf),
             dict_sorted=True)
 
+    def head(self, cap: int) -> "DeviceColumn":
+        """Front-slice to a smaller capacity (rows past n_rows are dead by
+        invariant, so a plain slice is sufficient)."""
+        if self.is_struct:
+            return DeviceColumn(
+                data=None, validity=self.validity[:cap], dtype=self.dtype,
+                children=tuple(c.head(cap) for c in self.children))
+        if self.is_array:
+            return DeviceColumn(
+                data=self.data[:cap], validity=self.validity[:cap],
+                dtype=self.dtype, elem_validity=self.elem_validity[:cap],
+                lengths=self.lengths[:cap])
+        if self.is_dict:
+            return self.replace_rows(self.validity[:cap],
+                                     codes=self.codes[:cap])
+        if self.is_string:
+            return DeviceColumn(self.data, self.validity[:cap], self.dtype,
+                                self.offsets[: cap + 1], self.max_bytes)
+        return DeviceColumn(self.data[:cap], self.validity[:cap], self.dtype)
+
     def replace_rows(self, validity, data=None, codes=None) -> "DeviceColumn":
         """Same column with row-level arrays swapped (dict buffers kept)."""
         return DeviceColumn(
@@ -262,7 +383,15 @@ class DeviceColumn:
     # -- download -----------------------------------------------------------
     def device_buffers(self) -> tuple:
         """The device arrays to download for host reassembly (batch these
-        through one ``jax.device_get`` — the tunnel charges per round trip)."""
+        through one ``jax.device_get`` — the tunnel charges per round trip).
+        Struct columns nest their children's buffers (device_get treats the
+        whole thing as one pytree)."""
+        if self.is_struct:
+            return (self.validity,
+                    tuple(c.device_buffers() for c in self.children))
+        if self.is_array:
+            return (self.data, self.validity, self.elem_validity,
+                    self.lengths)
         if self.is_dict:
             return (self.data, self.validity, self.offsets, self.codes)
         if self.is_string:
@@ -275,6 +404,35 @@ class DeviceColumn:
         layout (offsets + bytes, values + validity); no per-row Python."""
         if self.dtype is T.NULL:
             return pa.nulls(n_rows)
+        if self.is_struct:
+            validity = np.ascontiguousarray(bufs[0][:n_rows])
+            all_valid = bool(validity.all())
+            mask_buf = None if all_valid else \
+                pa.py_buffer(np.packbits(validity, bitorder="little"))
+            kids = [c.arrow_from_host(b, n_rows)
+                    for c, b in zip(self.children, bufs[1])]
+            return pa.Array.from_buffers(
+                T.to_arrow_type(self.dtype), n_rows, [mask_buf],
+                0 if all_valid else int(n_rows - validity.sum()),
+                children=kids)
+        if self.is_array:
+            data, validity, emask, lengths = bufs
+            validity = np.ascontiguousarray(validity[:n_rows])
+            all_valid = bool(validity.all())
+            mask_buf = None if all_valid else \
+                pa.py_buffer(np.packbits(validity, bitorder="little"))
+            lens = np.where(validity, lengths[:n_rows], 0).astype(np.int64)
+            offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
+            keep = np.arange(data.shape[1])[None, :] < lens[:, None]
+            flat_vals = np.ascontiguousarray(data[:n_rows][keep])
+            flat_valid = np.ascontiguousarray(emask[:n_rows][keep])
+            et = self.dtype.element_type
+            child = _np_values_to_arrow(flat_vals, flat_valid, et)
+            return pa.Array.from_buffers(
+                T.to_arrow_type(self.dtype), n_rows,
+                [mask_buf, pa.py_buffer(offsets)],
+                0 if all_valid else int(n_rows - validity.sum()),
+                children=[child])
         validity = np.ascontiguousarray(bufs[1][:n_rows])
         all_valid = bool(validity.all())
         null_count = 0 if all_valid else int(n_rows - validity.sum())
@@ -321,8 +479,59 @@ def _arrow_validity(arr: pa.Array) -> Optional[np.ndarray]:
     return np.asarray(arr.is_valid())
 
 
+def _pow2(n: int, lo: int = 1) -> int:
+    cap = max(lo, 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+def _np_values_to_arrow(values: np.ndarray, validity: Optional[np.ndarray],
+                        dtype: T.DataType) -> pa.Array:
+    """Fixed-width numpy values (+ optional bool validity) -> arrow array."""
+    n = len(values)
+    if validity is None or bool(np.asarray(validity).all()):
+        mask_buf, null_count = None, 0
+    else:
+        mask_buf = pa.py_buffer(np.packbits(validity, bitorder="little"))
+        null_count = int(n - validity.sum())
+    if dtype is T.BOOLEAN:
+        values_buf = pa.py_buffer(np.packbits(values, bitorder="little"))
+    else:
+        values_buf = pa.py_buffer(np.ascontiguousarray(values))
+    return pa.Array.from_buffers(
+        T.to_arrow_type(dtype), n, [mask_buf, values_buf], null_count)
+
+
+def _fixed_np_from_arrow(arr: pa.Array, dtype: T.DataType):
+    """(values, validity) numpy pair for a fixed-width arrow array, nulls
+    zero-filled (the null-data-is-zero invariant)."""
+    if dtype is T.TIMESTAMP:
+        arr = arr.cast(pa.timestamp("us"))
+    validity = _arrow_validity(arr)
+    filled = arr.fill_null(False if dtype is T.BOOLEAN else 0) \
+        if arr.null_count else arr
+    values = filled.to_numpy(zero_copy_only=False)
+    if values.dtype.kind == "M":  # datetime64 from date32/timestamp
+        unit = "D" if dtype is T.DATE else "us"
+        values = values.astype(f"datetime64[{unit}]").view(np.int64)
+    return values.astype(dtype.np_dtype, copy=False), validity
+
+
 def null_column(dtype: T.DataType, capacity: int) -> DeviceColumn:
     """An all-null column of the given type (used for outer-join padding)."""
+    if isinstance(dtype, T.ArrayType):
+        return DeviceColumn(
+            data=jnp.zeros((capacity, 1), dtype=dtype.element_type.np_dtype),
+            validity=jnp.zeros(capacity, dtype=jnp.bool_), dtype=dtype,
+            elem_validity=jnp.zeros((capacity, 1), dtype=jnp.bool_),
+            lengths=jnp.zeros(capacity, dtype=jnp.int32))
+    if isinstance(dtype, T.StructType):
+        return DeviceColumn(
+            data=None, validity=jnp.zeros(capacity, dtype=jnp.bool_),
+            dtype=dtype,
+            children=tuple(null_column(f.data_type, capacity)
+                           for f in dtype.fields))
     if dtype is T.STRING:
         # Dict-encoded: one empty dictionary entry, all codes 0, all null.
         return DeviceColumn(

@@ -407,6 +407,82 @@ class TpuExpandExec(TpuExec):
         return [run(p) for p in self.children[0].execute(ctx)]
 
 
+class TpuGenerateExec(TpuExec):
+    """Explode / posexplode over the padded-ragged array layout
+    (GpuGenerateExec.scala:101 does the same with a cudf gather).
+
+    One traced kernel: flatten the ``[capacity, max_len]`` element matrix to
+    ``capacity * max_len`` output lanes, repeat parent rows by a single 1D
+    gather (``row = lane // max_len``), then compact on the element-liveness
+    mask. Output capacity is the static ``capacity * max_len`` bucket — for
+    very wide arrays a production path would tile the input batch first
+    (the reference chunks similarly through its iterator)."""
+
+    def __init__(self, child: PhysicalPlan, generator: Expression,
+                 outer: bool, pos: bool, schema: T.Schema):
+        self.children = [child]
+        self.generator = generator
+        self.outer = outer
+        self.pos = pos
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"TpuGenerate [{self.generator}]"
+
+    def execute(self, ctx):
+        bound = self.generator.bind(self.children[0].schema)
+        out_schema = self._schema
+        outer, pos = self.outer, self.pos
+        elem_dt = out_schema[len(out_schema) - 1].data_type
+
+        def build():
+            def generate(db: ColumnarBatch) -> ColumnarBatch:
+                arr = bound.eval_device(db)
+                cap, w = arr.data.shape
+                out_cap = cap * w
+                lane = jnp.arange(out_cap, dtype=jnp.int32)
+                flat_r = lane // w
+                flat_j = lane % w
+                live = flat_r < db.n_rows
+                lens = arr.lengths[flat_r]
+                valid = arr.validity[flat_r]
+                keep_elem = live & (flat_j < lens)
+                if outer:
+                    extra = live & (flat_j == 0) & (~valid | (lens == 0))
+                    keep = keep_elem | extra
+                else:
+                    keep = keep_elem
+                parent = KR.gather_batch(
+                    db, flat_r, jnp.asarray(out_cap, jnp.int32),
+                    index_valid=None)
+                cols = list(parent.columns)
+                if pos:
+                    cols.append(make_column(flat_j, keep_elem, T.INT))
+                cols.append(make_column(
+                    arr.data.reshape(-1),
+                    arr.elem_validity.reshape(-1) & keep_elem, elem_dt))
+                expanded = ColumnarBatch(
+                    tuple(cols), jnp.asarray(out_cap, jnp.int32), out_schema)
+                return KR.compact(expanded, keep)
+            return generate
+
+        fn = cached_kernel(
+            "generate", kernel_key(bound, outer, pos, out_schema), build)
+
+        def run(part):
+            import time as _time
+            t0 = _time.perf_counter()
+            for db in part:
+                out = fn(db)
+                t0 = _tick(ctx, "TpuGenerate", t0)
+                yield out
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
 # ---------------------------------------------------------------------------
 # Sort
 # ---------------------------------------------------------------------------
@@ -463,17 +539,23 @@ def _accumulate_spillable(child: PhysicalPlan, ctx,
         batches = [b for part in child.execute(ctx) for b in part]
         return _coalesce_device(batches) if batches else None
     ids = []
-    for part in child.execute(ctx):
-        for db in part:
-            ids.append(catalog.register_batch(
-                db, SP.ACTIVE_BATCHING_PRIORITY))
-    if not ids:
-        return None
-    with trace_range(f"{label}.assemble"):
-        for b in ids:
-            catalog.pin(b)
-        batches = [catalog.acquire_batch(b) for b in ids]
-        out = _coalesce_device(batches)
+    try:
+        for part in child.execute(ctx):
+            for db in part:
+                ids.append(catalog.register_batch(
+                    db, SP.ACTIVE_BATCHING_PRIORITY))
+        if not ids:
+            return None
+        with trace_range(f"{label}.assemble"):
+            for b in ids:
+                catalog.pin(b)
+            batches = [catalog.acquire_batch(b) for b in ids]
+            out = _coalesce_device(batches)
+    finally:
+        # Free even when the child raises mid-stream (e.g. a transient
+        # remote-compile failure that session._run_with_retries retries) —
+        # leaked registrations would shrink the spill budget for the whole
+        # session.
         for b in ids:
             catalog.free(b)
     return out

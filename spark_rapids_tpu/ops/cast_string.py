@@ -244,7 +244,15 @@ def _parse_ymd(m, ln):
     mo, md, p2 = _parse_int_run(m, p + 1, 2)
     ok = ok & (md >= 1) & _expect_char(m, p2, "-")
     d, dd, p3 = _parse_int_run(m, p2 + 1, 2)
-    ok = ok & (dd >= 1) & (mo >= 1) & (mo <= 12) & (d >= 1) & (d <= 31)
+    ok = ok & (dd >= 1) & (mo >= 1) & (mo <= 12) & (d >= 1)
+    # Calendar-exact day bound (Feb 29 only in leap years, Apr 31 invalid,
+    # ...) — the CPU oracle parses via date.fromisoformat which rejects
+    # these, so the device must too.
+    dim = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                      jnp.int32)
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    max_d = dim[jnp.clip(mo - 1, 0, 11)] + (leap & (mo == 2))
+    ok = ok & (d <= max_d)
     return y, mo, d, p3, ok
 
 

@@ -1,0 +1,293 @@
+"""Complex-type expressions: arrays and structs.
+
+The reference's complex-type surface in this snapshot is
+``complexTypeExtractors.scala`` (GetArrayItem with a literal ordinal,
+GetStructField) plus ``GpuGenerateExec.scala:101`` (explode); CreateArray /
+CreateNamedStruct / Size / ArrayContains round out the minimal set needed to
+produce and consume arrays inside queries.
+
+Device layouts (see ``types.ArrayType`` / ``types.StructType``): arrays are
+padded-ragged ``[capacity, max_len]`` matrices with an element mask and a
+length lane, structs are column-shredded. Every expression here is a plain
+traced jnp computation — no Python per row.
+
+Null semantics follow Spark 3.0 non-ANSI:
+
+* ``arr[i]`` (GetArrayItem) is null when the array is null, the index is out
+  of range, or the element itself is null.
+* ``size(null)`` is -1 (legacy ``spark.sql.legacy.sizeOfNull=true`` default).
+* ``array_contains`` returns null for a null array; null (not false) when the
+  value is absent but the array has null elements.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, HostBatch
+from ..data.column import DeviceColumn
+from .expression import (Expression, Literal, host_to_array, make_column)
+
+
+def _common_type(types: Sequence[T.DataType]) -> T.DataType:
+    first = types[0]
+    for t in types[1:]:
+        if t.name != first.name:
+            raise TypeError(
+                f"array elements must share one type, got {first} and {t}")
+    return first
+
+
+class CreateArray(Expression):
+    """array(e1, e2, ...) — fixed-length array per row (never null itself)."""
+
+    def __init__(self, *elements: Expression):
+        if not elements:
+            raise ValueError("array() needs at least one element")
+        self.children = list(elements)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.ArrayType(_common_type([c.data_type for c in self.children]),
+                           any(c.nullable for c in self.children))
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def with_children(self, children):
+        return CreateArray(*children)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        n = batch.num_rows
+        cols = [host_to_array(c.eval_host(batch), n) for c in self.children]
+        et = T.to_arrow_type(self.data_type.element_type)
+        rows = [[col[i].as_py() for col in cols] for i in range(n)]
+        return pa.array(rows, type=pa.list_(et))
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        cols = [c.eval_device(batch) for c in self.children]
+        live = batch.row_mask()
+        data = jnp.stack([c.data for c in cols], axis=1)
+        emask = jnp.stack([c.validity for c in cols], axis=1) & live[:, None]
+        lengths = jnp.where(live, jnp.int32(len(cols)), 0)
+        data = jnp.where(emask, data, jnp.zeros((), data.dtype))
+        return DeviceColumn(data=data, validity=live, dtype=self.data_type,
+                            elem_validity=emask, lengths=lengths)
+
+
+class GetArrayItem(Expression):
+    """arr[ordinal] with a literal ordinal (reference
+    complexTypeExtractors.scala limits GetArrayItem to literal ordinals)."""
+
+    def __init__(self, child: Expression, ordinal: Expression):
+        if not isinstance(ordinal, Expression):
+            ordinal = Literal(int(ordinal), T.INT)
+        self.children = [child, ordinal]
+
+    @property
+    def ordinal(self) -> Optional[int]:
+        o = self.children[1]
+        return int(o.value) if isinstance(o, Literal) and o.value is not None \
+            else None
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self.children[0].data_type.element_type
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def with_children(self, children):
+        return GetArrayItem(children[0], children[1])
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        arr = host_to_array(self.children[0].eval_host(batch),
+                            batch.num_rows)
+        i = self.ordinal
+        et = T.to_arrow_type(self.data_type)
+        if i is None or i < 0:
+            return pa.nulls(len(arr), type=et)
+        out = [v[i] if v is not None and i < len(v) else None
+               for v in arr.to_pylist()]
+        return pa.array(out, type=et)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        arr = self.children[0].eval_device(batch)
+        i = self.ordinal
+        if i is None or i < 0 or i >= arr.max_len:
+            from ..data.column import null_column
+            return null_column(self.data_type, arr.capacity)
+        validity = arr.validity & (i < arr.lengths) & arr.elem_validity[:, i]
+        return make_column(arr.data[:, i], validity, self.data_type)
+
+
+class Size(Expression):
+    """size(arr) — int32 length; -1 for null arrays (Spark 3.0 legacy)."""
+
+    def __init__(self, child: Expression):
+        self.children = [child]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.INT
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def with_children(self, children):
+        return Size(children[0])
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        import pyarrow.compute as pc
+        arr = host_to_array(self.children[0].eval_host(batch),
+                            batch.num_rows)
+        lens = pc.list_value_length(arr).cast(pa.int32())
+        return pc.fill_null(lens, pa.scalar(-1, pa.int32()))
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        arr = self.children[0].eval_device(batch)
+        data = jnp.where(arr.validity, arr.lengths, jnp.int32(-1))
+        return make_column(data, batch.row_mask(), T.INT)
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, value). Spark null semantics (see module doc)."""
+
+    def __init__(self, array: Expression, value: Expression):
+        if not isinstance(value, Expression):
+            value = Literal(value)
+        self.children = [array, value]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.BOOLEAN
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def with_children(self, children):
+        return ArrayContains(children[0], children[1])
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        arr = host_to_array(self.children[0].eval_host(batch),
+                            batch.num_rows)
+        val = host_to_array(self.children[1].eval_host(batch),
+                            batch.num_rows)
+        out = []
+        for lst, v in zip(arr.to_pylist(), val.to_pylist()):
+            if lst is None or v is None:
+                out.append(None)
+            elif v in [x for x in lst if x is not None]:
+                out.append(True)
+            else:
+                out.append(None if any(x is None for x in lst) else False)
+        return pa.array(out, type=pa.bool_())
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        arr = self.children[0].eval_device(batch)
+        val = self.children[1].eval_device(batch)
+        in_len = jnp.arange(arr.max_len, dtype=jnp.int32)[None, :] \
+            < arr.lengths[:, None]
+        hit = jnp.any(arr.elem_validity
+                      & (arr.data == val.data[:, None]), axis=1)
+        has_null_elem = jnp.any(in_len & ~arr.elem_validity, axis=1)
+        validity = arr.validity & val.validity & (hit | ~has_null_elem)
+        return make_column(hit, validity, T.BOOLEAN)
+
+
+class CreateNamedStruct(Expression):
+    """named_struct(n1, e1, n2, e2, ...) — never null itself."""
+
+    def __init__(self, names: List[str], exprs: List[Expression]):
+        assert len(names) == len(exprs)
+        self.names = list(names)
+        self.children = list(exprs)
+
+    @property
+    def data_type(self) -> T.DataType:
+        return T.StructType([
+            T.StructField(n, e.data_type, e.nullable)
+            for n, e in zip(self.names, self.children)])
+
+    @property
+    def nullable(self) -> bool:
+        return False
+
+    def with_children(self, children):
+        return CreateNamedStruct(self.names, children)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        n = batch.num_rows
+        cols = [host_to_array(c.eval_host(batch), n).cast(
+                    T.to_arrow_type(c.data_type))
+                for c in self.children]
+        return pa.StructArray.from_arrays(cols, names=self.names)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        kids = tuple(c.eval_device(batch) for c in self.children)
+        return DeviceColumn(data=None, validity=batch.row_mask(),
+                            dtype=self.data_type, children=kids)
+
+
+class GetStructField(Expression):
+    """struct.field extraction by name (complexTypeExtractors.scala)."""
+
+    def __init__(self, child: Expression, field_name: str):
+        self.children = [child]
+        self.field_name = field_name
+
+    @property
+    def _struct_type(self) -> T.StructType:
+        return self.children[0].data_type
+
+    @property
+    def data_type(self) -> T.DataType:
+        st = self._struct_type
+        return st.fields[st.field_index(self.field_name)].data_type
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def with_children(self, children):
+        return GetStructField(children[0], self.field_name)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        import pyarrow.compute as pc
+        s = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        return pc.struct_field(s, self.field_name)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        s = self.children[0].eval_device(batch)
+        kid = s.children[s.dtype.field_index(self.field_name)]
+        validity = kid.validity & s.validity
+        if kid.is_dict:
+            return kid.replace_rows(validity,
+                                    codes=jnp.where(validity, kid.codes, 0))
+        if kid.is_string:
+            return DeviceColumn(kid.data, validity, kid.dtype, kid.offsets,
+                                kid.max_bytes)
+        return make_column(kid.data, validity, kid.dtype)
+
+
+def array(*elements) -> CreateArray:
+    from .expression import lit
+    return CreateArray(*[e if isinstance(e, Expression) else lit(e)
+                         for e in elements])
+
+
+def struct(**fields) -> CreateNamedStruct:
+    from .expression import lit
+    names = list(fields.keys())
+    exprs = [v if isinstance(v, Expression) else lit(v)
+             for v in fields.values()]
+    return CreateNamedStruct(names, exprs)

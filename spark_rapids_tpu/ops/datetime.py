@@ -303,10 +303,13 @@ class UnixTimestamp(Expression):
         src = self.children[0].data_type
         v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
         if src is T.TIMESTAMP:
-            # Floor division (Spark floorDiv): Arrow's integer divide
-            # truncates toward zero, wrong for pre-epoch timestamps.
-            us = v.cast(pa.int64()).cast(pa.float64())
-            return pc.floor(pc.divide(us, 1_000_000.0)).cast(pa.int64())
+            # Floor division (Spark floorDiv) in exact int64: Arrow's
+            # integer divide truncates toward zero, wrong pre-epoch, and a
+            # float64 detour loses exactness past 2^53 micros.
+            us = v.cast(pa.int64())
+            q = pc.divide(us, 1_000_000)
+            rem = pc.subtract(us, pc.multiply(q, 1_000_000))
+            return pc.if_else(pc.less(rem, 0), pc.subtract(q, 1), q)
         if src is T.DATE:
             days = v.cast(pa.int32()).cast(pa.int64())
             return pc.multiply(days, 86400)

@@ -23,6 +23,42 @@ def concat_columns(cols: List[DeviceColumn], n_rows_list, out_capacity: int,
                    total_rows) -> DeviceColumn:
     dtype = cols[0].dtype
     live_out = jnp.arange(out_capacity, dtype=jnp.int32) < total_rows
+    if cols[0].is_struct:
+        out_valid = _scatter_validity(cols, n_rows_list, out_capacity,
+                                      live_out)
+        kids = tuple(
+            concat_columns([c.children[k] for c in cols], n_rows_list,
+                           out_capacity, total_rows)
+            for k in range(len(cols[0].children)))
+        return DeviceColumn(data=None, validity=out_valid, dtype=dtype,
+                            children=kids)
+    if cols[0].is_array:
+        w = max(c.max_len for c in cols)
+        out_data = jnp.zeros((out_capacity, w), dtype=dtype.np_dtype)
+        out_emask = jnp.zeros((out_capacity, w), dtype=jnp.bool_)
+        out_lens = jnp.zeros(out_capacity, dtype=jnp.int32)
+        out_valid = jnp.zeros(out_capacity, dtype=jnp.bool_)
+        offset = jnp.zeros((), jnp.int32)
+        for c, n in zip(cols, n_rows_list):
+            idx = jnp.arange(c.capacity, dtype=jnp.int32)
+            live = idx < n
+            target = jnp.where(live, idx + offset, out_capacity)
+            pad = ((0, 0), (0, w - c.max_len))
+            out_data = out_data.at[target].set(
+                jnp.pad(c.data, pad), mode="drop")
+            out_emask = out_emask.at[target].set(
+                jnp.pad(c.elem_validity, pad) & live[:, None], mode="drop")
+            out_lens = out_lens.at[target].set(
+                jnp.where(live & c.validity, c.lengths, 0), mode="drop")
+            out_valid = out_valid.at[target].set(c.validity & live,
+                                                 mode="drop")
+            offset = offset + n
+        out_valid = out_valid & live_out
+        out_emask = out_emask & out_valid[:, None]
+        return DeviceColumn(
+            data=jnp.where(out_emask, out_data, jnp.zeros((), out_data.dtype)),
+            validity=out_valid, dtype=dtype, elem_validity=out_emask,
+            lengths=jnp.where(out_valid, out_lens, 0))
     if cols[0].is_string and all(c.is_dict for c in cols):
         return _concat_dict_columns(cols, n_rows_list, out_capacity,
                                     live_out)
@@ -58,6 +94,19 @@ def concat_columns(cols: List[DeviceColumn], n_rows_list, out_capacity: int,
     out_valid = out_valid & live_out
     return DeviceColumn(data=jnp.where(out_valid, out_data, jnp.zeros((), out_data.dtype)),
                         validity=out_valid, dtype=dtype)
+
+
+def _scatter_validity(cols: List[DeviceColumn], n_rows_list,
+                      out_capacity: int, live_out) -> jnp.ndarray:
+    out_valid = jnp.zeros(out_capacity, dtype=jnp.bool_)
+    offset = jnp.zeros((), jnp.int32)
+    for c, n in zip(cols, n_rows_list):
+        idx = jnp.arange(c.capacity, dtype=jnp.int32)
+        live = idx < n
+        target = jnp.where(live, idx + offset, out_capacity)
+        out_valid = out_valid.at[target].set(c.validity & live, mode="drop")
+        offset = offset + n
+    return out_valid & live_out
 
 
 def _concat_dict_columns(cols: List[DeviceColumn], n_rows_list,

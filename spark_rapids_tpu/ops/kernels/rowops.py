@@ -131,6 +131,19 @@ def gather_column(col: DeviceColumn, indices: jnp.ndarray,
     validity = col.validity[safe]
     if index_valid is not None:
         validity = validity & index_valid
+    if col.is_struct:
+        kids = tuple(gather_column(c, indices, index_valid)
+                     for c in col.children)
+        return DeviceColumn(data=None, validity=validity, dtype=col.dtype,
+                            children=kids)
+    if col.is_array:
+        # Padded-ragged layout: a 2D row gather moves whole arrays.
+        emask = col.elem_validity[safe] & validity[:, None]
+        data = jnp.where(emask, col.data[safe],
+                         jnp.zeros((), col.data.dtype))
+        lengths = jnp.where(validity, col.lengths[safe], 0)
+        return DeviceColumn(data=data, validity=validity, dtype=col.dtype,
+                            elem_validity=emask, lengths=lengths)
     if not col.is_string:
         data = jnp.where(validity, col.data[safe], jnp.zeros((), col.data.dtype))
         return DeviceColumn(data=data, validity=validity, dtype=col.dtype)
@@ -196,10 +209,12 @@ def _permute_by_sort(batch: ColumnarBatch, key_operands: List[jnp.ndarray],
     live_out = jnp.arange(cap, dtype=jnp.int32) < new_n_rows
     payload: List[jnp.ndarray] = []
     carried = []  # (col index, is_dict)
-    has_flat_strings = any(c.is_string and not c.is_dict
+    has_flat_strings = any((c.is_string and not c.is_dict) or c.is_complex
                            for c in batch.columns)
     for i, c in enumerate(batch.columns):
-        if not c.is_string:
+        if c.is_complex:
+            pass  # complex columns always go through the gather path
+        elif not c.is_string:
             payload.append(c.data)
             payload.append(c.validity)
             carried.append((i, False))

@@ -66,6 +66,19 @@ def resolve(expr: Expression, schema: T.Schema) -> Expression:
                 r = Cast(r, T.LONG)
             if l is not e.children[0] or r is not e.children[1]:
                 return ARITH.IntegralDivide(l, r)
+        from ..ops import complex as CPX
+        if isinstance(e, CPX.CreateArray):
+            types = [c.data_type for c in e.children]
+            if len({t.name for t in types}) > 1:
+                if not all(t.is_numeric for t in types):
+                    raise TypeError(
+                        f"array elements must share one type, got {types}")
+                common = types[0]
+                for t in types[1:]:
+                    common = T.numeric_promote(common, t)
+                return CPX.CreateArray(
+                    *[c if c.data_type.name == common.name
+                      else Cast(c, common) for c in e.children])
         if isinstance(e, PRED.Comparison) or isinstance(e, PRED.EqualNullSafe):
             l, r = e.children
             if l.data_type.is_numeric and r.data_type.is_numeric \
@@ -580,6 +593,42 @@ class Expand(LogicalPlan):
         return T.Schema(fields)
 
 
+class Generate(LogicalPlan):
+    """One input row -> zero or more output rows from an array generator
+    (explode / posexplode; GpuGenerateExec, GpuGenerateExec.scala:101).
+    Output = all child columns + [pos] + the element column."""
+
+    def __init__(self, child: LogicalPlan, generator: Expression,
+                 elem_name: str = "col", outer: bool = False,
+                 pos: bool = False, pos_name: str = "pos"):
+        self.children = [child]
+        self.generator = resolve(generator, child.schema)
+        if not isinstance(self.generator.data_type, T.ArrayType):
+            raise TypeError(
+                f"explode needs an array column, got "
+                f"{self.generator.data_type}")
+        self.elem_name = elem_name
+        self.outer = outer
+        self.pos = pos
+        self.pos_name = pos_name
+
+    @property
+    def schema(self) -> T.Schema:
+        fields = list(self.children[0].schema)
+        if self.pos:
+            fields.append(T.StructField(self.pos_name, T.INT, self.outer))
+        at: T.ArrayType = self.generator.data_type
+        fields.append(T.StructField(
+            self.elem_name, at.element_type,
+            at.contains_null or self.outer))
+        return T.Schema(fields)
+
+    def describe(self):
+        kind = "posexplode" if self.pos else "explode"
+        return f"Generate [{kind}{'_outer' if self.outer else ''}" \
+               f"({self.generator})]"
+
+
 # ---------------------------------------------------------------------------
 # DataFrame API
 # ---------------------------------------------------------------------------
@@ -640,6 +689,15 @@ class DataFrame:
     def with_windows(self, **name_to_window_expr) -> "DataFrame":
         """Append several window columns in one Window node."""
         plan = WindowOp(self._plan, list(name_to_window_expr.items()))
+        return DataFrame(plan, self._session)
+
+    def explode(self, column, name: str = "col",
+                outer: bool = False, pos: bool = False) -> "DataFrame":
+        """One output row per array element (explode / posexplode[_outer]);
+        all other columns repeat. ``outer`` keeps null/empty-array rows with
+        a null element, ``pos`` adds the element's position column."""
+        plan = Generate(self._plan, _as_expr(column), elem_name=name,
+                        outer=outer, pos=pos)
         return DataFrame(plan, self._session)
 
     def group_by(self, *keys) -> GroupedData:

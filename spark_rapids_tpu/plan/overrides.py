@@ -167,6 +167,25 @@ def _unix_ts_tag(e, conf: TpuConf) -> Optional[str]:
 _expr(DT.UnixTimestamp, tag=_unix_ts_tag)
 _expr(DT.FromUnixTime, tag=_unix_ts_tag)
 
+from ..ops import complex as CPX  # noqa: E402
+
+
+def _get_array_item_tag(e: "CPX.GetArrayItem", conf: TpuConf) \
+        -> Optional[str]:
+    if not isinstance(e.children[1], Literal):
+        return ("GetArrayItem with a non-literal ordinal is not supported "
+                "(reference complexTypeExtractors.scala limits to literal "
+                "ordinals)")
+    return None
+
+
+_expr(CPX.CreateArray)
+_expr(CPX.GetArrayItem, tag=_get_array_item_tag)
+_expr(CPX.Size)
+_expr(CPX.ArrayContains)
+_expr(CPX.CreateNamedStruct)
+_expr(CPX.GetStructField)
+
 
 # ---------------------------------------------------------------------------
 # Meta tree (RapidsMeta analog)
@@ -223,7 +242,7 @@ class ExecMeta:
                     self.will_not_work(reason)
             try:
                 dt = expr.data_type
-                if dt is not T.NULL and dt not in T.DEFAULT_DEVICE_TYPES:
+                if not T.device_supported(dt):
                     self.will_not_work(f"type {dt} is not supported on TPU")
             except (RuntimeError, NotImplementedError):
                 pass
@@ -274,8 +293,16 @@ def _agg_exprs(node: P.CpuHashAggregateExec) -> List[Expression]:
     return out
 
 
+def _no_complex_keys(meta: ExecMeta, exprs, what: str):
+    for e in exprs:
+        if isinstance(e.data_type, (T.ArrayType, T.StructType)):
+            meta.will_not_work(
+                f"{what} of type {e.data_type} is not supported on TPU")
+
+
 def _agg_tag(meta: ExecMeta, conf: TpuConf):
     node: P.CpuHashAggregateExec = meta.node
+    _no_complex_keys(meta, node.groupings, "grouping key")
     if not conf.get(VARIABLE_FLOAT_AGG):
         for a in node.aggregates:
             if isinstance(a.func, (AGG.Sum, AGG.Average)) and a.func.child \
@@ -336,6 +363,8 @@ def _window_tag(meta: ExecMeta, conf: TpuConf):
             if e.data_type not in T.DEFAULT_DEVICE_TYPES:
                 meta.will_not_work(
                     f"partition key type {e.data_type} not supported")
+        _no_complex_keys(meta, [o.child for o in we.spec.order_by],
+                         "window order-by key")
 
 
 def _join_tag(meta: ExecMeta, conf: TpuConf):
@@ -344,6 +373,8 @@ def _join_tag(meta: ExecMeta, conf: TpuConf):
     node: P.CpuJoinExec = meta.node
     if not node.left_keys:
         meta.will_not_work("hash join requires equi keys")
+    _no_complex_keys(meta, list(node.left_keys) + list(node.right_keys),
+                     "join key")
     if node.condition is not None and node.join_type != "inner":
         meta.will_not_work(
             f"conditions are not supported for {node.join_type} joins "
@@ -395,7 +426,9 @@ EXEC_RULES: Dict[Type[P.PhysicalPlan], ExecRule] = {
     P.CpuSortExec: ExecRule(
         "Sort",
         lambda n: [o.child for o in n.orders],
-        lambda n, ch, conf: E.TpuSortExec(ch[0], n.orders)),
+        lambda n, ch, conf: E.TpuSortExec(ch[0], n.orders),
+        tag=lambda m, conf: _no_complex_keys(
+            m, [o.child for o in m.node.orders], "sort key")),
     P.CpuLimitExec: ExecRule(
         "GlobalLimit",
         lambda n: [],
@@ -408,6 +441,11 @@ EXEC_RULES: Dict[Type[P.PhysicalPlan], ExecRule] = {
         "Expand",
         lambda n: [e for proj in n.projections for e in proj],
         lambda n, ch, conf: E.TpuExpandExec(ch[0], n.projections, n.schema)),
+    P.CpuGenerateExec: ExecRule(
+        "Generate",
+        lambda n: [n.generator],
+        lambda n, ch, conf: E.TpuGenerateExec(ch[0], n.generator, n.outer,
+                                              n.pos, n.schema)),
     P.CpuRangeExec: ExecRule(
         "Range",
         lambda n: [],

@@ -502,6 +502,57 @@ class CpuExpandExec(PhysicalPlan):
         return [run(p) for p in self.children[0].execute(ctx)]
 
 
+class CpuGenerateExec(PhysicalPlan):
+    """Explode oracle: per-row Python over the array column (the trusted
+    side of the Generate differential tests; GpuGenerateExec.scala:101)."""
+
+    def __init__(self, child: PhysicalPlan, generator, outer: bool,
+                 pos: bool, schema: T.Schema):
+        self.children = [child]
+        self.generator = generator
+        self.outer = outer
+        self.pos = pos
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"CpuGenerate [{self.generator}]"
+
+    def execute(self, ctx):
+        import pyarrow.compute as pc
+        arrow = _arrow_schema(self.schema)
+        elem_type = arrow.field(len(arrow) - 1).type
+
+        def run(part):
+            for hb in part:
+                gen = host_to_array(self.generator.eval_host(hb),
+                                    hb.num_rows)
+                idx, poss, elems = [], [], []
+                for i, lst in enumerate(gen.to_pylist()):
+                    if not lst:
+                        if self.outer:
+                            idx.append(i)
+                            poss.append(None)
+                            elems.append(None)
+                    else:
+                        for j, v in enumerate(lst):
+                            idx.append(i)
+                            poss.append(j)
+                            elems.append(v)
+                take = pa.array(idx, pa.int64())
+                arrays = [pc.take(c, take) for c in hb.rb.columns]
+                if self.pos:
+                    arrays.append(pa.array(poss, pa.int32()))
+                arrays.append(pa.array(elems, type=elem_type))
+                arrays = [a.cast(f.type) for a, f in zip(arrays, arrow)]
+                yield HostBatch(pa.RecordBatch.from_arrays(
+                    arrays, schema=arrow))
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
 class CpuWindowExec(PhysicalPlan):
     """Window oracle: comparator-sorted partitions, per-row frame scans.
 

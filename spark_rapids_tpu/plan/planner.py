@@ -132,4 +132,8 @@ def plan_physical(plan: L.LogicalPlan,
     if isinstance(plan, L.Expand):
         return P.CpuExpandExec(plan_physical(plan.children[0], conf),
                                plan.projections, plan.schema)
+    if isinstance(plan, L.Generate):
+        return P.CpuGenerateExec(plan_physical(plan.children[0], conf),
+                                 plan.generator, plan.outer, plan.pos,
+                                 plan.schema)
     raise NotImplementedError(f"no physical plan for {type(plan).__name__}")

@@ -169,6 +169,59 @@ class TimestampType(DataType):
         return np.dtype(np.int64)
 
 
+@dataclasses.dataclass(frozen=True)
+class ArrayType(DataType):
+    """ARRAY<element>. Device layout is padded-ragged (TPU-native): a
+    ``[capacity, max_len]`` element matrix + per-element validity + an
+    int32 length lane, instead of cudf's offsets+child (the reference
+    reaches arrays via ``complexTypeExtractors.scala`` GetArrayItem and
+    ``GpuGenerateExec.scala:101`` explode). Padding keeps every row the
+    same machine shape, so gathers/filters/joins move arrays exactly like
+    fixed-width scalars — no ragged re-layout inside jit."""
+
+    element_type: "DataType" = dataclasses.field(default=None)
+    contains_null: bool = True
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        return f"array<{self.element_type.name}>"
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return False
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return self.element_type.np_dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class StructType(DataType):
+    """STRUCT<f1: t1, ...>. Device layout is column-shredded: one child
+    DeviceColumn per field plus a struct-level validity lane, so struct
+    columns cost nothing beyond their fields."""
+
+    fields: tuple = dataclasses.field(default=None)  # tuple[StructField]
+
+    def __init__(self, fields):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    @property
+    def name(self) -> str:  # type: ignore[override]
+        inner = ",".join(f"{f.name}:{f.data_type.name}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    @property
+    def is_fixed_width(self) -> bool:
+        return False
+
+    def field_index(self, name: str) -> int:
+        for i, f in enumerate(self.fields):
+            if f.name == name:
+                return i
+        raise KeyError(name)
+
+
 # Singletons, Spark style.
 NULL = NullType()
 BOOLEAN = BooleanType()
@@ -190,6 +243,20 @@ _BY_NAME = {t.name: t for t in _ALL_TYPES}
 DEFAULT_DEVICE_TYPES = frozenset(
     [BOOLEAN, BYTE, SHORT, INT, LONG, FLOAT, DOUBLE, STRING, DATE, TIMESTAMP]
 )
+
+
+def device_supported(dt: DataType) -> bool:
+    """Recursive device type-support check (areAllSupportedTypes analog).
+    Arrays support fixed-width elements; structs support any supported
+    non-nested field type."""
+    if dt is NULL or dt in DEFAULT_DEVICE_TYPES:
+        return True
+    if isinstance(dt, ArrayType):
+        return dt.element_type in DEFAULT_DEVICE_TYPES \
+            and dt.element_type.is_fixed_width
+    if isinstance(dt, StructType):
+        return all(f.data_type in DEFAULT_DEVICE_TYPES for f in dt.fields)
+    return False
 
 _NUMERIC_ORDER = [BYTE, SHORT, INT, LONG, FLOAT, DOUBLE]
 
@@ -282,6 +349,12 @@ def from_arrow_type(at) -> DataType:
         return TIMESTAMP
     if pa.types.is_null(at):
         return NULL
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow_type(at.value_type),
+                         at.value_field.nullable)
+    if pa.types.is_struct(at):
+        return StructType([StructField(f.name, from_arrow_type(f.type),
+                                       f.nullable) for f in at])
     if pa.types.is_decimal(at):
         raise TypeError("decimal is not supported yet (matches reference v0.2 snapshot)")
     raise TypeError(f"unsupported arrow type {at}")
@@ -290,6 +363,12 @@ def from_arrow_type(at) -> DataType:
 def to_arrow_type(dt: DataType):
     import pyarrow as pa
 
+    if isinstance(dt, ArrayType):
+        return pa.list_(pa.field("item", to_arrow_type(dt.element_type),
+                                 dt.contains_null))
+    if isinstance(dt, StructType):
+        return pa.struct([pa.field(f.name, to_arrow_type(f.data_type),
+                                   f.nullable) for f in dt.fields])
     mapping = {
         "null": pa.null(),
         "boolean": pa.bool_(),

@@ -116,6 +116,47 @@ class TimestampGen(Gen):
         return [epoch + datetime.timedelta(microseconds=int(u)) for u in us]
 
 
+class ArrayGen(Gen):
+    """Arrays of a fixed-width element generator, with null rows, empty
+    arrays, and null elements (data_gen.py ArrayGen analog)."""
+
+    def __init__(self, elem_gen: Gen, max_len: int = 6, **kw):
+        super().__init__(T.ArrayType(elem_gen.dtype, elem_gen.nullable), **kw)
+        self.elem_gen = elem_gen
+        self.max_len = max_len
+
+    def values(self, rng, n):
+        lens = rng.integers(0, self.max_len, size=n, endpoint=True)
+        out = []
+        for ln in lens:
+            elems = self.elem_gen.values(rng, int(ln)) if ln else []
+            if self.elem_gen.null_prob > 0 and ln:
+                mask = rng.random(int(ln)) < self.elem_gen.null_prob
+                elems = [None if m else v for v, m in zip(elems, mask)]
+            out.append(elems)
+        return out
+
+
+class StructGen(Gen):
+    """Structs over named child generators, with null struct rows."""
+
+    def __init__(self, fields: dict, **kw):
+        super().__init__(T.StructType(
+            [T.StructField(k, g.dtype, g.nullable)
+             for k, g in fields.items()]), **kw)
+        self.fields = fields
+
+    def values(self, rng, n):
+        cols = {}
+        for name, g in self.fields.items():
+            vals = g.values(rng, n)
+            if g.null_prob > 0:
+                mask = rng.random(n) < g.null_prob
+                vals = [None if m else v for v, m in zip(vals, mask)]
+            cols[name] = vals
+        return [{k: cols[k][i] for k in cols} for i in range(n)]
+
+
 def gen_batch(gens: dict, n: int = 256, seed: int = 0) -> pa.RecordBatch:
     rng = np.random.default_rng(seed)
     arrays, names = [], []

@@ -57,6 +57,10 @@ def _canon(v):
         if v == 0.0:
             return 0.0  # -0.0 == 0.0
         return v
+    if isinstance(v, list):  # array column values
+        return tuple(_canon(x) for x in v)
+    if isinstance(v, dict):  # struct column values
+        return tuple((k, _canon(x)) for k, x in sorted(v.items()))
     return v
 
 

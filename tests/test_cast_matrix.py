@@ -63,7 +63,10 @@ class TestStringToTemporal:
     def test_string_to_date(self):
         vals = ["2024-01-31", "1999-12-31", "2024-2-5", "2024-13-01",
                 "2024-00-10", "20240131", "2024-01-41", "not a date",
-                None, " 2024-06-15 ", "0001-01-01"]
+                None, " 2024-06-15 ", "0001-01-01",
+                # Calendar-invalid: device must null these like the oracle.
+                "2023-02-29", "2024-02-29", "1900-02-29", "2000-02-29",
+                "2024-04-31", "2024-06-31"]
         assert_tpu_and_cpu_are_equal(
             lambda s: s.create_dataframe(_str_df(vals))
             .with_column("v", Cast(col("s"), T.DATE)).select(col("v")))

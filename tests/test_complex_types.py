@@ -1,0 +1,155 @@
+"""Differential tests for complex types: ARRAY/STRUCT columns, extractor
+expressions (complexTypeExtractors.scala analog), and Generate/explode
+(GpuGenerateExec.scala:101 analog)."""
+
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.ops import complex as CPX
+from spark_rapids_tpu.ops.expression import col, lit
+
+from datagen import ArrayGen, FloatGen, IntGen, StringGen, StructGen, \
+    gen_batch
+from harness import assert_tpu_and_cpu_are_equal
+
+
+ARR = pa.array([[1, 2, 3], [], None, [4, None], [5], None, [6, 7]],
+               type=pa.list_(pa.int64()))
+KEYS = pa.array([1, 2, 3, 4, 5, 6, 7], pa.int64())
+
+
+def _df(s):
+    return s.create_dataframe(
+        pa.RecordBatch.from_arrays([KEYS, ARR], names=["k", "arr"]))
+
+
+def _rand_df(s, elem_gen=None, seed=0):
+    rb = gen_batch({
+        "k": IntGen(T.LONG, nullable=False),
+        "arr": ArrayGen(elem_gen or IntGen(T.LONG)),
+    }, n=257, seed=seed)
+    return s.create_dataframe(rb)
+
+
+class TestArrayExpressions:
+    def test_get_array_item(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).select(
+                col("k"), CPX.GetArrayItem(col("arr"), lit(1)).alias("x")))
+
+    def test_get_array_item_out_of_range(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).select(
+                CPX.GetArrayItem(col("arr"), lit(9)).alias("x")))
+
+    def test_size(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).select(col("k"), CPX.Size(col("arr")).alias("n")))
+
+    def test_array_contains(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).select(
+                col("k"), CPX.ArrayContains(col("arr"), lit(4)).alias("c")))
+
+    def test_create_array(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).select(
+                col("k"), CPX.array(col("k"), col("k") * 2, lit(0)).alias("a")))
+
+    def test_create_then_extract(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).select(
+                CPX.GetArrayItem(
+                    CPX.array(col("k"), col("k") + 10), 1).alias("x")))
+
+    @pytest.mark.parametrize("elem", ["long", "double", "int"])
+    def test_random_arrays_roundtrip(self, elem):
+        gens = {"long": IntGen(T.LONG), "double": FloatGen(T.DOUBLE),
+                "int": IntGen(T.INT)}
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _rand_df(s, gens[elem]).select(
+                col("k"), col("arr"),
+                CPX.Size(col("arr")).alias("n"),
+                CPX.GetArrayItem(col("arr"), lit(0)).alias("head")))
+
+    def test_array_through_filter(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _rand_df(s).where(col("k") > 0)
+            .select(col("arr"), CPX.Size(col("arr")).alias("n")))
+
+    def test_array_through_union(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _rand_df(s, seed=1).union(_rand_df(s, seed=2))
+            .select(col("arr")))
+
+    def test_group_by_array_tags_fallback(self):
+        # Array grouping keys must be tagged off the TPU (the CPU oracle
+        # can't group by lists either, so this checks planning only).
+        from harness import tpu_session
+        s = tpu_session(**{"spark.rapids.sql.test.enabled": False})
+        df = _df(s).group_by(col("arr")).count()
+        plan = s.plan(df._plan)
+        from spark_rapids_tpu.exec.execs import TpuHashAggregateExec
+
+        def find(p):
+            return isinstance(p, TpuHashAggregateExec) or \
+                any(find(c) for c in p.children)
+        assert not find(plan), "array grouping key must not plan on TPU"
+
+
+class TestStructExpressions:
+    def _sdf(self, s, seed=0):
+        rb = gen_batch({
+            "k": IntGen(T.LONG, nullable=False),
+            "st": StructGen({"a": IntGen(T.LONG), "b": StringGen()}),
+        }, n=129, seed=seed)
+        return s.create_dataframe(rb)
+
+    def test_struct_roundtrip(self):
+        assert_tpu_and_cpu_are_equal(lambda s: self._sdf(s).select(col("st")))
+
+    def test_get_struct_field(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: self._sdf(s).select(
+                col("k"),
+                CPX.GetStructField(col("st"), "a").alias("a"),
+                CPX.GetStructField(col("st"), "b").alias("b")))
+
+    def test_create_named_struct(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: self._sdf(s).select(
+                CPX.struct(x=col("k"), y=col("k") * 2).alias("made")))
+
+    def test_struct_through_filter(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: self._sdf(s).where((col("k") % 2).eq(lit(0)))
+            .select(col("st")))
+
+
+class TestGenerate:
+    @pytest.mark.parametrize("outer", [False, True])
+    @pytest.mark.parametrize("pos", [False, True])
+    def test_explode(self, outer, pos):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).explode(col("arr"), name="x",
+                                     outer=outer, pos=pos)
+            .select(*( [col("k"), col("pos"), col("x")] if pos
+                       else [col("k"), col("x")] )))
+
+    def test_explode_random(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _rand_df(s).explode(col("arr"), name="x")
+            .select(col("k"), col("x")))
+
+    def test_explode_then_aggregate(self):
+        from spark_rapids_tpu.ops import aggregates as AGG
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _rand_df(s).explode(col("arr"), name="x")
+            .group_by(col("k"))
+            .agg(AGG.AggregateExpression(AGG.Sum(col("x")), "sx"),
+                 AGG.AggregateExpression(AGG.Count(), "c")))
+
+    def test_explode_keeps_array_column(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _df(s).explode(col("arr"), name="x"))
