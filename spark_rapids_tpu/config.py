@@ -189,6 +189,30 @@ AUTO_BROADCAST_JOIN_ROWS = conf_int(
     "plan as broadcast hash joins; -1 disables (row-count analog of "
     "spark.sql.autoBroadcastJoinThreshold).")
 
+ADAPTIVE_ENABLED = conf_bool(
+    "spark.rapids.sql.adaptive.enabled", False,
+    "Re-plan shuffle reads with OBSERVED map-output sizes: coalesce "
+    "adjacent small reduce partitions toward the target size, and split "
+    "skewed partitions by map ranges where co-partitioning is not required "
+    "(GpuCustomShuffleReaderExec.scala:38 / ShuffledBatchRDD.scala:31-105 "
+    "analog). Off by default because every exchange here carries a "
+    "user-specified partition count, which Spark's AQE also respects.")
+
+ADAPTIVE_TARGET_SIZE = conf_int(
+    "spark.rapids.sql.adaptive.targetPartitionSizeBytes", 64 << 20,
+    "Advisory serialized size per post-shuffle partition for adaptive "
+    "coalescing/splitting (spark.sql.adaptive.advisoryPartitionSizeInBytes "
+    "analog).")
+
+ADAPTIVE_SKEW_FACTOR = conf_float(
+    "spark.rapids.sql.adaptive.skewedPartitionFactor", 5.0,
+    "A reduce partition is skewed when its size exceeds this multiple of "
+    "the median partition size (and the threshold below).")
+
+ADAPTIVE_SKEW_THRESHOLD = conf_int(
+    "spark.rapids.sql.adaptive.skewedPartitionThresholdBytes", 256 << 20,
+    "Minimum serialized size before a partition can be considered skewed.")
+
 # ---------------------------------------------------------------------------
 # Shuffle (reference RapidsConf.scala:522-618)
 # ---------------------------------------------------------------------------

@@ -110,12 +110,20 @@ class GetArrayItem(Expression):
     def eval_host(self, batch: HostBatch) -> pa.Array:
         arr = host_to_array(self.children[0].eval_host(batch),
                             batch.num_rows)
-        i = self.ordinal
         et = T.to_arrow_type(self.data_type)
-        if i is None or i < 0:
-            return pa.nulls(len(arr), type=et)
-        out = [v[i] if v is not None and i < len(v) else None
-               for v in arr.to_pylist()]
+        i = self.ordinal
+        if isinstance(self.children[1], Literal):
+            if i is None or i < 0:
+                return pa.nulls(len(arr), type=et)
+            ords = [i] * len(arr)
+        else:
+            # Per-row ordinal (the oracle/fallback path — the device rule
+            # tags non-literal ordinals off the TPU).
+            ords = host_to_array(self.children[1].eval_host(batch),
+                                 batch.num_rows).to_pylist()
+        out = [v[o] if v is not None and o is not None and 0 <= o < len(v)
+               else None
+               for v, o in zip(arr.to_pylist(), ords)]
         return pa.array(out, type=et)
 
     def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:

@@ -474,6 +474,9 @@ def _make_broadcast_join(n: "P.CpuBroadcastHashJoinExec", ch):
 def _shuffle_tag(meta: ExecMeta, conf: TpuConf):
     factory = meta.node.partitioner_factory
     if factory.mode == "range":
+        _no_complex_keys(meta, [o.child for o in (factory.orders or [])],
+                         "range partitioning key")
+    if factory.mode == "range":
         for o in factory.orders:
             if o.child.data_type is T.STRING:
                 meta.will_not_work(

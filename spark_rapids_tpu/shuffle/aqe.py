@@ -1,0 +1,120 @@
+"""Adaptive shuffle-read planning — the AQE analog.
+
+The reference plugs into Spark's adaptive query execution at shuffle
+boundaries: ``GpuCustomShuffleReaderExec`` (GpuCustomShuffleReaderExec.scala:38)
+reads shuffle output through partition SPECS computed from observed map
+output sizes, and ``ShuffledBatchRDD`` (ShuffledBatchRDD.scala:31-105)
+implements the three spec kinds (coalesced range, partial reducer, partial
+mapper). A standalone engine owns both halves: the exchange records each
+serialized block's size at write time, and the read side re-plans with those
+REAL sizes before any reduce work starts.
+
+Two spec kinds here (the two the reference's reader exercises):
+
+* :class:`CoalescedSpec` — one output partition reading the reduce-id range
+  ``[start, end)``. Preserves hash co-partitioning (whole reduce ids move
+  together), so it is always safe.
+* :class:`PartialReducerSpec` — one output partition reading only map ids
+  ``[map_start, map_end)`` of a single skewed reduce id. This SPLITS a
+  reduce id across outputs, so it is only applied where downstream does not
+  rely on co-partitioning (round-robin repartitions; Spark likewise limits
+  skew-split to reads whose consumers tolerate it).
+
+The mesh/ICI path (shuffle/ici.py) is a fixed-participant ``all_to_all``
+collective — partition counts are the mesh shape, so adaptive re-planning
+does not apply there.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class CoalescedSpec:
+    """Read reduce ids [start, end) as one output partition
+    (CoalescedPartitionSpec analog)."""
+
+    start: int
+    end: int
+
+
+@dataclasses.dataclass(frozen=True)
+class PartialReducerSpec:
+    """Read map ids [map_start, map_end) of one reduce id
+    (PartialReducerPartitionSpec analog)."""
+
+    reduce_id: int
+    map_start: int
+    map_end: int
+
+
+def _median(xs: List[int]) -> float:
+    s = sorted(xs)
+    n = len(s)
+    if n == 0:
+        return 0.0
+    mid = n // 2
+    return float(s[mid]) if n % 2 else (s[mid - 1] + s[mid]) / 2.0
+
+
+def plan_specs(block_sizes: Dict[Tuple[int, int], int], n_parts: int,
+               n_maps: int, target_size: int, skew_factor: float,
+               skew_threshold: int, allow_skew_split: bool
+               ) -> List[object]:
+    """Partition specs from observed sizes.
+
+    ``block_sizes`` maps (map_id, reduce_id) -> serialized bytes (absent =
+    empty). Mirrors Spark's ShufflePartitionsUtil: first mark skewed
+    partitions (> max(skew_factor * median, skew_threshold)) and split them
+    by map ranges packed toward ``target_size``; then greedily coalesce
+    adjacent non-skewed partitions while the running sum stays within
+    ``target_size``."""
+    sizes = [0] * n_parts
+    for (_m, r), b in block_sizes.items():
+        sizes[r] += b
+    med = _median(sizes)
+    skew_cut = max(skew_factor * med, float(skew_threshold))
+
+    specs: List[object] = []
+    run_start, run_bytes = None, 0
+
+    def flush_run(end: int):
+        nonlocal run_start, run_bytes
+        if run_start is not None:
+            specs.append(CoalescedSpec(run_start, end))
+            run_start, run_bytes = None, 0
+
+    for r in range(n_parts):
+        skewed = allow_skew_split and sizes[r] > skew_cut and n_maps > 1
+        if skewed:
+            flush_run(r)
+            specs.extend(_split_by_maps(block_sizes, r, n_maps, target_size))
+            continue
+        if run_start is None:
+            run_start, run_bytes = r, sizes[r]
+        elif run_bytes + sizes[r] > target_size and run_bytes > 0:
+            flush_run(r)
+            run_start, run_bytes = r, sizes[r]
+        else:
+            run_bytes += sizes[r]
+    flush_run(n_parts)
+    return specs
+
+
+def _split_by_maps(block_sizes: Dict[Tuple[int, int], int], reduce_id: int,
+                   n_maps: int, target_size: int) -> List[PartialReducerSpec]:
+    """Pack contiguous map-id ranges of one reduce id toward target_size
+    (the reference's createSkewPartitionSpecs shape)."""
+    out: List[PartialReducerSpec] = []
+    start, acc = 0, 0
+    for m in range(n_maps):
+        b = block_sizes.get((m, reduce_id), 0)
+        if acc > 0 and acc + b > target_size:
+            out.append(PartialReducerSpec(reduce_id, start, m))
+            start, acc = m, b
+        else:
+            acc += b
+    out.append(PartialReducerSpec(reduce_id, start, n_maps))
+    return out

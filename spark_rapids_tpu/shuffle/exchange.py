@@ -79,11 +79,14 @@ class ShuffleBufferCatalog:
                 self._blocks[key] = payload
                 self._host_bytes += len(payload)
 
-    def blocks_for_reduce(self, shuffle_id: int, reduce_id: int
+    def blocks_for_reduce(self, shuffle_id: int, reduce_id: int,
+                          map_range: Optional[Tuple[int, int]] = None
                           ) -> List[bytes]:
         with self._lock:
             keys = sorted(k for k in self._blocks
-                          if k[0] == shuffle_id and k[2] == reduce_id)
+                          if k[0] == shuffle_id and k[2] == reduce_id
+                          and (map_range is None
+                               or map_range[0] <= k[1] < map_range[1]))
             out = []
             for k in keys:
                 v = self._blocks[k]
@@ -92,6 +95,15 @@ class ShuffleBufferCatalog:
                 else:
                     out.append(v)
             return out
+
+    def sizes_for_shuffle(self, shuffle_id: int
+                          ) -> Dict[Tuple[int, int], int]:
+        """(map_id, reduce_id) -> serialized bytes: the observed statistics
+        adaptive re-planning runs on (MapStatus sizes analog)."""
+        with self._lock:
+            return {(m, r): (v[1] if isinstance(v, tuple) else len(v))
+                    for (s, m, r), v in self._blocks.items()
+                    if s == shuffle_id}
 
     def unregister_shuffle(self, shuffle_id: int):
         with self._lock:
@@ -226,19 +238,47 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         # partitions) — the unregisterShuffle lifecycle
         # (ShuffleBufferCatalog.scala:50).
         ctx.add_cleanup(lambda: catalog.unregister_shuffle(shuffle_id))
+
+        # Adaptive read planning with the OBSERVED block sizes
+        # (GpuCustomShuffleReaderExec analog; see shuffle/aqe.py). Skew
+        # split only for round-robin exchanges, which carry no
+        # co-partitioning guarantee downstream.
+        from ..config import (ADAPTIVE_ENABLED, ADAPTIVE_SKEW_FACTOR,
+                              ADAPTIVE_SKEW_THRESHOLD, ADAPTIVE_TARGET_SIZE)
+        from . import aqe
+        if ctx.conf.get(ADAPTIVE_ENABLED) and n_parts > 1:
+            specs = aqe.plan_specs(
+                catalog.sizes_for_shuffle(shuffle_id), n_parts, map_id,
+                ctx.conf.get(ADAPTIVE_TARGET_SIZE),
+                ctx.conf.get(ADAPTIVE_SKEW_FACTOR),
+                ctx.conf.get(ADAPTIVE_SKEW_THRESHOLD),
+                allow_skew_split=getattr(self.partitioner_factory, "mode",
+                                         None) == "round_robin")
+            ctx.metric("TpuShuffleExchange", "aqeOutputPartitions",
+                       len(specs))
+        else:
+            specs = [aqe.CoalescedSpec(p, p + 1) for p in range(n_parts)]
         drained = {"n": 0}
 
-        def read_partition(p):
+        def read_spec(spec):
             try:
-                for payload in catalog.blocks_for_reduce(shuffle_id, p):
-                    with trace_range("shuffle.deserialize"):
-                        _, rb = deserialize_batch(payload)
-                    yield ColumnarBatch.from_arrow(rb)
+                if isinstance(spec, aqe.PartialReducerSpec):
+                    pieces = [(spec.reduce_id,
+                               (spec.map_start, spec.map_end))]
+                else:
+                    pieces = [(p, None)
+                              for p in range(spec.start, spec.end)]
+                for p, map_range in pieces:
+                    for payload in catalog.blocks_for_reduce(
+                            shuffle_id, p, map_range):
+                        with trace_range("shuffle.deserialize"):
+                            _, rb = deserialize_batch(payload)
+                        yield ColumnarBatch.from_arrow(rb)
             finally:
                 drained["n"] += 1
-                if drained["n"] == n_parts:
+                if drained["n"] == len(specs):
                     catalog.unregister_shuffle(shuffle_id)
-        return [read_partition(p) for p in range(self.n_parts)]
+        return [read_spec(s) for s in specs]
 
 
 def _shuffle_env(ctx: ExecContext) -> ShuffleBufferCatalog:

@@ -152,14 +152,38 @@ def spark_hash_columns_device(cols: Sequence[DeviceColumn],
     n = cols[0].capacity
     h = jnp.full(n, jnp.uint32(seed & 0xFFFFFFFF), dtype=jnp.uint32)
     for c in cols:
-        if c.is_string:
-            from ..ops.strings_util import lengths as str_lengths
-            m = char_matrix(c)
-            nh = murmur3_bytes_rows(jnp, m, str_lengths(c), h)
-            h = jnp.where(c.validity, nh, h)
-        else:
-            h = hash_column(jnp, c.data, c.validity, c.dtype, h)
+        h = _hash_device_column(c, h)
     return h.astype(jnp.int32)
+
+
+def _hash_device_column(c: DeviceColumn, h: jnp.ndarray) -> jnp.ndarray:
+    """Fold one column into the running row hash, Spark semantics: null
+    values (and null elements/fields) leave the hash unchanged; arrays and
+    structs fold element-by-element / field-by-field
+    (Spark HashExpression.computeHash on ArrayType/StructType)."""
+    if c.is_struct:
+        hh = h
+        for kid in c.children:
+            hh = _hash_device_column(kid, hh)
+        return jnp.where(c.validity, hh, h)
+    if c.is_array:
+        # Sequential fold over the padded element lanes; masked lanes keep
+        # the running hash, exactly like Spark's per-element loop.
+        hh = h
+        in_len = jnp.arange(c.max_len, dtype=jnp.int32)[None, :] \
+            < c.lengths[:, None]
+        for j in range(c.max_len):
+            live = in_len[:, j] & c.elem_validity[:, j]
+            nh = hash_column(jnp, c.data[:, j], live,
+                             c.dtype.element_type, hh)
+            hh = jnp.where(live, nh, hh)
+        return jnp.where(c.validity, hh, h)
+    if c.is_string:
+        from ..ops.strings_util import lengths as str_lengths
+        m = char_matrix(c)
+        nh = murmur3_bytes_rows(jnp, m, str_lengths(c), h)
+        return jnp.where(c.validity, nh, h)
+    return hash_column(jnp, c.data, c.validity, c.dtype, h)
 
 
 def spark_hash_columns_host(arrays, dtypes: List[T.DataType],
@@ -171,33 +195,60 @@ def spark_hash_columns_host(arrays, dtypes: List[T.DataType],
     old = np.seterr(over="ignore")
     try:
         for arr, dt in zip(arrays, dtypes):
-            validity = np.asarray(arr.is_valid()) if arr.null_count \
-                else np.ones(n, dtype=bool)
-            if dt is T.STRING:
-                lengths = np.zeros(n, dtype=np.int32)
-                vals = arr.to_pylist()
-                w = max([len(v.encode()) if v else 0 for v in vals] + [4])
-                w = ((w + 3) // 4) * 4
-                mat = np.full((n, w), -1, dtype=np.int16)
-                for i, v in enumerate(vals):
-                    if v is not None:
-                        raw = np.frombuffer(v.encode(), dtype=np.uint8)
-                        lengths[i] = len(raw)
-                        mat[i, : len(raw)] = raw
-                nh = murmur3_bytes_rows(np, mat, lengths, h)
-                h = np.where(validity, nh, h)
-            else:
-                filled = arr.fill_null(False if dt is T.BOOLEAN else 0) \
-                    if arr.null_count else arr
-                vals = filled.to_numpy(zero_copy_only=False)
-                if vals.dtype.kind == "M":
-                    unit = "D" if dt is T.DATE else "us"
-                    vals = vals.astype(f"datetime64[{unit}]").view(np.int64)
-                vals = vals.astype(dt.np_dtype, copy=False)
-                h = hash_column(np, vals, validity, dt, h)
+            h = _hash_host_column(arr, dt, h)
     finally:
         np.seterr(**old)
     return h.astype(np.int32)
+
+
+def _hash_host_column(arr, dt: T.DataType, h: np.ndarray) -> np.ndarray:
+    """Host fold of one pyarrow column into the running row hash (same
+    semantics as _hash_device_column)."""
+    import pyarrow as pa
+    n = len(arr)
+    validity = np.asarray(arr.is_valid()) if arr.null_count \
+        else np.ones(n, dtype=bool)
+    if isinstance(dt, T.StructType):
+        hh = h
+        for i, f in enumerate(dt.fields):
+            hh = _hash_host_column(arr.field(i), f.data_type, hh)
+        return np.where(validity, hh, h)
+    if isinstance(dt, T.ArrayType):
+        # Oracle path: per-row element fold in Python.
+        et = dt.element_type
+        out = h.copy()
+        for i, lst in enumerate(arr.to_pylist()):
+            if lst is None:
+                continue
+            hh = out[i: i + 1].copy()
+            for v in lst:
+                if v is None:
+                    continue
+                one = pa.array([v], type=T.to_arrow_type(et))
+                hh = _hash_host_column(one, et, hh)
+            out[i] = hh[0]
+        return np.where(validity, out, h)
+    if dt is T.STRING:
+        lengths = np.zeros(n, dtype=np.int32)
+        vals = arr.to_pylist()
+        w = max([len(v.encode()) if v else 0 for v in vals] + [4])
+        w = ((w + 3) // 4) * 4
+        mat = np.full((n, w), -1, dtype=np.int16)
+        for i, v in enumerate(vals):
+            if v is not None:
+                raw = np.frombuffer(v.encode(), dtype=np.uint8)
+                lengths[i] = len(raw)
+                mat[i, : len(raw)] = raw
+        nh = murmur3_bytes_rows(np, mat, lengths, h)
+        return np.where(validity, nh, h)
+    filled = arr.fill_null(False if dt is T.BOOLEAN else 0) \
+        if arr.null_count else arr
+    vals = filled.to_numpy(zero_copy_only=False)
+    if vals.dtype.kind == "M":
+        unit = "D" if dt is T.DATE else "us"
+        vals = vals.astype(f"datetime64[{unit}]").view(np.int64)
+    vals = vals.astype(dt.np_dtype, copy=False)
+    return hash_column(np, vals, validity, dt, h)
 
 
 def pmod_partition(hash32, n_parts: int, xp=jnp):

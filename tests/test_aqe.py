@@ -1,0 +1,119 @@
+"""Adaptive shuffle-read planning tests (GpuCustomShuffleReaderExec /
+ShuffledBatchRDD spec analog, shuffle/aqe.py)."""
+
+import pyarrow as pa
+import numpy as np
+
+from spark_rapids_tpu.ops.expression import col
+from spark_rapids_tpu.shuffle import aqe
+from spark_rapids_tpu.shuffle.aqe import CoalescedSpec, PartialReducerSpec
+
+from harness import assert_tpu_and_cpu_are_equal, tpu_session
+
+
+class TestSpecPlanning:
+    def test_coalesces_small_adjacent(self):
+        sizes = {(0, r): 10 for r in range(8)}
+        specs = aqe.plan_specs(sizes, 8, 1, target_size=35, skew_factor=5.0,
+                               skew_threshold=1 << 30,
+                               allow_skew_split=False)
+        assert specs == [CoalescedSpec(0, 3), CoalescedSpec(3, 6),
+                         CoalescedSpec(6, 8)]
+
+    def test_large_partitions_stay_alone(self):
+        sizes = {(0, 0): 100, (0, 1): 5, (0, 2): 5, (0, 3): 100}
+        specs = aqe.plan_specs(sizes, 4, 1, target_size=50, skew_factor=5.0,
+                               skew_threshold=1 << 30,
+                               allow_skew_split=False)
+        assert specs == [CoalescedSpec(0, 1), CoalescedSpec(1, 3),
+                         CoalescedSpec(3, 4)]
+
+    def test_empty_partitions_merge(self):
+        specs = aqe.plan_specs({(0, 3): 10}, 6, 1, target_size=100,
+                               skew_factor=5.0, skew_threshold=1 << 30,
+                               allow_skew_split=False)
+        assert specs == [CoalescedSpec(0, 6)]
+
+    def test_skew_split_by_map_ranges(self):
+        # Partition 1 is 40x the median and over threshold: split it.
+        sizes = {(m, r): 5 for m in range(4) for r in (0, 2, 3)}
+        sizes.update({(m, 1): 200 for m in range(4)})
+        specs = aqe.plan_specs(sizes, 4, 4, target_size=400,
+                               skew_factor=5.0, skew_threshold=100,
+                               allow_skew_split=True)
+        assert specs == [
+            CoalescedSpec(0, 1),
+            PartialReducerSpec(1, 0, 2), PartialReducerSpec(1, 2, 4),
+            CoalescedSpec(2, 4)]
+
+    def test_skew_needs_opt_in(self):
+        sizes = {(m, r): 5 for m in range(4) for r in (0, 2, 3)}
+        sizes.update({(m, 1): 200 for m in range(4)})
+        specs = aqe.plan_specs(sizes, 4, 4, target_size=400,
+                               skew_factor=5.0, skew_threshold=100,
+                               allow_skew_split=False)
+        assert all(isinstance(s, CoalescedSpec) for s in specs)
+
+
+def _skewed_batch(n=2000, seed=0):
+    rng = np.random.default_rng(seed)
+    # ~90% of rows share one key -> one giant hash partition.
+    k = np.where(rng.random(n) < 0.9, 7, rng.integers(0, 64, n))
+    return pa.RecordBatch.from_pydict({
+        "k": pa.array(k, pa.int64()),
+        "v": pa.array(rng.integers(-100, 100, n), pa.int64()),
+    })
+
+
+AQE_CONF = {
+    "spark.rapids.sql.adaptive.enabled": True,
+    "spark.rapids.sql.adaptive.targetPartitionSizeBytes": 4096,
+    "spark.rapids.sql.adaptive.skewedPartitionThresholdBytes": 2048,
+}
+
+
+class TestAdaptiveExchange:
+    def test_hash_repartition_coalesces_and_stays_correct(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(_skewed_batch())
+            .repartition(16, col("k"))
+            .group_by(col("k")).count(),
+            conf=AQE_CONF)
+
+    def test_round_robin_skew_split_correct(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(_skewed_batch())
+            .repartition(4)
+            .select(col("k"), col("v")),
+            conf=AQE_CONF)
+
+    def test_coalesce_reduces_partition_count(self):
+        s = tpu_session(**{**AQE_CONF,
+                           "spark.rapids.sql.test.enabled": False})
+        df = s.create_dataframe(_skewed_batch()) \
+            .repartition(16, col("k"))
+        from spark_rapids_tpu.plan import physical as P
+        physical = s.plan(df._plan)
+        ctx = P.ExecContext(s.conf, catalog=s.device_manager.catalog)
+        try:
+            parts = physical.execute(ctx)
+            n_out = len(parts)
+            rows = sum(b.num_rows for p in parts for b in p)
+        finally:
+            ctx.close()
+        assert rows == 2000
+        assert n_out < 16, f"expected coalesced reads, got {n_out}"
+
+    def test_hash_partition_never_splits_reduce_ids(self):
+        # Hash exchange: skew split must NOT apply even when partitions are
+        # huge — downstream group-by relies on co-partitioning.
+        s = tpu_session(**{**AQE_CONF,
+                           "spark.rapids.sql.test.enabled": False})
+        df = s.create_dataframe(_skewed_batch()) \
+            .repartition(8, col("k")).group_by(col("k")).count()
+        got = df.collect().to_pylist()
+        want = {}
+        rb = _skewed_batch()
+        for k in rb.column(0).to_pylist():
+            want[k] = want.get(k, 0) + 1
+        assert {r["k"]: r["count"] for r in got} == want

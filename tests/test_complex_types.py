@@ -83,6 +83,14 @@ class TestArrayExpressions:
             lambda s: _rand_df(s, seed=1).union(_rand_df(s, seed=2))
             .select(col("arr")))
 
+    @pytest.mark.parametrize("key", ["arr", "k"])
+    def test_repartition_by_array_on_device(self, key):
+        # Hash partitioning folds array elements like Spark's
+        # HashExpression.computeHash — runs on device, no fallback.
+        assert_tpu_and_cpu_are_equal(
+            lambda s: _rand_df(s).repartition(4, col(key))
+            .select(col("k"), col("arr")))
+
     def test_group_by_array_tags_fallback(self):
         # Array grouping keys must be tagged off the TPU (the CPU oracle
         # can't group by lists either, so this checks planning only).
