@@ -913,25 +913,28 @@ class TpuShuffledHashJoinExec(TpuExec):
         post_filter = join_post_filter(self.condition, out_schema)
 
         def join_batch(probe, build):
-            # Optimistic output sizing: allocate from the probe capacity and
-            # defer the real match-count check to a device-side flag the
-            # session reads ONCE per query (TpuSession.execute retry loop).
-            # The old int(total) here cost a ~100ms tunnel round trip per
-            # probe batch and broke whole-stage fusion tracing.
-            out_cap = bucket_capacity(
-                max(int(probe.capacity * self.growth * ctx.join_growth), 128))
+            # Optimistic output sizing: allocate from the learned exact
+            # capacity for this join site when a previous run of this plan
+            # observed it (ctx.join_caps, filled by the session's
+            # overflow-learning retry), else from the probe capacity. The
+            # real match count stays a deferred device-side observation the
+            # session reads ONCE per query — no per-batch host syncs.
             if jt in ("left_semi", "left_anti"):
-                out, hits = kernel(probe, build, out_cap)
+                out, hits = kernel(probe, build, probe.capacity)
                 return ColumnarBatch(out.columns, out.n_rows, out_schema), hits
+            site = ctx.next_join_site()
+            out_cap = ctx.join_caps.get(site) or bucket_capacity(
+                max(int(probe.capacity * self.growth * ctx.join_growth), 128))
             (out, hits), total = kernel(probe, build, out_cap)
             if ctx.eager_overflow:
                 # Exact resize with a per-batch sync: for side-effecting
-                # plans (writes) and the retry ladder's guaranteed rung.
+                # plans (writes) and the guaranteed last retry rung.
                 t = int(total)
                 if t > out_cap:
                     (out, hits), _ = kernel(probe, build, bucket_capacity(t))
             else:
                 ctx.overflow_flags.append(total > out_cap)
+                ctx.join_totals.append((site, total))
             if post_filter is not None:
                 out = post_filter(out)
             return out, hits

@@ -27,7 +27,7 @@ Contract:
   bucket, the full batch (still device-resident) downloads in a second
   round trip — the price only large collects pay.
 * Join overflow flags ride the same transfer; ``TpuSession.execute``
-  re-runs the query with a larger ``join_growth`` when one trips.
+  re-runs the query with learned exact join capacities when one trips.
 """
 
 from __future__ import annotations
@@ -154,8 +154,8 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     """Run a fusable plan as one compiled program.
 
     Returns ``(table, overflowed)``; ``table`` is None when a join's
-    deferred overflow check tripped and the caller must retry with a larger
-    ``ctx.join_growth``."""
+    deferred overflow check tripped and the caller must retry with the
+    learned exact join capacities (``ctx.join_caps``)."""
     device_plan = root.children[0]
     boundaries: List = []
     fused_plan = _split(device_plan, boundaries)

@@ -227,9 +227,10 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                         continue
                     # Optimistic sizing + deferred overflow flag — same
                     # no-sync discipline as TpuShuffledHashJoinExec; the
-                    # session retries with a larger ctx.join_growth if the
-                    # pair count exceeded the allocation.
-                    out_cap = bucket_capacity(
+                    # session retries with the learned exact capacity when
+                    # the pair count exceeded the allocation.
+                    site = ctx.next_join_site()
+                    out_cap = ctx.join_caps.get(site) or bucket_capacity(
                         max(int(probe.capacity * ctx.join_growth), 128))
                     (out, extra), n_match = kernel(probe, build, out_cap)
                     if ctx.eager_overflow:
@@ -239,6 +240,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                                                      bucket_capacity(t))
                     else:
                         ctx.overflow_flags.append(n_match > out_cap)
+                        ctx.join_totals.append((site, n_match))
                     yield out
                     if extra is not None:
                         yield _null_extend_right(extra, out_schema, n_right)

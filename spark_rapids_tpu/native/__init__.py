@@ -1,0 +1,96 @@
+"""Native host runtime loader.
+
+Compiles the C++ sources in this directory into one shared library on first
+import (g++ is part of the toolchain; ~1s, cached by source mtime) and
+exposes it through ctypes. Callers use :func:`lib` and must fall back to
+their pure-Python path when it returns None — the engine never hard-requires
+the native build (same stance as the reference, whose JNI layer is a
+packaged dependency, SURVEY.md §2.10).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SOURCES = ["hostkern.cpp", "arena.cpp"]
+_SO = os.path.join(_DIR, "_build", "libsrtpu_host.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_SO):
+        return True
+    so_mtime = os.path.getmtime(_SO)
+    return any(os.path.getmtime(os.path.join(_DIR, s)) > so_mtime
+               for s in _SOURCES)
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    srcs = [os.path.join(_DIR, s) for s in _SOURCES]
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+           "-o", _SO] + srcs
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def _declare(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64, u32p, u8p = ctypes.c_int64, \
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint8)
+    for name, args in [
+        ("sr_hash_col_i32", [ctypes.c_void_p, u8p, i64, u32p]),
+        ("sr_hash_col_i64", [ctypes.c_void_p, u8p, i64, u32p]),
+        ("sr_hash_col_f32", [ctypes.c_void_p, u8p, i64, u32p]),
+        ("sr_hash_col_f64", [ctypes.c_void_p, u8p, i64, u32p]),
+        ("sr_hash_col_str", [ctypes.c_void_p, ctypes.c_void_p, u8p, i64,
+                             u32p]),
+        ("sr_arena_write", [ctypes.c_void_p, i64, ctypes.c_void_p, i64]),
+        ("sr_arena_read", [ctypes.c_void_p, i64, ctypes.c_void_p, i64]),
+    ]:
+        fn = getattr(lib, name)
+        fn.argtypes = args
+        fn.restype = None
+    lib.sr_arena_create.argtypes = [i64]
+    lib.sr_arena_create.restype = ctypes.c_void_p
+    lib.sr_arena_destroy.argtypes = [ctypes.c_void_p]
+    lib.sr_arena_destroy.restype = None
+    lib.sr_arena_base.argtypes = [ctypes.c_void_p]
+    lib.sr_arena_base.restype = ctypes.POINTER(ctypes.c_uint8)
+    lib.sr_arena_alloc.argtypes = [ctypes.c_void_p, i64]
+    lib.sr_arena_alloc.restype = i64
+    lib.sr_arena_free.argtypes = [ctypes.c_void_p, i64]
+    lib.sr_arena_free.restype = ctypes.c_int
+    lib.sr_arena_in_use.argtypes = [ctypes.c_void_p]
+    lib.sr_arena_in_use.restype = i64
+    return lib
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """The loaded native library, or None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SPARK_RAPIDS_TPU_NO_NATIVE"):
+            return None
+        try:
+            if _needs_build() and not _build():
+                return None
+            _lib = _declare(ctypes.CDLL(_SO))
+        except OSError:
+            _lib = None
+    return _lib

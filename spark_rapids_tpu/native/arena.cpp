@@ -1,0 +1,120 @@
+// Host arena allocator — the RMM / AddressSpaceAllocator analog.
+//
+// The reference backs device memory with RMM's pooled allocator
+// (GpuDeviceManager.scala:209) and slices host memory through a best-fit
+// address-space sub-allocator (AddressSpaceAllocator.scala:22). On TPU the
+// device pool belongs to XLA, but the HOST tier of the spill/shuffle chain
+// still wants one: thousands of serialized shuffle blocks as individual
+// Python bytes objects fragment the heap and double-copy on every spill.
+// This arena carves offsets out of ONE contiguous region with a best-fit
+// free list and neighbor coalescing.
+//
+// C ABI for ctypes; no dependencies.
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+
+namespace {
+
+struct Arena {
+  uint8_t* base;
+  int64_t capacity;
+  // free blocks: offset -> size (ordered, so neighbor coalescing is a
+  // map lookup); allocated blocks: offset -> size.
+  std::map<int64_t, int64_t> free_blocks;
+  std::map<int64_t, int64_t> used;
+  int64_t in_use;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* sr_arena_create(int64_t capacity) {
+  auto* a = new (std::nothrow) Arena();
+  if (!a) return nullptr;
+  a->base = static_cast<uint8_t*>(std::malloc(capacity));
+  if (!a->base) {
+    delete a;
+    return nullptr;
+  }
+  a->capacity = capacity;
+  a->free_blocks[0] = capacity;
+  a->in_use = 0;
+  return a;
+}
+
+void sr_arena_destroy(void* handle) {
+  auto* a = static_cast<Arena*>(handle);
+  std::free(a->base);
+  delete a;
+}
+
+uint8_t* sr_arena_base(void* handle) {
+  return static_cast<Arena*>(handle)->base;
+}
+
+int64_t sr_arena_in_use(void* handle) {
+  return static_cast<Arena*>(handle)->in_use;
+}
+
+// Best-fit allocate; returns offset or -1 when no block fits.
+int64_t sr_arena_alloc(void* handle, int64_t size) {
+  auto* a = static_cast<Arena*>(handle);
+  if (size <= 0) size = 1;
+  auto best = a->free_blocks.end();
+  for (auto it = a->free_blocks.begin(); it != a->free_blocks.end(); ++it) {
+    if (it->second >= size &&
+        (best == a->free_blocks.end() || it->second < best->second)) {
+      best = it;
+    }
+  }
+  if (best == a->free_blocks.end()) return -1;
+  int64_t offset = best->first;
+  int64_t block = best->second;
+  a->free_blocks.erase(best);
+  if (block > size) a->free_blocks[offset + size] = block - size;
+  a->used[offset] = size;
+  a->in_use += size;
+  return offset;
+}
+
+// Free + coalesce with adjacent free neighbors. Returns 0 ok, -1 unknown.
+int sr_arena_free(void* handle, int64_t offset) {
+  auto* a = static_cast<Arena*>(handle);
+  auto it = a->used.find(offset);
+  if (it == a->used.end()) return -1;
+  int64_t size = it->second;
+  a->used.erase(it);
+  a->in_use -= size;
+  // merge with successor
+  auto next = a->free_blocks.find(offset + size);
+  if (next != a->free_blocks.end()) {
+    size += next->second;
+    a->free_blocks.erase(next);
+  }
+  // merge with predecessor
+  auto prev = a->free_blocks.lower_bound(offset);
+  if (prev != a->free_blocks.begin()) {
+    --prev;
+    if (prev->first + prev->second == offset) {
+      prev->second += size;
+      return 0;
+    }
+  }
+  a->free_blocks[offset] = size;
+  return 0;
+}
+
+void sr_arena_write(void* handle, int64_t offset, const uint8_t* src,
+                    int64_t len) {
+  std::memcpy(static_cast<Arena*>(handle)->base + offset, src, len);
+}
+
+void sr_arena_read(void* handle, int64_t offset, uint8_t* dst, int64_t len) {
+  std::memcpy(dst, static_cast<Arena*>(handle)->base + offset, len);
+}
+
+}  // extern "C"

@@ -1,0 +1,70 @@
+"""HostArena — Python face of the native best-fit arena (arena.cpp).
+
+Backs the host tier of the shuffle block catalog: serialized blocks live at
+offsets inside one contiguous native region instead of thousands of Python
+bytes objects (the AddressSpaceAllocator/host-store role from the
+reference's spill chain). Falls back transparently when the native library
+is unavailable — callers check :attr:`available`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+from . import lib
+
+
+class HostArena:
+    def __init__(self, capacity_bytes: int):
+        self._lib = lib()
+        self._handle = None
+        if self._lib is not None:
+            self._handle = self._lib.sr_arena_create(int(capacity_bytes))
+
+    @property
+    def available(self) -> bool:
+        return self._handle is not None
+
+    @property
+    def in_use(self) -> int:
+        if self._handle is None:
+            return 0
+        return int(self._lib.sr_arena_in_use(self._handle))
+
+    def put(self, payload: bytes) -> Optional[int]:
+        """Store payload; returns its offset or None when the arena is full
+        (caller falls back to its own storage)."""
+        if self._handle is None:
+            return None
+        off = self._lib.sr_arena_alloc(self._handle, len(payload))
+        if off < 0:
+            return None
+        self._lib.sr_arena_write(
+            self._handle, off,
+            ctypes.cast(ctypes.c_char_p(payload), ctypes.c_void_p),
+            len(payload))
+        return int(off)
+
+    def get(self, offset: int, length: int) -> bytes:
+        if self._handle is None:
+            raise RuntimeError("arena is closed or unavailable")
+        buf = ctypes.create_string_buffer(length)
+        self._lib.sr_arena_read(self._handle, offset,
+                                ctypes.cast(buf, ctypes.c_void_p), length)
+        return buf.raw
+
+    def free(self, offset: int) -> None:
+        if self._handle is not None:
+            self._lib.sr_arena_free(self._handle, offset)
+
+    def close(self) -> None:
+        if self._handle is not None:
+            self._lib.sr_arena_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):  # pragma: no cover - gc safety net
+        try:
+            self.close()
+        except Exception:
+            pass

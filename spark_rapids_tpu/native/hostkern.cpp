@@ -1,0 +1,140 @@
+// Host columnar kernels — the native side of the host runtime.
+//
+// The reference's host hot paths live in C++ behind JNI (libcudf host code,
+// JCudfSerialization buffer assembly); this library plays that role for the
+// TPU engine's host paths. First resident: Spark Murmur3 row hashing
+// (bit-for-bit the semantics of shuffle/partitioning.py's numpy/jnp
+// implementation, itself matching Spark's Murmur3_x86_32) — used by the CPU
+// oracle exchange and any host-side partition placement, where the Python
+// per-row string loop was the cost.
+//
+// Build: g++ -O3 -shared -fPIC (see native/__init__.py); pure C ABI for
+// ctypes. No dependencies.
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t C1 = 0xCC9E2D51u;
+constexpr uint32_t C2 = 0x1B873593u;
+
+inline uint32_t rotl32(uint32_t x, int r) {
+  return (x << r) | (x >> (32 - r));
+}
+
+inline uint32_t mix_k1(uint32_t k1) {
+  k1 *= C1;
+  k1 = rotl32(k1, 15);
+  return k1 * C2;
+}
+
+inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+  h1 ^= k1;
+  h1 = rotl32(h1, 13);
+  return h1 * 5u + 0xE6546B64u;
+}
+
+inline uint32_t fmix(uint32_t h1, uint32_t length) {
+  h1 ^= length;
+  h1 ^= h1 >> 16;
+  h1 *= 0x85EBCA6Bu;
+  h1 ^= h1 >> 13;
+  h1 *= 0xC2B2AE35u;
+  return h1 ^ (h1 >> 16);
+}
+
+inline uint32_t hash_int(uint32_t v, uint32_t seed) {
+  return fmix(mix_h1(seed, mix_k1(v)), 4);
+}
+
+inline uint32_t hash_long(uint64_t v, uint32_t seed) {
+  uint32_t h1 = mix_h1(seed, mix_k1(static_cast<uint32_t>(v)));
+  h1 = mix_h1(h1, mix_k1(static_cast<uint32_t>(v >> 32)));
+  return fmix(h1, 8);
+}
+
+// Spark Murmur3_x86_32.hashUnsafeBytes: 4-byte little-endian blocks through
+// the full mix, then the 1-3 trailing bytes one at a time as SIGNED ints.
+inline uint32_t hash_bytes(const uint8_t* data, int32_t len, uint32_t seed) {
+  uint32_t h1 = seed;
+  int32_t aligned = (len / 4) * 4;
+  for (int32_t i = 0; i < aligned; i += 4) {
+    uint32_t block;
+    std::memcpy(&block, data + i, 4);  // little-endian hosts only
+    h1 = mix_h1(h1, mix_k1(block));
+  }
+  for (int32_t i = aligned; i < len; i++) {
+    int32_t signed_byte = static_cast<int8_t>(data[i]);
+    h1 = mix_h1(h1, mix_k1(static_cast<uint32_t>(signed_byte)));
+  }
+  return fmix(h1, static_cast<uint32_t>(len));
+}
+
+}  // namespace
+
+extern "C" {
+
+// Fold one int-width column into the running row hashes h[n]; invalid rows
+// keep their hash (Spark skips null columns per row).
+void sr_hash_col_i32(const int32_t* vals, const uint8_t* valid, int64_t n,
+                     uint32_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid[i]) h[i] = hash_int(static_cast<uint32_t>(vals[i]), h[i]);
+  }
+}
+
+void sr_hash_col_i64(const int64_t* vals, const uint8_t* valid, int64_t n,
+                     uint32_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (valid[i]) h[i] = hash_long(static_cast<uint64_t>(vals[i]), h[i]);
+  }
+}
+
+// Floats hash their IEEE bits with NaN canonicalized and -0.0 -> 0.0
+// (Spark Murmur3Hash semantics).
+void sr_hash_col_f32(const float* vals, const uint8_t* valid, int64_t n,
+                     uint32_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (!valid[i]) continue;
+    float v = vals[i];
+    uint32_t bits;
+    if (v != v) {
+      bits = 0x7FC00000u;
+    } else if (v == 0.0f) {
+      bits = 0;
+    } else {
+      std::memcpy(&bits, &v, 4);
+    }
+    h[i] = hash_int(bits, h[i]);
+  }
+}
+
+void sr_hash_col_f64(const double* vals, const uint8_t* valid, int64_t n,
+                     uint32_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (!valid[i]) continue;
+    double v = vals[i];
+    uint64_t bits;
+    if (v != v) {
+      bits = 0x7FF8000000000000ull;
+    } else if (v == 0.0) {
+      bits = 0;
+    } else {
+      std::memcpy(&bits, &v, 8);
+    }
+    h[i] = hash_long(bits, h[i]);
+  }
+}
+
+// Arrow string layout: offsets[n+1] into payload; per-row hashUnsafeBytes.
+void sr_hash_col_str(const int32_t* offsets, const uint8_t* payload,
+                     const uint8_t* valid, int64_t n, uint32_t* h) {
+  for (int64_t i = 0; i < n; i++) {
+    if (!valid[i]) continue;
+    int32_t start = offsets[i];
+    h[i] = hash_bytes(payload + start, offsets[i + 1] - start, h[i]);
+  }
+}
+
+}  // extern "C"

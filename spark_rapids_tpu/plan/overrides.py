@@ -476,7 +476,6 @@ def _shuffle_tag(meta: ExecMeta, conf: TpuConf):
     if factory.mode == "range":
         _no_complex_keys(meta, [o.child for o in (factory.orders or [])],
                          "range partitioning key")
-    if factory.mode == "range":
         for o in factory.orders:
             if o.child.data_type is T.STRING:
                 meta.will_not_work(

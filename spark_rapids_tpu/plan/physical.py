@@ -55,6 +55,24 @@ class ExecContext:
     #: True while executing under a whole-stage fusion trace: execs must not
     #: force host syncs (int(n_rows)) or touch the spill catalog.
     in_fusion: bool = False
+    #: Exact join output capacities learned from a previous run of the same
+    #: plan (site ordinal -> static capacity). Joins consult this before
+    #: falling back to the optimistic probe-capacity guess; the session
+    #: fills it from observed match totals and caches it per plan signature
+    #: so steady-state queries execute exactly once.
+    join_caps: dict = dataclasses.field(default_factory=dict)
+    #: (site ordinal, traced total-match-count scalar) per deferred join
+    #: batch — the observations join_caps learns from.
+    join_totals: list = dataclasses.field(default_factory=list)
+    _join_site: int = 0
+
+    def next_join_site(self) -> int:
+        """Deterministic per-execution ordinal for a join probe batch
+        (execution order is deterministic, so ordinals are stable across
+        runs of the same plan)."""
+        s = self._join_site
+        self._join_site += 1
+        return s
 
     def metric(self, node: str, name: str, value):
         self.metrics.setdefault(node, {})

@@ -100,30 +100,52 @@ class TpuSession:
         cpu_plan = plan_physical(prune_columns(logical), self.conf)
         return self._overrides.apply(cpu_plan)
 
-    #: Deferred-overflow retry ladder: optimistic join/exchange sizing with
-    #: growing buckets, ending in the eager exact-resize rung that can
-    #: never overflow.
-    _ATTEMPTS = (("deferred", 1.0), ("deferred", 8.0), ("deferred", 64.0),
-                 ("eager", 1.0))
+    #: plan signature -> {join site ordinal: exact output capacity}. Learned
+    #: from observed match totals the first time a plan's optimistic sizing
+    #: overflows; persists for the session so re-running the same query
+    #: shape executes exactly once (no retry ladder, no re-compiles).
+    _JOIN_CAP_CACHE: Dict[tuple, dict] = {}
 
-    def _run_with_retries(self, fn, eager_only: bool = False):
-        """Run ``fn(ctx, mode) -> (result, overflowed)`` through the retry
-        ladder; return the first non-overflowed result. The axon remote
-        compile helper occasionally fails transiently (worker-hostname env
-        races, helper restarts); those retry in place."""
-        attempts = (("eager", 1.0),) if eager_only else self._ATTEMPTS
-        for mode, growth in attempts:
+    #: Deferred overflow attempts before the guaranteed eager rung: each
+    #: attempt learns exact capacities for every join it reached, so a
+    #: chain of N joins converges in <= N attempts (a truncated join feeds
+    #: its consumer an underestimate, which the next attempt corrects).
+    _MAX_LEARN_ATTEMPTS = 6
+
+    def _run_with_retries(self, fn, eager_only: bool = False,
+                          plan_sig: Optional[tuple] = None):
+        """Run ``fn(ctx, mode) -> (result, overflowed)``; on a deferred join
+        overflow, learn the exact output capacities from the run's observed
+        match totals and retry with them (cached per plan signature). The
+        axon remote compile helper occasionally fails transiently
+        (worker-hostname env races, helper restarts); those retry in
+        place."""
+        import jax
+        from .data.column import bucket_capacity
+        caps = dict(self._JOIN_CAP_CACHE.get(plan_sig, {})) \
+            if plan_sig is not None else {}
+        attempts = 1 if eager_only else self._MAX_LEARN_ATTEMPTS + 1
+        # Growth escalation covers paths that size from ctx.join_growth but
+        # report no per-site totals (the mesh SPMD path, exec/mesh.py):
+        # when an attempt overflows without teaching us any capacity, the
+        # next attempt multiplies the optimistic bucket instead of
+        # re-running the identical program.
+        growth = 1.0
+        for attempt in range(attempts):
+            eager = eager_only or attempt == attempts - 1
             for compile_try in range(3):
                 ctx = P.ExecContext(self.conf,
                                     catalog=self.device_manager.catalog)
+                ctx.join_caps = caps
                 ctx.join_growth = growth
-                ctx.eager_overflow = mode == "eager"
+                ctx.eager_overflow = eager
                 try:
                     # Task admission: bound concurrent queries holding the
                     # device (GpuSemaphore.acquireIfNecessary analog; conf
                     # spark.rapids.sql.concurrentTpuTasks).
                     with self.device_manager.semaphore:
-                        result, overflowed = fn(ctx, mode)
+                        result, overflowed = fn(
+                            ctx, "eager" if eager else "deferred")
                     break
                 except Exception as e:  # noqa: BLE001 - filtered below
                     transient = "remote_compile" in str(e) \
@@ -133,7 +155,30 @@ class TpuSession:
                 finally:
                     ctx.close()
             if not overflowed:
+                if plan_sig is not None and caps:
+                    if len(self._JOIN_CAP_CACHE) > 512:
+                        self._JOIN_CAP_CACHE.pop(
+                            next(iter(self._JOIN_CAP_CACHE)))
+                    self._JOIN_CAP_CACHE[plan_sig] = caps
                 return result
+            # Learn exact capacities from this run's observations (one
+            # batched download). Totals observed downstream of a truncated
+            # join are underestimates; max() keeps monotone convergence
+            # within one query. (Across queries the cache only ratchets up,
+            # so a plan shape re-run on much smaller data keeps the larger
+            # buckets — bounded by the largest data actually seen for that
+            # shape, and the cache itself is bounded at 512 entries.)
+            learned = False
+            if ctx.join_totals:
+                sites = [s for s, _ in ctx.join_totals]
+                totals = jax.device_get([t for _, t in ctx.join_totals])
+                for s, t in zip(sites, totals):
+                    new_cap = bucket_capacity(max(int(t), 128))
+                    if new_cap > caps.get(s, 0):
+                        caps[s] = new_cap
+                        learned = True
+            if not learned:
+                growth *= 8.0
         raise AssertionError("unreachable: eager join path cannot overflow")
 
     def _device_root(self, physical: P.PhysicalPlan) -> P.PhysicalPlan:
@@ -151,11 +196,11 @@ class TpuSession:
     def execute(self, logical: L.LogicalPlan) -> pa.Table:
         """Plan + run. Joins size their output optimistically with a
         deferred device-side overflow flag (no per-batch host syncs); when a
-        flag trips the query re-runs with a larger ``join_growth`` — the
-        rare path fan-out joins pay so everything else stays round-trip
-        free. Fusable device plans run as ONE compiled program
-        (exec/fusion.py); mesh-capable plans as one SPMD program
-        (exec/mesh.py)."""
+        flag trips the query re-runs with the EXACT capacities learned from
+        the observed match totals (cached per plan signature, so the same
+        query shape never pays the retry twice). Fusable device plans run
+        as ONE compiled program (exec/fusion.py); mesh-capable plans as one
+        SPMD program (exec/mesh.py)."""
         from .exec import fusion
         physical = self.plan(logical)
 
@@ -176,8 +221,10 @@ class TpuSession:
         # Write plans are side-effecting: a discard-and-retry would commit
         # truncated files first, so they always use the eager exact-resize
         # join path (writes are IO-bound anyway).
+        from .utils.kernel_cache import plan_signature
         return self._run_with_retries(run,
-                                      eager_only=_contains_write(physical))
+                                      eager_only=_contains_write(physical),
+                                      plan_sig=plan_signature(physical))
 
     def materialize(self, logical: L.LogicalPlan) -> "L.CachedRelation":
         """Execute now and pin the result (eager df.cache()). Under a
@@ -203,7 +250,9 @@ class TpuSession:
             n = sum(int(b.n_rows) for p in parts for b in p)
             return L.CachedRelation(logical.schema, device_parts=parts,
                                     n_rows=n), False
-        return self._run_with_retries(run)
+        from .utils.kernel_cache import plan_signature
+        return self._run_with_retries(run,
+                                      plan_sig=plan_signature(device_root))
 
     def collect_device(self, logical: L.LogicalPlan) -> List:
         """Execute and return HBM-resident ColumnarBatches with NO host
@@ -226,7 +275,9 @@ class TpuSession:
             if fusion.any_overflow(ctx):
                 return None, True
             return [b for p in parts for b in p], False
-        return self._run_with_retries(run)
+        from .utils.kernel_cache import plan_signature
+        return self._run_with_retries(run,
+                                      plan_sig=plan_signature(device_root))
 
     def explain(self, logical: L.LogicalPlan) -> str:
         physical = self.plan(logical)

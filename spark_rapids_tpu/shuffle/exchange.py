@@ -57,6 +57,12 @@ class ShuffleBufferCatalog:
         self._lock = threading.Lock()
         self._spill_dir = spill_dir
         self._spill_file = None
+        # Host tier storage: serialized blocks go into ONE native arena
+        # region (native/arena.cpp, the AddressSpaceAllocator analog)
+        # instead of per-block Python bytes; arena-full or no-native falls
+        # back to bytes, over-budget falls through to disk.
+        from ..native.arena import HostArena
+        self._arena = HostArena(host_budget_bytes)
         self.metrics = {"blocks": 0, "bytes_written": 0, "spilled_blocks": 0}
 
     def _disk(self):
@@ -73,11 +79,25 @@ class ShuffleBufferCatalog:
             self.metrics["bytes_written"] += len(payload)
             if self._host_bytes + len(payload) > self.host_budget:
                 offset, length = self._disk().append(payload)
-                self._blocks[key] = (offset, length)
+                self._blocks[key] = ("disk", offset, length)
                 self.metrics["spilled_blocks"] += 1
-            else:
-                self._blocks[key] = payload
-                self._host_bytes += len(payload)
+                return
+            if self._arena.available:
+                off = self._arena.put(payload)
+                if off is not None:
+                    self._blocks[key] = ("arena", off, len(payload))
+                    self._host_bytes += len(payload)
+                    return
+            self._blocks[key] = payload
+            self._host_bytes += len(payload)
+
+    def _read_block(self, v) -> bytes:
+        if isinstance(v, tuple):
+            kind, offset, length = v
+            if kind == "arena":
+                return self._arena.get(offset, length)
+            return self._disk().read(offset, length)
+        return v
 
     def blocks_for_reduce(self, shuffle_id: int, reduce_id: int,
                           map_range: Optional[Tuple[int, int]] = None
@@ -87,21 +107,14 @@ class ShuffleBufferCatalog:
                           if k[0] == shuffle_id and k[2] == reduce_id
                           and (map_range is None
                                or map_range[0] <= k[1] < map_range[1]))
-            out = []
-            for k in keys:
-                v = self._blocks[k]
-                if isinstance(v, tuple):
-                    out.append(self._disk().read(*v))
-                else:
-                    out.append(v)
-            return out
+            return [self._read_block(self._blocks[k]) for k in keys]
 
     def sizes_for_shuffle(self, shuffle_id: int
                           ) -> Dict[Tuple[int, int], int]:
         """(map_id, reduce_id) -> serialized bytes: the observed statistics
         adaptive re-planning runs on (MapStatus sizes analog)."""
         with self._lock:
-            return {(m, r): (v[1] if isinstance(v, tuple) else len(v))
+            return {(m, r): (v[2] if isinstance(v, tuple) else len(v))
                     for (s, m, r), v in self._blocks.items()
                     if s == shuffle_id}
 
@@ -109,12 +122,17 @@ class ShuffleBufferCatalog:
         with self._lock:
             for k in [k for k in self._blocks if k[0] == shuffle_id]:
                 v = self._blocks.pop(k)
-                if not isinstance(v, tuple):
+                if isinstance(v, tuple):
+                    if v[0] == "arena":
+                        self._arena.free(v[1])
+                        self._host_bytes -= v[2]
+                else:
                     self._host_bytes -= len(v)
 
     def close(self):
         with self._lock:
             self._blocks.clear()
+            self._arena.close()
             if self._spill_file is not None:
                 self._spill_file.close()
                 self._spill_file = None

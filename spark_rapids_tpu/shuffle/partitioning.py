@@ -229,6 +229,9 @@ def _hash_host_column(arr, dt: T.DataType, h: np.ndarray) -> np.ndarray:
             out[i] = hh[0]
         return np.where(validity, out, h)
     if dt is T.STRING:
+        nh = _native_hash_strings(arr, validity, h)
+        if nh is not None:
+            return nh
         lengths = np.zeros(n, dtype=np.int32)
         vals = arr.to_pylist()
         w = max([len(v.encode()) if v else 0 for v in vals] + [4])
@@ -248,7 +251,59 @@ def _hash_host_column(arr, dt: T.DataType, h: np.ndarray) -> np.ndarray:
         unit = "D" if dt is T.DATE else "us"
         vals = vals.astype(f"datetime64[{unit}]").view(np.int64)
     vals = vals.astype(dt.np_dtype, copy=False)
+    nh = _native_hash_fixed(vals, validity, dt, h)
+    if nh is not None:
+        return nh
     return hash_column(np, vals, validity, dt, h)
+
+
+def _native_hash_fixed(vals: np.ndarray, validity: np.ndarray,
+                       dt: T.DataType, h: np.ndarray):
+    """Fold one fixed-width column via the native kernels (hostkern.cpp);
+    None when the native library is unavailable."""
+    import ctypes
+    from ..native import lib
+    L = lib()
+    if L is None:
+        return None
+    if dt.is_floating:
+        fn, cast = (L.sr_hash_col_f32, np.float32) if dt is T.FLOAT \
+            else (L.sr_hash_col_f64, np.float64)
+    elif dt in (T.LONG, T.TIMESTAMP):
+        fn, cast = L.sr_hash_col_i64, np.int64
+    else:  # bool/byte/short/int/date widen to int (Spark semantics)
+        fn, cast = L.sr_hash_col_i32, np.int32
+    v = np.ascontiguousarray(vals.astype(cast, copy=False))
+    val8 = np.ascontiguousarray(validity, dtype=np.uint8)
+    out = np.ascontiguousarray(h, dtype=np.uint32).copy()
+    fn(v.ctypes.data_as(ctypes.c_void_p),
+       val8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+       len(v), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return out
+
+
+def _native_hash_strings(arr, validity: np.ndarray, h: np.ndarray):
+    import ctypes
+    import pyarrow as pa
+    from ..native import lib
+    L = lib()
+    if L is None:
+        return None
+    arr = arr.cast(pa.string())
+    bufs = arr.buffers()
+    raw_off = np.frombuffer(bufs[1], dtype=np.int32)
+    offsets = np.ascontiguousarray(
+        raw_off[arr.offset: arr.offset + len(arr) + 1])
+    payload = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] \
+        else np.zeros(0, np.uint8)
+    val8 = np.ascontiguousarray(validity, dtype=np.uint8)
+    out = np.ascontiguousarray(h, dtype=np.uint32).copy()
+    L.sr_hash_col_str(
+        offsets.ctypes.data_as(ctypes.c_void_p),
+        payload.ctypes.data_as(ctypes.c_void_p),
+        val8.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(arr), out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)))
+    return out
 
 
 def pmod_partition(hash32, n_parts: int, xp=jnp):

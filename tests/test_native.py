@@ -1,0 +1,169 @@
+"""Native host runtime tests: C++ murmur3 kernels match the numpy/device
+implementation bit-for-bit, and the arena allocator round-trips under
+alloc/free churn (hostkern.cpp / arena.cpp; the libcudf-host/RMM analog
+layer, SURVEY.md §2.10)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.native import lib
+from spark_rapids_tpu.native.arena import HostArena
+from spark_rapids_tpu.shuffle import partitioning as PT
+
+pytestmark = pytest.mark.skipif(lib() is None,
+                                reason="native library unavailable")
+
+
+def _numpy_hash(arrays, dtypes):
+    """Reference result via the pure-Python path (native disabled)."""
+    import os
+    n = len(arrays[0])
+    h = np.full(n, np.uint32(PT.SPARK_SEED), dtype=np.uint32)
+    old = np.seterr(over="ignore")
+    try:
+        for arr, dt in zip(arrays, dtypes):
+            validity = np.asarray(arr.is_valid()) if arr.null_count \
+                else np.ones(n, dtype=bool)
+            if dt is T.STRING:
+                lengths = np.zeros(n, dtype=np.int32)
+                vals = arr.to_pylist()
+                w = max([len(v.encode()) if v else 0 for v in vals] + [4])
+                w = ((w + 3) // 4) * 4
+                mat = np.full((n, w), -1, dtype=np.int16)
+                for i, v in enumerate(vals):
+                    if v is not None:
+                        raw = np.frombuffer(v.encode(), dtype=np.uint8)
+                        lengths[i] = len(raw)
+                        mat[i, : len(raw)] = raw
+                nh = PT.murmur3_bytes_rows(np, mat, lengths, h)
+                h = np.where(validity, nh, h)
+            else:
+                filled = arr.fill_null(False if dt is T.BOOLEAN else 0) \
+                    if arr.null_count else arr
+                vals = filled.to_numpy(zero_copy_only=False)
+                vals = vals.astype(dt.np_dtype, copy=False)
+                h = PT.hash_column(np, vals, validity, dt, h)
+    finally:
+        np.seterr(**old)
+    return h.astype(np.int32)
+
+
+class TestNativeHashParity:
+    @pytest.mark.parametrize("dt,values", [
+        (T.INT, [1, -1, 0, 2**31 - 1, -(2**31), None, 42]),
+        (T.LONG, [1, -1, 0, 2**63 - 1, -(2**63), None, 12345678901234]),
+        (T.DOUBLE, [1.5, -0.0, 0.0, float("nan"), float("inf"), None, -2.75]),
+        (T.FLOAT, [1.5, -0.0, 0.0, float("nan"), None, 3.25]),
+        (T.BOOLEAN, [True, False, None, True]),
+        (T.SHORT, [1, -5, None, 32767]),
+    ])
+    def test_fixed_width(self, dt, values):
+        arr = pa.array(values, type=T.to_arrow_type(dt))
+        want = _numpy_hash([arr], [dt])
+        got = PT.spark_hash_columns_host([arr], [dt])
+        np.testing.assert_array_equal(got, want)
+
+    def test_strings(self):
+        vals = ["", "a", "abc", "abcd", "abcde", None, "hello world",
+                "exactly8", "ünïcödé ßtring", "x" * 100]
+        arr = pa.array(vals, pa.string())
+        want = _numpy_hash([arr], [T.STRING])
+        got = PT.spark_hash_columns_host([arr], [T.STRING])
+        np.testing.assert_array_equal(got, want)
+
+    def test_sliced_string_array(self):
+        arr = pa.array(["aa", "bb", "cc", "dd", "ee"]).slice(1, 3)
+        want = _numpy_hash([arr], [T.STRING])
+        got = PT.spark_hash_columns_host([arr], [T.STRING])
+        np.testing.assert_array_equal(got, want)
+
+    def test_multi_column_chaining(self):
+        rng = np.random.default_rng(0)
+        a = pa.array(rng.integers(-100, 100, 64), pa.int64())
+        b = pa.array([f"s{i}" if i % 3 else None for i in range(64)])
+        c = pa.array(rng.random(64), pa.float64())
+        arrays, dtypes = [a, b, c], [T.LONG, T.STRING, T.DOUBLE]
+        np.testing.assert_array_equal(
+            PT.spark_hash_columns_host(arrays, dtypes),
+            _numpy_hash(arrays, dtypes))
+
+    def test_matches_device_hash(self):
+        import jax
+        from spark_rapids_tpu.data.column import DeviceColumn
+        rng = np.random.default_rng(1)
+        vals = rng.integers(-1000, 1000, 128)
+        arr = pa.array(vals, pa.int64())
+        host = PT.spark_hash_columns_host([arr], [T.LONG])
+        col = DeviceColumn.from_arrow(arr, 128)
+        dev = np.asarray(jax.jit(
+            lambda c: PT.spark_hash_columns_device([c]))(col))
+        np.testing.assert_array_equal(host, dev[:128])
+
+
+class TestArena:
+    def test_roundtrip(self):
+        a = HostArena(1 << 16)
+        assert a.available
+        off1 = a.put(b"hello")
+        off2 = a.put(b"world!!")
+        assert a.get(off1, 5) == b"hello"
+        assert a.get(off2, 7) == b"world!!"
+        a.free(off1)
+        a.free(off2)
+        assert a.in_use == 0
+        a.close()
+
+    def test_best_fit_and_coalescing(self):
+        a = HostArena(1024)
+        offs = [a.put(bytes([i]) * 100) for i in range(10)]
+        assert all(o is not None for o in offs)
+        assert a.put(b"x" * 100) is None  # full
+        # free two adjacent blocks -> coalesced 200-byte hole fits 150
+        a.free(offs[3])
+        a.free(offs[4])
+        big = a.put(b"y" * 150)
+        assert big is not None
+        assert a.get(big, 150) == b"y" * 150
+        a.close()
+
+    def test_churn(self):
+        rng = np.random.default_rng(2)
+        a = HostArena(1 << 20)
+        live = {}
+        for i in range(500):
+            if live and rng.random() < 0.4:
+                off = list(live)[int(rng.integers(len(live)))]
+                payload = live.pop(off)
+                assert a.get(off, len(payload)) == payload
+                a.free(off)
+            else:
+                payload = bytes(rng.integers(0, 256, int(
+                    rng.integers(1, 2000))).astype(np.uint8))
+                off = a.put(payload)
+                if off is not None:
+                    live[off] = payload
+        for off, payload in live.items():
+            assert a.get(off, len(payload)) == payload
+        a.close()
+
+
+class TestCatalogArenaIntegration:
+    def test_blocks_through_arena(self):
+        from spark_rapids_tpu.shuffle.exchange import ShuffleBufferCatalog
+        cat = ShuffleBufferCatalog(host_budget_bytes=1 << 20)
+        payloads = {}
+        for m in range(4):
+            for r in range(4):
+                p = bytes([m * 16 + r]) * (100 + m)
+                payloads[(m, r)] = p
+                cat.add_block(7, m, r, p)
+        for r in range(4):
+            got = cat.blocks_for_reduce(7, r)
+            assert got == [payloads[(m, r)] for m in range(4)]
+        sizes = cat.sizes_for_shuffle(7)
+        assert sizes[(2, 1)] == 102
+        cat.unregister_shuffle(7)
+        assert cat.blocks_for_reduce(7, 0) == []
+        cat.close()
