@@ -311,9 +311,35 @@ class TpuUnionExec(TpuExec):
         return parts
 
 
-class TpuLimitExec(TpuExec):
-    """Global limit: truncates the live-row count batch by batch (one host
-    sync per batch, like the reference's per-batch row slicing limit.scala:115)."""
+def _limit_stream(batches, n: int, in_fusion: bool):
+    """Truncate a device-batch stream to a running limit of n rows.
+
+    Traced (fusion) path: the running remainder is a device scalar so no
+    host sync interrupts the fused program — loses the early-exit, which
+    fusion (a materialized, finite batch list) does not need. Streaming
+    path: one host sync per batch with early-exit, the reference's
+    per-batch row slicing (limit.scala:115)."""
+    if in_fusion:
+        remaining = jnp.asarray(n, jnp.int32)
+        for db in batches:
+            take = jnp.minimum(db.n_rows, remaining)
+            yield _truncate(db, take)
+            remaining = remaining - take
+        return
+    remaining = n
+    for db in batches:
+        if remaining <= 0:
+            return
+        rows = int(db.n_rows)
+        take = min(rows, remaining)
+        remaining -= take
+        yield db if take == rows else _truncate(db, take)
+
+
+class TpuLocalLimitExec(TpuExec):
+    """Per-partition limit (GpuLocalLimitExec, limit.scala:115): each
+    partition truncates independently, preserving the partitioning — the
+    cheap first phase of a collect-limit."""
 
     def __init__(self, child: PhysicalPlan, n: int):
         self.children = [child]
@@ -324,32 +350,27 @@ class TpuLimitExec(TpuExec):
         return self.children[0].schema
 
     def execute(self, ctx):
-        def gen():
-            if ctx.in_fusion:
-                # Traced path: the running remainder is a device scalar so
-                # no host sync interrupts the fused program. Loses the
-                # early-exit, which fusion (a materialized, finite batch
-                # list) does not need.
-                remaining = jnp.asarray(self.n, jnp.int32)
-                for part in self.children[0].execute(ctx):
-                    for db in part:
-                        take = jnp.minimum(db.n_rows, remaining)
-                        yield _truncate(db, take)
-                        remaining = remaining - take
-                return
-            remaining = self.n
+        return [_limit_stream(p, self.n, ctx.in_fusion)
+                for p in self.children[0].execute(ctx)]
+
+
+class TpuLimitExec(TpuExec):
+    """Global limit: one running limit over the flattened partition stream
+    (GpuGlobalLimitExec, limit.scala:120)."""
+
+    def __init__(self, child: PhysicalPlan, n: int):
+        self.children = [child]
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def execute(self, ctx):
+        def flat():
             for part in self.children[0].execute(ctx):
-                for db in part:
-                    if remaining <= 0:
-                        return
-                    rows = int(db.n_rows)
-                    take = min(rows, remaining)
-                    remaining -= take
-                    if take == rows:
-                        yield db
-                    else:
-                        yield _truncate(db, take)
-        return [gen()]
+                yield from part
+        return [_limit_stream(flat(), self.n, ctx.in_fusion)]
 
 
 @jax.jit

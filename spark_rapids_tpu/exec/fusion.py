@@ -46,7 +46,8 @@ from ..plan.physical import ExecContext
 from ..utils.kernel_cache import plan_signature as _plan_sig
 from .coalesce import TpuCoalesceBatchesExec
 from .execs import (DeviceToHostExec, TpuExec, TpuExpandExec, TpuFilterExec,
-                    TpuHashAggregateExec, TpuLimitExec, TpuProjectExec,
+                    TpuHashAggregateExec, TpuLimitExec, TpuLocalLimitExec,
+                    TpuProjectExec,
                     TpuUnionExec, _coalesce_device)
 
 
@@ -83,7 +84,7 @@ class FusedInputExec(TpuExec):
 #: across queries.
 _INLINE = (TpuProjectExec, TpuFilterExec, TpuHashAggregateExec,
            TpuCoalesceBatchesExec, TpuExpandExec,
-           TpuUnionExec, TpuLimitExec, FusedInputExec)
+           TpuUnionExec, TpuLimitExec, TpuLocalLimitExec, FusedInputExec)
 
 
 def _is_boundary(p) -> bool:

@@ -18,13 +18,21 @@ from . import lib
 class HostArena:
     def __init__(self, capacity_bytes: int):
         self._lib = lib()
+        self._capacity = int(capacity_bytes)
         self._handle = None
-        if self._lib is not None:
-            self._handle = self._lib.sr_arena_create(int(capacity_bytes))
+        self._closed = False
+
+    def _ensure(self) -> bool:
+        """Lazy creation: the region mallocs on FIRST put, so idle
+        catalogs (one exists per query context) cost nothing."""
+        if self._handle is None and not self._closed \
+                and self._lib is not None:
+            self._handle = self._lib.sr_arena_create(self._capacity)
+        return self._handle is not None
 
     @property
     def available(self) -> bool:
-        return self._handle is not None
+        return self._lib is not None and not self._closed
 
     @property
     def in_use(self) -> int:
@@ -35,7 +43,7 @@ class HostArena:
     def put(self, payload: bytes) -> Optional[int]:
         """Store payload; returns its offset or None when the arena is full
         (caller falls back to its own storage)."""
-        if self._handle is None:
+        if not self._ensure():
             return None
         off = self._lib.sr_arena_alloc(self._handle, len(payload))
         if off < 0:
@@ -59,6 +67,7 @@ class HostArena:
             self._lib.sr_arena_free(self._handle, offset)
 
     def close(self) -> None:
+        self._closed = True
         if self._handle is not None:
             self._lib.sr_arena_destroy(self._handle)
             self._handle = None

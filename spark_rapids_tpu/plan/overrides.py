@@ -433,6 +433,10 @@ EXEC_RULES: Dict[Type[P.PhysicalPlan], ExecRule] = {
         "GlobalLimit",
         lambda n: [],
         lambda n, ch, conf: E.TpuLimitExec(ch[0], n.n)),
+    P.CpuLocalLimitExec: ExecRule(
+        "LocalLimit",
+        lambda n: [],
+        lambda n, ch, conf: E.TpuLocalLimitExec(ch[0], n.n)),
     P.CpuUnionExec: ExecRule(
         "Union",
         lambda n: [],

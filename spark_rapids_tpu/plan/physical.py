@@ -450,6 +450,37 @@ class CpuSortExec(PhysicalPlan):
         return [iter([HostBatch(rb)])]
 
 
+def _limit_host_stream(batches, n: int):
+    remaining = n
+    for hb in batches:
+        if remaining <= 0:
+            return
+        take = min(remaining, hb.num_rows)
+        remaining -= take
+        yield hb if take == hb.num_rows else HostBatch(hb.rb.slice(0, take))
+
+
+class CpuLocalLimitExec(PhysicalPlan):
+    """Per-partition limit (GpuLocalLimitExec, limit.scala:115): caps each
+    partition at n WITHOUT cross-partition coordination, so upstream work
+    short-circuits before the global merge."""
+
+    def __init__(self, child: PhysicalPlan, n: int):
+        self.children = [child]
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"CpuLocalLimit {self.n}"
+
+    def execute(self, ctx):
+        return [_limit_host_stream(p, self.n)
+                for p in self.children[0].execute(ctx)]
+
+
 class CpuLimitExec(PhysicalPlan):
     def __init__(self, child: PhysicalPlan, n: int):
         self.children = [child]
@@ -460,16 +491,10 @@ class CpuLimitExec(PhysicalPlan):
         return self.children[0].schema
 
     def execute(self, ctx):
-        def gen():
-            remaining = self.n
+        def flat():
             for part in self.children[0].execute(ctx):
-                for hb in part:
-                    if remaining <= 0:
-                        return
-                    take = min(remaining, hb.num_rows)
-                    remaining -= take
-                    yield HostBatch(hb.rb.slice(0, take))
-        return [gen()]
+                yield from part
+        return [_limit_host_stream(flat(), self.n)]
 
 
 class CpuUnionExec(PhysicalPlan):

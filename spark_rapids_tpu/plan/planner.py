@@ -110,7 +110,10 @@ def plan_physical(plan: L.LogicalPlan,
         return P.CpuSortExec(plan_physical(plan.children[0], conf),
                              plan.orders)
     if isinstance(plan, L.Limit):
-        return P.CpuLimitExec(plan_physical(plan.children[0], conf), plan.n)
+        # CollectLimit shape (limit.scala:115 + GpuOverrides:1688-1704):
+        # per-partition LocalLimit caps work early, GlobalLimit merges.
+        child = plan_physical(plan.children[0], conf)
+        return P.CpuLimitExec(P.CpuLocalLimitExec(child, plan.n), plan.n)
     if isinstance(plan, L.Union):
         return P.CpuUnionExec([plan_physical(c, conf) for c in plan.children],
                               plan.schema)

@@ -131,8 +131,9 @@ class TpuSession:
         # next attempt multiplies the optimistic bucket instead of
         # re-running the identical program.
         growth = 1.0
+        force_eager = False
         for attempt in range(attempts):
-            eager = eager_only or attempt == attempts - 1
+            eager = eager_only or force_eager or attempt == attempts - 1
             for compile_try in range(3):
                 ctx = P.ExecContext(self.conf,
                                     catalog=self.device_manager.catalog)
@@ -178,7 +179,13 @@ class TpuSession:
                         caps[s] = new_cap
                         learned = True
             if not learned:
-                growth *= 8.0
+                # Non-learning path (mesh SPMD): escalate the optimistic
+                # bucket, but cap at 64x — beyond that the allocation
+                # itself is the risk, so fall to the guaranteed eager rung.
+                if growth >= 64.0:
+                    force_eager = True
+                else:
+                    growth *= 8.0
         raise AssertionError("unreachable: eager join path cannot overflow")
 
     def _device_root(self, physical: P.PhysicalPlan) -> P.PhysicalPlan:

@@ -1,0 +1,236 @@
+"""TCP wire transport for the shuffle fetch plane — the UCX module analog.
+
+The reference's opt-in shuffle transport is UCX tag-matching with a TCP
+management port for the handshake (UCX.scala:53, startManagementPort:192,
+handleSocket:423); fetch failures surface as
+``RapidsShuffleFetchFailedException`` so the engine can retry
+(RapidsShuffleIterator.scala:28,70-80). On TPU the intra-slice exchange is
+an XLA collective (shuffle/ici.py) — this wire is the HOST-coordinated
+cross-process / cross-slice (DCN) plane: one process serves its
+:class:`~.exchange.ShuffleBufferCatalog` blocks over TCP, peers fetch them
+through the same :class:`~.transport.ShuffleClient` state machine
+(bounce buffers + inflight throttle) that the in-process
+:class:`~.transport.LocalTransport` feeds.
+
+Protocol (length-prefixed binary, little-endian):
+
+* handshake: server greets ``b"SRTPU" + version`` on accept; a client that
+  sees anything else disconnects (the management-port validation role).
+* ``META  (op=1, shuffle_id, reduce_id)`` -> ``ok, n, n * u64 length``
+* ``FETCH (op=2, shuffle_id, reduce_id, block_no)`` -> ``ok, u64 len, bytes``
+* errors -> ``ok=1, u32 msg_len, msg`` and the connection stays usable.
+
+:class:`RetryingBlockIterator` is the task-facing
+``RapidsShuffleIterator`` analog: it drains fetched blocks, retries
+transient failures with backoff, and raises
+:class:`ShuffleFetchFailedError` (naming the peer) when retries exhaust —
+the signal an upper layer uses to recompute the map outputs, exactly the
+role ``FetchFailedException`` plays for Spark's stage retry.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from .transport import (BlockDescriptor, BounceBufferPool, ShuffleClient,
+                        Throttle, Transport)
+
+MAGIC = b"SRTPU"
+VERSION = 1
+
+_OP_META = 1
+_OP_FETCH = 2
+
+_REQ = struct.Struct("<BIII")  # op, shuffle_id, reduce_id, block_no
+
+
+class ShuffleFetchFailedError(Exception):
+    """Fetch retries exhausted against a peer
+    (RapidsShuffleFetchFailedException analog): carries the peer address
+    and the (shuffle, reduce) that must be recomputed."""
+
+    def __init__(self, peer: Tuple[str, int], shuffle_id: int,
+                 reduce_id: int, cause: str):
+        super().__init__(
+            f"shuffle {shuffle_id} reduce {reduce_id} fetch from "
+            f"{peer[0]}:{peer[1]} failed: {cause}")
+        self.peer = peer
+        self.shuffle_id = shuffle_id
+        self.reduce_id = reduce_id
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    out = bytearray()
+    while len(out) < n:
+        chunk = sock.recv(n - len(out))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        out.extend(chunk)
+    return bytes(out)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        self.request.sendall(MAGIC + bytes([VERSION]))
+        catalog = self.server.catalog  # type: ignore[attr-defined]
+        while True:
+            try:
+                req = _recv_exact(self.request, _REQ.size)
+            except (ConnectionError, OSError):
+                return
+            op, shuffle_id, reduce_id, block_no = _REQ.unpack(req)
+            try:
+                blocks = catalog.blocks_for_reduce(shuffle_id, reduce_id)
+                if op == _OP_META:
+                    resp = bytearray(struct.pack("<BI", 0, len(blocks)))
+                    for b in blocks:
+                        resp += struct.pack("<Q", len(b))
+                    self.request.sendall(bytes(resp))
+                elif op == _OP_FETCH:
+                    if block_no >= len(blocks):
+                        raise KeyError(
+                            f"no block {block_no} for shuffle {shuffle_id} "
+                            f"reduce {reduce_id}")
+                    payload = blocks[block_no]
+                    self.request.sendall(struct.pack("<BQ", 0, len(payload)))
+                    self.request.sendall(payload)
+                else:
+                    raise ValueError(f"bad opcode {op}")
+            except (ConnectionError, OSError):
+                return
+            except Exception as e:  # noqa: BLE001 - protocol error reply
+                msg = str(e).encode()
+                try:
+                    self.request.sendall(
+                        struct.pack("<BI", 1, len(msg)) + msg)
+                except OSError:
+                    return
+
+
+class NetShuffleServer:
+    """Serves one process's shuffle catalog over TCP (RapidsShuffleServer +
+    management port). ``port=0`` picks a free port; ``address`` is what
+    peers dial — the MapStatus-topology-string role."""
+
+    def __init__(self, catalog, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.catalog = catalog  # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._srv.server_address[:2]
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class NetTransport(Transport):
+    """TCP client side of the wire (one connection, request/response).
+    Raises ConnectionError on handshake mismatch."""
+
+    def __init__(self, peer: Tuple[str, int], connect_timeout: float = 5.0):
+        self.peer = peer
+        self._sock = socket.create_connection(peer, timeout=connect_timeout)
+        self._sock.settimeout(30.0)
+        greeting = _recv_exact(self._sock, len(MAGIC) + 1)
+        if greeting[:len(MAGIC)] != MAGIC or greeting[-1] != VERSION:
+            self._sock.close()
+            raise ConnectionError(f"bad handshake from {peer}: {greeting!r}")
+        self._lock = threading.Lock()
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _check_error(self, status: int) -> None:
+        if status:
+            (msg_len,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+            raise IOError(_recv_exact(self._sock, msg_len).decode())
+
+    def request_metadata(self, shuffle_id: int,
+                         reduce_id: int) -> List[BlockDescriptor]:
+        with self._lock:
+            self._sock.sendall(_REQ.pack(_OP_META, shuffle_id, reduce_id, 0))
+            status = _recv_exact(self._sock, 1)[0]
+            self._check_error(status)
+            (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
+            out = []
+            for i in range(n):
+                (length,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+                out.append(BlockDescriptor((shuffle_id, 0, reduce_id),
+                                           length, block_no=i))
+            return out
+
+    def fetch_block_chunks(self, desc: BlockDescriptor, chunk_size: int):
+        sid, _, rid = desc.tag
+        with self._lock:
+            self._sock.sendall(_REQ.pack(_OP_FETCH, sid, rid, desc.block_no))
+            status = _recv_exact(self._sock, 1)[0]
+            self._check_error(status)
+            (length,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+            remaining = length
+            while remaining > 0:
+                chunk = _recv_exact(self._sock, min(chunk_size, remaining))
+                remaining -= len(chunk)
+                yield chunk
+
+
+class RetryingBlockIterator:
+    """Task-facing fetch iterator with retry (RapidsShuffleIterator:46).
+
+    Pulls every block of (shuffle_id, reduce_id) from ``peer``. Transient
+    failures (connection resets, short reads) reconnect and retry up to
+    ``max_retries`` with exponential backoff; exhaustion raises
+    :class:`ShuffleFetchFailedError` for the recompute path."""
+
+    def __init__(self, peer: Tuple[str, int], shuffle_id: int,
+                 reduce_id: int, bounce: Optional[BounceBufferPool] = None,
+                 throttle: Optional[Throttle] = None, max_retries: int = 3,
+                 backoff_s: float = 0.05,
+                 transport_factory: Optional[Callable[[], Transport]] = None):
+        self.peer = peer
+        self.shuffle_id = shuffle_id
+        self.reduce_id = reduce_id
+        self.bounce = bounce or BounceBufferPool(1 << 20, 4)
+        self.throttle = throttle or Throttle(64 << 20)
+        self.max_retries = max_retries
+        self.backoff_s = backoff_s
+        self._factory = transport_factory or (lambda: NetTransport(peer))
+
+    def __iter__(self):
+        last_error = "unknown"
+        for attempt in range(self.max_retries + 1):
+            blocks: List[bytes] = []
+            errors: List[str] = []
+            transport = None
+            try:
+                transport = self._factory()
+                client = ShuffleClient(transport, self.bounce, self.throttle)
+                client.fetch(self.shuffle_id, self.reduce_id,
+                             blocks.append, errors.append)
+            except Exception as e:  # noqa: BLE001 - retried below
+                errors.append(str(e))
+            finally:
+                if transport is not None and hasattr(transport, "close"):
+                    transport.close()
+            if not errors:
+                yield from blocks
+                return
+            last_error = errors[0]
+            if attempt < self.max_retries:
+                time.sleep(self.backoff_s * (2 ** attempt))
+        raise ShuffleFetchFailedError(self.peer, self.shuffle_id,
+                                      self.reduce_id, last_error)

@@ -1,0 +1,131 @@
+"""Registry drift validation — the api_validation module analog.
+
+The reference's api_validation tool reflects over Spark exec constructor
+signatures vs their Gpu counterparts to catch API drift between versions
+(api_validation/.../ApiValidation.scala:27). The standalone analog of that
+drift: an expression or exec class added to the engine without a
+device-replacement rule (it would silently fall back forever), or a rule
+pointing at a class that no longer exists. This walker checks:
+
+* every concrete Expression subclass in ``ops/`` is either registered in
+  ``EXPR_RULES`` or explicitly listed as host-only / framework-internal;
+* every ``Cpu*Exec`` physical operator has an ``EXEC_RULES`` entry or an
+  explicit host-only justification;
+* every registered rule name is unique (conf keys derive from them).
+
+Run: ``python -m spark_rapids_tpu.tools.api_validation`` (exit 1 on drift);
+``tests/test_api_validation.py`` runs it in CI.
+"""
+
+from __future__ import annotations
+
+import importlib
+import inspect
+from typing import List
+
+#: Expression classes with no device rule ON PURPOSE, with the reason.
+HOST_ONLY_EXPRS = {
+    # Framework plumbing, never appears in a physical plan directly.
+    "UnaryExpression": "abstract base",
+    "BinaryExpression": "abstract base",
+    "Expression": "abstract base",
+    "Comparison": "abstract base",
+    "BinaryArithmetic": "abstract base",
+    "MathUnary": "abstract base",
+    "String2TrimExpression": "abstract base",
+    "DictString1": "abstract base",
+    "AggregateExpression": "container; the inner function is the rule",
+    "AggregateFunction": "abstract base",
+    "DeclarativeAggregate": "abstract base",
+    "WindowExpression": "handled by the Window exec rule",
+    "WindowFunction": "abstract base",
+    "RankingFunction": "abstract base",
+    "RowNumber": "window-exec internal (ranking registry)",
+    "Rank": "window-exec internal (ranking registry)",
+    "DenseRank": "window-exec internal (ranking registry)",
+    "DatePart": "abstract base for extract-style functions",
+}
+
+#: Cpu exec classes that stay host-side by design.
+HOST_ONLY_EXECS = {
+    "CpuLocalScanExec": "in-memory source; upload happens via transitions",
+    "CpuWindowExec": "replaced through the Window rule's _make_window",
+    "CpuGenerateExec": "registered",
+    "CpuFileScanExec": "host scan by design (decode stage is separate)",
+    "CpuWriteFilesExec": "write command rule handles it",
+    "CpuShuffleExchangeExec": "registered dynamically",
+}
+
+_OPS_MODULES = [
+    "arithmetic", "bitwise", "cast", "complex", "conditional", "datetime",
+    "math", "nondeterministic", "predicates", "strings", "strings2",
+    "expression", "aggregates",
+]
+
+
+def validate() -> List[str]:
+    from ..ops.expression import Expression
+    from ..plan import overrides as O
+    from ..plan import physical as P
+
+    issues: List[str] = []
+
+    # 1. rule name uniqueness (conf keys derive from names).
+    seen = {}
+    for cls, rule in O.EXPR_RULES.items():
+        if rule.name in seen and seen[rule.name] is not cls:
+            issues.append(f"duplicate expression rule name {rule.name!r} "
+                          f"({cls.__name__} vs {seen[rule.name].__name__})")
+        seen[rule.name] = cls
+
+    # 2. every concrete expression has a rule or a documented exemption.
+    for mod_name in _OPS_MODULES:
+        mod = importlib.import_module(f"spark_rapids_tpu.ops.{mod_name}")
+        for name, cls in inspect.getmembers(mod, inspect.isclass):
+            if not issubclass(cls, Expression) or cls is Expression:
+                continue
+            if cls.__module__ != mod.__name__:
+                continue  # re-export
+            if name.startswith("_") or inspect.isabstract(cls):
+                continue  # private helper base
+            concrete = "eval_device" in cls.__dict__ \
+                or "do_device" in cls.__dict__ \
+                or "do_host" in cls.__dict__ \
+                or "eval_host" in cls.__dict__
+            if not concrete:
+                continue  # abstract helper base
+            if cls not in O.EXPR_RULES and name not in HOST_ONLY_EXPRS:
+                issues.append(
+                    f"expression {mod_name}.{name} has no EXPR_RULES entry "
+                    "and no HOST_ONLY_EXPRS justification")
+
+    # 3. every Cpu*Exec has a rule or a documented exemption.
+    from ..io import files as IOF
+    from ..io import writers as IOW
+    from ..shuffle import exchange as EX
+    exec_rules = dict(O.EXEC_RULES)
+    O._register_shuffle_rule()
+    exec_rules.update(O.EXEC_RULES)
+    for mod in (P, IOF, IOW, EX):
+        for name, cls in inspect.getmembers(mod, inspect.isclass):
+            if not name.startswith("Cpu") or not name.endswith("Exec"):
+                continue
+            if cls.__module__ != mod.__name__:
+                continue
+            if cls not in exec_rules and name not in HOST_ONLY_EXECS:
+                issues.append(
+                    f"exec {mod.__name__.split('.')[-1]}.{name} has no "
+                    "EXEC_RULES entry and no HOST_ONLY_EXECS justification")
+    return issues
+
+
+def main() -> int:
+    issues = validate()
+    for i in issues:
+        print("DRIFT:", i)
+    print(f"api_validation: {len(issues)} issue(s)")
+    return 1 if issues else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,10 @@
+"""CI guard for registry drift (api_validation analog,
+ApiValidation.scala:27): every expression/exec either has a device rule or
+a documented host-only justification."""
+
+from spark_rapids_tpu.tools.api_validation import validate
+
+
+def test_no_registry_drift():
+    issues = validate()
+    assert not issues, "\n".join(issues)

@@ -1,0 +1,158 @@
+"""Wire-transport tests: real TCP sockets, handshake, chunked fetch through
+the client state machine, fetch-failure retry, and a true cross-process
+fetch (the reference tests these layers with mocked transactions,
+RapidsShuffleTestHelper.scala:33-120; the wire itself deserves real
+sockets)."""
+
+import os
+import socket
+import struct
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.shuffle.exchange import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.net import (MAGIC, NetShuffleServer,
+                                          NetTransport,
+                                          RetryingBlockIterator,
+                                          ShuffleFetchFailedError)
+from spark_rapids_tpu.shuffle.serializer import serialize_batch
+from spark_rapids_tpu.shuffle.codec import get_codec
+
+
+def _payload(tag: int) -> bytes:
+    import pyarrow as pa
+    rb = pa.RecordBatch.from_pydict({"v": list(range(tag, tag + 10))})
+    return serialize_batch(rb, get_codec("none"))
+
+
+@pytest.fixture
+def served_catalog():
+    cat = ShuffleBufferCatalog()
+    blocks = {}
+    for m in range(3):
+        for r in range(2):
+            p = _payload(m * 10 + r)
+            blocks[(m, r)] = p
+            cat.add_block(5, m, r, p)
+    srv = NetShuffleServer(cat)
+    yield srv, blocks
+    srv.close()
+    cat.close()
+
+
+class TestWire:
+    def test_handshake_and_metadata(self, served_catalog):
+        srv, blocks = served_catalog
+        t = NetTransport(srv.address)
+        descs = t.request_metadata(5, 0)
+        assert [d.length for d in descs] == \
+            [len(blocks[(m, 0)]) for m in range(3)]
+        t.close()
+
+    def test_fetch_roundtrip_chunked(self, served_catalog):
+        srv, blocks = served_catalog
+        t = NetTransport(srv.address)
+        descs = t.request_metadata(5, 1)
+        got = [b"".join(t.fetch_block_chunks(d, 16)) for d in descs]
+        assert got == [blocks[(m, 1)] for m in range(3)]
+        t.close()
+
+    def test_unknown_block_is_protocol_error_not_disconnect(
+            self, served_catalog):
+        srv, _ = served_catalog
+        t = NetTransport(srv.address)
+        from spark_rapids_tpu.shuffle.transport import BlockDescriptor
+        with pytest.raises(IOError):
+            list(t.fetch_block_chunks(
+                BlockDescriptor((5, 0, 0), 10, block_no=99), 16))
+        # connection still usable after an error reply
+        assert len(t.request_metadata(5, 0)) == 3
+        t.close()
+
+    def test_bad_handshake_rejected(self):
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(1)
+
+        def fake():
+            conn, _ = srv.accept()
+            conn.sendall(b"NOTSR" + bytes([9]))
+            conn.close()
+        threading.Thread(target=fake, daemon=True).start()
+        with pytest.raises(ConnectionError):
+            NetTransport(srv.getsockname())
+        srv.close()
+
+    def test_iterator_drains_all_blocks(self, served_catalog):
+        srv, blocks = served_catalog
+        got = list(RetryingBlockIterator(srv.address, 5, 0))
+        assert got == [blocks[(m, 0)] for m in range(3)]
+
+    def test_fetch_failed_after_retries(self):
+        # nobody listening on this port
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        addr = s.getsockname()
+        s.close()
+        it = RetryingBlockIterator(addr, 1, 0, max_retries=2,
+                                   backoff_s=0.01)
+        with pytest.raises(ShuffleFetchFailedError) as ei:
+            list(it)
+        assert ei.value.peer == addr
+        assert ei.value.reduce_id == 0
+
+    def test_retry_recovers_from_flaky_server(self, served_catalog):
+        srv, blocks = served_catalog
+        attempts = {"n": 0}
+        real_addr = srv.address
+
+        class FlakyFirst:
+            """Transport factory whose first connection dies mid-flight."""
+
+            def __call__(self):
+                attempts["n"] += 1
+                t = NetTransport(real_addr)
+                if attempts["n"] == 1:
+                    t._sock.close()  # simulate connection reset
+                return t
+        got = list(RetryingBlockIterator(
+            real_addr, 5, 1, max_retries=3, backoff_s=0.01,
+            transport_factory=FlakyFirst()))
+        assert got == [blocks[(m, 1)] for m in range(3)]
+        assert attempts["n"] >= 2
+
+
+CHILD = r"""
+import os, sys, struct, time
+sys.path.insert(0, os.getcwd())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+from spark_rapids_tpu.shuffle.exchange import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.net import NetShuffleServer
+cat = ShuffleBufferCatalog()
+for m in range(2):
+    for r in range(2):
+        cat.add_block(9, m, r, bytes([m * 4 + r]) * 1000)
+srv = NetShuffleServer(cat)
+print(srv.address[1], flush=True)
+time.sleep(30)
+"""
+
+
+class TestCrossProcess:
+    def test_fetch_from_another_process(self):
+        proc = subprocess.Popen(
+            [sys.executable, "-c", CHILD], stdout=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            text=True)
+        try:
+            port = int(proc.stdout.readline())
+            got = list(RetryingBlockIterator(("127.0.0.1", port), 9, 1))
+            assert got == [bytes([r]) * 1000 for r in (1, 5)]
+        finally:
+            proc.kill()
+            proc.wait()

@@ -202,6 +202,23 @@ class TestSortLimit:
             return a.union(b)
         assert_tpu_and_cpu_are_equal(q)
 
+    def test_limit_across_partitions(self):
+        # CollectLimit shape: LocalLimit caps each shuffle partition, the
+        # global merge stops at n (limit.scala:115 + local/global split).
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(fuzz_table())
+            .repartition(4).limit(13)
+            .group_by().count())
+
+    def test_limit_zero(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(small_table()).limit(0)
+            .group_by().count())
+
+    def test_limit_larger_than_input(self):
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(small_table()).limit(10_000))
+
 
 class TestRange:
     def test_range(self):

@@ -168,3 +168,52 @@ def test_csv_partition_by_round_trip(tmp_path):
     got = sorted(zip(back.column("k").to_pylist(),
                      back.column("v").to_pylist()))
     assert got == [(1, 10), (1, 20), (2, 30)]
+
+
+class TestCsvOptionGates:
+    """CSV option validation (GpuCSVScan object:87 analog): unsupported
+    combinations fail loudly instead of misparsing."""
+
+    def _write(self, tmp_path):
+        import pyarrow as pa
+        from harness import cpu_session
+        s = cpu_session()
+        df = s.create_dataframe(pa.RecordBatch.from_pydict(
+            {"a": [1, 2], "b": ["x", "y"]}))
+        path = str(tmp_path / "gate.csv")
+        df.write.csv(path)
+        return s, path
+
+    def test_multichar_delimiter_rejected(self, tmp_path):
+        import pytest
+        s, path = self._write(tmp_path)
+        with pytest.raises(ValueError, match="single character"):
+            s.read.option("delimiter", "||").csv(path).collect()
+
+    def test_multiline_rejected(self, tmp_path):
+        import pytest
+        s, path = self._write(tmp_path)
+        with pytest.raises(ValueError, match="multiLine"):
+            s.read.option("multiLine", "true").csv(path).collect()
+
+    def test_charset_rejected(self, tmp_path):
+        import pytest
+        s, path = self._write(tmp_path)
+        with pytest.raises(ValueError, match="charset"):
+            s.read.option("charset", "ISO-8859-1").csv(path).collect()
+
+    def test_quote_equals_delimiter_rejected(self, tmp_path):
+        import pytest
+        s, path = self._write(tmp_path)
+        with pytest.raises(ValueError, match="differ"):
+            s.read.option("quote", ",").csv(path).collect()
+
+    def test_null_value_option(self, tmp_path):
+        import pyarrow as pa
+        from harness import cpu_session
+        s = cpu_session()
+        path = str(tmp_path / "nv.csv")
+        with open(path, "w") as f:
+            f.write("a,b\n1,NA\n2,y\n")
+        got = s.read.option("nullValue", "NA").csv(path).collect()
+        assert got.column("b").to_pylist() == [None, "y"]
