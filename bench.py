@@ -54,7 +54,14 @@ def main():
     import sys
     from spark_rapids_tpu.workloads.compare import tables_match
     ratios, tpu_times = [], []
-    for name, q in sorted(tpch.QUERIES.items()):
+    # Subset: every operator shape (scan/filter/project/agg, 1-4 joins,
+    # semi join, disjunctive band join, conditional sums, float scoring)
+    # without double-paying remote-compile time for shapes q5/q3 already
+    # cover (q10/q18 re-run under pytest, tests/test_tpch.py).
+    bench_queries = ["q1", "q3", "q4", "q5", "q6", "q12", "q14", "q19",
+                     "xbb_score"]
+    for name in bench_queries:
+        q = tpch.QUERIES[name]
         t0 = time.perf_counter()
         cpu_result = q(cpu_t).collect()       # oracle
         tpu_result = q(tpu_t).collect()       # warmup + compile

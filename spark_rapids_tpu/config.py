@@ -189,6 +189,14 @@ AUTO_BROADCAST_JOIN_ROWS = conf_int(
     "plan as broadcast hash joins; -1 disables (row-count analog of "
     "spark.sql.autoBroadcastJoinThreshold).")
 
+PARQUET_DEVICE_DECODE = conf_bool(
+    "spark.rapids.sql.parquet.deviceDecode.enabled", True,
+    "Decode parquet pages ON DEVICE: the host parses footers/page headers "
+    "and uploads raw page bytes + RLE run tables; traced kernels expand "
+    "definition levels and dictionary indices (the GpuParquetScan -> "
+    "Table.readParquet split, GpuParquetScan.scala:365-388). Row groups "
+    "outside the decoder's scope fall back to the host reader per unit.")
+
 ADAPTIVE_ENABLED = conf_bool(
     "spark.rapids.sql.adaptive.enabled", False,
     "Re-plan shuffle reads with OBSERVED map-output sizes: coalesce "

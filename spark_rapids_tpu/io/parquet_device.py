@@ -1,0 +1,652 @@
+"""Device-side parquet decode — the ``Table.readParquet`` stage analog.
+
+The reference's scan splits work exactly this way: the CPU parses the footer
+and reassembles the selected row-group bytes in host memory, then cuDF
+decodes ON DEVICE (GpuParquetScan.scala:365-388 -> Table.readParquet). The
+TPU-native split here:
+
+* HOST (metadata-sized work): pyarrow reads the footer; a minimal
+  thrift-compact parser walks page headers; page payloads decompress; the
+  RLE/bit-packed hybrid streams (definition levels + dictionary indices)
+  are sliced into RUN TABLES — (kind, count, value | bit offset) per run —
+  without expanding a single value.
+* DEVICE (data-sized work): one traced kernel expands the run tables —
+  ``searchsorted`` over run ends finds each output's run, RLE runs
+  broadcast their value, bit-packed runs gather+shift+mask straight from
+  the uploaded page bytes — then definition levels become the validity
+  mask and dictionary indices scatter into row order. Everything is
+  vectorized; no per-value host loop anywhere.
+
+Parquet dictionaries pair perfectly with this engine's dict-encoded string
+columns: the page dictionary IS the column dictionary. The host sorts the
+(small) dictionary and uploads a rank table; the device remaps codes, so
+decoded string columns arrive ``dict_sorted`` and every downstream sort /
+group-by / join uses the fast code paths.
+
+Scope (falls back to the host scan otherwise, reference-style graceful
+degradation): v1 data pages, PLAIN + PLAIN_DICTIONARY/RLE_DICTIONARY
+encodings, flat schemas, dictionary bit widths <= 24.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct as _struct
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import types as T
+from ..data.batch import ColumnarBatch
+from ..data.column import DeviceColumn, bucket_capacity
+from ..utils.kernel_cache import cached_kernel
+
+# -- minimal thrift compact protocol reader ---------------------------------
+
+
+class _Thrift:
+    """Just enough of the thrift compact protocol for parquet PageHeader."""
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def _byte(self) -> int:
+        b = self.buf[self.pos]
+        self.pos += 1
+        return b
+
+    def varint(self) -> int:
+        out = shift = 0
+        while True:
+            b = self._byte()
+            out |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return out
+            shift += 7
+
+    def zigzag(self) -> int:
+        v = self.varint()
+        return (v >> 1) ^ -(v & 1)
+
+    def read_struct(self) -> Dict[int, object]:
+        """Field id -> value; nested structs become dicts, unneeded types
+        are skipped structurally."""
+        out: Dict[int, object] = {}
+        field_id = 0
+        while True:
+            header = self._byte()
+            if header == 0:
+                return out
+            delta = header >> 4
+            ftype = header & 0x0F
+            field_id = field_id + delta if delta else self.zigzag()
+            out[field_id] = self._read_value(ftype)
+
+    def _read_value(self, ftype: int):
+        if ftype in (1, 2):  # bool true/false encoded in the type nibble
+            return ftype == 1
+        if ftype == 3:
+            return self._byte()
+        if ftype in (4, 5, 6):  # i16/i32/i64
+            return self.zigzag()
+        if ftype == 7:
+            v = _struct.unpack_from("<d", self.buf, self.pos)[0]
+            self.pos += 8
+            return v
+        if ftype == 8:  # binary
+            n = self.varint()
+            v = self.buf[self.pos: self.pos + n]
+            self.pos += n
+            return v
+        if ftype == 9:  # list
+            head = self._byte()
+            size = head >> 4
+            etype = head & 0x0F
+            if size == 15:
+                size = self.varint()
+            return [self._read_value(etype) for _ in range(size)]
+        if ftype == 12:
+            return self.read_struct()
+        raise NotImplementedError(f"thrift compact type {ftype}")
+
+
+@dataclasses.dataclass
+class _PageHeader:
+    page_type: int            # 0 data v1, 2 dictionary, 3 data v2
+    compressed_size: int
+    uncompressed_size: int
+    num_values: int = 0
+    encoding: int = 0
+    def_encoding: int = 3     # RLE
+    header_len: int = 0
+
+
+def _parse_page_header(buf: bytes, pos: int) -> _PageHeader:
+    t = _Thrift(buf, pos)
+    d = t.read_struct()
+    ph = _PageHeader(page_type=d[1], uncompressed_size=d[2],
+                     compressed_size=d[3], header_len=t.pos - pos)
+    if ph.page_type == 0:
+        dph = d[5]
+        ph.num_values = dph[1]
+        ph.encoding = dph[2]
+        ph.def_encoding = dph[3]
+    elif ph.page_type == 2:
+        ph.num_values = d[7][1]
+        ph.encoding = d[7][2]
+    return ph
+
+
+# -- host page walk: bytes -> run tables ------------------------------------
+
+PLAIN, PLAIN_DICTIONARY, RLE, RLE_DICTIONARY = 0, 2, 3, 8
+
+
+@dataclasses.dataclass
+class _HybridRuns:
+    """Run table for one RLE/bit-packed hybrid stream, offsets relative to
+    ONE shared packed-bytes buffer uploaded to the device."""
+
+    kinds: List[int]          # 1 = RLE, 0 = bit-packed
+    counts: List[int]
+    values: List[int]         # RLE value (0 for bit-packed runs)
+    bit_starts: List[int]     # absolute bit offset into the packed buffer
+    widths: List[int]         # per-run bit width (dict width grows as the
+    #                           dictionary fills across pages)
+
+    def __init__(self):
+        self.kinds, self.counts, self.values = [], [], []
+        self.bit_starts, self.widths = [], []
+
+    def non_null_count(self, start_run: int, packed: bytearray) -> int:
+        """Popcount of a bit-width-1 (definition level) run suffix: the
+        number of NON-NULL values — which is exactly how many entries the
+        page's index stream stores."""
+        total = 0
+        for i in range(start_run, len(self.kinds)):
+            if self.kinds[i] == 1:
+                total += self.counts[i] * (self.values[i] & 1)
+            else:
+                b0 = self.bit_starts[i]
+                count = self.counts[i]
+                chunk = np.frombuffer(
+                    packed, np.uint8,
+                    count=(b0 % 8 + count + 7) // 8, offset=b0 // 8)
+                bits = np.unpackbits(chunk, bitorder="little")
+                total += int(bits[b0 % 8: b0 % 8 + count].sum())
+        return total
+
+
+def _parse_hybrid(buf: bytes, pos: int, end: int, bit_width: int,
+                  n_values: int, runs: _HybridRuns, packed: bytearray,
+                  pad_tail: bool = True) -> None:
+    """Slice one hybrid stream into runs; bit-packed payloads append to
+    ``packed``. Never expands values. Counts CAP at the page's n_values so
+    the (multiple-of-8 padded) last bit-packed group never leaks phantom
+    positions into the next page's runs."""
+    produced = 0
+    t = _Thrift(buf, pos)
+    byte_w = (bit_width + 7) // 8
+    while produced < n_values and t.pos < end:
+        header = t.varint()
+        if header & 1:  # bit-packed: (header>>1) groups of 8 values
+            groups = header >> 1
+            count = min(groups * 8, n_values - produced)
+            nbytes = groups * bit_width  # groups * 8 * bw / 8
+            runs.kinds.append(0)
+            runs.counts.append(count)
+            runs.values.append(0)
+            runs.bit_starts.append(len(packed) * 8)
+            runs.widths.append(bit_width)
+            packed.extend(buf[t.pos: t.pos + nbytes])
+            t.pos += nbytes
+        else:
+            count = min(header >> 1, n_values - produced)
+            raw = buf[t.pos: t.pos + byte_w]
+            t.pos += byte_w
+            runs.kinds.append(1)
+            runs.counts.append(count)
+            runs.values.append(int.from_bytes(raw, "little"))
+            runs.bit_starts.append(0)
+            runs.widths.append(bit_width)
+        produced += count
+    if pad_tail and produced < n_values:
+        # Implicit trailing zeros (writers may omit the final RLE run).
+        runs.kinds.append(1)
+        runs.counts.append(n_values - produced)
+        runs.values.append(0)
+        runs.bit_starts.append(0)
+        runs.widths.append(bit_width)
+
+
+_PHYS_NP = {"INT32": np.int32, "INT64": np.int64, "FLOAT": np.float32,
+            "DOUBLE": np.float64, "BOOLEAN": np.bool_}
+
+
+@dataclasses.dataclass
+class ColumnChunkPlan:
+    """Everything the device kernel needs for one column chunk, prepared
+    host-side from page bytes."""
+
+    dtype: T.DataType
+    n_rows: int
+    nullable: bool
+    # definition-level hybrid (bw=1): validity
+    def_runs: Optional[_HybridRuns]
+    # value source: either dictionary indices (hybrid) + dictionary, or
+    # PLAIN values uploaded directly
+    idx_runs: Optional[_HybridRuns]
+    idx_bit_width: int
+    packed: bytes             # shared packed buffer (def + idx bitpacks)
+    plain_values: Optional[np.ndarray]
+    # dictionary: fixed-width values, or sorted string dict + rank remap
+    dict_values: Optional[np.ndarray]
+    dict_rank: Optional[np.ndarray]
+    dict_offsets: Optional[np.ndarray]
+    dict_payload: Optional[np.ndarray]
+
+
+def _decompress(codec: str, payload: bytes, uncompressed_size: int) -> bytes:
+    if codec == "UNCOMPRESSED":
+        return payload
+    import pyarrow as pa
+    return pa.Codec(codec.lower()).decompress(
+        payload, decompressed_size=uncompressed_size).to_pybytes()
+
+
+def plan_column_chunk(f, col_md, field: T.StructField) -> ColumnChunkPlan:
+    """Host phase for one column chunk: page headers -> run tables.
+
+    ``f`` is an open file object; ``col_md`` a pyarrow ColumnChunkMetaData.
+    Raises NotImplementedError for shapes outside scope (caller falls back
+    to the host scan)."""
+    phys = col_md.physical_type
+    if phys not in _PHYS_NP and phys != "BYTE_ARRAY":
+        raise NotImplementedError(f"physical type {phys}")
+    start = col_md.data_page_offset
+    if col_md.has_dictionary_page:
+        start = min(start, col_md.dictionary_page_offset)
+    f.seek(start)
+    chunk = f.read(col_md.total_compressed_size)
+    codec = col_md.compression
+
+    pos = 0
+    dict_vals_raw: Optional[bytes] = None
+    def_runs = _HybridRuns()
+    idx_runs = _HybridRuns()
+    packed = bytearray()
+    plain_parts: List[bytes] = []
+    idx_bw = 0
+    n_rows = 0
+    uses_dict = False
+    uses_plain = False
+    while pos < len(chunk):
+        ph = _parse_page_header(chunk, pos)
+        pos += ph.header_len
+        payload = _decompress(codec, chunk[pos: pos + ph.compressed_size],
+                              ph.uncompressed_size)
+        pos += ph.compressed_size
+        if ph.page_type == 2:  # dictionary page (PLAIN-encoded)
+            dict_vals_raw = payload
+            continue
+        if ph.page_type != 0:
+            raise NotImplementedError(f"page type {ph.page_type} (v2?)")
+        p = 0
+        page_def_start = len(def_runs.kinds)
+        if field.nullable:
+            if ph.def_encoding != RLE:
+                raise NotImplementedError("non-RLE definition levels")
+            (def_len,) = _struct.unpack_from("<I", payload, p)
+            p += 4
+            _parse_hybrid(payload, p, p + def_len, 1, ph.num_values,
+                          def_runs, packed)
+            p += def_len
+            non_null = def_runs.non_null_count(page_def_start, packed)
+        else:
+            def_runs.kinds.append(1)
+            def_runs.counts.append(ph.num_values)
+            def_runs.values.append(1)
+            def_runs.bit_starts.append(0)
+            def_runs.widths.append(1)
+            non_null = ph.num_values
+        if ph.encoding in (PLAIN_DICTIONARY, RLE_DICTIONARY):
+            uses_dict = True
+            bw = payload[p]
+            p += 1
+            if bw > 24:
+                raise NotImplementedError(f"dictionary bit width {bw}")
+            idx_bw = max(idx_bw, bw)
+            # The page stores exactly non_null indices (indices exist only
+            # for non-null slots; the def mask scatters them into row order
+            # on device). Capping at the EXACT count keeps multi-page run
+            # tables positionally aligned; per-run widths let later pages
+            # use wider codes as the dictionary fills.
+            _parse_hybrid(payload, p, len(payload), bw, non_null,
+                          idx_runs, packed)
+        elif ph.encoding == PLAIN:
+            uses_plain = True
+            plain_parts.append(payload[p:])
+        else:
+            raise NotImplementedError(f"encoding {ph.encoding}")
+        n_rows += ph.num_values
+    if uses_dict and uses_plain:
+        raise NotImplementedError("mixed PLAIN + dictionary pages")
+    if phys == "BYTE_ARRAY" and not uses_dict:
+        raise NotImplementedError("PLAIN byte-array pages")
+
+    plan = ColumnChunkPlan(
+        dtype=field.data_type, n_rows=n_rows, nullable=field.nullable,
+        def_runs=def_runs, idx_runs=idx_runs if uses_dict else None,
+        idx_bit_width=idx_bw, packed=bytes(packed),
+        plain_values=None, dict_values=None, dict_rank=None,
+        dict_offsets=None, dict_payload=None)
+
+    if uses_plain:
+        raw = b"".join(plain_parts)
+        if phys == "BOOLEAN":
+            raise NotImplementedError("PLAIN boolean pages")
+        plan.plain_values = np.frombuffer(
+            raw, dtype=_PHYS_NP[phys]).astype(
+                field.data_type.np_dtype, copy=False)
+    if uses_dict:
+        assert dict_vals_raw is not None, "dict pages missing"
+        if phys == "BYTE_ARRAY":
+            # PLAIN byte-array dictionary: [u32 len][bytes]... Host-parse
+            # (dictionary-sized, small), sort, build the rank remap so the
+            # device column lands dict_sorted.
+            vals: List[bytes] = []
+            q = 0
+            while q < len(dict_vals_raw):
+                (ln,) = _struct.unpack_from("<I", dict_vals_raw, q)
+                q += 4
+                vals.append(dict_vals_raw[q: q + ln])
+                q += ln
+            order = np.argsort(np.asarray(vals, dtype=object), kind="stable")
+            rank = np.empty(len(vals), dtype=np.int32)
+            rank[order] = np.arange(len(vals), dtype=np.int32)
+            sorted_vals = [vals[i] for i in order] or [b""]
+            lens = np.asarray([len(b) for b in sorted_vals], np.int32)
+            plan.dict_offsets = np.concatenate(
+                [[0], np.cumsum(lens)]).astype(np.int32)
+            plan.dict_payload = np.frombuffer(
+                b"".join(sorted_vals) or b"\0", dtype=np.uint8)
+            plan.dict_rank = rank
+        else:
+            plan.dict_values = np.frombuffer(
+                dict_vals_raw, dtype=_PHYS_NP[phys]).astype(
+                    field.data_type.np_dtype, copy=False)
+    return plan
+
+
+# -- device expansion kernels -----------------------------------------------
+
+
+def _expand_hybrid(kinds, counts, values, bit_starts, widths, packed,
+                   capacity):
+    """Expand a hybrid run table to ``capacity`` int32 values (traced).
+
+    For output i: its run via searchsorted over cumulative counts; RLE runs
+    broadcast, bit-packed runs gather 4 bytes around the value's bit
+    position and shift/mask. Widths are per RUN (a dictionary's bit width
+    grows across pages as it fills; <= 24, so shift <= 7 + width <= 24
+    keeps every value inside the 4 gathered bytes)."""
+    ends = jnp.cumsum(counts)
+    starts = ends - counts
+    i = jnp.arange(capacity, dtype=jnp.int32)
+    r = jnp.searchsorted(ends, i, side="right")
+    r = jnp.clip(r, 0, kinds.shape[0] - 1)
+    within = i - starts[r]
+    w = widths[r]
+    bit0 = bit_starts[r] + within * w
+    byte0 = bit0 >> 3
+    shift = (bit0 & 7).astype(jnp.uint32)
+    nb = packed.shape[0]
+    b = [packed[jnp.clip(byte0 + k, 0, nb - 1)].astype(jnp.uint32)
+         for k in range(4)]
+    word = b[0] | (b[1] << 8) | (b[2] << 16) | (b[3] << 24)
+    mask = (jnp.uint32(1) << jnp.clip(w, 0, 31).astype(jnp.uint32)) \
+        - jnp.uint32(1)
+    packed_val = ((word >> shift) & mask).astype(jnp.int32)
+    return jnp.where(kinds[r] == 1, values[r], packed_val)
+
+
+def _decode_chunk_device(def_table, idx_table, packed, plain, dict_table,
+                         n_rows, capacity, idx_bw, dtype,
+                         dict_string: bool):
+    """Traced device decode of one column chunk (see module doc)."""
+    live = jnp.arange(capacity, dtype=jnp.int32) < n_rows
+    dk, dc, dv, db, dw = def_table
+    levels = _expand_hybrid(dk, dc, dv, db, dw, packed, capacity)
+    validity = (levels == 1) & live
+    # Indices/values are stored for NON-NULL slots only, compacted: row ->
+    # slot via an exclusive cumsum of the validity mask.
+    slot = jnp.cumsum(validity.astype(jnp.int32)) - 1
+    slot = jnp.clip(slot, 0, capacity - 1)
+    if idx_table is not None:
+        ik, ic, iv, ib, iw = idx_table
+        raw_idx = _expand_hybrid(ik, ic, iv, ib, iw, packed, capacity)
+        codes = jnp.where(validity, raw_idx[slot], 0)
+        if dict_string:
+            rank = dict_table
+            codes = jnp.where(validity,
+                              rank[jnp.clip(codes, 0, rank.shape[0] - 1)], 0)
+            return codes, validity
+        vals = dict_table[jnp.clip(codes, 0, dict_table.shape[0] - 1)]
+        data = jnp.where(validity, vals, jnp.zeros((), vals.dtype))
+        return data, validity
+    data = jnp.where(validity, plain[slot], jnp.zeros((), plain.dtype))
+    return data, validity
+
+
+def _runs_arrays(runs: _HybridRuns, pad_to: int):
+    def arr(xs, fill):
+        a = np.full(pad_to, fill, np.int32)
+        a[: len(xs)] = xs
+        return jnp.asarray(a)
+    # Padding runs have count 0 -> they own no output positions.
+    return (arr(runs.kinds, 1), arr(runs.counts, 0), arr(runs.values, 0),
+            arr(runs.bit_starts, 0), arr(runs.widths, 1))
+
+
+def decode_chunk(plan: ColumnChunkPlan, capacity: int) -> DeviceColumn:
+    """Upload one chunk's page bytes + run tables and decode on device."""
+    pad = bucket_capacity(max(len(plan.def_runs.kinds),
+                              len(plan.idx_runs.kinds)
+                              if plan.idx_runs else 1, 1), 8)
+    def_table = _runs_arrays(plan.def_runs, pad)
+    idx_table = _runs_arrays(plan.idx_runs, pad) if plan.idx_runs else None
+    packed = np.frombuffer(plan.packed or b"\0\0\0\0", dtype=np.uint8)
+    packed_dev = jnp.asarray(packed)
+    dict_string = plan.dict_rank is not None
+    if dict_string:
+        # All-null chunks write an empty dictionary; keep one slot so the
+        # (masked-out) gathers stay in range.
+        rank = plan.dict_rank if len(plan.dict_rank) else \
+            np.zeros(1, np.int32)
+        dict_table = jnp.asarray(rank)
+    elif plan.dict_values is not None:
+        vals = plan.dict_values if len(plan.dict_values) else \
+            np.zeros(1, plan.dict_values.dtype)
+        dict_table = jnp.asarray(vals)
+    else:
+        dict_table = None
+    plain = None
+    if plan.plain_values is not None:
+        buf = np.zeros(capacity, plan.plain_values.dtype)
+        buf[: len(plan.plain_values)] = plan.plain_values
+        plain = jnp.asarray(buf)
+
+    idx_bw, dtype = plan.idx_bit_width, plan.dtype
+
+    def build():
+        def kern(dt, it, pk, pl, dtab, n):
+            return _decode_chunk_device(dt, it, pk, pl, dtab, n, capacity,
+                                        idx_bw, dtype, dict_string)
+        return kern
+    kern = cached_kernel(
+        "parquet_decode",
+        (dtype.name, capacity, idx_bw, idx_table is not None, dict_string,
+         plain is not None, pad),
+        build)
+    data, validity = kern(def_table, idx_table, packed_dev, plain,
+                          dict_table, jnp.asarray(plan.n_rows, jnp.int32))
+    if dict_string:
+        max_bytes = 8
+        if plan.dict_offsets is not None and len(plan.dict_offsets) > 1:
+            max_bytes = bucket_capacity(
+                int(np.diff(plan.dict_offsets).max() or 1), 8)
+        byte_cap = bucket_capacity(max(int(plan.dict_offsets[-1]), 1))
+        payload = np.zeros(byte_cap, np.uint8)
+        payload[: len(plan.dict_payload)] = plan.dict_payload
+        return DeviceColumn(
+            data=jnp.asarray(payload), validity=validity, dtype=T.STRING,
+            offsets=jnp.asarray(plan.dict_offsets), max_bytes=max_bytes,
+            codes=data, dict_sorted=True)
+    return DeviceColumn(data=data, validity=validity, dtype=plan.dtype)
+
+
+def decode_row_group(path: str, row_group: int, schema: T.Schema,
+                     pf=None) -> ColumnarBatch:
+    """Decode one row group of a parquet file into a device batch.
+    Pass an open ``pyarrow.parquet.ParquetFile`` to amortize the footer
+    parse across a file's row groups."""
+    import pyarrow.parquet as pq
+    if pf is None:
+        pf = pq.ParquetFile(path)
+    md = pf.metadata.row_group(row_group)
+    name_to_idx = {md.column(i).path_in_schema: i
+                   for i in range(md.num_columns)}
+    cols = []
+    n_rows = md.num_rows
+    capacity = bucket_capacity(max(n_rows, 1))
+    with open(path, "rb") as f:
+        for field in schema:
+            ci = name_to_idx[field.name]
+            plan = plan_column_chunk(f, md.column(ci), field)
+            cols.append(decode_chunk(plan, capacity))
+    return ColumnarBatch(tuple(cols), jnp.asarray(n_rows, jnp.int32),
+                         schema)
+
+
+class TpuParquetScanExec:
+    """Device parquet scan: one partition per (file, row group); each batch
+    decodes ON DEVICE from uploaded page bytes (the GpuParquetScan +
+    Table.readParquet split). A row group outside the decoder's scope
+    falls back to a host pyarrow read + upload for JUST that row group —
+    the reference's graceful per-unit degradation."""
+
+    columnar = True
+    children = ()
+    children_coalesce_goals = None
+
+    def __init__(self, files: List[str], schema: T.Schema):
+        self.files = list(files)
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def node_name(self):
+        return "TpuParquetScanExec"
+
+    def describe(self):
+        return f"TpuParquetScan files={len(self.files)}"
+
+    def tree_string(self, indent: int = 0) -> str:
+        return "  " * indent + self.describe() + "\n"
+
+    def with_children(self, children):
+        assert not children
+        return self
+
+    def execute(self, ctx):
+        import pyarrow.parquet as pq
+        units = []
+        for path in self.files:
+            pf = pq.ParquetFile(path)  # one footer parse per file
+            units.extend((path, pf, rg)
+                         for rg in range(pf.metadata.num_row_groups))
+
+        def read(path, pf, rg):
+            from ..utils.tracing import trace_range
+            try:
+                with trace_range("parquet.device_decode"):
+                    yield decode_row_group(path, rg, self._schema, pf=pf)
+                ctx.metric("TpuParquetScan", "deviceDecodedRowGroups", 1)
+            except NotImplementedError:
+                with trace_range("parquet.host_fallback"):
+                    tbl = pf.read_row_group(
+                        rg, columns=self._schema.names)
+                    rb = tbl.combine_chunks().to_batches()[0] \
+                        if tbl.num_rows else None
+                    import pyarrow as pa
+                    if rb is None:
+                        rb = pa.RecordBatch.from_pydict(
+                            {n: [] for n in self._schema.names},
+                            schema=T.schema_to_arrow(self._schema))
+                    yield ColumnarBatch.from_arrow(
+                        rb.cast(T.schema_to_arrow(self._schema)))
+                ctx.metric("TpuParquetScan", "hostFallbackRowGroups", 1)
+        return [read(p, pf, rg) for p, pf, rg in units]
+
+
+def scan_files(paths: List[str]) -> Optional[List[str]]:
+    """Concrete parquet files behind a scan's paths (None when the layout
+    is unsupported, e.g. hive-partitioned directories)."""
+    import os
+    import pyarrow.dataset as pads
+    try:
+        src = paths[0] if len(paths) == 1 else paths
+        if len(paths) == 1 and os.path.isdir(paths[0]):
+            # Hive layouts carry partition columns in directory names that
+            # the file-level decoder cannot restore — host path handles it.
+            d = pads.dataset(src, format="parquet", partitioning="hive")
+            if any("=" in os.path.basename(os.path.dirname(f))
+                   for f in d.files):
+                return None
+            return list(d.files)
+        return list(pads.dataset(src, format="parquet").files)
+    except Exception:
+        return None
+
+
+def device_decodable(path: str, schema: T.Schema) -> bool:
+    """Cheap metadata-only check: can every column of every row group go
+    through the device decoder? (The graceful-fallback gate.)"""
+    import pyarrow.parquet as pq
+    try:
+        pf = pq.ParquetFile(path)
+    except Exception:
+        return False
+    for field in schema:
+        if isinstance(field.data_type, (T.ArrayType, T.StructType)):
+            return False
+    file_cols = set(pf.schema_arrow.names)
+    if not set(schema.names) <= file_cols:
+        return False
+    md = pf.metadata
+    for rg in range(md.num_row_groups):
+        g = md.row_group(rg)
+        for ci in range(g.num_columns):
+            cm = g.column(ci)
+            if cm.physical_type not in _PHYS_NP and \
+                    cm.physical_type != "BYTE_ARRAY":
+                return False
+            encs = set(cm.encodings)
+            # NOTE: "PLAIN" always appears (the dictionary page itself is
+            # PLAIN-encoded), so a byte-array chunk that actually fell back
+            # to PLAIN data pages is indistinguishable here — the
+            # authoritative gate is plan_column_chunk raising
+            # NotImplementedError at scan time, which the scan catches to
+            # fall back to the host path.
+            if not encs <= {"PLAIN", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
+                            "RLE", "BIT_PACKED"}:
+                return False
+            if cm.compression not in ("UNCOMPRESSED", "SNAPPY", "ZSTD",
+                                      "GZIP", "LZ4"):
+                return False
+    return True

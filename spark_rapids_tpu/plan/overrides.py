@@ -563,7 +563,8 @@ class TpuOverrides:
             if self.last_explain:
                 print(self.last_explain, end="")
         converted = meta.convert(self.conf)
-        converted = insert_transitions(converted, self.conf.batch_size_rows)
+        converted = insert_transitions(converted, self.conf.batch_size_rows,
+                                       self.conf)
         from ..exec.coalesce import insert_coalesce
         converted = insert_coalesce(converted, self.conf.batch_size_rows)
         if self.conf.test_enabled:
@@ -597,8 +598,29 @@ class TpuOverrides:
                 f"ops fell back to CPU: {bad}; allowed={sorted(allowed)}")
 
 
+def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
+    """Swap an uploadable parquet host scan for the device decoder
+    (io/parquet_device.py) when every row group qualifies."""
+    from ..config import PARQUET_DEVICE_DECODE
+    from ..io.files import CpuFileScanExec
+    if conf is None or not conf.get(PARQUET_DEVICE_DECODE):
+        return None
+    if not isinstance(node, CpuFileScanExec) or node.fmt != "parquet":
+        return None
+    if node.pushed_filters:
+        return None
+    from ..io import parquet_device as PD
+    files = PD.scan_files(node.paths)
+    if not files:
+        return None
+    if not all(PD.device_decodable(f, node.schema) for f in files):
+        return None
+    return PD.TpuParquetScanExec(files, node.schema)
+
+
 def insert_transitions(plan: P.PhysicalPlan,
-                       goal_rows: int = 1 << 20) -> P.PhysicalPlan:
+                       goal_rows: int = 1 << 20,
+                       conf: Optional[TpuConf] = None) -> P.PhysicalPlan:
     """Insert HostToDevice/DeviceToHost where columnar-ness flips, and make
     the root host-side (GpuTransitionOverrides analog)."""
 
@@ -610,7 +632,9 @@ def insert_transitions(plan: P.PhysicalPlan,
         new_children = []
         for c in fixed_children(node):
             if wants_columnar and not c.columnar:
-                c = E.HostToDeviceExec(c, goal_rows)
+                dev_scan = _device_scan_or_none(c, conf)
+                c = dev_scan if dev_scan is not None \
+                    else E.HostToDeviceExec(c, goal_rows)
             elif not wants_columnar and c.columnar:
                 c = E.DeviceToHostExec(c)
             new_children.append(c)

@@ -274,6 +274,89 @@ def q14(t):
                  A.AggregateExpression(A.Sum(col("rev")), "total")))
 
 
+def q4(t):
+    """Order priority checking (Q4): EXISTS subquery as a left-semi join,
+    then count by priority (TpchLikeSpark.scala Q4 uses the same shape)."""
+    late = t["lineitem"].where(
+        P.LessThan(col("l_commitdate"), col("l_receiptdate")))
+    orders = t["orders"].where(P.And(
+        P.GreaterThanOrEqual(col("o_orderdate"), lit(D_1994_01_01, T.DATE)),
+        P.LessThan(col("o_orderdate"), lit(D_1995_01_01, T.DATE))))
+    return (orders
+            .join(late, on=P.EqualTo(col("o_orderkey"), col("l_orderkey")),
+                  how="left_semi")
+            .group_by(col("o_orderpriority"))
+            .agg(A.AggregateExpression(A.Count(), "order_count"))
+            .sort(SortOrder(col("o_orderpriority"))))
+
+
+def q10(t):
+    """Returned item reporting (Q10): 4-way join, revenue per customer,
+    top 20 (TpchLikeSpark.scala Q10)."""
+    orders = t["orders"].where(P.And(
+        P.GreaterThanOrEqual(col("o_orderdate"), lit(D_1994_01_01, T.DATE)),
+        P.LessThan(col("o_orderdate"), lit(D_1995_01_01, T.DATE))))
+    returned = t["lineitem"].where(
+        P.EqualTo(col("l_returnflag"), lit("R")))
+    return (t["customer"]
+            .join(orders, on=P.EqualTo(col("c_custkey"), col("o_custkey")),
+                  how="inner")
+            .join(returned,
+                  on=P.EqualTo(col("o_orderkey"), col("l_orderkey")),
+                  how="inner")
+            .join(t["nation"],
+                  on=P.EqualTo(col("c_nationkey"), col("n_nationkey")),
+                  how="inner")
+            .with_column("rev", _rev())
+            .group_by(col("c_custkey"), col("n_name"))
+            .agg(A.AggregateExpression(A.Sum(col("rev")), "revenue"))
+            .sort(SortOrder(col("revenue"), ascending=False),
+                  SortOrder(col("c_custkey")))
+            .limit(20))
+
+
+def q18(t):
+    """Large volume customer (Q18): HAVING via aggregate-then-filter, the
+    qualifying keys rejoin the fact tables (TpchLikeSpark.scala Q18)."""
+    big = (t["lineitem"]
+           .group_by(col("l_orderkey"))
+           .agg(A.AggregateExpression(A.Sum(col("l_quantity")), "sum_qty"))
+           .where(P.GreaterThan(col("sum_qty"), lit(150.0))))
+    return (t["orders"]
+            .join(big, on=P.EqualTo(col("o_orderkey"), col("l_orderkey")),
+                  how="inner")
+            .join(t["customer"],
+                  on=P.EqualTo(col("o_custkey"), col("c_custkey")),
+                  how="inner")
+            .group_by(col("c_custkey"))
+            .agg(A.AggregateExpression(A.Count(), "n_orders"),
+                 A.AggregateExpression(A.Sum(col("sum_qty")), "total_qty"))
+            .sort(SortOrder(col("total_qty"), ascending=False),
+                  SortOrder(col("c_custkey")))
+            .limit(100))
+
+
+def q19(t):
+    """Discounted revenue (Q19): join under a disjunction of conjunctive
+    band predicates, global sum (TpchLikeSpark.scala Q19)."""
+    li = t["lineitem"].where(P.And(
+        P.Or(P.EqualTo(col("l_shipmode"), lit("AIR")),
+             P.EqualTo(col("l_shipmode"), lit("REG AIR"))),
+        P.LessThanOrEqual(col("l_quantity"), lit(30.0))))
+    joined = t["part"].join(
+        li, on=P.EqualTo(col("p_partkey"), col("l_partkey")), how="inner")
+    band = P.Or(
+        P.And(StartsWith(col("p_type"), "PROMO"),
+              P.LessThanOrEqual(col("l_quantity"), lit(11.0))),
+        P.And(StartsWith(col("p_type"), "STANDARD"),
+              P.And(P.GreaterThanOrEqual(col("l_quantity"), lit(10.0)),
+                    P.LessThanOrEqual(col("l_quantity"), lit(20.0)))))
+    return (joined.where(band)
+            .with_column("rev", _rev())
+            .group_by()
+            .agg(A.AggregateExpression(A.Sum(col("rev")), "revenue")))
+
+
 def xbb_score(t):
     """TPCxBB q05-shaped logistic scoring (TpcxbbLikeSpark.scala q05 trains
     a logistic model): sigmoid of a linear feature combination per line
@@ -297,5 +380,6 @@ def Divide_safe(z):
     return Divide(lit(1.0), Add(lit(1.0), Exp(UnaryMinus(z))))
 
 
-QUERIES = {"q1": q1, "q3": q3, "q5": q5, "q6": q6, "q12": q12, "q14": q14,
+QUERIES = {"q1": q1, "q3": q3, "q4": q4, "q5": q5, "q6": q6, "q10": q10,
+           "q12": q12, "q14": q14, "q18": q18, "q19": q19,
            "xbb_score": xbb_score}
