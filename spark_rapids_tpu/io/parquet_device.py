@@ -257,12 +257,18 @@ def _decompress(codec: str, payload: bytes, uncompressed_size: int) -> bytes:
         payload, decompressed_size=uncompressed_size).to_pybytes()
 
 
-def plan_column_chunk(f, col_md, field: T.StructField) -> ColumnChunkPlan:
+def plan_column_chunk(f, col_md, field: T.StructField,
+                      max_def_level: int) -> ColumnChunkPlan:
     """Host phase for one column chunk: page headers -> run tables.
 
-    ``f`` is an open file object; ``col_md`` a pyarrow ColumnChunkMetaData.
-    Raises NotImplementedError for shapes outside scope (caller falls back
-    to the host scan)."""
+    ``f`` is an open file object; ``col_md`` a pyarrow ColumnChunkMetaData;
+    ``max_def_level`` comes from the FILE's schema (a REQUIRED column has
+    no definition-level stream regardless of what the engine schema says
+    about nullability — trusting the engine schema here mis-frames the
+    page payload). Raises NotImplementedError for shapes outside scope
+    (caller falls back to the host scan)."""
+    if max_def_level > 1:
+        raise NotImplementedError("nested columns (max_def_level > 1)")
     phys = col_md.physical_type
     if phys not in _PHYS_NP and phys != "BYTE_ARRAY":
         raise NotImplementedError(f"physical type {phys}")
@@ -296,7 +302,7 @@ def plan_column_chunk(f, col_md, field: T.StructField) -> ColumnChunkPlan:
             raise NotImplementedError(f"page type {ph.page_type} (v2?)")
         p = 0
         page_def_start = len(def_runs.kinds)
-        if field.nullable:
+        if max_def_level > 0:
             if ph.def_encoding != RLE:
                 raise NotImplementedError("non-RLE definition levels")
             (def_len,) = _struct.unpack_from("<I", payload, p)
@@ -441,6 +447,14 @@ def _decode_chunk_device(def_table, idx_table, packed, plain, dict_table,
     return data, validity
 
 
+def _pad_packed(packed: bytes) -> jnp.ndarray:
+    raw = np.frombuffer(packed or b"\0\0\0\0", dtype=np.uint8)
+    cap = bucket_capacity(max(len(raw), 4), 8)
+    buf = np.zeros(cap, np.uint8)
+    buf[: len(raw)] = raw
+    return jnp.asarray(buf)
+
+
 def _runs_arrays(runs: _HybridRuns, pad_to: int):
     def arr(xs, fill):
         a = np.full(pad_to, fill, np.int32)
@@ -458,19 +472,21 @@ def decode_chunk(plan: ColumnChunkPlan, capacity: int) -> DeviceColumn:
                               if plan.idx_runs else 1, 1), 8)
     def_table = _runs_arrays(plan.def_runs, pad)
     idx_table = _runs_arrays(plan.idx_runs, pad) if plan.idx_runs else None
-    packed = np.frombuffer(plan.packed or b"\0\0\0\0", dtype=np.uint8)
-    packed_dev = jnp.asarray(packed)
+    packed_dev = _pad_packed(plan.packed)
+    def _bucketed(arr, dtype):
+        """Pad to a power-of-two length: unbucketed shapes would retrace
+        the jitted kernel per row group (kernel_cache discipline). Also
+        keeps (masked-out) gathers in range for empty dictionaries."""
+        cap = bucket_capacity(max(len(arr), 1), 8)
+        buf = np.zeros(cap, dtype)
+        buf[: len(arr)] = arr
+        return jnp.asarray(buf)
+
     dict_string = plan.dict_rank is not None
     if dict_string:
-        # All-null chunks write an empty dictionary; keep one slot so the
-        # (masked-out) gathers stay in range.
-        rank = plan.dict_rank if len(plan.dict_rank) else \
-            np.zeros(1, np.int32)
-        dict_table = jnp.asarray(rank)
+        dict_table = _bucketed(plan.dict_rank, np.int32)
     elif plan.dict_values is not None:
-        vals = plan.dict_values if len(plan.dict_values) else \
-            np.zeros(1, plan.dict_values.dtype)
-        dict_table = jnp.asarray(vals)
+        dict_table = _bucketed(plan.dict_values, plan.dict_values.dtype)
     else:
         dict_table = None
     plain = None
@@ -519,13 +535,16 @@ def decode_row_group(path: str, row_group: int, schema: T.Schema,
     md = pf.metadata.row_group(row_group)
     name_to_idx = {md.column(i).path_in_schema: i
                    for i in range(md.num_columns)}
+    pq_schema = pf.schema
     cols = []
     n_rows = md.num_rows
     capacity = bucket_capacity(max(n_rows, 1))
     with open(path, "rb") as f:
         for field in schema:
             ci = name_to_idx[field.name]
-            plan = plan_column_chunk(f, md.column(ci), field)
+            plan = plan_column_chunk(
+                f, md.column(ci), field,
+                pq_schema.column(ci).max_definition_level)
             cols.append(decode_chunk(plan, capacity))
     return ColumnarBatch(tuple(cols), jnp.asarray(n_rows, jnp.int32),
                          schema)
@@ -542,9 +561,13 @@ class TpuParquetScanExec:
     children = ()
     children_coalesce_goals = None
 
-    def __init__(self, files: List[str], schema: T.Schema):
+    def __init__(self, files: List[str], schema: T.Schema, pf_cache=None):
         self.files = list(files)
         self._schema = schema
+        # Open ParquetFile handles carried from the planning-time gate so
+        # each footer parses ONCE (excluded from plan signatures via
+        # PLAN_SIG_SKIP_ATTRS — object identity would destabilize them).
+        self._pf_cache = dict(pf_cache or {})
 
     @property
     def schema(self):
@@ -567,7 +590,7 @@ class TpuParquetScanExec:
         import pyarrow.parquet as pq
         units = []
         for path in self.files:
-            pf = pq.ParquetFile(path)  # one footer parse per file
+            pf = self._pf_cache.get(path) or pq.ParquetFile(path)
             units.extend((path, pf, rg)
                          for rg in range(pf.metadata.num_row_groups))
 
@@ -577,7 +600,11 @@ class TpuParquetScanExec:
                 with trace_range("parquet.device_decode"):
                     yield decode_row_group(path, rg, self._schema, pf=pf)
                 ctx.metric("TpuParquetScan", "deviceDecodedRowGroups", 1)
-            except NotImplementedError:
+            # ANY decode failure (unsupported shape, decompression codec
+            # mismatch, corrupt/truncated page metadata) degrades to the
+            # host reader for just this row group — the host result is the
+            # correctness baseline, so falling back is always safe.
+            except Exception:  # noqa: BLE001 - graceful per-unit fallback
                 with trace_range("parquet.host_fallback"):
                     tbl = pf.read_row_group(
                         rg, columns=self._schema.names)
@@ -614,12 +641,13 @@ def scan_files(paths: List[str]) -> Optional[List[str]]:
         return None
 
 
-def device_decodable(path: str, schema: T.Schema) -> bool:
-    """Cheap metadata-only check: can every column of every row group go
-    through the device decoder? (The graceful-fallback gate.)"""
+def device_decodable(path: str, schema: T.Schema, pf=None) -> bool:
+    """Cheap metadata-only check: can every SELECTED column of every row
+    group go through the device decoder? (The graceful-fallback gate.)"""
     import pyarrow.parquet as pq
     try:
-        pf = pq.ParquetFile(path)
+        if pf is None:
+            pf = pq.ParquetFile(path)
     except Exception:
         return False
     for field in schema:
@@ -629,10 +657,13 @@ def device_decodable(path: str, schema: T.Schema) -> bool:
     if not set(schema.names) <= file_cols:
         return False
     md = pf.metadata
+    wanted = set(schema.names)
     for rg in range(md.num_row_groups):
         g = md.row_group(rg)
         for ci in range(g.num_columns):
             cm = g.column(ci)
+            if cm.path_in_schema not in wanted:
+                continue  # pruned away; its shape is irrelevant
             if cm.physical_type not in _PHYS_NP and \
                     cm.physical_type != "BYTE_ARRAY":
                 return False
@@ -640,13 +671,14 @@ def device_decodable(path: str, schema: T.Schema) -> bool:
             # NOTE: "PLAIN" always appears (the dictionary page itself is
             # PLAIN-encoded), so a byte-array chunk that actually fell back
             # to PLAIN data pages is indistinguishable here — the
-            # authoritative gate is plan_column_chunk raising
-            # NotImplementedError at scan time, which the scan catches to
-            # fall back to the host path.
+            # authoritative gate is plan_column_chunk raising at scan time,
+            # which the scan catches to fall back to the host path.
             if not encs <= {"PLAIN", "PLAIN_DICTIONARY", "RLE_DICTIONARY",
                             "RLE", "BIT_PACKED"}:
                 return False
+            # No "LZ4": parquet's legacy LZ4 is Hadoop-block-framed, which
+            # pa.Codec("lz4") (frame format) cannot decode.
             if cm.compression not in ("UNCOMPRESSED", "SNAPPY", "ZSTD",
-                                      "GZIP", "LZ4"):
+                                      "GZIP"):
                 return False
     return True

@@ -233,8 +233,24 @@ class BufferCatalog:
         with self._lock:
             self._pinned.discard(buffer_id)
 
+    def leak_report(self) -> list:
+        """Buffers registered but never freed — the cudf ref-count
+        leak-warning role (SURVEY.md §5 race/leak tracking; reference
+        `noWarnLeakExpected`). Returns [(buffer_id, tier, bytes)]."""
+        with self._lock:
+            return [(bid, e.tier, e.meta.size_bytes)
+                    for bid, e in self._entries.items() if not e.freed]
+
     def close(self):
         with self._lock:
+            leaks = self.leak_report()
+            if leaks:
+                import logging
+                total = sum(b for _, _, b in leaks)
+                logging.getLogger(__name__).warning(
+                    "spill catalog closed with %d leaked buffer(s), "
+                    "%d bytes: %s", len(leaks), total,
+                    [(bid, t) for bid, t, _ in leaks[:8]])
             self._entries.clear()
             self._device_heap.clear()
             self._host_heap.clear()

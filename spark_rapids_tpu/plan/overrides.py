@@ -613,9 +613,16 @@ def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
     files = PD.scan_files(node.paths)
     if not files:
         return None
-    if not all(PD.device_decodable(f, node.schema) for f in files):
-        return None
-    return PD.TpuParquetScanExec(files, node.schema)
+    import pyarrow.parquet as pq
+    pf_cache = {}
+    for f in files:
+        try:
+            pf_cache[f] = pq.ParquetFile(f)
+        except Exception:
+            return None
+        if not PD.device_decodable(f, node.schema, pf=pf_cache[f]):
+            return None
+    return PD.TpuParquetScanExec(files, node.schema, pf_cache)
 
 
 def insert_transitions(plan: P.PhysicalPlan,

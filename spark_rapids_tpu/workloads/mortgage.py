@@ -1,0 +1,115 @@
+"""Mortgage-like ETL workload — the ``MortgageSpark`` analog.
+
+The reference ships a mortgage ETL pipeline as a benchmark/test fixture
+(``integration_tests/.../mortgage/MortgageSpark.scala:437``): clean the
+performance records, derive delinquency features per loan, join against
+acquisitions, and produce a per-loan feature table. This module generates
+TPC-style seeded tables at a requested scale and expresses the same
+pipeline shape through the public DataFrame API:
+
+1. performance cleanup: parse-ish projections + filters,
+2. per-loan delinquency aggregation (12-month windows via conditional
+   sums),
+3. join with acquisitions (credit score bands via CaseWhen),
+4. final feature aggregation per (seller, score band).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from ..ops import aggregates as A
+from ..ops import predicates as P
+from ..ops.arithmetic import Multiply, Subtract
+from ..ops.conditional import CaseWhen, If
+from ..ops.expression import col, lit
+from .. import types as T
+
+_SELLERS = np.array(["ACME BANK", "BIG LENDER", "CREDIT ONE", "DELTA TRUST",
+                     "EVERGREEN"])
+
+
+def gen_tables(perf_rows: int = 1 << 18, seed: int = 7) -> dict:
+    rng = np.random.default_rng(seed)
+    n_perf = perf_rows
+    n_loans = max(n_perf // 24, 16)  # ~24 monthly records per loan
+    acquisition = pa.RecordBatch.from_pydict({
+        "loan_id": np.arange(n_loans, dtype=np.int64),
+        "seller": _SELLERS[rng.integers(0, len(_SELLERS), n_loans)],
+        "orig_rate": np.round(rng.uniform(2.5, 7.5, n_loans), 3),
+        "orig_upb": rng.integers(50_000, 800_000, n_loans).astype(np.int64),
+        "credit_score": rng.integers(300, 850, n_loans).astype(np.int64),
+        "orig_date": rng.integers(14000, 17000, n_loans).astype(np.int32),
+    }, schema=pa.schema([
+        ("loan_id", pa.int64()), ("seller", pa.string()),
+        ("orig_rate", pa.float64()), ("orig_upb", pa.int64()),
+        ("credit_score", pa.int64()), ("orig_date", pa.date32()),
+    ]))
+    performance = pa.RecordBatch.from_pydict({
+        "loan_id": rng.integers(0, n_loans, n_perf).astype(np.int64),
+        "month": rng.integers(0, 48, n_perf).astype(np.int64),
+        "current_upb": rng.integers(10_000, 800_000, n_perf)
+        .astype(np.float64),
+        "delinq_status": np.maximum(
+            rng.integers(-6, 7, n_perf), 0).astype(np.int64),
+        "servicer": _SELLERS[rng.integers(0, len(_SELLERS), n_perf)],
+    }, schema=pa.schema([
+        ("loan_id", pa.int64()), ("month", pa.int64()),
+        ("current_upb", pa.float64()), ("delinq_status", pa.int64()),
+        ("servicer", pa.string()),
+    ]))
+    return {"acquisition": acquisition, "performance": performance}
+
+
+def load(session, tables: dict, cache: bool = True) -> dict:
+    out = {}
+    for name, rb in tables.items():
+        df = session.create_dataframe(rb)
+        out[name] = df.cache() if cache else df
+    return out
+
+
+def etl(t):
+    """The full pipeline: clean -> per-loan features -> join -> report."""
+    perf = (t["performance"]
+            .where(P.GreaterThan(col("current_upb"), lit(0.0)))
+            .with_column("ever_delinq",
+                         If(P.GreaterThanOrEqual(col("delinq_status"),
+                                                 lit(1)), lit(1), lit(0)))
+            .with_column("serious_delinq",
+                         If(P.GreaterThanOrEqual(col("delinq_status"),
+                                                 lit(3)), lit(1), lit(0)))
+            .with_column("recent",
+                         If(P.GreaterThanOrEqual(col("month"), lit(36)),
+                            col("current_upb"), lit(0.0))))
+    loan_features = (perf.group_by(col("loan_id"))
+                     .agg(A.AggregateExpression(A.Count(), "n_records"),
+                          A.AggregateExpression(
+                              A.Sum(col("ever_delinq")), "months_delinq"),
+                          A.AggregateExpression(
+                              A.Sum(col("serious_delinq")),
+                              "months_serious"),
+                          A.AggregateExpression(
+                              A.Max(col("delinq_status")), "worst_status"),
+                          A.AggregateExpression(
+                              A.Sum(col("recent")), "recent_upb")))
+    band = CaseWhen(
+        [(P.LessThan(col("credit_score"), lit(580)), lit("SUBPRIME")),
+         (P.LessThan(col("credit_score"), lit(670)), lit("FAIR")),
+         (P.LessThan(col("credit_score"), lit(740)), lit("GOOD"))],
+        lit("EXCELLENT"))
+    joined = (t["acquisition"]
+              .join(loan_features, on="loan_id", how="inner")
+              .with_column("score_band", band)
+              .with_column("risk_upb",
+                           If(P.GreaterThan(col("months_serious"), lit(0)),
+                              Multiply(col("orig_upb").cast(T.DOUBLE),
+                                       lit(1.0)), lit(0.0))))
+    return (joined.group_by(col("seller"), col("score_band"))
+            .agg(A.AggregateExpression(A.Count(), "n_loans"),
+                 A.AggregateExpression(A.Sum(col("months_delinq")),
+                                       "total_delinq_months"),
+                 A.AggregateExpression(A.Sum(col("risk_upb")), "risk_upb"),
+                 A.AggregateExpression(A.Average(col("orig_rate")),
+                                       "avg_rate")))

@@ -1,0 +1,14 @@
+"""Generated docs stay current (the reference generates docs/configs.md
+from RapidsConf.help, RapidsConf.scala:641)."""
+
+import os
+
+
+def test_configs_md_is_current():
+    from spark_rapids_tpu.config import TpuConf
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "configs.md")
+    assert open(path).read() == TpuConf.help_markdown(), \
+        "docs/configs.md is stale; regenerate with " \
+        "python -c \"from spark_rapids_tpu.config import TpuConf; " \
+        "open('docs/configs.md','w').write(TpuConf.help_markdown())\""

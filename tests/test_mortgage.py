@@ -1,0 +1,32 @@
+"""Mortgage-like ETL differential test (MortgageSpark.scala:437 analog):
+the full clean -> per-loan features -> join -> report pipeline matches the
+CPU oracle."""
+
+import pytest
+
+from spark_rapids_tpu.workloads import mortgage
+
+from harness import assert_tpu_and_cpu_are_equal
+
+
+@pytest.fixture(scope="module")
+def tables():
+    return mortgage.gen_tables(perf_rows=1 << 13, seed=7)
+
+
+def test_etl_differential(tables):
+    assert_tpu_and_cpu_are_equal(
+        lambda s: mortgage.etl(mortgage.load(s, tables, cache=False)),
+        conf={"spark.rapids.sql.variableFloatAgg.enabled": True},
+        approx=1e-9)
+
+
+def test_etl_shape(tables):
+    from harness import tpu_session
+    s = tpu_session(**{"spark.rapids.sql.variableFloatAgg.enabled": True})
+    out = mortgage.etl(mortgage.load(s, tables, cache=False)).collect()
+    assert set(out.column_names) == {
+        "seller", "score_band", "n_loans", "total_delinq_months",
+        "risk_upb", "avg_rate"}
+    assert 0 < out.num_rows <= 5 * 4
+    assert sum(out.column("n_loans").to_pylist()) > 0

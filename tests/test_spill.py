@@ -245,3 +245,32 @@ class TestLifecycle:
         cat.register_batch(_batch())
         assert cat._spill_file is None
         cat.close()
+
+
+class TestLeakTracking:
+    def test_leak_report_and_close_warning(self, caplog):
+        import logging
+        from spark_rapids_tpu.memory.spill import BufferCatalog
+        from spark_rapids_tpu.data.batch import HostBatch
+        cat = BufferCatalog(1 << 20, 1 << 20)
+        db = HostBatch.from_pydict({"a": [1, 2, 3]}).to_device()
+        kept = cat.register_batch(db)
+        freed = cat.register_batch(db)
+        cat.free(freed)
+        leaks = cat.leak_report()
+        assert [bid for bid, _, _ in leaks] == [kept]
+        with caplog.at_level(logging.WARNING):
+            cat.close()
+        assert any("leaked buffer" in r.message for r in caplog.records)
+
+    def test_clean_close_is_silent(self, caplog):
+        import logging
+        from spark_rapids_tpu.memory.spill import BufferCatalog
+        from spark_rapids_tpu.data.batch import HostBatch
+        cat = BufferCatalog(1 << 20, 1 << 20)
+        db = HostBatch.from_pydict({"a": [1]}).to_device()
+        b = cat.register_batch(db)
+        cat.free(b)
+        with caplog.at_level(logging.WARNING):
+            cat.close()
+        assert not [r for r in caplog.records if "leaked" in r.message]
