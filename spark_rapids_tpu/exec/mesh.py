@@ -21,8 +21,18 @@ Topology of one mesh query:
 
 Plans whose operators are all mesh-capable run here when
 ``spark.rapids.tpu.mesh.enabled`` is set; anything else falls back to the
-single-chip fused/streaming paths. String columns currently take the
-fallback (variable-width payloads need a char-matrix exchange layout).
+single-chip fused/streaming paths.
+
+**Strings over the mesh** ride the dictionary encoding: a source batch is
+materialized centrally, so its dictionary is global — the int32 CODES
+shard and exchange like any fixed-width lane while the dictionary buffers
+REPLICATE across chips (passed as unsharded shard_map inputs). Group-bys
+keep the sorted-dict fast path per shard, joins and hash partitioning read
+strings through the shared dictionaries, and the collect downloads one
+dictionary plus per-shard code lanes. Only dictionary-encoded strings
+qualify; expressions that produce FLAT strings (per-row payloads would
+need a variable-width exchange) keep the single-chip fallback.
+
 Exchange buckets are capacity-bounded with the deferred-overflow contract:
 a ``psum``-reduced flag rides back with the result and the session retries
 with a larger bucket growth, exactly like the join ladder.
@@ -85,7 +95,10 @@ def _exchange_by_key(batch: ColumnarBatch, key_exprs: List[Expression],
     live = batch.row_mask()
     payload = {}
     for i, c in enumerate(batch.columns):
-        payload[f"d{i}"] = c.data
+        # Dict strings move as their int32 code lane; the dictionary
+        # buffers are replicated (identical on every chip), so codes stay
+        # meaningful after the exchange.
+        payload[f"d{i}"] = c.codes if c.is_dict else c.data
         payload[f"v{i}"] = c.validity
     send, send_valid, overflow = ici.build_send_buffers(
         payload, jnp.ones(batch.capacity, jnp.bool_), pid, live,
@@ -96,10 +109,16 @@ def _exchange_by_key(batch: ColumnarBatch, key_exprs: List[Expression],
     cols = []
     for i, c in enumerate(batch.columns):
         validity = flat[f"v{i}"] & flat_valid
-        data = jnp.where(validity, flat[f"d{i}"],
-                         jnp.zeros((), c.data.dtype))
-        cols.append(DeviceColumn(data=data, validity=validity,
-                                 dtype=c.dtype))
+        lane = jnp.where(validity, flat[f"d{i}"],
+                         jnp.zeros((), flat[f"d{i}"].dtype))
+        if c.is_dict:
+            cols.append(DeviceColumn(
+                data=c.data, validity=validity, dtype=c.dtype,
+                offsets=c.offsets, max_bytes=c.max_bytes, codes=lane,
+                dict_sorted=c.dict_sorted))
+        else:
+            cols.append(DeviceColumn(data=lane, validity=validity,
+                                     dtype=c.dtype))
     return ColumnarBatch(tuple(cols), n_live.astype(jnp.int32), batch.schema)
 
 
@@ -117,15 +136,30 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
     where env maps source index -> the local shard batch. Raises
     NotMeshCapable for anything without a mesh story yet."""
     if isinstance(node, DeviceSourceExec):
-        _require(all(f.data_type is not T.STRING for f in node.schema),
-                 "string columns in mesh source")
+        # String columns qualify only dictionary-encoded (codes shard, the
+        # dictionary replicates); the source batches exist at plan time so
+        # this is checkable here.
+        for p in node.partitions:
+            for b in p:
+                for c, f in zip(b.columns, node.schema):
+                    if f.data_type is T.STRING:
+                        _require(c.is_dict,
+                                 "flat (non-dictionary) string column in "
+                                 "mesh source")
         sources.append(node)
         idx = len(sources) - 1
         return lambda env, flags: env[idx]
 
     if isinstance(node, TpuProjectExec):
-        _require(all(f.data_type is not T.STRING for f in node.schema),
-                 "string-producing projection over the mesh")
+        from ..ops.expression import Alias, AttributeReference, \
+            BoundReference
+        for e in node.exprs:
+            if e.data_type is T.STRING:
+                inner = e.children[0] if isinstance(e, Alias) else e
+                _require(isinstance(inner, (AttributeReference,
+                                            BoundReference)),
+                         "string-PRODUCING expression over the mesh "
+                         "(could yield flat per-shard payloads)")
         child = _compile(node.children[0], sources, n_parts, bucket_growth,
                          conf)
         bound = _bind_all(node.exprs, node.children[0].schema)
@@ -158,9 +192,14 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
         child_schema = node.children[0].schema
         _require(bool(node.groupings), "global agg needs no shuffle; "
                  "mesh path expects grouped agg here")
-        _require(all(f.data_type is not T.STRING
-                     for f in node._buffer_schema()),
-                 "string grouping keys over the mesh")
+        from ..ops.expression import Alias, AttributeReference, \
+            BoundReference
+        for g in node.groupings:
+            if g.data_type is T.STRING:
+                inner = g.children[0] if isinstance(g, Alias) else g
+                _require(isinstance(inner, (AttributeReference,
+                                            BoundReference)),
+                         "computed string grouping key over the mesh")
         groupings = _bind_all(node.groupings, child_schema)
         from ..ops import aggregates as AGG
         aggs = [AGG.AggregateExpression(a.func.bind(child_schema), a.name)
@@ -221,9 +260,6 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
             left, TpuBroadcastExchangeExec) else left
         lfn = _compile(left, sources, n_parts, bucket_growth, conf)
         rfn = _compile(right_src, sources, n_parts, bucket_growth, conf)
-        _require(all(f.data_type is not T.STRING
-                     for f in list(left.schema) + list(right_src.schema)),
-                 "string columns through a mesh join")
         lkeys = _bind_all(node.left_keys, left.schema)
         rkeys = _bind_all(node.right_keys, right_src.schema)
         out_schema = node.schema
@@ -270,27 +306,62 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
 
 def _replicate(batch: ColumnarBatch) -> ColumnarBatch:
     """all_gather every chip's shard and compact: the mesh broadcast —
-    every chip ends up with the full (small) table resident locally."""
+    every chip ends up with the full (small) table resident locally.
+    Dict strings gather their code lane; the dictionary is already
+    replicated."""
     def ag(x):
         return jax.lax.all_gather(x, PART_AXIS, axis=0, tiled=True)
     live_g = ag(batch.row_mask())
     cols = []
     for c in batch.columns:
-        cols.append(DeviceColumn(data=ag(c.data), validity=ag(c.validity),
-                                 dtype=c.dtype))
+        if c.is_dict:
+            cols.append(DeviceColumn(
+                data=c.data, validity=ag(c.validity), dtype=c.dtype,
+                offsets=c.offsets, max_bytes=c.max_bytes,
+                codes=ag(c.codes), dict_sorted=c.dict_sorted))
+        else:
+            cols.append(DeviceColumn(data=ag(c.data),
+                                     validity=ag(c.validity),
+                                     dtype=c.dtype))
     total_cap = live_g.shape[0]
     gb = ColumnarBatch(tuple(cols), jnp.asarray(total_cap, jnp.int32),
                        batch.schema)
     return KR.compact(gb, live_g)
 
 
+def _encoding_fingerprint(node) -> tuple:
+    """Per-source string-encoding layout (dict vs flat), which lives in the
+    DATA (DeviceSourceExec.partitions — excluded from plan signatures), so
+    mesh cache keys must carry it explicitly: capability and the compiled
+    program both depend on it."""
+    out = []
+
+    def walk(n):
+        if isinstance(n, DeviceSourceExec):
+            per_col = []
+            for ci, f in enumerate(n.schema):
+                if f.data_type is T.STRING:
+                    per_col.append(all(
+                        b.columns[ci].is_dict
+                        for p in n.partitions for b in p))
+                else:
+                    per_col.append(None)
+            out.append(tuple(per_col))
+            return
+        kids = list(n.children)
+        if isinstance(n, TpuShuffledHashJoinExec) and n.join_type == "right":
+            kids = [n.children[1], n.children[0]]
+        for c in kids:
+            walk(c)
+    walk(node)
+    return tuple(out)
+
+
 def mesh_capable(root, conf) -> bool:
     if not isinstance(root, DeviceToHostExec):
         return False
-    # Result reassembly downloads (data, validity) pairs only.
-    if any(f.data_type is T.STRING for f in root.schema):
-        return False
-    sig = ("mesh_capable", _plan_sig(root.children[0]))
+    sig = ("mesh_capable", _plan_sig(root.children[0]),
+           _encoding_fingerprint(root.children[0]))
     cached = _MESH_CACHE.get(sig)
     if cached is None:
         try:
@@ -326,19 +397,28 @@ def _collect_sources(node, out: List) -> None:
 def _shard_source(batch: ColumnarBatch, mesh: Mesh, n_parts: int):
     """Lay a source batch out across the mesh: shard s owns rows
     [s*shard_cap, (s+1)*shard_cap); per-shard live counts derive from the
-    traced n_rows with no host sync."""
+    traced n_rows with no host sync.
+
+    Per column, the sharded LANE is (data, validity) for fixed-width and
+    (codes, validity) for dict strings, whose (payload, offsets) ride
+    separately as REPLICATED arrays. Returns (lanes, counts, shard_cap,
+    kinds, sides): ``kinds`` is the static per-column descriptor the
+    traced program specializes on."""
     shard_cap = bucket_capacity(max(-(-batch.capacity // n_parts), 128))
     global_cap = shard_cap * n_parts
     sharding = NamedSharding(mesh, PartitionSpec(PART_AXIS))
+    kinds = tuple(
+        ("dict", c.max_bytes, c.dict_sorted) if c.is_dict else ("fixed",)
+        for c in batch.columns)
 
     def build_pad():
         def pad(batch):
             cols = []
             for c in batch.columns:
+                lane = c.codes if c.is_dict else c.data
                 pad_n = global_cap - c.capacity
-                data = jnp.pad(c.data, (0, pad_n))
-                validity = jnp.pad(c.validity, (0, pad_n))
-                cols.append((data, validity))
+                cols.append((jnp.pad(lane, (0, pad_n)),
+                             jnp.pad(c.validity, (0, pad_n))))
             counts = jnp.clip(
                 batch.n_rows
                 - jnp.arange(n_parts, dtype=jnp.int32) * shard_cap,
@@ -348,13 +428,18 @@ def _shard_source(batch: ColumnarBatch, mesh: Mesh, n_parts: int):
 
     pad = cached_kernel(
         "mesh_shard_pad",
-        kernel_key(n_parts, shard_cap, batch.schema, batch.capacity),
+        kernel_key(n_parts, shard_cap, batch.schema, batch.capacity, kinds),
         build_pad)
     cols, counts = pad(batch)
     cols = [(jax.device_put(d, sharding), jax.device_put(v, sharding))
             for d, v in cols]
     counts = jax.device_put(counts, sharding)
-    return cols, counts, shard_cap
+    repl = NamedSharding(mesh, PartitionSpec())
+    sides = tuple(
+        (jax.device_put(c.data, repl), jax.device_put(c.offsets, repl))
+        if c.is_dict else ()
+        for c in batch.columns)
+    return cols, counts, shard_cap, kinds, sides
 
 
 def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
@@ -366,8 +451,8 @@ def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
     mesh = mesh or make_mesh()
     n_parts = mesh.devices.size
     bucket_growth = float(ctx.join_growth)
-    sig = (_plan_sig(device_plan), n_parts, bucket_growth,
-           ctx.conf.collect_guess_rows)
+    sig = (_plan_sig(device_plan), _encoding_fingerprint(device_plan),
+           n_parts, bucket_growth, ctx.conf.collect_guess_rows)
     entry = _MESH_CACHE.get(sig)
     if entry is None:
         sources: List = []
@@ -383,45 +468,66 @@ def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
     for s in cur_sources:
         batch = _coalesce_device([b for p in s.partitions for b in p])
         sharded.append(_shard_source(batch, mesh, n_parts))
-    shard_caps = tuple(sc for _, _, sc in sharded)
+    shard_caps = tuple(sc for _, _, sc, _, _ in sharded)
+    src_kinds = tuple(k for _, _, _, k, _ in sharded)
     schemas = tuple(s.schema for s in cur_sources)
 
-    run = entry["jit"].get(shard_caps)
+    run = entry["jit"].get((shard_caps, src_kinds))
     if run is None:
         fn = entry["fn"]
 
-        def spmd(source_cols, source_counts):
+        def spmd(source_cols, source_counts, source_sides):
             env = {}
-            for i, (cols, counts) in enumerate(
-                    zip(source_cols, source_counts)):
+            for i, (cols, counts, sides) in enumerate(
+                    zip(source_cols, source_counts, source_sides)):
                 n = counts[0]
                 cap = cols[0][0].shape[0]
                 live = jnp.arange(cap, dtype=jnp.int32) < n
                 dcs = []
-                for (data, validity), f in zip(cols, schemas[i]):
+                for (lane, validity), side, kind, f in zip(
+                        cols, sides, src_kinds[i], schemas[i]):
                     validity = validity & live
-                    data = jnp.where(validity, data,
-                                     jnp.zeros((), data.dtype))
-                    dcs.append(DeviceColumn(data=data, validity=validity,
-                                            dtype=f.data_type))
+                    lane = jnp.where(validity, lane,
+                                     jnp.zeros((), lane.dtype))
+                    if kind[0] == "dict":
+                        payload, offsets = side
+                        dcs.append(DeviceColumn(
+                            data=payload, validity=validity,
+                            dtype=f.data_type, offsets=offsets,
+                            max_bytes=kind[1], codes=lane,
+                            dict_sorted=kind[2]))
+                    else:
+                        dcs.append(DeviceColumn(data=lane,
+                                                validity=validity,
+                                                dtype=f.data_type))
                 env[i] = ColumnarBatch(tuple(dcs), n.astype(jnp.int32),
                                        schemas[i])
             flags: List = []
             out = fn(env, flags)
             flag = jnp.any(jnp.stack(flags)) if flags else \
                 jnp.zeros((), jnp.bool_)
-            out_bufs = tuple((c.data, c.validity) for c in out.columns)
+            # Dict output columns: the code lane shards; the dictionary
+            # buffers are shard-invariant, returned TILED (host slices
+            # shard 0's copy — replicated out_specs would need invariance
+            # proofs through the collectives).
+            out_bufs = tuple(
+                (c.codes, c.validity, c.data, c.offsets) if c.is_dict
+                else (c.data, c.validity)
+                for c in out.columns)
             return out_bufs, out.n_rows.reshape(1), flag.reshape(1)
 
         spec = PartitionSpec(PART_AXIS)
         run = jax.jit(jax.shard_map(
             spmd, mesh=mesh,
-            in_specs=(spec, spec), out_specs=(spec, spec, spec)))
-        entry["jit"][shard_caps] = run
+            in_specs=(spec, spec, PartitionSpec()),
+            out_specs=(spec, spec, spec)))
+        entry["jit"][(shard_caps, src_kinds)] = run
 
-    source_cols = tuple(tuple(cols) for cols, _, _ in sharded)
-    source_counts = tuple(counts for _, counts, _ in sharded)
-    out_bufs, out_counts, out_flags = run(source_cols, source_counts)
+    source_cols = tuple(tuple(cols) for cols, _, _, _, _ in sharded)
+    source_counts = tuple(counts for _, counts, _, _, _ in sharded)
+    source_sides = tuple(sides for _, _, _, _, sides in sharded)
+    out_bufs, out_counts, out_flags = run(source_cols, source_counts,
+                                          source_sides)
     got_bufs, counts_np, flags_np = jax.device_get(
         (out_bufs, out_counts, out_flags))
     if bool(np.any(flags_np)):
@@ -435,13 +541,27 @@ def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
         if n == 0:
             continue
         arrays = []
-        for (data, validity), f in zip(got_bufs, out_schema):
+        for bufs, f in zip(got_bufs, out_schema):
             lo = s * shard_out_cap
-            col = DeviceColumn(data=data[lo:lo + shard_out_cap],
-                               validity=validity[lo:lo + shard_out_cap],
-                               dtype=f.data_type)
-            arrays.append(col.arrow_from_host(
-                (col.data, col.validity), n))
+            if len(bufs) == 4:  # dict string: codes shard, dict tiled
+                codes, validity, payload_t, offsets_t = bufs
+                n_dict = offsets_t.shape[0] // n_parts - 1
+                payload = payload_t[: payload_t.shape[0] // n_parts]
+                offsets = offsets_t[: n_dict + 1]
+                col = DeviceColumn(
+                    data=payload,
+                    validity=validity[lo: lo + shard_out_cap],
+                    dtype=f.data_type, offsets=offsets,
+                    codes=codes[lo: lo + shard_out_cap])
+                arrays.append(col.arrow_from_host(
+                    (payload, col.validity, offsets, col.codes), n))
+            else:
+                data, validity = bufs
+                col = DeviceColumn(data=data[lo: lo + shard_out_cap],
+                                   validity=validity[lo: lo + shard_out_cap],
+                                   dtype=f.data_type)
+                arrays.append(col.arrow_from_host(
+                    (col.data, col.validity), n))
         batches.append(pa.RecordBatch.from_arrays(arrays,
                                                   schema=arrow_schema))
     if not batches:

@@ -525,17 +525,19 @@ def decode_chunk(plan: ColumnChunkPlan, capacity: int) -> DeviceColumn:
 
 
 def decode_row_group(path: str, row_group: int, schema: T.Schema,
-                     pf=None) -> ColumnarBatch:
+                     pf=None, meta=None, pq_schema=None) -> ColumnarBatch:
     """Decode one row group of a parquet file into a device batch.
-    Pass an open ``pyarrow.parquet.ParquetFile`` to amortize the footer
-    parse across a file's row groups."""
+    Pass either an open ``pyarrow.parquet.ParquetFile`` or its parsed
+    ``(meta, pq_schema)`` to amortize the footer parse across a file's
+    row groups (metadata objects hold no file descriptor)."""
     import pyarrow.parquet as pq
-    if pf is None:
-        pf = pq.ParquetFile(path)
-    md = pf.metadata.row_group(row_group)
+    if meta is None:
+        if pf is None:
+            pf = pq.ParquetFile(path)
+        meta, pq_schema = pf.metadata, pf.schema
+    md = meta.row_group(row_group)
     name_to_idx = {md.column(i).path_in_schema: i
                    for i in range(md.num_columns)}
-    pq_schema = pf.schema
     cols = []
     n_rows = md.num_rows
     capacity = bucket_capacity(max(n_rows, 1))
@@ -564,9 +566,11 @@ class TpuParquetScanExec:
     def __init__(self, files: List[str], schema: T.Schema, pf_cache=None):
         self.files = list(files)
         self._schema = schema
-        # Open ParquetFile handles carried from the planning-time gate so
-        # each footer parses ONCE (excluded from plan signatures via
-        # PLAN_SIG_SKIP_ATTRS — object identity would destabilize them).
+        # Parsed footers carried from the planning-time gate so each one
+        # parses ONCE: {path: (FileMetaData, ParquetSchema)} — metadata
+        # objects only, NOT open file handles (a thousand-file scan must
+        # not pin a thousand descriptors from plan time). Excluded from
+        # plan signatures via PLAN_SIG_SKIP_ATTRS.
         self._pf_cache = dict(pf_cache or {})
 
     @property
@@ -590,22 +594,28 @@ class TpuParquetScanExec:
         import pyarrow.parquet as pq
         units = []
         for path in self.files:
-            pf = self._pf_cache.get(path) or pq.ParquetFile(path)
-            units.extend((path, pf, rg)
-                         for rg in range(pf.metadata.num_row_groups))
+            cached = self._pf_cache.get(path)
+            if cached is None:
+                with pq.ParquetFile(path) as pf:
+                    cached = (pf.metadata, pf.schema)
+            meta, pq_schema = cached
+            units.extend((path, meta, pq_schema, rg)
+                         for rg in range(meta.num_row_groups))
 
-        def read(path, pf, rg):
+        def read(path, meta, pq_schema, rg):
             from ..utils.tracing import trace_range
             try:
                 with trace_range("parquet.device_decode"):
-                    yield decode_row_group(path, rg, self._schema, pf=pf)
+                    yield decode_row_group(path, rg, self._schema,
+                                           meta=meta, pq_schema=pq_schema)
                 ctx.metric("TpuParquetScan", "deviceDecodedRowGroups", 1)
             # ANY decode failure (unsupported shape, decompression codec
             # mismatch, corrupt/truncated page metadata) degrades to the
             # host reader for just this row group — the host result is the
             # correctness baseline, so falling back is always safe.
             except Exception:  # noqa: BLE001 - graceful per-unit fallback
-                with trace_range("parquet.host_fallback"):
+                with trace_range("parquet.host_fallback"), \
+                        pq.ParquetFile(path) as pf:
                     tbl = pf.read_row_group(
                         rg, columns=self._schema.names)
                     rb = tbl.combine_chunks().to_batches()[0] \
@@ -618,7 +628,7 @@ class TpuParquetScanExec:
                     yield ColumnarBatch.from_arrow(
                         rb.cast(T.schema_to_arrow(self._schema)))
                 ctx.metric("TpuParquetScan", "hostFallbackRowGroups", 1)
-        return [read(p, pf, rg) for p, pf, rg in units]
+        return [read(p, m, ps, rg) for p, m, ps, rg in units]
 
 
 def scan_files(paths: List[str]) -> Optional[List[str]]:

@@ -617,10 +617,13 @@ def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
     pf_cache = {}
     for f in files:
         try:
-            pf_cache[f] = pq.ParquetFile(f)
+            with pq.ParquetFile(f) as pf:
+                ok = PD.device_decodable(f, node.schema, pf=pf)
+                # Keep parsed metadata only — no open descriptors on plans.
+                pf_cache[f] = (pf.metadata, pf.schema)
         except Exception:
             return None
-        if not PD.device_decodable(f, node.schema, pf=pf_cache[f]):
+        if not ok:
             return None
     return PD.TpuParquetScanExec(files, node.schema, pf_cache)
 

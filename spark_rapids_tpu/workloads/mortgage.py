@@ -21,7 +21,6 @@ import pyarrow as pa
 
 from ..ops import aggregates as A
 from ..ops import predicates as P
-from ..ops.arithmetic import Multiply, Subtract
 from ..ops.conditional import CaseWhen, If
 from ..ops.expression import col, lit
 from .. import types as T
@@ -104,8 +103,7 @@ def etl(t):
               .with_column("score_band", band)
               .with_column("risk_upb",
                            If(P.GreaterThan(col("months_serious"), lit(0)),
-                              Multiply(col("orig_upb").cast(T.DOUBLE),
-                                       lit(1.0)), lit(0.0))))
+                              col("orig_upb").cast(T.DOUBLE), lit(0.0))))
     return (joined.group_by(col("seller"), col("score_band"))
             .agg(A.AggregateExpression(A.Count(), "n_loans"),
                  A.AggregateExpression(A.Sum(col("months_delinq")),

@@ -61,18 +61,37 @@ class TestMeshCapability:
               .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s")))
         assert M.mesh_capable(mesh.plan(df._plan), mesh.conf)
 
-    def test_string_plan_falls_back(self):
+    def test_string_group_key_is_mesh_capable(self):
+        # Dict-encoded strings shard their code lanes with a replicated
+        # dictionary, so string group keys run the SPMD path.
+        _, mesh = _sessions()
+        rb = pa.RecordBatch.from_pydict(
+            {"k": pa.array(["a", "b", None, "a"]),
+             "v": pa.array([1, 2, 3, 4])})
+        df = (mesh.create_dataframe(rb).cache()
+              .group_by(col("k"))
+              .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s")))
+        assert M.mesh_capable(mesh.plan(df._plan), mesh.conf)
+        _assert_match(lambda s: (
+            s.create_dataframe(rb).cache().group_by(col("k"))
+            .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s"))))
+
+    def test_computed_string_falls_back(self):
+        # String-PRODUCING expressions could yield flat per-shard payloads
+        # -> single-chip fallback (still correct).
+        from spark_rapids_tpu.ops.strings import Upper
         _, mesh = _sessions()
         rb = pa.RecordBatch.from_pydict(
             {"k": pa.array(["a", "b"]), "v": pa.array([1, 2])})
         df = (mesh.create_dataframe(rb).cache()
-              .group_by(col("k"))
+              .select(Upper(col("k")).alias("u"), col("v"))
+              .group_by(col("u"))
               .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s")))
-        plan = mesh.plan(df._plan)
-        assert not M.mesh_capable(plan, mesh.conf)
-        # ...but the query still runs (single-chip fused fallback).
+        assert not M.mesh_capable(mesh.plan(df._plan), mesh.conf)
         _assert_match(lambda s: (
-            s.create_dataframe(rb).cache().group_by(col("k"))
+            s.create_dataframe(rb).cache()
+            .select(Upper(col("k")).alias("u"), col("v"))
+            .group_by(col("u"))
             .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s"))))
 
 
@@ -170,4 +189,65 @@ class TestMeshJoin:
             return (p.join(b, on="k", how="inner")
                     .group_by(col("w"))
                     .agg(AGG.AggregateExpression(AGG.Count(), "c")))
+        _assert_match(q)
+
+
+class TestMeshStrings:
+    """Strings over the mesh: code lanes shard/exchange, dictionaries
+    replicate (see exec/mesh.py module doc)."""
+
+    def _rb(self, n=20_000, seed=11):
+        rng = np.random.default_rng(seed)
+        cats = np.array([f"cat{i:02d}" for i in range(37)])
+        return pa.RecordBatch.from_pydict({
+            "k": pa.array([c if i % 13 else None for i, c in
+                           enumerate(cats[rng.integers(0, 37, n)])]),
+            "v": rng.integers(-50, 50, n).astype(np.int64),
+        })
+
+    def test_string_groupby_large(self):
+        rb = self._rb()
+        _assert_match(lambda s: (
+            s.create_dataframe(rb).cache()
+            .group_by(col("k"))
+            .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s"),
+                 AGG.AggregateExpression(AGG.Count(), "c"),
+                 AGG.AggregateExpression(AGG.Min(col("v")), "mn"))))
+
+    def test_string_join_key_and_payload(self):
+        rng = np.random.default_rng(12)
+        n, m = 8_000, 23
+        names = np.array([f"n{i}" for i in range(m)])
+        probe = pa.RecordBatch.from_pydict({
+            "name": pa.array(names[rng.integers(0, m, n)]),
+            "v": rng.integers(0, 100, n).astype(np.int64)})
+        build = pa.RecordBatch.from_pydict({
+            "name": pa.array(names[: m - 3]),
+            "label": pa.array([f"label_{i}" for i in range(m - 3)])})
+
+        def q(s):
+            p = s.create_dataframe(probe).cache()
+            b = s.create_dataframe(build).cache()
+            return (p.join(b, on="name", how="inner")
+                    .group_by(col("label"))
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "sv")))
+        _assert_match(q)
+
+    def test_q5_shape_with_string_group_key(self):
+        rng = np.random.default_rng(13)
+        n, m = 10_000, 64
+        fact = pa.RecordBatch.from_pydict({
+            "fk": rng.integers(0, m, n).astype(np.int64),
+            "amt": rng.integers(1, 1000, n).astype(np.int64)})
+        dim = pa.RecordBatch.from_pydict({
+            "fk": np.arange(m, dtype=np.int64),
+            "region": pa.array([f"R{i % 5}" for i in range(m)])})
+
+        def q(s):
+            f = s.create_dataframe(fact).cache()
+            d = s.create_dataframe(dim).cache()
+            return (f.join(d, on="fk", how="inner")
+                    .group_by(col("region"))
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("amt")),
+                                                 "revenue")))
         _assert_match(q)
