@@ -7,6 +7,13 @@ suite). The metric is the suite GEOMEAN, matching BASELINE.md's stated
 Prints exactly one JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
 
+Resilience contract (the driver parses stdout's last JSON line): this
+script ALWAYS emits a valid JSON line and exits 0. If the TPU backend is
+unreachable (probed in a short subprocess so a hanging backend init can't
+wedge this process — the reference likewise fails fast on executor init,
+Plugin.scala:130-137), the whole benchmark re-runs on the CPU XLA backend
+and the JSON carries an "error" field saying so.
+
 Methodology (TPC practice + the reference's CPU-vs-accelerator compare):
 tables load once per engine — ``df.cache()`` pins them host-side for the
 CPU oracle and HBM-resident for the TPU. Each query runs once for compile
@@ -17,14 +24,17 @@ value = geomean TPU time; vs_baseline = geomean(CPU time / TPU time),
 """
 
 import json
-import os
 import math
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+PROBE_TIMEOUT_S = 240
 
 
 def timed(fn, reps=3):
+    import numpy as np
     times = []
     for _ in range(reps):
         t0 = time.perf_counter()
@@ -33,11 +43,32 @@ def timed(fn, reps=3):
     return float(np.median(times))
 
 
-def main():
+def probe_backend() -> str:
+    """Check in a throwaway subprocess whether the default JAX backend
+    initializes and runs one op. Returns '' on success, else a reason."""
+    code = ("import jax, jax.numpy as jnp;"
+            "print(jax.devices());"
+            "print(int(jnp.arange(8).sum()))")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, text=True,
+                              timeout=PROBE_TIMEOUT_S,
+                              cwd=os.path.dirname(os.path.abspath(__file__)))
+    except subprocess.TimeoutExpired:
+        return f"backend probe timed out after {PROBE_TIMEOUT_S}s"
+    if proc.returncode != 0:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-1:]
+        return f"backend probe failed (rc={proc.returncode}): " \
+               f"{tail[0] if tail else 'no output'}"
+    return ""
+
+
+def run_suite():
     # NOTE: do not enable jax_compilation_cache_dir here — it deadlocks the
     # axon remote-compile helper (observed: queries hang indefinitely).
     from spark_rapids_tpu.session import TpuSession
     from spark_rapids_tpu.workloads import tpch
+    from spark_rapids_tpu.workloads.compare import tables_match
 
     n_li = 1 << 20
     tables = tpch.gen_tables(n_li, seed=42)
@@ -51,8 +82,6 @@ def main():
     cpu_t = tpch.load(cpu, tables)
     tpu_t = tpch.load(tpu, tables)
 
-    import sys
-    from spark_rapids_tpu.workloads.compare import tables_match
     ratios, tpu_times = [], []
     # Subset: every operator shape (scan/filter/project/agg, 1-4 joins,
     # semi join, disjunctive band join, conditional sums, float scoring)
@@ -78,12 +107,60 @@ def main():
 
     geo_t = math.exp(sum(math.log(t) for t in tpu_times) / len(tpu_times))
     geo_r = math.exp(sum(math.log(r) for r in ratios) / len(ratios))
-    print(json.dumps({
+    return {
         "metric": f"tpchlike_{len(tpu_times)}q_1Mrow_geomean_device_time",
         "value": round(geo_t * 1000, 2),
         "unit": "ms",
         "vs_baseline": round(geo_r, 3),
-    }))
+    }
+
+
+def main():
+    if os.environ.get("SPARK_RAPIDS_TPU_BENCH_CHILD") != "1":
+        reason = probe_backend()
+        if reason:
+            # Accelerator unreachable: rerun this script on the CPU XLA
+            # backend in a scrubbed env so a number still lands, and say so.
+            # The child gets a hard timeout too — the always-emit-JSON
+            # contract must survive a wedged child as well.
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+            env["SPARK_RAPIDS_TPU_BENCH_CHILD"] = "1"
+            stdout, stderr = "", ""
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)], env=env,
+                    capture_output=True, text=True, timeout=3000,
+                    cwd=os.path.dirname(os.path.abspath(__file__)))
+                stdout, stderr = proc.stdout or "", proc.stderr or ""
+            except subprocess.TimeoutExpired as te:
+                stdout = (te.stdout or b"").decode(errors="replace") \
+                    if isinstance(te.stdout, bytes) else (te.stdout or "")
+                stderr = f"cpu-fallback child timed out after {te.timeout}s"
+            sys.stderr.write(stderr)
+            line = None
+            for ln in stdout.strip().splitlines():
+                try:
+                    line = json.loads(ln)
+                except (json.JSONDecodeError, ValueError):
+                    continue
+            if line is None:
+                line = {"metric": "tpchlike_geomean_device_time",
+                        "value": 0.0, "unit": "ms", "vs_baseline": 0.0}
+            line["error"] = (f"tpu backend unreachable ({reason}); "
+                             "measured on cpu XLA backend instead")
+            print(json.dumps(line))
+            return
+    try:
+        result = run_suite()
+    except Exception as e:  # noqa: BLE001 — the JSON line must always land
+        import traceback
+        traceback.print_exc()
+        result = {"metric": "tpchlike_geomean_device_time", "value": 0.0,
+                  "unit": "ms", "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

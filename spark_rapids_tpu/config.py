@@ -153,6 +153,15 @@ MAX_READ_BATCH_SIZE_BYTES = conf_int(
 # Memory & admission (reference RapidsConf.scala:241-301)
 # ---------------------------------------------------------------------------
 
+SORT_EXTERNAL_THRESHOLD = conf_int(
+    "spark.rapids.sql.sort.externalThresholdBytes", 0,
+    "Accumulated input bytes above which a global sort switches to the "
+    "external merge-sort path (sorted runs through the spill store, "
+    "bounded device residency). 0 = auto: a quarter of the device spill "
+    "budget. The reference bounds sorts with RequireSingleBatch + the "
+    "spill store (GpuSortExec.scala:50); the external path removes the "
+    "single-batch ceiling.")
+
 CONCURRENT_TPU_TASKS = conf_int(
     "spark.rapids.sql.concurrentTpuTasks", 2,
     "Number of tasks that may hold the TPU concurrently "

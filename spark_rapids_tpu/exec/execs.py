@@ -432,12 +432,19 @@ class TpuGenerateExec(TpuExec):
     """Explode / posexplode over the padded-ragged array layout
     (GpuGenerateExec.scala:101 does the same with a cudf gather).
 
-    One traced kernel: flatten the ``[capacity, max_len]`` element matrix to
-    ``capacity * max_len`` output lanes, repeat parent rows by a single 1D
+    Traced kernels: flatten the ``[rows, max_len]`` element matrix to
+    ``rows * max_len`` output lanes, repeat parent rows by a single 1D
     gather (``row = lane // max_len``), then compact on the element-liveness
-    mask. Output capacity is the static ``capacity * max_len`` bucket — for
-    very wide arrays a production path would tile the input batch first
-    (the reference chunks similarly through its iterator)."""
+    mask. When ``capacity * max_len`` exceeds :attr:`TILE_LANES`, the batch
+    explodes in row tiles so no single invocation allocates more than
+    ``TILE_LANES`` lanes per output column (the reference chunks similarly
+    through its iterator); each tile yields its own output batch."""
+
+    #: Lane bound per explode invocation: a coalesced 1M-row batch with a
+    #: 64-wide array bucket would otherwise allocate 64M lanes per output
+    #: column in one program — an HBM blow-up at exactly the batch sizes
+    #: coalescing produces.
+    TILE_LANES = 1 << 22
 
     def __init__(self, child: PhysicalPlan, generator: Expression,
                  outer: bool, pos: bool, schema: T.Schema):
@@ -460,17 +467,30 @@ class TpuGenerateExec(TpuExec):
         outer, pos = self.outer, self.pos
         elem_dt = out_schema[len(out_schema) - 1].data_type
 
-        def build():
-            def generate(db: ColumnarBatch) -> ColumnarBatch:
-                arr = bound.eval_device(db)
-                cap, w = arr.data.shape
-                out_cap = cap * w
+        eval_arr = cached_kernel(
+            "generate_arr", kernel_key(bound, out_schema),
+            lambda: lambda db: bound.eval_device(db))
+
+        def make_explode(tile_rows: int):
+            """Explode rows [offset, offset+tile_rows) of the evaluated
+            array column. Row indices past the live count read clamped
+            garbage that the keep mask then drops."""
+            def explode(db: ColumnarBatch, arr,
+                        offset: jnp.ndarray) -> ColumnarBatch:
+                w = arr.data.shape[1]
+                rows_sel = offset + jnp.arange(tile_rows, dtype=jnp.int32)
+                data = arr.data[rows_sel]
+                elem_validity = arr.elem_validity[rows_sel]
+                lengths = arr.lengths[rows_sel]
+                validity = arr.validity[rows_sel]
+                out_cap = tile_rows * w
                 lane = jnp.arange(out_cap, dtype=jnp.int32)
-                flat_r = lane // w
+                local_r = lane // w
+                flat_r = offset + local_r
                 flat_j = lane % w
                 live = flat_r < db.n_rows
-                lens = arr.lengths[flat_r]
-                valid = arr.validity[flat_r]
+                lens = lengths[local_r]
+                valid = validity[local_r]
                 keep_elem = live & (flat_j < lens)
                 if outer:
                     extra = live & (flat_j == 0) & (~valid | (lens == 0))
@@ -484,23 +504,36 @@ class TpuGenerateExec(TpuExec):
                 if pos:
                     cols.append(make_column(flat_j, keep_elem, T.INT))
                 cols.append(make_column(
-                    arr.data.reshape(-1),
-                    arr.elem_validity.reshape(-1) & keep_elem, elem_dt))
+                    data.reshape(-1),
+                    elem_validity.reshape(-1) & keep_elem, elem_dt))
                 expanded = ColumnarBatch(
                     tuple(cols), jnp.asarray(out_cap, jnp.int32), out_schema)
                 return KR.compact(expanded, keep)
-            return generate
-
-        fn = cached_kernel(
-            "generate", kernel_key(bound, outer, pos, out_schema), build)
+            return explode
 
         def run(part):
             import time as _time
+            from ..data.column import bucket_capacity
             t0 = _time.perf_counter()
             for db in part:
-                out = fn(db)
-                t0 = _tick(ctx, "TpuGenerate", t0)
-                yield out
+                arr = eval_arr(db)
+                cap, w = arr.data.shape
+                tile_rows = cap if cap * w <= self.TILE_LANES else \
+                    bucket_capacity(max(self.TILE_LANES // w, 128))
+                fn = cached_kernel(
+                    "generate",
+                    kernel_key(bound, outer, pos, out_schema, tile_rows),
+                    lambda: make_explode(tile_rows))
+                # When tiling, bound the loop by live rows, not bucket
+                # capacity — a filtered batch in a large bucket would
+                # otherwise run dead kernels past n_rows. The device sync
+                # is paid only on the (large-batch) tiled path.
+                live_rows = cap if tile_rows == cap else \
+                    max(int(jax.device_get(db.n_rows)), 1)
+                for off in range(0, live_rows, tile_rows):
+                    out = fn(db, arr, jnp.asarray(off, jnp.int32))
+                    t0 = _tick(ctx, "TpuGenerate", t0)
+                    yield out
         return [run(p) for p in self.children[0].execute(ctx)]
 
 
@@ -510,10 +543,14 @@ class TpuGenerateExec(TpuExec):
 
 
 class TpuSortExec(TpuExec):
-    """Global sort requires a single batch (RequireSingleBatch, reference
-    GpuSortExec.scala:54): coalesce all partitions then one device sort."""
+    """Global sort. Small inputs coalesce to a single batch and sort once
+    (RequireSingleBatch, reference GpuSortExec.scala:54); inputs above the
+    external threshold run the bounded-memory external merge sort
+    (exec/external_sort.py): per-batch sorted runs through the spill
+    catalog, pairwise chunked merges, a stream of globally ordered chunks
+    out — the device never holds more than a few chunks."""
 
-    children_coalesce_goals = ["single"]
+    children_coalesce_goals = ["target"]
 
     def __init__(self, child: PhysicalPlan, orders: List[SortOrder]):
         self.children = [child]
@@ -537,11 +574,51 @@ class TpuSortExec(TpuExec):
         do_sort = cached_kernel("sort", kernel_key(key_exprs, asc, nf), build)
 
         def gen():
-            merged = _accumulate_spillable(self.children[0], ctx, "sort")
-            if merged is None:
+            from ..config import SORT_EXTERNAL_THRESHOLD
+            catalog = getattr(ctx, "catalog", None)
+            if ctx.in_fusion or catalog is None:
+                merged = _accumulate_spillable(self.children[0], ctx, "sort")
+                if merged is None:
+                    return
+                ctx.metric(self.node_name(), "numOutputBatches", 1)
+                yield do_sort(merged)
                 return
-            ctx.metric(self.node_name(), "numOutputBatches", 1)
-            yield do_sort(merged)
+            from ..memory import spill as SP_MOD
+            threshold = ctx.conf.get(SORT_EXTERNAL_THRESHOLD) or \
+                catalog.device_budget // 4
+            ids, total = [], 0
+            try:
+                for part in self.children[0].execute(ctx):
+                    for db in part:
+                        ids.append(catalog.register_batch(
+                            db, SP_MOD.ACTIVE_BATCHING_PRIORITY))
+                        total += db.device_size_bytes
+                if not ids:
+                    return
+                if total <= threshold:
+                    for b in ids:
+                        catalog.pin(b)
+                    merged = _coalesce_device(
+                        [catalog.acquire_batch(b) for b in ids])
+                    ctx.metric(self.node_name(), "numOutputBatches", 1)
+                    yield do_sort(merged)
+                    return
+                from .external_sort import ExternalSorter
+                sorter = ExternalSorter(self.orders, schema, catalog,
+                                        key_exprs)
+                for b in ids:
+                    sorter.add_batch(catalog.acquire_batch(b))
+                    catalog.free(b)
+                ids = []
+                n_out = 0
+                for chunk in sorter.sorted_chunks():
+                    n_out += 1
+                    yield chunk
+                ctx.metric(self.node_name(), "numOutputBatches", n_out)
+                ctx.metric(self.node_name(), "externalSort", 1)
+            finally:
+                for b in ids:
+                    catalog.free(b)
         return [gen()]
 
 
@@ -822,22 +899,30 @@ def hash_join_kernel(jt: str, lkeys: List[Expression],
     def kernel_impl(probe, build, out_cap):
         pk = [e.eval_device(probe) for e in lkeys]
         bk = [e.eval_device(build) for e in rkeys]
-        bids, pids = KJ.dense_key_ids(bk, pk, build.n_rows, probe.n_rows)
-        lo, counts, perm, sorted_ids = KJ.match_ranges(bids, pids)
+        hits = None
+        if jt != "full" and len(bk) == 1 \
+                and KJ.binsearch_joinable(bk[0]) \
+                and KJ.binsearch_joinable(pk[0]):
+            # Fact-to-dimension shape: build-side-only sort + probe binary
+            # search (full joins need the build hit mask, which this path
+            # can't produce without sorting the probe side).
+            lo, counts, build_at_rank = KJ.join_match_binsearch(
+                bk[0], pk[0], build.n_rows, probe.n_rows)
+        else:
+            lo, counts, build_at_rank, hits = KJ.join_match(
+                bk, pk, build.n_rows, probe.n_rows,
+                need_build_hits=(jt == "full"))
         live_p = probe.row_mask()
         counts = jnp.where(live_p, counts, 0)
         matched = counts > 0
-        hits = None
-        if jt == "full":
-            hits = KJ.build_hit_mask(bids, sorted_ids, pids, probe.n_rows)
         if jt in ("left_semi", "left_anti"):
             keep = matched if jt == "left_semi" else (~matched & live_p)
             return KR.compact(probe, keep), hits
         exp_counts = counts
         if jt in ("left", "full"):
             exp_counts = KJ.left_outer_counts(counts, live_p)
-        p_idx, b_idx, n_out, total = KJ.expand_matches(
-            lo, exp_counts, perm, out_cap)
+        p_idx, b_idx, n_out, total = KJ.expand_matches_binsearch(
+            lo, exp_counts, build_at_rank, out_cap)
         real = matched[p_idx]
         out_live = jnp.arange(out_cap, dtype=jnp.int32) < n_out
         pcols = [KR.gather_column(c, p_idx, out_live)

@@ -150,6 +150,22 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
         idx = len(sources) - 1
         return lambda env, flags: env[idx]
 
+    if _is_scan_source(node):
+        # File scans (and any host subtree behind an upload) are mesh
+        # sources too: the scan materializes at execution time, uploads
+        # (strings dict-encode on upload, so the dictionary is global),
+        # and shards row-wise across the chips — row groups land on chips
+        # the way the reference's resident shuffle serves arbitrary stages
+        # (RapidsShuffleInternalManager.scala:73-149). Decode happens once
+        # host-side in this single-host runtime; a multi-host deployment
+        # would decode per-host before the same sharding step.
+        for f in node.schema:
+            _require(T.device_supported(f.data_type),
+                     f"scan column type {f.data_type} over the mesh")
+        sources.append(node)
+        idx = len(sources) - 1
+        return lambda env, flags: env[idx]
+
     if isinstance(node, TpuProjectExec):
         from ..ops.expression import Alias, AttributeReference, \
             BoundReference
@@ -380,10 +396,18 @@ def clear_mesh_cache() -> None:
     _MESH_CACHE.clear()
 
 
+def _is_scan_source(node) -> bool:
+    """Upload-at-execution source nodes: a host scan behind its upload
+    transition, or the device parquet decoder."""
+    from ..io.parquet_device import TpuParquetScanExec
+    from .execs import HostToDeviceExec
+    return isinstance(node, (HostToDeviceExec, TpuParquetScanExec))
+
+
 def _collect_sources(node, out: List) -> None:
     """Source nodes in the exact order _compile visits them (a mirrored
     right join compiles its children swapped)."""
-    if isinstance(node, DeviceSourceExec):
+    if isinstance(node, DeviceSourceExec) or _is_scan_source(node):
         out.append(node)
         return
     kids = list(node.children)
@@ -466,7 +490,19 @@ def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
 
     sharded = []
     for s in cur_sources:
-        batch = _coalesce_device([b for p in s.partitions for b in p])
+        if isinstance(s, DeviceSourceExec):
+            batches = [b for p in s.partitions for b in p]
+        else:  # scan source: execute now (host decode + upload)
+            batches = [b for p in s.execute(ctx) for b in p]
+        if batches:
+            batch = _coalesce_device(batches)
+        else:
+            import pyarrow as _pa
+            rb = _pa.RecordBatch.from_arrays(
+                [_pa.array([], type=f.type)
+                 for f in T.schema_to_arrow(s.schema)],
+                schema=T.schema_to_arrow(s.schema))
+            batch = ColumnarBatch.from_arrow(rb, 128)
         sharded.append(_shard_source(batch, mesh, n_parts))
     shard_caps = tuple(sc for _, _, sc, _, _ in sharded)
     src_kinds = tuple(k for _, _, _, k, _ in sharded)

@@ -65,10 +65,8 @@ class TpuWindowExec(TpuExec):
             def window_all(batch: ColumnarBatch) -> ColumnarBatch:
                 out_cols = list(batch.columns)
                 for name, func, part, orders, frame in bound:
-                    data, valid, dtype = _eval_window(batch, func, part,
-                                                      orders, frame)
-                    out_cols.append(DeviceColumn(data=data, validity=valid,
-                                                 dtype=dtype))
+                    out_cols.append(_eval_window(batch, func, part,
+                                                 orders, frame))
                 return ColumnarBatch(tuple(out_cols), batch.n_rows,
                                      out_schema)
             return window_all
@@ -157,6 +155,35 @@ def _eval_window(batch: ColumnarBatch, func: Expression,
     # and -0.0 == 0.0, matching Spark instead of jnp.minimum's NaN poison.
     is_min = isinstance(func, AGG.Min)
     dtype = func.data_type
+    if dtype is T.STRING:
+        # Strings: rank every row's string (sorted-dictionary codes are the
+        # rank already; otherwise one char-matrix sort + inversion), then
+        # min/max the packed (rank, row) key over the frame and gather the
+        # winning row's string — layout-preserving, so dictionary columns
+        # stay dictionary columns.
+        if sv.is_dict and sv.dict_sorted:
+            rank = sv.codes.astype(jnp.int64)
+        else:
+            ops = KR.string_sort_keys(sv)
+            s = jax.lax.sort(tuple(ops) + (iota,), num_keys=len(ops),
+                             is_stable=True)
+            _, rank32 = jax.lax.sort((s[-1], iota), num_keys=1,
+                                     is_stable=True)
+            rank = rank32.astype(jnp.int64)
+        packed = rank * cap + iota.astype(jnp.int64)
+        info = jnp.iinfo(jnp.int64)
+        neutral = jnp.int64(info.max if is_min else info.min)
+        masked = jnp.where(sv.validity, packed, neutral)
+        mm = KW.range_min_max(KW.sparse_table(masked, is_min), lo, hi,
+                              is_min)
+        valid_sorted = live & (cnt > 0)
+        win_row = jnp.where(valid_sorted, (mm % cap).astype(jnp.int32), 0)
+        win_orig = jnp.zeros(cap, jnp.int32).at[perm].set(win_row)
+        valid = jnp.zeros(cap, jnp.bool_).at[perm].set(valid_sorted)
+        out = KR.gather_column(sv, win_orig)
+        return DeviceColumn(data=out.data, validity=valid, dtype=T.STRING,
+                            offsets=out.offsets, max_bytes=out.max_bytes,
+                            codes=out.codes, dict_sorted=out.dict_sorted)
     keys = KR.orderable_values(sv.data, dtype.is_floating)
     info = jnp.iinfo(jnp.int64)
     neutral = jnp.int64(info.max if is_min else info.min)
@@ -213,4 +240,5 @@ def _scatter(data_sorted, valid_sorted, perm, cap, dtype: T.DataType):
     data = jnp.zeros(cap, data_sorted.dtype).at[perm].set(data_sorted)
     valid = jnp.zeros(cap, jnp.bool_).at[perm].set(valid_sorted)
     data = jnp.where(valid, data, jnp.zeros((), data.dtype))
-    return data.astype(dtype.np_dtype), valid, dtype
+    return DeviceColumn(data=data.astype(dtype.np_dtype), validity=valid,
+                        dtype=dtype)

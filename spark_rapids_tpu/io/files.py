@@ -136,12 +136,17 @@ class CpuFileScanExec(PhysicalPlan):
     cluster), chunked by reader batch-size limits."""
 
     def __init__(self, fmt: str, paths: List[str], schema: T.Schema,
-                 options: dict, pushed_filters: List[Expression]):
+                 options: dict, pushed_filters: List[Expression],
+                 emit_file_meta: bool = False):
         self.fmt = fmt
         self.paths = paths
         self._schema = schema
         self.options = options
         self.pushed_filters = pushed_filters
+        #: emit the hidden __input_file_* metadata columns (set by the
+        #: input_file_name() rewrite, plan/input_file.py); the columns are
+        #: part of ``schema`` but synthesized per fragment, not read.
+        self.emit_file_meta = emit_file_meta
 
     @property
     def schema(self):
@@ -151,9 +156,15 @@ class CpuFileScanExec(PhysicalPlan):
         return f"CpuFileScan {self.fmt} {self.paths}"
 
     def execute(self, ctx):
+        import pyarrow as pa_mod
         dataset = _dataset(self.fmt, self.paths, self.options)
         arrow_schema = T.schema_to_arrow(self._schema)
-        names = [f.name for f in arrow_schema]
+        meta_names = ()
+        if self.emit_file_meta:
+            from ..plan.input_file import (FILE_LENGTH_COL, FILE_NAME_COL,
+                                           FILE_START_COL)
+            meta_names = (FILE_NAME_COL, FILE_START_COL, FILE_LENGTH_COL)
+        names = [f.name for f in arrow_schema if f.name not in meta_names]
         filt = None
         for f in self.pushed_filters:
             af = to_arrow_filter(f)
@@ -169,9 +180,41 @@ class CpuFileScanExec(PhysicalPlan):
             scanner = ds.Scanner.from_fragment(
                 frag, schema=dataset.schema, columns=names, filter=filt,
                 batch_size=max_rows)
+            meta_present = [f.name for f in arrow_schema
+                            if f.name in meta_names]
+            if meta_present:
+                # Whole-file fragments: the split is the file, so block
+                # start is 0 and block length the file size (the reference
+                # reports the Hadoop split, GpuInputFileBlock.scala:114).
+                path = getattr(frag, "path", "") or ""
+                try:
+                    import os
+                    size = os.path.getsize(path)
+                except OSError:
+                    size = -1
+                meta_value = {meta_names[0]: (path, pa_mod.string()),
+                              meta_names[1]: (0, pa_mod.int64()),
+                              meta_names[2]: (size, pa_mod.int64())}
+            data_schema = pa_mod.schema(
+                [f for f in arrow_schema if f.name not in meta_names])
             for rb in scanner.to_batches():
-                if rb.num_rows:
-                    yield HostBatch(rb.cast(arrow_schema))
+                if not rb.num_rows:
+                    continue
+                rb = rb.cast(data_schema)
+                if meta_present:
+                    n = rb.num_rows
+                    by_name = {f.name: c for f, c in zip(data_schema,
+                                                         rb.columns)}
+                    arrays = []
+                    for f in arrow_schema:
+                        if f.name in meta_value:
+                            v, t = meta_value[f.name]
+                            arrays.append(pa_mod.array([v] * n, t))
+                        else:
+                            arrays.append(by_name[f.name])
+                    rb = pa_mod.RecordBatch.from_arrays(
+                        arrays, schema=arrow_schema)
+                yield HostBatch(rb)
         if not fragments:
             return [iter([])]
         return [read_fragment(f) for f in fragments]

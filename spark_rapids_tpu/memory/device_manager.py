@@ -7,14 +7,18 @@ TPU-native analog manages: backend selection, the one-device invariant for
 local execution, HBM budget accounting for the spill framework, and the task
 semaphore bootstrap. Multi-chip execution goes through the mesh layer
 (:mod:`..parallel.mesh`) instead of one-process-per-device.
+
+Backend init is LAZY: constructing a session (CPU-oracle sessions included,
+``sql.enabled=false``) must never initialize the accelerator backend — the
+reference likewise only touches the GPU from the *executor* plugin, never on
+the driver (Plugin.scala:104-143). ``jax.devices()`` on a broken/unreachable
+TPU backend can hang or raise; deferring it to first device use keeps pure
+host paths (oracle runs, planning, explain) alive regardless.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Optional
-
-import jax
 
 from ..config import (CONCURRENT_TPU_TASKS, DEVICE_BACKEND,
                       DEVICE_SPILL_BUDGET, HBM_ALLOC_FRACTION,
@@ -22,34 +26,62 @@ from ..config import (CONCURRENT_TPU_TASKS, DEVICE_BACKEND,
                       TpuConf)
 from .semaphore import TpuSemaphore
 
+#: Conservative HBM guess used when the backend can't report a size (CPU
+#: backend, or device never touched). Matches the reference's stance of a
+#: fraction-of-total pool (RapidsConf.scala:257).
+_DEFAULT_HBM_BYTES = 16 << 30
+
 
 class DeviceManager:
     _instances: dict = {}
     _lock = threading.Lock()
 
     def __init__(self, conf: TpuConf):
-        backend = conf.get(DEVICE_BACKEND)
-        self.devices = (jax.devices(backend) if backend else jax.devices())
-        self.device = self.devices[0]
+        self._backend = conf.get(DEVICE_BACKEND)
+        self._frac = conf.get(HBM_ALLOC_FRACTION)
         self.debug = conf.get(MEMORY_DEBUG)
-        # HBM budget for the spill framework; jax doesn't expose exact HBM
-        # sizes for every backend, so fall back to a conservative default.
-        frac = conf.get(HBM_ALLOC_FRACTION)
-        try:
-            stats = self.device.memory_stats() or {}
-            total = stats.get("bytes_limit", 16 << 30)
-        except Exception:
-            total = 16 << 30
-        self.hbm_budget_bytes = int(total * frac)
         self.semaphore = TpuSemaphore(conf.get(CONCURRENT_TPU_TASKS))
+        self._devices = None
+        self._hbm_budget = None
+        self._init_lock = threading.Lock()
         # Spill catalog: the GpuShuffleEnv.initStorage chain
-        # (device -> host -> disk, GpuShuffleEnv.scala:52-69).
+        # (device -> host -> disk, GpuShuffleEnv.scala:52-69). The device
+        # budget resolves lazily on the first budget check — by then device
+        # buffers exist, so the backend is necessarily live.
         from .spill import BufferCatalog
         explicit = conf.get(DEVICE_SPILL_BUDGET)
         self.catalog = BufferCatalog(
-            explicit if explicit > 0 else self.hbm_budget_bytes,
+            explicit if explicit > 0 else (lambda: self.hbm_budget_bytes),
             conf.get(HOST_SPILL_STORAGE_SIZE),
             conf.get(SPILL_DIR))
+
+    @property
+    def devices(self):
+        if self._devices is None:
+            with self._init_lock:
+                if self._devices is None:
+                    import jax
+                    self._devices = (jax.devices(self._backend)
+                                     if self._backend else jax.devices())
+        return self._devices
+
+    @property
+    def device(self):
+        return self.devices[0]
+
+    @property
+    def hbm_budget_bytes(self) -> int:
+        """Fraction-of-HBM byte budget for the spill framework; jax doesn't
+        expose exact HBM sizes for every backend, so fall back to a
+        conservative default."""
+        if self._hbm_budget is None:
+            try:
+                stats = self.device.memory_stats() or {}
+                total = stats.get("bytes_limit", _DEFAULT_HBM_BYTES)
+            except Exception:
+                total = _DEFAULT_HBM_BYTES
+            self._hbm_budget = int(total * self._frac)
+        return self._hbm_budget
 
     @classmethod
     def get_or_create(cls, conf: TpuConf) -> "DeviceManager":

@@ -132,10 +132,13 @@ class BufferCatalog:
     three store objects; the chain wiring is identical —
     GpuShuffleEnv.initStorage, GpuShuffleEnv.scala:52-69)."""
 
-    def __init__(self, device_budget_bytes: int,
+    def __init__(self, device_budget_bytes,
                  host_budget_bytes: int,
                  spill_dir: Optional[str] = None):
-        self.device_budget = device_budget_bytes
+        # int, or a 0-arg callable resolved on first budget check (lets the
+        # device manager defer accelerator-backend init until device buffers
+        # actually exist — see DeviceManager).
+        self._device_budget = device_budget_bytes
         self.host_budget = host_budget_bytes
         self._entries: Dict[int, _Entry] = {}
         self._device_heap = []  # (priority, buffer_id)
@@ -149,6 +152,16 @@ class BufferCatalog:
         self._pinned: set = set()
         self.metrics = {"spilled_to_host": 0, "spilled_to_disk": 0,
                         "reloaded_from_host": 0, "reloaded_from_disk": 0}
+
+    @property
+    def device_budget(self) -> int:
+        if callable(self._device_budget):
+            self._device_budget = self._device_budget()
+        return self._device_budget
+
+    @device_budget.setter
+    def device_budget(self, value: int):
+        self._device_budget = value
 
     def _disk(self) -> SpillFile:
         if self._spill_file is None:

@@ -361,3 +361,116 @@ def parse_bool_matrix(m: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     for wd in _FALSE_WORDS:
         is_false = is_false | word_eq(wd)
     return is_true, is_true | is_false
+
+
+# ---------------------------------------------------------------------------
+# Fixed-width custom timestamp patterns (non-default formats)
+# ---------------------------------------------------------------------------
+
+#: Java pattern token -> (field width, strftime directive)
+_TS_TOKENS = [("yyyy", 4, "%Y"), ("MM", 2, "%m"), ("dd", 2, "%d"),
+              ("HH", 2, "%H"), ("mm", 2, "%M"), ("ss", 2, "%S")]
+
+
+def compile_ts_pattern(fmt: str):
+    """Compile a Java time pattern into fixed positions, or None when the
+    pattern is outside the supported fixed-width subset.
+
+    Supported: the yyyy/MM/dd/HH/mm/ss tokens (each at most once, year +
+    month + day required) joined by non-alphabetic single-char literals —
+    the same fixed-format stance the reference takes for its timestamp
+    parsing (GpuUnixTimestamp; docs/compatibility.md date gates), extended
+    from one hardcoded pattern to the whole fixed-width family. Returns
+    (fields, total_len, strftime_fmt) with fields as (token, pos, width) /
+    ('lit', pos, char).
+    """
+    fields, i, strf = [], 0, []
+    seen = set()
+    while i < len(fmt):
+        for tok, width, directive in _TS_TOKENS:
+            if fmt.startswith(tok, i):
+                if tok in seen:
+                    return None
+                seen.add(tok)
+                fields.append((tok, i, width))
+                strf.append(directive)
+                i += width
+                break
+        else:
+            ch = fmt[i]
+            if ch.isalpha():
+                return None
+            fields.append(("lit", i, ch))
+            strf.append(ch)
+            i += 1
+    if not {"yyyy", "MM", "dd"} <= seen:
+        return None
+    return fields, len(fmt), "".join(strf)
+
+
+def parse_timestamp_pattern(m: jnp.ndarray, fmt: str
+                            ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Strict fixed-width parse of ``fmt`` -> (micros int64, valid).
+
+    Every field must have exactly its width in digits, every literal must
+    match, and the (trimmed) string length must equal the pattern length.
+    Calendar validity is exact: the parsed (y, m, d) must round-trip
+    through the epoch-day conversion."""
+    from .datetime import _civil_from_days, _days_from_civil
+    spec = compile_ts_pattern(fmt)
+    assert spec is not None, f"unsupported timestamp pattern {fmt!r}"
+    fields, total, _ = spec
+    m, ln = _trimmed(m)
+    n = m.shape[0]
+    ok = ln == total
+    vals = {"yyyy": None, "MM": None, "dd": None,
+            "HH": 0, "mm": 0, "ss": 0}
+    for tok, pos, width in fields:
+        if tok == "lit":
+            ok = ok & _expect_char(m, jnp.full(n, pos, jnp.int32), width)
+            continue
+        v, nd, _ = _parse_int_run(m, jnp.full(n, pos, jnp.int32), width)
+        ok = ok & (nd == width)
+        vals[tok] = v
+    y, mo, d = vals["yyyy"], vals["MM"], vals["dd"]
+    hh, mi, ss = vals["HH"], vals["mm"], vals["ss"]
+    days = _days_from_civil(y, mo, d)
+    y2, m2, d2 = _civil_from_days(days)
+    ok = ok & (y2 == y) & (m2 == mo) & (d2 == d)
+    for v, hi in ((hh, 24), (mi, 60), (ss, 60)):
+        if not isinstance(v, int):
+            ok = ok & (v >= 0) & (v < hi)
+    def _us(v, mult):
+        return (v if isinstance(v, int) else v) * mult
+    micros = days.astype(jnp.int64) * 86_400_000_000 \
+        + _us(hh, 3_600_000_000) + _us(mi, 60_000_000) \
+        + _us(ss, 1_000_000)
+    return jnp.where(ok, micros, 0), ok
+
+
+def format_timestamp_pattern(us: jnp.ndarray, fmt: str) -> jnp.ndarray:
+    """micros -> fixed-width ``fmt`` char matrix [N, len(fmt)]."""
+    from .datetime import _civil_from_days
+    spec = compile_ts_pattern(fmt)
+    assert spec is not None, f"unsupported timestamp pattern {fmt!r}"
+    fields, total, _ = spec
+    days = jnp.floor_divide(us, 86_400_000_000)
+    rem = us - days * 86_400_000_000
+    y, mo, d = _civil_from_days(days)
+    parts = {"yyyy": y, "MM": mo, "dd": d,
+             "HH": rem // 3_600_000_000,
+             "mm": (rem // 60_000_000) % 60,
+             "ss": (rem // 1_000_000) % 60}
+
+    def dig(x, p):
+        return ((x // p) % 10 + ord("0")).astype(jnp.int16)
+
+    cols = [None] * total
+    for tok, pos, width in fields:
+        if tok == "lit":
+            cols[pos] = jnp.full(us.shape[0], ord(width), jnp.int16)
+            continue
+        v = parts[tok]
+        for k in range(width):
+            cols[pos + k] = dig(v, 10 ** (width - 1 - k))
+    return jnp.stack(cols, axis=1)

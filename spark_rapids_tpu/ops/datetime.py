@@ -298,10 +298,41 @@ class UnixTimestamp(Expression):
     def is_default_format(self) -> bool:
         return self.fmt == _DEFAULT_TS_FMT
 
+    @property
+    def is_supported_format(self) -> bool:
+        """Default pattern, or any fixed-width yyyy/MM/dd[/HH/mm/ss]
+        pattern (cast_string.compile_ts_pattern)."""
+        if self.is_default_format:
+            return True
+        from .cast_string import compile_ts_pattern
+        return compile_ts_pattern(self.fmt) is not None
+
     def eval_host(self, batch):
         from .expression import host_to_array
         src = self.children[0].data_type
         v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
+        if src is T.STRING and not self.is_default_format:
+            # Strict fixed-width custom pattern (matches the device
+            # kernel): exact length + strptime.
+            from .cast_string import compile_ts_pattern
+            _, total, strf = compile_ts_pattern(self.fmt)
+            import datetime as _dt
+            out = []
+            for s in v.to_pylist():
+                if s is None:
+                    out.append(None)
+                    continue
+                s = s.strip()
+                if len(s) != total:
+                    out.append(None)
+                    continue
+                try:
+                    dt = _dt.datetime.strptime(s, strf).replace(
+                        tzinfo=_dt.timezone.utc)
+                    out.append(int(dt.timestamp()))
+                except ValueError:
+                    out.append(None)
+            return pa.array(out, type=pa.int64())
         if src is T.TIMESTAMP:
             # Floor division (Spark floorDiv) in exact int64: Arrow's
             # integer divide truncates toward zero, wrong pre-epoch, and a
@@ -331,18 +362,23 @@ class UnixTimestamp(Expression):
         if src is T.DATE:
             return make_column(c.data.astype(jnp.int64) * 86400,
                                c.validity, T.LONG)
-        from .cast_string import parse_timestamp_matrix
+        from .cast_string import (parse_timestamp_matrix,
+                                  parse_timestamp_pattern)
         from .strings_util import char_matrix
+        if self.is_default_format:
+            parse = parse_timestamp_matrix
+        else:
+            parse = (lambda mm: parse_timestamp_pattern(mm, self.fmt))
         if c.is_dict:
             from ..data.column import DeviceColumn as _DC
             dm = char_matrix(_DC(
                 data=c.data, validity=jnp.ones(c.dict_size, jnp.bool_),
                 dtype=T.STRING, offsets=c.offsets, max_bytes=c.max_bytes))
-            us_d, ok_d = parse_timestamp_matrix(dm)
+            us_d, ok_d = parse(dm)
             safe = jnp.clip(c.codes, 0, c.dict_size - 1)
             us, ok = us_d[safe], ok_d[safe]
         else:
-            us, ok = parse_timestamp_matrix(char_matrix(c))
+            us, ok = parse(char_matrix(c))
         validity = c.validity & ok
         secs = jnp.where(validity, jnp.floor_divide(us, 1_000_000), 0)
         return make_column(secs, validity, T.LONG)
@@ -367,11 +403,36 @@ class FromUnixTime(Expression):
     def is_default_format(self) -> bool:
         return self.fmt == _DEFAULT_TS_FMT
 
+    @property
+    def is_supported_format(self) -> bool:
+        if self.is_default_format:
+            return True
+        from .cast_string import compile_ts_pattern
+        return compile_ts_pattern(self.fmt) is not None
+
     def eval_host(self, batch):
         from .expression import host_to_array
         v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
         secs = v.cast(pa.int64()).to_pylist()
         import datetime as _dt
+        if self.is_default_format:
+            strf = "%Y-%m-%d %H:%M:%S"
+        else:
+            # Generic token mapping — the host oracle formats ANY pattern
+            # made of the known tokens (the device path additionally
+            # requires year+month+day, and falls back here otherwise).
+            toks = [("yyyy", "%Y"), ("MM", "%m"), ("dd", "%d"),
+                    ("HH", "%H"), ("mm", "%M"), ("ss", "%S")]
+            strf, i = "", 0
+            while i < len(self.fmt):
+                for t, d in toks:
+                    if self.fmt.startswith(t, i):
+                        strf += d
+                        i += len(t)
+                        break
+                else:
+                    strf += self.fmt[i]
+                    i += 1
         out = []
         for s in secs:
             if s is None:
@@ -379,15 +440,21 @@ class FromUnixTime(Expression):
             else:
                 out.append(
                     _dt.datetime.fromtimestamp(s, _dt.timezone.utc)
-                    .strftime("%Y-%m-%d %H:%M:%S"))
+                    .strftime(strf))
         return pa.array(out, type=pa.string())
 
     def eval_device(self, batch):
-        from .cast_string import format_timestamp_matrix
+        from .cast_string import (format_timestamp_matrix,
+                                  format_timestamp_pattern)
         from .kernels.rowops import strings_from_matrix
         from .strings_util import PAD
         c = self.children[0].eval_device(batch)
         us = c.data.astype(jnp.int64) * 1_000_000
-        m = format_timestamp_matrix(us)
+        if self.is_default_format:
+            m = format_timestamp_matrix(us)
+            max_bytes = 32
+        else:
+            m = format_timestamp_pattern(us, self.fmt)
+            max_bytes = len(self.fmt)
         m = jnp.where(c.validity[:, None], m, PAD)
-        return strings_from_matrix(m, c.validity, 32)
+        return strings_from_matrix(m, c.validity, max_bytes)

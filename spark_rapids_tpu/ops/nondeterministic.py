@@ -177,3 +177,86 @@ def has_nondeterministic(expr) -> bool:
     if isinstance(expr, (Rand, SparkPartitionID, MonotonicallyIncreasingID)):
         return True
     return any(has_nondeterministic(c) for c in expr.children)
+
+
+# ---------------------------------------------------------------------------
+# Input file metadata (GpuInputFileBlock.scala:114 family)
+# ---------------------------------------------------------------------------
+
+
+class _InputFileExpr(Expression):
+    """input_file_name / block start / block length.
+
+    The planner rewrites these into hidden metadata columns the file scan
+    emits per fragment (plan/input_file.py) — the TPU-native equivalent of
+    the reference reading InputFileBlockHolder from the task context: a
+    per-fragment constant column dict-encodes to one entry, so the device
+    path pays one int32 lane. If one survives un-rewritten (a site the
+    rewrite doesn't cover), it evaluates to the no-file constant, exactly
+    Spark's behavior outside a file scan."""
+
+    children: list = []
+
+    def __init__(self):
+        self.children = []
+
+    def with_children(self, children):
+        return type(self)()
+
+    @property
+    def name(self):
+        return type(self).__name__
+
+    def __str__(self):
+        return f"{type(self).__name__.lower()}()"
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        return pa.array([self.NO_FILE] * batch.num_rows,
+                        type=T.schema_to_arrow(
+                            T.Schema([T.StructField("x", self.data_type,
+                                                    True)]))[0].type)
+
+
+class InputFileName(_InputFileExpr):
+    """input_file_name() — the path of the file being read, '' without a
+    file scan below (reference GpuInputFileName)."""
+
+    NO_FILE = ""
+
+    @property
+    def data_type(self):
+        return T.STRING
+
+    @property
+    def nullable(self):
+        return False
+
+
+class InputFileBlockStart(_InputFileExpr):
+    """input_file_block_start() — byte offset of the split, -1 without a
+    file scan (reference GpuInputFileBlockStart)."""
+
+    NO_FILE = -1
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False
+
+
+class InputFileBlockLength(_InputFileExpr):
+    """input_file_block_length() — byte length of the split, -1 without a
+    file scan (reference GpuInputFileBlockLength)."""
+
+    NO_FILE = -1
+
+    @property
+    def data_type(self):
+        return T.LONG
+
+    @property
+    def nullable(self):
+        return False

@@ -278,17 +278,20 @@ class In(Expression):
         non_null = [x for x in self.values if x is not None]
         has_null = len(non_null) != len(self.values)
         if c.is_string:
-            from .strings_util import PAD, char_matrix
+            from .strings_util import PAD, lift_dict
             needles = [str(x).encode("utf-8") for x in non_null]
             w = max([c.max_bytes, 1] + [len(b) for b in needles])
-            m = char_matrix(c, w)
-            found = jnp.zeros(c.capacity, dtype=jnp.bool_)
-            for b in needles:
-                chars = np.frombuffer(b, dtype=np.uint8).astype(np.int16)
-                row = np.full(w, PAD, dtype=np.int16)
-                row[: len(chars)] = chars
-                found = found | jnp.all(m == jnp.asarray(row)[None, :],
-                                        axis=1)
+
+            def match(m, _lengths):
+                found = jnp.zeros(m.shape[0], dtype=jnp.bool_)
+                for b in needles:
+                    chars = np.frombuffer(b, dtype=np.uint8).astype(np.int16)
+                    row = np.full(w, PAD, dtype=np.int16)
+                    row[: len(chars)] = chars
+                    found = found | jnp.all(m == jnp.asarray(row)[None, :],
+                                            axis=1)
+                return found
+            found = lift_dict(c, match, width=w)
             validity = c.validity & (found | (not has_null))
             return make_column(found, validity, T.BOOLEAN)
         found = jnp.zeros_like(c.validity)

@@ -173,10 +173,10 @@ class _FixMatch(Expression):
         return jnp.asarray(list(raw), dtype=jnp.int16), len(raw)
 
     def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        from .strings_util import lift_dict
         c = self.children[0].eval_device(batch)
-        m = char_matrix(c)
         needle, k = self._needle_arr()
-        data = self.match(m, lengths(c), needle, k)
+        data = lift_dict(c, lambda m, ln: self.match(m, ln, needle, k))
         return make_column(data, c.validity, T.BOOLEAN)
 
 

@@ -476,3 +476,50 @@ class StringRepeat(Expression):
                                        col.validity,
                                        bucket_capacity(w_out, 8))
         return map_string_column(c, xform)
+
+
+class StringSplit(Expression):
+    """split(str, delimiter[, limit]) — literal delimiter only, the same
+    gate the reference applies to its regex argument
+    (GpuStringSplit, stringFunctions.scala:862 requires a literal pattern
+    and treats it as a literal string when it contains no regex
+    metacharacters).
+
+    Spark limit semantics: limit > 0 caps the element count (last element
+    keeps the remainder); limit <= 0 splits fully and KEEPS trailing empty
+    strings (Spark's split uses Java split(regex, -1)).
+
+    Produces ARRAY<STRING>, which has no device layout yet — the rule tags
+    it to evaluate on the host path (overrides._string_split_tag)."""
+
+    def __init__(self, child: Expression, delimiter: str, limit: int = -1):
+        self.children = [child]
+        self.delimiter = delimiter
+        self.limit = limit
+
+    def with_children(self, children):
+        return StringSplit(children[0], self.delimiter, self.limit)
+
+    @property
+    def data_type(self):
+        return T.ArrayType(T.STRING, contains_null=False)
+
+    @property
+    def name(self):
+        return f"split({self.children[0]}, {self.delimiter!r})"
+
+    def __str__(self):
+        return self.name
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        n = batch.num_rows
+        v = host_to_array(self.children[0].eval_host(batch), n)
+        out = []
+        for s in v.to_pylist():
+            if s is None:
+                out.append(None)
+            elif self.limit > 0:
+                out.append(s.split(self.delimiter, self.limit - 1))
+            else:
+                out.append(s.split(self.delimiter))
+        return pa.array(out, type=pa.list_(pa.string()))

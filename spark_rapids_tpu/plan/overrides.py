@@ -157,10 +157,33 @@ for _cls in [ND.Rand, ND.SparkPartitionID, ND.MonotonicallyIncreasingID]:
 _expr(PRED.AtLeastNNonNulls)
 
 
+def _string_split_tag(e, conf: TpuConf) -> Optional[str]:
+    return ("ARRAY<STRING> has no device layout; split(str, delim) "
+            "evaluates on the host path (reference GpuStringSplit gates "
+            "to literal patterns, stringFunctions.scala:862)")
+
+
+_expr(STR2.StringSplit, tag=_string_split_tag)
+
+
+def _input_file_tag(e, conf: TpuConf) -> Optional[str]:
+    # Normally rewritten into hidden scan metadata columns before planning
+    # (plan/input_file.py); one surviving here sits at a site the rewrite
+    # does not cover (aggregate/join/sort expressions).
+    return ("input_file expressions are only supported in projections and "
+            "filters (rewritten to scan metadata columns)")
+
+
+for _cls in [ND.InputFileName, ND.InputFileBlockStart,
+             ND.InputFileBlockLength]:
+    _expr(_cls, tag=_input_file_tag)
+
+
 def _unix_ts_tag(e, conf: TpuConf) -> Optional[str]:
-    if not e.is_default_format:
-        return ("only the default 'yyyy-MM-dd HH:mm:ss' pattern runs on "
-                "the device (reference fixed-format stance)")
+    if not e.is_supported_format:
+        return (f"timestamp pattern {e.fmt!r} is outside the fixed-width "
+                "yyyy/MM/dd[/HH/mm/ss] family the device parses "
+                "(reference fixed-format stance)")
     return None
 
 
@@ -222,6 +245,17 @@ class ExecMeta:
         if not conf.is_operator_enabled(key, self.rule.incompat,
                                         self.rule.disabled):
             self.will_not_work(f"{key} is disabled")
+        # Every input column must be device-representable: if the child
+        # ends up host-side, its whole output schema crosses the upload
+        # boundary (areAllSupportedTypes applied to plan inputs — the
+        # reference tags on input schemas the same way,
+        # RapidsMeta.tagForGpu:186-213).
+        for child in self.node.children:
+            for f in child.schema:
+                if not T.device_supported(f.data_type):
+                    self.will_not_work(
+                        f"input column {f.name}: type {f.data_type} is "
+                        "not supported on TPU")
         for expr in self.rule.exprs_of(self.node):
             self._tag_expr(expr, conf)
         if self.rule.tag is not None:
@@ -333,10 +367,6 @@ def _window_tag(meta: ExecMeta, conf: TpuConf):
             meta.will_not_work(
                 f"window function {type(f).__name__} is not supported on TPU")
             continue
-        if isinstance(f, (AGG.Min, AGG.Max)) and f.children and \
-                f.children[0].data_type is T.STRING:
-            meta.will_not_work("string min/max over windows is not supported "
-                               "on the device yet")
         if isinstance(f, (AGG.Sum, AGG.Average)) and f.children and \
                 f.children[0].data_type.is_floating and \
                 not conf.get(VARIABLE_FLOAT_AGG):
@@ -379,6 +409,12 @@ def _join_tag(meta: ExecMeta, conf: TpuConf):
         meta.will_not_work(
             f"conditions are not supported for {node.join_type} joins "
             "(reference limits join conditions to inner joins)")
+    if type(node) is P.CpuJoinExec \
+            and not conf.get(REPLACE_SORT_MERGE_JOIN):
+        meta.will_not_work(
+            "spark.rapids.sql.replaceSortMergeJoin.enabled=false keeps "
+            "sort-merge-shaped (non-broadcast) equi joins on the CPU "
+            "(reference GpuSortMergeJoinMeta, RapidsConf.scala:384)")
 
 
 def _nlj_tag(meta: ExecMeta, conf: TpuConf):
@@ -608,6 +644,10 @@ def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
     if not isinstance(node, CpuFileScanExec) or node.fmt != "parquet":
         return None
     if node.pushed_filters:
+        return None
+    if node.emit_file_meta:
+        # input_file_name() queries synthesize metadata columns host-side;
+        # the host scan + upload path handles them.
         return None
     from ..io import parquet_device as PD
     files = PD.scan_files(node.paths)

@@ -423,17 +423,33 @@ class CpuSortExec(PhysicalPlan):
             for hb in part:
                 cols = [host_to_array(o.child.eval_host(hb), hb.num_rows)
                         for o in self.orders]
-                names = list(hb.rb.schema.names) + \
-                    [f"_s{i}" for i in range(len(cols))]
+                extra, enames = [], []
+                for i, (c, o) in enumerate(zip(cols, self.orders)):
+                    extra.append(c)
+                    enames.append(f"_s{i}")
+                    if pa.types.is_floating(c.type):
+                        # Spark: NaN is GREATEST (first in desc, last in
+                        # asc) — pyarrow always sorts NaN last, so carry a
+                        # bucket column: null placement rides it too.
+                        nan_b = 1 if o.ascending else -1
+                        null_b = -2 if o.effective_nulls_first else 2
+                        isn = pc.if_else(pc.is_nan(c), pa.scalar(nan_b,
+                                                                 pa.int8()),
+                                         pa.scalar(0, pa.int8()))
+                        bucket = pc.if_else(
+                            pc.is_null(c, nan_is_null=False),
+                            pa.scalar(null_b, pa.int8()), isn)
+                        extra.append(bucket)
+                        enames.append(f"_b{i}")
+                names = list(hb.rb.schema.names) + enames
                 batches.append(pa.RecordBatch.from_arrays(
-                    list(hb.rb.columns) + cols, names=names))
+                    list(hb.rb.columns) + extra, names=names))
         if not batches:
             return [iter([_empty_batch(self.schema)])]
         table = pa.Table.from_batches(batches)
         # pyarrow sort_by has one global null_placement; emulate per-key
-        # placement via successive stable sorts (last key first).
-        indices = None
-        n = table.num_rows
+        # placement (and per-key NaN buckets) via successive stable sorts
+        # (last key first; within a key, value first then bucket).
         current = table
         for i in reversed(range(len(self.orders))):
             o = self.orders[i]
@@ -443,6 +459,11 @@ class CpuSortExec(PhysicalPlan):
                 current, sort_keys=[(f"_s{i}", order)],
                 null_placement=placement)
             current = current.take(idx)
+            if f"_b{i}" in current.column_names:
+                idx = pc.sort_indices(
+                    current, sort_keys=[(f"_b{i}", "ascending")],
+                    null_placement="at_end")
+                current = current.take(idx)
         out_arrow = _arrow_schema(self.schema)
         arrays = [current.column(f.name).combine_chunks().cast(f.type)
                   for f in out_arrow]

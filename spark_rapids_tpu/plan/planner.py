@@ -94,7 +94,9 @@ def plan_physical(plan: L.LogicalPlan,
     if isinstance(plan, L.Scan):
         from ..io.files import CpuFileScanExec
         return CpuFileScanExec(plan.fmt, plan.paths, plan.schema,
-                               plan.options, plan.pushed_filters)
+                               plan.options, plan.pushed_filters,
+                               emit_file_meta=getattr(
+                                   plan, "emit_file_meta", False))
     if isinstance(plan, L.Project):
         return P.CpuProjectExec(plan_physical(plan.children[0], conf),
                                 plan.exprs)

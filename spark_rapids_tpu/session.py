@@ -96,7 +96,9 @@ class TpuSession:
 
     # -- execution ----------------------------------------------------------
     def plan(self, logical: L.LogicalPlan) -> P.PhysicalPlan:
+        from .plan.input_file import rewrite_input_file_exprs
         from .plan.optimizer import prune_columns
+        logical = rewrite_input_file_exprs(logical)
         cpu_plan = plan_physical(prune_columns(logical), self.conf)
         return self._overrides.apply(cpu_plan)
 

@@ -99,15 +99,45 @@ class ShuffleBufferCatalog:
             return self._disk().read(offset, length)
         return v
 
+    def _keys_for_reduce(self, shuffle_id: int, reduce_id: int,
+                         map_range: Optional[Tuple[int, int]]
+                         ) -> List[Tuple[int, int, int]]:
+        """Sorted block keys of one reduce partition; callers hold _lock.
+        The single source of block addressing — META and payload reads must
+        agree on it."""
+        return sorted(k for k in self._blocks
+                      if k[0] == shuffle_id and k[2] == reduce_id
+                      and (map_range is None
+                           or map_range[0] <= k[1] < map_range[1]))
+
     def blocks_for_reduce(self, shuffle_id: int, reduce_id: int,
                           map_range: Optional[Tuple[int, int]] = None
                           ) -> List[bytes]:
         with self._lock:
-            keys = sorted(k for k in self._blocks
-                          if k[0] == shuffle_id and k[2] == reduce_id
-                          and (map_range is None
-                               or map_range[0] <= k[1] < map_range[1]))
+            keys = self._keys_for_reduce(shuffle_id, reduce_id, map_range)
             return [self._read_block(self._blocks[k]) for k in keys]
+
+    def block_metas_for_reduce(self, shuffle_id: int, reduce_id: int,
+                               map_range: Optional[Tuple[int, int]] = None
+                               ) -> List[Tuple[int, int]]:
+        """(map_id, size_bytes) per block of the reduce partition, sorted
+        by map_id — metadata only. Serving META must not materialize
+        payloads (arena copies / disk reads); a k-block fetch then reads
+        each payload exactly once via :meth:`read_block`."""
+        with self._lock:
+            keys = self._keys_for_reduce(shuffle_id, reduce_id, map_range)
+            return [(k[1], self._blocks[k][2]
+                     if isinstance(self._blocks[k], tuple)
+                     else len(self._blocks[k])) for k in keys]
+
+    def read_block(self, shuffle_id: int, map_id: int,
+                   reduce_id: int) -> bytes:
+        """One block payload by its stable (shuffle, map, reduce) key — the
+        reference's tag scheme. Position-independent, so blocks added
+        between a client's META and FETCH can't shift addressing."""
+        with self._lock:
+            return self._read_block(
+                self._blocks[(shuffle_id, map_id, reduce_id)])
 
     def sizes_for_shuffle(self, shuffle_id: int
                           ) -> Dict[Tuple[int, int], int]:

@@ -16,8 +16,13 @@ Protocol (length-prefixed binary, little-endian):
 
 * handshake: server greets ``b"SRTPU" + version`` on accept; a client that
   sees anything else disconnects (the management-port validation role).
-* ``META  (op=1, shuffle_id, reduce_id)`` -> ``ok, n, n * u64 length``
-* ``FETCH (op=2, shuffle_id, reduce_id, block_no)`` -> ``ok, u64 len, bytes``
+* ``META  (op=1, shuffle_id, reduce_id)`` ->
+  ``ok, n, n * (u32 map_id, u64 length)`` — metadata only; the server
+  never materializes payloads to answer META.
+* ``FETCH (op=2, shuffle_id, reduce_id, map_id)`` -> ``ok, u64 len,
+  bytes`` — keyed by the stable (shuffle, map, reduce) block id (the
+  reference's tag scheme), not by position in a catalog snapshot, so
+  blocks registered between META and FETCH cannot shift addressing.
 * errors -> ``ok=1, u32 msg_len, msg`` and the connection stays usable.
 
 :class:`RetryingBlockIterator` is the task-facing
@@ -41,12 +46,12 @@ from .transport import (BlockDescriptor, BounceBufferPool, ShuffleClient,
                         Throttle, Transport)
 
 MAGIC = b"SRTPU"
-VERSION = 1
+VERSION = 2
 
 _OP_META = 1
 _OP_FETCH = 2
 
-_REQ = struct.Struct("<BIII")  # op, shuffle_id, reduce_id, block_no
+_REQ = struct.Struct("<BIII")  # op, shuffle_id, reduce_id, map_id
 
 
 class ShuffleFetchFailedError(Exception):
@@ -83,20 +88,23 @@ class _Handler(socketserver.BaseRequestHandler):
                 req = _recv_exact(self.request, _REQ.size)
             except (ConnectionError, OSError):
                 return
-            op, shuffle_id, reduce_id, block_no = _REQ.unpack(req)
+            op, shuffle_id, reduce_id, map_id = _REQ.unpack(req)
             try:
-                blocks = catalog.blocks_for_reduce(shuffle_id, reduce_id)
                 if op == _OP_META:
-                    resp = bytearray(struct.pack("<BI", 0, len(blocks)))
-                    for b in blocks:
-                        resp += struct.pack("<Q", len(b))
+                    metas = catalog.block_metas_for_reduce(shuffle_id,
+                                                           reduce_id)
+                    resp = bytearray(struct.pack("<BI", 0, len(metas)))
+                    for mid, length in metas:
+                        resp += struct.pack("<IQ", mid, length)
                     self.request.sendall(bytes(resp))
                 elif op == _OP_FETCH:
-                    if block_no >= len(blocks):
+                    try:
+                        payload = catalog.read_block(shuffle_id, map_id,
+                                                     reduce_id)
+                    except KeyError:
                         raise KeyError(
-                            f"no block {block_no} for shuffle {shuffle_id} "
-                            f"reduce {reduce_id}")
-                    payload = blocks[block_no]
+                            f"no block map {map_id} for shuffle "
+                            f"{shuffle_id} reduce {reduce_id}") from None
                     self.request.sendall(struct.pack("<BQ", 0, len(payload)))
                     self.request.sendall(payload)
                 else:
@@ -168,24 +176,37 @@ class NetTransport(Transport):
             self._check_error(status)
             (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
             out = []
-            for i in range(n):
-                (length,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
-                out.append(BlockDescriptor((shuffle_id, 0, reduce_id),
-                                           length, block_no=i))
+            for _ in range(n):
+                mid, length = struct.unpack(
+                    "<IQ", _recv_exact(self._sock, 12))
+                out.append(BlockDescriptor((shuffle_id, mid, reduce_id),
+                                           length, block_no=mid))
             return out
 
     def fetch_block_chunks(self, desc: BlockDescriptor, chunk_size: int):
-        sid, _, rid = desc.tag
+        sid, mid, rid = desc.tag
         with self._lock:
-            self._sock.sendall(_REQ.pack(_OP_FETCH, sid, rid, desc.block_no))
+            self._sock.sendall(_REQ.pack(_OP_FETCH, sid, rid, mid))
             status = _recv_exact(self._sock, 1)[0]
             self._check_error(status)
             (length,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
             remaining = length
-            while remaining > 0:
-                chunk = _recv_exact(self._sock, min(chunk_size, remaining))
-                remaining -= len(chunk)
-                yield chunk
+            try:
+                while remaining > 0:
+                    chunk = _recv_exact(self._sock,
+                                        min(chunk_size, remaining))
+                    remaining -= len(chunk)
+                    yield chunk
+            finally:
+                # A consumer abandoning the generator early must not leave
+                # payload bytes on the socket — the next request on this
+                # transport would parse them as a status byte.
+                try:
+                    while remaining > 0:
+                        remaining -= len(_recv_exact(
+                            self._sock, min(chunk_size, remaining)))
+                except (ConnectionError, OSError):
+                    self.close()
 
 
 class RetryingBlockIterator:

@@ -179,3 +179,28 @@ def test_same_key_name_string_api_still_works():
     r = {"k": [2, 3, 4], "w": [200, 300, 400]}
     assert_tpu_and_cpu_are_equal(
         lambda s: s.create_dataframe(l).join(s.create_dataframe(r), on="k"))
+
+
+def test_full_join_unmatched_builds_between_matched_runs():
+    """Regression (round 3): the fused join's build-hit mask used a reverse
+    cummax to find each run's probe count, smearing the LAST run's end over
+    earlier runs — build keys with no probe match but sorting before
+    matched keys were wrongly marked hit and dropped from the full-outer
+    tail. Shape: unmatched build keys interleaved between matched ones."""
+    import collections
+
+    from spark_rapids_tpu.session import TpuSession
+    probe = {"k": [10, 30, 50, 70], "k2": [0, 0, 0, 0],
+             "v": [1, 2, 3, 4]}
+    build = {"k": [10, 20, 30, 40, 50, 60, 70], "k2": [0] * 7,
+             "w": [100, 200, 300, 400, 500, 600, 700]}
+    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+    dev = TpuSession({"spark.rapids.sql.enabled": True})
+
+    def q(s):
+        return (s.create_dataframe(probe)
+                .join(s.create_dataframe(build), on=["k", "k2"],
+                      how="full"))
+    want = collections.Counter(map(str, q(cpu).collect().to_pylist()))
+    got = collections.Counter(map(str, q(dev).collect().to_pylist()))
+    assert got == want, (want - got, got - want)

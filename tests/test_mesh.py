@@ -251,3 +251,52 @@ class TestMeshStrings:
                     .agg(AGG.AggregateExpression(AGG.Sum(col("amt")),
                                                  "revenue")))
         _assert_match(q)
+
+
+class TestMeshFileScan:
+    """Round 3: file scans qualify as mesh sources (no .cache())."""
+
+    @pytest.fixture(scope="class")
+    def pq_dir(self, tmp_path_factory):
+        import pyarrow.parquet as pq
+        d = tmp_path_factory.mktemp("meshscan")
+        rng = np.random.default_rng(21)
+        n = 4000
+        pq.write_table(pa.table({
+            "k": rng.integers(0, 32, n),
+            "v": rng.integers(-100, 100, n),
+            "tag": np.array(["red", "green", "blue"])[
+                rng.integers(0, 3, n)],
+        }), str(d / "part0.parquet"))
+        return str(d)
+
+    def test_scan_agg_is_mesh_capable_and_correct(self, pq_dir):
+        from spark_rapids_tpu.exec import mesh as M
+        cpu, mesh = _sessions()
+
+        def q(s):
+            return (s.read.parquet(pq_dir)
+                    .where(P.GreaterThan(col("v"), lit(-90)))
+                    .group_by(col("tag"), col("k"))
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "sv"),
+                         AGG.AggregateExpression(AGG.Count(), "c")))
+        plan = mesh.plan(q(mesh)._plan)
+        assert M.mesh_capable(plan, mesh.conf)
+        _assert_match(q)
+
+    def test_scan_join_cached_build(self, pq_dir):
+        from spark_rapids_tpu.exec import mesh as M
+        cpu, mesh = _sessions()
+        dims = pa.RecordBatch.from_pydict({
+            "k": np.arange(32, dtype=np.int64),
+            "g": (np.arange(32) % 4).astype(np.int64)})
+
+        def q(s):
+            return (s.read.parquet(pq_dir)
+                    .join(s.create_dataframe(dims).cache(), on="k",
+                          how="inner")
+                    .group_by(col("g"))
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "sv")))
+        plan = mesh.plan(q(mesh)._plan)
+        assert M.mesh_capable(plan, mesh.conf)
+        _assert_match(q)

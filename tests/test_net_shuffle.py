@@ -66,12 +66,41 @@ class TestWire:
         srv, _ = served_catalog
         t = NetTransport(srv.address)
         from spark_rapids_tpu.shuffle.transport import BlockDescriptor
+        # FETCH is keyed by the stable (shuffle, map, reduce) tag; an
+        # unknown map_id is a protocol-level error reply.
         with pytest.raises(IOError):
             list(t.fetch_block_chunks(
-                BlockDescriptor((5, 0, 0), 10, block_no=99), 16))
+                BlockDescriptor((5, 99, 0), 10, block_no=99), 16))
         # connection still usable after an error reply
         assert len(t.request_metadata(5, 0)) == 3
         t.close()
+
+    def test_abandoned_fetch_does_not_desync_protocol(self, served_catalog):
+        # Abandoning the chunk generator mid-payload must drain the socket:
+        # the next request on the same transport still parses correctly.
+        srv, blocks = served_catalog
+        t = NetTransport(srv.address)
+        descs = t.request_metadata(5, 0)
+        gen = t.fetch_block_chunks(descs[0], 8)
+        next(gen)  # read one chunk, leave the rest unread
+        gen.close()
+        assert len(t.request_metadata(5, 1)) == 3
+        got = b"".join(t.fetch_block_chunks(descs[1], 16))
+        assert got == blocks[(descs[1].tag[1], 0)]
+        t.close()
+
+    def test_meta_is_metadata_only(self, served_catalog):
+        # META must not materialize payloads server-side: register a block
+        # whose payload lives on disk via a catalog with a zero host
+        # budget, then answer META without touching the spill file.
+        cat = ShuffleBufferCatalog(host_budget_bytes=0)
+        p = _payload(1)
+        cat.add_block(9, 0, 0, p)
+        metas = cat.block_metas_for_reduce(9, 0)
+        assert metas == [(0, len(p))]
+        assert cat._spill_file is not None  # block went to disk
+        assert cat.read_block(9, 0, 0) == p
+        cat.close()
 
     def test_bad_handshake_rejected(self):
         srv = socket.socket()

@@ -192,12 +192,18 @@ class TestPlanInsertion:
             AGG.AggregateExpression(AGG.Sum(col("v")), "s"))._plan)
         assert "TpuCoalesceBatches" not in plan.tree_string()
 
-    def test_sort_gets_single_batch_goal(self):
+    def test_sort_gets_target_size_goal(self):
+        # Round 3: sorts take a TargetSize goal, not RequireSingleBatch —
+        # large inputs run the external merge sort (exec/external_sort.py)
+        # instead of requiring one device-resident batch.
         s = tpu_session()
         df = s.create_dataframe({"v": [3, 1, 2]})
         plan = s.plan(df.sort("v")._plan)
         text = plan.tree_string()
-        assert "RequireSingleBatch" in text
+        assert "RequireSingleBatch" not in text
+        # a sort over an exchange/device child still coalesces to target
+        plan2 = s.plan(df.repartition(4).sort("v")._plan)
+        assert "RequireSingleBatch" not in plan2.tree_string()
 
     def test_queries_still_differential(self):
         # End-to-end: coalesce inserted + tiny target still bit-exact.
