@@ -142,9 +142,13 @@ def main():
             line = None
             for ln in stdout.strip().splitlines():
                 try:
-                    line = json.loads(ln)
+                    parsed = json.loads(ln)
                 except (json.JSONDecodeError, ValueError):
                     continue
+                # json.loads accepts bare scalars; only a dict payload can
+                # take the "error" key without breaking the exit-0 contract
+                if isinstance(parsed, dict):
+                    line = parsed
             if line is None:
                 line = {"metric": "tpchlike_geomean_device_time",
                         "value": 0.0, "unit": "ms", "vs_baseline": 0.0}

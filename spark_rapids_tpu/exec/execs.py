@@ -586,7 +586,7 @@ class TpuSortExec(TpuExec):
             from ..memory import spill as SP_MOD
             threshold = ctx.conf.get(SORT_EXTERNAL_THRESHOLD) or \
                 catalog.device_budget // 4
-            ids, total = [], 0
+            ids, total, sorter = [], 0, None
             try:
                 for part in self.children[0].execute(ctx):
                     for db in part:
@@ -619,6 +619,10 @@ class TpuSortExec(TpuExec):
             finally:
                 for b in ids:
                     catalog.free(b)
+                if sorter is not None:
+                    # An abandoned chunk stream (limit above an external
+                    # sort) must not leak the un-merged runs' registrations.
+                    sorter.release()
         return [gen()]
 
 

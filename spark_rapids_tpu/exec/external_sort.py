@@ -130,9 +130,11 @@ def _merge_step_kernel(key_exprs, asc, nf, schema, with_sentinel: bool):
             keys = [e.eval_device(merged) for e in key_exprs]
             iota = jnp.arange(total, dtype=jnp.int32)
             if sent is not None:
+                # concat_batches compacts live rows to a prefix, so the
+                # sentinel's rows start at the live-row count, not at the
+                # capacity offset.
                 n_data = a.n_rows + b.n_rows
-                is_sent = (iota >= a.capacity + b.capacity) \
-                    & (iota < a.capacity + b.capacity + sent.n_rows)
+                is_sent = (iota >= n_data) & (iota < n_data + sent.n_rows)
             operands = []
             for k, kasc, knf in zip(keys, asc, nf):
                 if k.is_string:
@@ -192,6 +194,34 @@ def _slice_kernel(schema):
                          static_argnums=(3,))
 
 
+class _TrackingCatalog:
+    """Thin catalog proxy recording which chunk ids this sorter still owns,
+    so an abandoned chunk stream (e.g. a limit closing the generator early)
+    can free every outstanding registration instead of leaking them into
+    the session-lifetime spill budget."""
+
+    def __init__(self, catalog):
+        self._c = catalog
+        self.live = set()
+
+    def register_batch(self, batch, priority):
+        bid = self._c.register_batch(batch, priority)
+        self.live.add(bid)
+        return bid
+
+    def free(self, bid):
+        self.live.discard(bid)
+        self._c.free(bid)
+
+    def acquire_batch(self, bid):
+        return self._c.acquire_batch(bid)
+
+    def release_all(self):
+        for bid in list(self.live):
+            self._c.free(bid)
+        self.live.clear()
+
+
 class ExternalSorter:
     """Streaming global sort: feed batches, then iterate sorted chunks."""
 
@@ -199,12 +229,18 @@ class ExternalSorter:
                  key_exprs=None):
         self.orders = orders
         self.schema = schema
-        self.catalog = catalog
+        self.catalog = _TrackingCatalog(catalog)
         self.key_exprs = key_exprs or [o.child.bind(schema) for o in orders]
         self.asc = [o.ascending for o in orders]
         self.nf = [o.effective_nulls_first for o in orders]
         self._runs: List[_Run] = []
         self._sort_one = self._make_sort_one()
+
+    def release(self):
+        """Free every chunk this sorter still has registered (safe to call
+        after normal completion — it is then a no-op)."""
+        self._runs = []
+        self.catalog.release_all()
 
     def _make_sort_one(self):
         key_exprs, asc, nf = self.key_exprs, self.asc, self.nf

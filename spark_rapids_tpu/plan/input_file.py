@@ -66,6 +66,11 @@ def _has_scan(plan: L.LogicalPlan) -> bool:
     return any(_has_scan(c) for c in plan.children)
 
 
+def _scan_count(plan: L.LogicalPlan) -> int:
+    n = 1 if isinstance(plan, L.Scan) else 0
+    return n + sum(_scan_count(c) for c in plan.children)
+
+
 def _substitute(e, use_cols: bool):
     cls = type(e)
     if cls in _COL_OF:
@@ -116,12 +121,47 @@ def _rewrite(plan: L.LogicalPlan) -> L.LogicalPlan:
     return new
 
 
+def _rewrite_no_file(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Replace input_file exprs with the no-file constants everywhere,
+    leaving scans untouched (multi-scan fallback)."""
+    children = [_rewrite_no_file(c) for c in plan.children]
+    if isinstance(plan, L.Project):
+        return L.Project(children[0],
+                         [_keep_name(e, _substitute(e, False))
+                          for e in plan.exprs])
+    if isinstance(plan, L.Filter):
+        return L.Filter(children[0], _substitute(plan.condition, False))
+    if children == list(plan.children):
+        return plan
+    import copy
+    new = copy.copy(plan)
+    new.children = children
+    return new
+
+
+def _keep_name(orig, sub):
+    if sub is not orig and not isinstance(sub, Alias) \
+            and getattr(orig, "name", None):
+        return Alias(sub, orig.name)
+    return sub
+
+
 def rewrite_input_file_exprs(plan: L.LogicalPlan) -> L.LogicalPlan:
     """No-op unless the plan uses the input_file family; otherwise rewrite
     and re-project to the original output schema (hidden metadata columns
     must not leak into results of projection-free plans)."""
     if not _has_any(plan):
         return plan
+    if _scan_count(plan) > 1:
+        # A join of two file scans would give BOTH sides the same hidden
+        # column names -> ambiguous resolution above the join. Spark keeps
+        # per-task file context; we only model the single-scan case, so
+        # substitute the no-file constants and stay unambiguous.
+        original_names = plan.schema.names
+        new = _rewrite_no_file(plan)
+        if new.schema.names != original_names:
+            new = L.Project(new, [col(n) for n in original_names])
+        return new
     original_names = plan.schema.names
     new = _rewrite(plan)
     if new.schema.names != original_names:

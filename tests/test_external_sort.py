@@ -66,6 +66,45 @@ class TestExternalSort:
         assert _norm(wd["f"]) == _norm(gd["f"])
         assert wd["k"] == gd["k"]
 
+    def test_multi_run_merge_differential(self):
+        # repartition(6) forces SIX input partitions -> six sorted runs, so
+        # sorted_chunks must drive the binary merge tree (_merge_two) —
+        # a single create_dataframe batch never exercises it.
+        data = _data(60_000, seed=21)
+        cpu = TpuSession({"spark.rapids.sql.enabled": False})
+        tpu = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.sort.externalThresholdBytes": 1 << 17,
+            "spark.rapids.sql.batchSizeRows": 1 << 13,
+            "spark.rapids.tpu.fusion.enabled": False})
+        wd = _q(cpu, data, ORDERS).collect().to_pydict()
+        gd = (tpu.create_dataframe(data).repartition(6)
+              .sort(*ORDERS).collect().to_pydict())
+        assert wd["k"] == gd["k"]
+        assert _norm(wd["f"]) == _norm(gd["f"])
+        assert wd["s"] == gd["s"]
+
+    def test_multi_run_merge_limit_releases_chunks(self):
+        # A limit above an external sort abandons the chunk stream early;
+        # the sorter must free every outstanding registration.
+        data = _data(40_000, seed=33)
+        tpu = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.sort.externalThresholdBytes": 1 << 17,
+            "spark.rapids.sql.batchSizeRows": 1 << 13,
+            "spark.rapids.tpu.fusion.enabled": False})
+        catalog = tpu.device_manager.catalog
+        before = len(catalog.leak_report())
+        out = (tpu.create_dataframe(data).repartition(5)
+               .sort(*ORDERS).limit(10).collect())
+        assert out.num_rows == 10
+        cpu = TpuSession({"spark.rapids.sql.enabled": False})
+        exp = _q(cpu, data, ORDERS).collect().to_pydict()
+        got = out.to_pydict()
+        assert got["k"] == exp["k"][:10]
+        assert len(catalog.leak_report()) == before, \
+            "abandoned external-sort stream leaked spill registrations"
+
     def test_ten_times_budget_spills_and_stays_bounded(self, tmp_path):
         # ~16 MB of sort input against a 1.5 MB device budget: runs must
         # spill and the device store must never exceed its budget.
