@@ -635,13 +635,56 @@ class Generate(LogicalPlan):
 
 
 class GroupedData:
-    def __init__(self, df: "DataFrame", keys: List[Expression]):
+    """Grouping handle; with ``sets`` it models GROUPING SETS (rollup /
+    cube), realized as Expand + Aggregate exactly like the reference
+    (GpuExpandExec.scala:66 — one projection per grouping set with nulls
+    for the absent keys plus a grouping-id discriminator column)."""
+
+    def __init__(self, df: "DataFrame", keys: List[Expression],
+                 sets: Optional[List[Tuple[int, ...]]] = None,
+                 gid_name: Optional[str] = None):
         self._df = df
         self._keys = keys
+        self._sets = sets
+        self._gid_name = gid_name
 
     def agg(self, *aggs: AGG.AggregateExpression) -> "DataFrame":
-        plan = Aggregate(self._df._plan, self._keys, list(aggs))
-        return DataFrame(plan, self._df._session)
+        if self._sets is None:
+            plan = Aggregate(self._df._plan, self._keys, list(aggs))
+            return DataFrame(plan, self._df._session)
+        return self._agg_grouping_sets(list(aggs))
+
+    def _agg_grouping_sets(self, aggs) -> "DataFrame":
+        child = self._df._plan
+        keys = [resolve(_as_expr(k), child.schema) for k in self._keys]
+        key_names = [k.name for k in keys]
+        passthrough = [n for n in child.schema.names if n not in key_names]
+        gid_name = self._gid_name or "__grouping_id"
+        n = len(keys)
+        projections, names = [], key_names + passthrough + [gid_name]
+        for s in self._sets:
+            member = set(s)
+            proj = []
+            for i, k in enumerate(keys):
+                proj.append(k if i in member
+                            else Literal(None, k.data_type))
+            proj += [AttributeReference(c, child.schema[c].data_type,
+                                        child.schema[c].nullable)
+                     for c in passthrough]
+            # Spark's grouping id: bit i set when key i is ABSENT from the
+            # grouping set (most-significant = first key).
+            gid = sum((0 if i in member else 1) << (n - 1 - i)
+                      for i in range(n))
+            proj.append(Literal(gid, T.INT))
+            projections.append(proj)
+        expanded = Expand(child, projections, names)
+        plan = Aggregate(expanded,
+                         [col(nm) for nm in key_names + [gid_name]], aggs)
+        out = DataFrame(plan, self._df._session)
+        if self._gid_name is None:
+            keep = [nm for nm in plan.schema.names if nm != gid_name]
+            out = out.select(*[col(nm) for nm in keep])
+        return out
 
     def count(self) -> "DataFrame":
         return self.agg(AGG.AggregateExpression(AGG.Count(), "count"))
@@ -704,6 +747,32 @@ class DataFrame:
         return GroupedData(self, [_as_expr(k) for k in keys])
 
     groupBy = group_by
+
+    def rollup(self, *keys, grouping_id: Optional[str] = None
+               ) -> GroupedData:
+        """GROUP BY ROLLUP: grouping sets = every key prefix down to the
+        grand total. Realized as Expand + Aggregate (GpuExpandExec role)."""
+        ks = [_as_expr(k) for k in keys]
+        sets = [tuple(range(i)) for i in range(len(ks), -1, -1)]
+        return GroupedData(self, ks, sets=sets, gid_name=grouping_id)
+
+    def cube(self, *keys, grouping_id: Optional[str] = None) -> GroupedData:
+        """GROUP BY CUBE: grouping sets = every key subset."""
+        ks = [_as_expr(k) for k in keys]
+        n = len(ks)
+        sets = [tuple(i for i in range(n) if mask & (1 << i))
+                for mask in range((1 << n) - 1, -1, -1)]
+        return GroupedData(self, ks, sets=sets, gid_name=grouping_id)
+
+    def grouping_sets(self, sets: List[List[str]], *keys,
+                      grouping_id: Optional[str] = None) -> GroupedData:
+        """Explicit GROUPING SETS over named keys; each set lists the key
+        names present in that set."""
+        ks = [_as_expr(k) for k in keys]
+        names = [resolve(k, self._plan.schema).name for k in ks]
+        idx = {nm: i for i, nm in enumerate(names)}
+        resolved = [tuple(sorted(idx[nm] for nm in s)) for s in sets]
+        return GroupedData(self, ks, sets=resolved, gid_name=grouping_id)
 
     def join(self, other: "DataFrame", on=None,
              how: str = "inner") -> "DataFrame":

@@ -47,9 +47,21 @@ def pytest_configure(config):
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
+    # The persistent compilation cache in this environment holds XLA:CPU
+    # AOT executables compiled (by the remote-compile helper) for machine
+    # features this host lacks (+avx512*, +prefer-no-gather); loading them
+    # segfaults inside compilation_cache.get_executable_and_time. Scrub it
+    # for the CPU tier entirely.
+    os.environ["JAX_ENABLE_COMPILATION_CACHE"] = "false"
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:
+        pass
 
 
 #: Test modules that need the 8-device virtual mesh (single real chip

@@ -1,0 +1,72 @@
+"""Per-query perf breakdown on the CPU XLA backend — where does the time go?
+
+Reports, for each query: oracle (pyarrow) time, device time, and the device
+time split into plan/trace (host Python), device compute (dispatch ->
+block_until_ready), and result download; plus kernel-cache and fused-cache
+stats so compile counts are visible.
+
+Run:  JAX_PLATFORMS=cpu python tools/profile_bench.py [q1 q6 q5 ...]
+"""
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_ENABLE_COMPILATION_CACHE"] = "false"
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import numpy as np
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.utils import kernel_cache as KC
+    from spark_rapids_tpu.workloads import tpch
+
+    names = sys.argv[1:] or ["q1", "q6", "q3", "q5"]
+    n_li = 1 << 20
+    tables = tpch.gen_tables(n_li, seed=42)
+    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+    tpu = TpuSession({"spark.rapids.sql.enabled": True,
+                      "spark.rapids.sql.variableFloatAgg.enabled": True})
+    cpu_t = tpch.load(cpu, tables)
+    tpu_t = tpch.load(tpu, tables)
+
+    def timed(fn, reps=5):
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) * 1e3
+
+    for name in names:
+        q = tpch.QUERIES[name]
+        q(cpu_t).collect()
+        q(tpu_t).collect()  # warmup/compile
+        stats0 = KC.cache_stats()
+        cpu_ms = timed(lambda: q(cpu_t).collect())
+        tpu_ms = timed(lambda: q(tpu_t).collect())
+        stats1 = KC.cache_stats()
+        print(f"{name}: cpu={cpu_ms:.1f}ms tpu={tpu_ms:.1f}ms "
+              f"ratio={cpu_ms / tpu_ms:.2f} "
+              f"kernel_lookups/run~{(stats1['hits'] - stats0['hits']) / 5:.0f}"
+              )
+
+    # cProfile one device run of the slowest query for host-side hotspots
+    import cProfile
+    import pstats
+    name = names[-1]
+    q = tpch.QUERIES[name]
+    pr = cProfile.Profile()
+    pr.enable()
+    for _ in range(3):
+        q(tpu_t).collect()
+    pr.disable()
+    st = pstats.Stats(pr)
+    st.sort_stats("cumulative")
+    print(f"\n== cProfile {name} (3 device runs) ==")
+    st.print_stats(28)
+
+
+if __name__ == "__main__":
+    main()
