@@ -126,6 +126,11 @@ def main():
             env = dict(os.environ)
             env["JAX_PLATFORMS"] = "cpu"
             env.pop("PALLAS_AXON_POOL_IPS", None)
+            # The remote-compile helper can serve XLA:CPU AOT executables
+            # built for CPU features this host lacks (SIGILL risk) — the
+            # CPU fallback must compile locally.
+            env.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+            env["JAX_ENABLE_COMPILATION_CACHE"] = "false"
             env["SPARK_RAPIDS_TPU_BENCH_CHILD"] = "1"
             stdout, stderr = "", ""
             try:

@@ -30,10 +30,12 @@ import pyarrow as pa
 from ..ops import aggregates as A
 from ..ops import predicates as P
 from ..ops.arithmetic import Add, Divide, Multiply, Subtract
-from ..ops.conditional import If
+from ..ops.cast import Cast
+from ..ops.conditional import Coalesce, If
 from ..ops.expression import col, lit
+from ..ops.math import Sqrt
 from ..ops.strings import Substring
-from ..ops.windows import Window, over
+from ..ops.windows import (DenseRank, Rank, RowNumber, Window, over)
 from ..plan.logical import SortOrder
 from .. import types as T
 
@@ -85,6 +87,13 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
     n_promo = 30
     n_site = 6
     n_cp = 40
+    n_wh = 5
+    n_sm = 20
+    n_reason = 35
+    n_cc = 6
+    n_wp = 20
+    n_ib = 20
+    n_inv = max(n_ss // 4, 256)
 
     # ---- date_dim: 5 years 1998-2002, d_date_sk = day ordinal ------------
     days = np.arange(np.datetime64("1998-01-01"), np.datetime64("2003-01-01"),
@@ -116,6 +125,7 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
     cat_idx = rng.integers(0, len(_CATEGORIES), n_item)
     class_idx = rng.integers(0, len(_CLASSES), n_item)
     brand_id = rng.integers(1, 100, n_item).astype(np.int64)
+    manufact_id = rng.integers(1, 100, n_item).astype(np.int64)
     item = pa.RecordBatch.from_pydict({
         "i_item_sk": np.arange(n_item, dtype=np.int64),
         "i_item_id": np.char.add("ITEM", np.arange(n_item).astype(np.str_)),
@@ -125,15 +135,19 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
         "i_class": _CLASSES[class_idx],
         "i_category_id": cat_idx.astype(np.int64),
         "i_category": _CATEGORIES[cat_idx],
-        "i_manufact_id": rng.integers(1, 100, n_item).astype(np.int64),
+        "i_manufact_id": manufact_id,
+        "i_manufact": np.char.add("ably", manufact_id.astype(np.str_)),
         "i_manager_id": rng.integers(1, 100, n_item).astype(np.int64),
+        "i_product_name": np.char.add(
+            "prod", np.arange(n_item).astype(np.str_)),
         "i_current_price": _money(rng, 0.5, 100.0, n_item),
     }, schema=pa.schema([
         ("i_item_sk", pa.int64()), ("i_item_id", pa.string()),
         ("i_brand_id", pa.int64()), ("i_brand", pa.string()),
         ("i_class_id", pa.int64()), ("i_class", pa.string()),
         ("i_category_id", pa.int64()), ("i_category", pa.string()),
-        ("i_manufact_id", pa.int64()), ("i_manager_id", pa.int64()),
+        ("i_manufact_id", pa.int64()), ("i_manufact", pa.string()),
+        ("i_manager_id", pa.int64()), ("i_product_name", pa.string()),
         ("i_current_price", pa.float64()),
     ]))
 
@@ -144,27 +158,41 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
         "s_store_name": np.char.add("able",
                                     np.arange(n_store).astype(np.str_)),
         "s_city": _CITIES[rng.integers(0, len(_CITIES), n_store)],
+        "s_county": np.char.add(
+            _CITIES[rng.integers(0, len(_CITIES), n_store)], " County"),
         "s_state": _STATES[rng.integers(0, len(_STATES), n_store)],
         "s_zip": (rng.integers(10000, 99999, n_store)).astype(np.str_),
+        "s_company_id": rng.integers(1, 3, n_store).astype(np.int64),
+        "s_number_employees": rng.integers(200, 300,
+                                           n_store).astype(np.int64),
         "s_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], n_store),
     }, schema=pa.schema([
         ("s_store_sk", pa.int64()), ("s_store_id", pa.string()),
         ("s_store_name", pa.string()), ("s_city", pa.string()),
-        ("s_state", pa.string()), ("s_zip", pa.string()),
+        ("s_county", pa.string()), ("s_state", pa.string()),
+        ("s_zip", pa.string()), ("s_company_id", pa.int64()),
+        ("s_number_employees", pa.int64()),
         ("s_gmt_offset", pa.float64()),
     ]))
 
     ca = pa.RecordBatch.from_pydict({
         "ca_address_sk": np.arange(n_cust, dtype=np.int64),
         "ca_city": _CITIES[rng.integers(0, len(_CITIES), n_cust)],
+        "ca_county": np.char.add(
+            _CITIES[rng.integers(0, len(_CITIES), n_cust)], " County"),
         "ca_state": _STATES[rng.integers(0, len(_STATES), n_cust)],
         "ca_zip": (rng.integers(10000, 99999, n_cust)).astype(np.str_),
         "ca_country": _COUNTRIES[np.zeros(n_cust, dtype=np.int64)],
+        "ca_location_type": np.array(["condo", "single family",
+                                      "apartment"])[
+            rng.integers(0, 3, n_cust)],
         "ca_gmt_offset": rng.choice([-5.0, -6.0, -7.0, -8.0], n_cust),
     }, schema=pa.schema([
         ("ca_address_sk", pa.int64()), ("ca_city", pa.string()),
-        ("ca_state", pa.string()), ("ca_zip", pa.string()),
-        ("ca_country", pa.string()), ("ca_gmt_offset", pa.float64()),
+        ("ca_county", pa.string()), ("ca_state", pa.string()),
+        ("ca_zip", pa.string()), ("ca_country", pa.string()),
+        ("ca_location_type", pa.string()),
+        ("ca_gmt_offset", pa.float64()),
     ]))
 
     customer = pa.RecordBatch.from_pydict({
@@ -176,12 +204,24 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
         "c_current_addr_sk": rng.permutation(n_cust).astype(np.int64),
         "c_first_name": _FIRST[rng.integers(0, len(_FIRST), n_cust)],
         "c_last_name": _LAST[rng.integers(0, len(_LAST), n_cust)],
+        "c_preferred_cust_flag": np.array(["Y", "N"])[
+            rng.integers(0, 2, n_cust)],
+        "c_birth_month": rng.integers(1, 13, n_cust).astype(np.int64),
+        "c_birth_year": rng.integers(1930, 1995, n_cust).astype(np.int64),
+        "c_birth_country": np.array(["UNITED STATES", "CANADA", "MEXICO",
+                                     "PERU", "CHILE"])[
+            rng.integers(0, 5, n_cust)],
+        "c_salutation": np.array(["Mr.", "Mrs.", "Ms.", "Dr."])[
+            rng.integers(0, 4, n_cust)],
     }, schema=pa.schema([
         ("c_customer_sk", pa.int64()), ("c_customer_id", pa.string()),
         ("c_current_cdemo_sk", pa.int64()),
         ("c_current_hdemo_sk", pa.int64()),
         ("c_current_addr_sk", pa.int64()),
         ("c_first_name", pa.string()), ("c_last_name", pa.string()),
+        ("c_preferred_cust_flag", pa.string()),
+        ("c_birth_month", pa.int64()), ("c_birth_year", pa.int64()),
+        ("c_birth_country", pa.string()), ("c_salutation", pa.string()),
     ]))
 
     cd_idx = np.arange(n_cd)
@@ -201,13 +241,84 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
     hd_idx = np.arange(n_hd)
     hd = pa.RecordBatch.from_pydict({
         "hd_demo_sk": hd_idx.astype(np.int64),
+        "hd_income_band_sk": (hd_idx % n_ib).astype(np.int64),
         "hd_dep_count": (hd_idx % 10).astype(np.int64),
         "hd_vehicle_count": (hd_idx % 5).astype(np.int64),
         "hd_buy_potential":
             _BUY_POTENTIAL[hd_idx % len(_BUY_POTENTIAL)],
     }, schema=pa.schema([
-        ("hd_demo_sk", pa.int64()), ("hd_dep_count", pa.int64()),
+        ("hd_demo_sk", pa.int64()), ("hd_income_band_sk", pa.int64()),
+        ("hd_dep_count", pa.int64()),
         ("hd_vehicle_count", pa.int64()), ("hd_buy_potential", pa.string()),
+    ]))
+
+    income_band = pa.RecordBatch.from_pydict({
+        "ib_income_band_sk": np.arange(n_ib, dtype=np.int64),
+        "ib_lower_bound": (np.arange(n_ib) * 10000).astype(np.int64),
+        "ib_upper_bound": ((np.arange(n_ib) + 1) * 10000).astype(np.int64),
+    }, schema=pa.schema([
+        ("ib_income_band_sk", pa.int64()), ("ib_lower_bound", pa.int64()),
+        ("ib_upper_bound", pa.int64()),
+    ]))
+
+    warehouse = pa.RecordBatch.from_pydict({
+        "w_warehouse_sk": np.arange(n_wh, dtype=np.int64),
+        "w_warehouse_name": np.char.add(
+            "Warehouse", np.arange(n_wh).astype(np.str_)),
+        "w_warehouse_sq_ft":
+            rng.integers(50_000, 1_000_000, n_wh).astype(np.int64),
+        "w_city": _CITIES[rng.integers(0, len(_CITIES), n_wh)],
+        "w_county": np.char.add(
+            _CITIES[rng.integers(0, len(_CITIES), n_wh)], " County"),
+        "w_state": _STATES[rng.integers(0, len(_STATES), n_wh)],
+        "w_country": _COUNTRIES[np.zeros(n_wh, dtype=np.int64)],
+    }, schema=pa.schema([
+        ("w_warehouse_sk", pa.int64()), ("w_warehouse_name", pa.string()),
+        ("w_warehouse_sq_ft", pa.int64()), ("w_city", pa.string()),
+        ("w_county", pa.string()), ("w_state", pa.string()),
+        ("w_country", pa.string()),
+    ]))
+
+    ship_mode = pa.RecordBatch.from_pydict({
+        "sm_ship_mode_sk": np.arange(n_sm, dtype=np.int64),
+        "sm_type": np.array(["EXPRESS", "NEXT DAY", "OVERNIGHT", "REGULAR",
+                             "TWO DAY"])[np.arange(n_sm) % 5],
+        "sm_carrier": np.array(["UPS", "FEDEX", "AIRBORNE", "USPS",
+                                "DHL"])[np.arange(n_sm) % 5],
+        "sm_code": np.array(["AIR", "SURFACE", "SEA", "LIBRARY"])[
+            np.arange(n_sm) % 4],
+    }, schema=pa.schema([
+        ("sm_ship_mode_sk", pa.int64()), ("sm_type", pa.string()),
+        ("sm_carrier", pa.string()), ("sm_code", pa.string()),
+    ]))
+
+    reason = pa.RecordBatch.from_pydict({
+        "r_reason_sk": np.arange(n_reason, dtype=np.int64),
+        "r_reason_desc": np.char.add(
+            "reason ", np.arange(n_reason).astype(np.str_)),
+    }, schema=pa.schema([
+        ("r_reason_sk", pa.int64()), ("r_reason_desc", pa.string()),
+    ]))
+
+    call_center = pa.RecordBatch.from_pydict({
+        "cc_call_center_sk": np.arange(n_cc, dtype=np.int64),
+        "cc_call_center_id": np.char.add(
+            "CC", np.arange(n_cc).astype(np.str_)),
+        "cc_name": np.char.add("center", np.arange(n_cc).astype(np.str_)),
+        "cc_manager": _FIRST[rng.integers(0, len(_FIRST), n_cc)],
+        "cc_county": np.char.add(
+            _CITIES[rng.integers(0, len(_CITIES), n_cc)], " County"),
+    }, schema=pa.schema([
+        ("cc_call_center_sk", pa.int64()),
+        ("cc_call_center_id", pa.string()), ("cc_name", pa.string()),
+        ("cc_manager", pa.string()), ("cc_county", pa.string()),
+    ]))
+
+    web_page = pa.RecordBatch.from_pydict({
+        "wp_web_page_sk": np.arange(n_wp, dtype=np.int64),
+        "wp_char_count": rng.integers(2000, 8000, n_wp).astype(np.int64),
+    }, schema=pa.schema([
+        ("wp_web_page_sk", pa.int64()), ("wp_char_count", pa.int64()),
     ]))
 
     yn = np.array(["Y", "N"])
@@ -235,8 +346,12 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
         "web_site_sk": np.arange(n_site, dtype=np.int64),
         "web_site_id": np.char.add("SITE",
                                    np.arange(n_site).astype(np.str_)),
+        "web_name": np.char.add("site", np.arange(n_site).astype(np.str_)),
+        "web_company_name": np.array(["pri", "able", "ese", "anti", "cally",
+                                      "ation"])[np.arange(n_site) % 6],
     }, schema=pa.schema([
         ("web_site_sk", pa.int64()), ("web_site_id", pa.string()),
+        ("web_name", pa.string()), ("web_company_name", pa.string()),
     ]))
 
     catalog_page = pa.RecordBatch.from_pydict({
@@ -272,7 +387,11 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
         "ss_hdemo_sk": rng.integers(0, n_hd, n_ss).astype(np.int64),
         "ss_addr_sk": rng.integers(0, n_cust, n_ss).astype(np.int64),
         "ss_store_sk": rng.integers(0, n_store, n_ss).astype(np.int64),
-        "ss_promo_sk": rng.integers(0, n_promo, n_ss).astype(np.int64),
+        # ~5% null promo fk: null-fk channel queries (q76 shape) need real
+        # nulls; inner joins on promo simply drop them, matching dsdgen.
+        "ss_promo_sk": pa.array(
+            rng.integers(0, n_promo, n_ss).astype(np.int64),
+            mask=rng.random(n_ss) < 0.05),
         "ss_ticket_number":
             rng.integers(0, max(n_ss // 8, 8), n_ss).astype(np.int64),
         "ss_quantity": qty,
@@ -321,14 +440,20 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
         "sr_customer_sk": ss_custs[ret_idx].astype(np.int64),
         "sr_ticket_number": ss_tickets[ret_idx].astype(np.int64),
         "sr_store_sk": ss_stores[ret_idx].astype(np.int64),
+        "sr_reason_sk": rng.integers(0, n_reason, n_sr).astype(np.int64),
         "sr_return_quantity": rng.integers(1, 50, n_sr).astype(np.int64),
         "sr_return_amt": ret_amt,
+        "sr_refunded_cash":
+            np.round(ret_amt * rng.uniform(0.5, 1.0, n_sr), 2),
         "sr_net_loss": np.round(ret_amt * rng.uniform(0.3, 1.0, n_sr), 2),
     }, schema=pa.schema([
         ("sr_returned_date_sk", pa.int64()), ("sr_item_sk", pa.int64()),
         ("sr_customer_sk", pa.int64()), ("sr_ticket_number", pa.int64()),
-        ("sr_store_sk", pa.int64()), ("sr_return_quantity", pa.int64()),
-        ("sr_return_amt", pa.float64()), ("sr_net_loss", pa.float64()),
+        ("sr_store_sk", pa.int64()), ("sr_reason_sk", pa.int64()),
+        ("sr_return_quantity", pa.int64()),
+        ("sr_return_amt", pa.float64()),
+        ("sr_refunded_cash", pa.float64()),
+        ("sr_net_loss", pa.float64()),
     ]))
 
     cw, cl, cs_p, cqty, cqf = sales_money(n_cs)
@@ -350,85 +475,215 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
         sr_dates[rep_idx] + rng.integers(1, 60, n_rep), n_dates - 1)
     cs_item[:n_rep] = sr_items[rep_idx]
     cs_cust[:n_rep] = sr_custs[rep_idx]
+    cs_net_paid = np.round(c_ext - c_coupon, 2)
     catalog_sales = pa.RecordBatch.from_pydict({
         "cs_sold_date_sk": cs_date.astype(np.int64),
+        "cs_sold_time_sk": rng.integers(0, n_time, n_cs).astype(np.int64),
+        "cs_ship_date_sk":
+            np.minimum(cs_date + rng.integers(1, 120, n_cs),
+                       n_dates - 1).astype(np.int64),
         "cs_item_sk": cs_item.astype(np.int64),
         "cs_bill_customer_sk": cs_cust.astype(np.int64),
+        "cs_ship_customer_sk":
+            rng.integers(0, n_cust, n_cs).astype(np.int64),
         "cs_bill_cdemo_sk": rng.integers(0, n_cd, n_cs).astype(np.int64),
+        "cs_bill_hdemo_sk": rng.integers(0, n_hd, n_cs).astype(np.int64),
         "cs_bill_addr_sk": rng.integers(0, n_cust, n_cs).astype(np.int64),
+        # ~8% null ship-address fk (q76-family null-channel counts)
+        "cs_ship_addr_sk": pa.array(
+            rng.integers(0, n_cust, n_cs).astype(np.int64),
+            mask=rng.random(n_cs) < 0.08),
+        "cs_call_center_sk": rng.integers(0, n_cc, n_cs).astype(np.int64),
         "cs_catalog_page_sk": rng.integers(0, n_cp, n_cs).astype(np.int64),
+        "cs_ship_mode_sk": rng.integers(0, n_sm, n_cs).astype(np.int64),
+        "cs_warehouse_sk": rng.integers(0, n_wh, n_cs).astype(np.int64),
         "cs_promo_sk": rng.integers(0, n_promo, n_cs).astype(np.int64),
+        "cs_order_number":
+            rng.integers(0, max(n_cs // 4, 8), n_cs).astype(np.int64),
         "cs_quantity": cqty,
+        "cs_wholesale_cost": cw,
         "cs_list_price": cl,
         "cs_sales_price": cs_p,
+        "cs_ext_discount_amt": np.round((cl - cs_p) * cqf, 2),
         "cs_ext_sales_price": c_ext,
         "cs_ext_wholesale_cost": np.round(cw * cqf, 2),
+        "cs_ext_list_price": np.round(cl * cqf, 2),
+        "cs_ext_ship_cost": _money(rng, 0.0, 100.0, n_cs),
         "cs_coupon_amt": c_coupon,
+        "cs_net_paid": cs_net_paid,
         "cs_net_profit":
             np.round(c_ext - c_coupon - np.round(cw * cqf, 2), 2),
     }, schema=pa.schema([
-        ("cs_sold_date_sk", pa.int64()), ("cs_item_sk", pa.int64()),
+        ("cs_sold_date_sk", pa.int64()), ("cs_sold_time_sk", pa.int64()),
+        ("cs_ship_date_sk", pa.int64()), ("cs_item_sk", pa.int64()),
         ("cs_bill_customer_sk", pa.int64()),
-        ("cs_bill_cdemo_sk", pa.int64()), ("cs_bill_addr_sk", pa.int64()),
-        ("cs_catalog_page_sk", pa.int64()), ("cs_promo_sk", pa.int64()),
-        ("cs_quantity", pa.int64()), ("cs_list_price", pa.float64()),
+        ("cs_ship_customer_sk", pa.int64()),
+        ("cs_bill_cdemo_sk", pa.int64()), ("cs_bill_hdemo_sk", pa.int64()),
+        ("cs_bill_addr_sk", pa.int64()), ("cs_ship_addr_sk", pa.int64()),
+        ("cs_call_center_sk", pa.int64()),
+        ("cs_catalog_page_sk", pa.int64()),
+        ("cs_ship_mode_sk", pa.int64()), ("cs_warehouse_sk", pa.int64()),
+        ("cs_promo_sk", pa.int64()), ("cs_order_number", pa.int64()),
+        ("cs_quantity", pa.int64()), ("cs_wholesale_cost", pa.float64()),
+        ("cs_list_price", pa.float64()),
         ("cs_sales_price", pa.float64()),
+        ("cs_ext_discount_amt", pa.float64()),
         ("cs_ext_sales_price", pa.float64()),
         ("cs_ext_wholesale_cost", pa.float64()),
-        ("cs_coupon_amt", pa.float64()), ("cs_net_profit", pa.float64()),
+        ("cs_ext_list_price", pa.float64()),
+        ("cs_ext_ship_cost", pa.float64()),
+        ("cs_coupon_amt", pa.float64()), ("cs_net_paid", pa.float64()),
+        ("cs_net_profit", pa.float64()),
     ]))
 
+    # Catalog returns reference actual catalog sales rows (item + order
+    # line up so order-number joins match, as dsdgen guarantees).
+    cret_idx = rng.integers(0, n_cs, n_cr)
+    cs_dates_np = np.asarray(catalog_sales.column("cs_sold_date_sk"))
+    cs_items_np = np.asarray(catalog_sales.column("cs_item_sk"))
+    cs_orders_np = np.asarray(catalog_sales.column("cs_order_number"))
+    cs_custs_np = np.asarray(catalog_sales.column("cs_bill_customer_sk"))
     cr_amt = _money(rng, 1.0, 4000.0, n_cr)
     catalog_returns = pa.RecordBatch.from_pydict({
         "cr_returned_date_sk":
-            rng.integers(0, n_dates, n_cr).astype(np.int64),
-        "cr_item_sk": rng.integers(0, n_item, n_cr).astype(np.int64),
+            np.minimum(cs_dates_np[cret_idx] + rng.integers(1, 90, n_cr),
+                       n_dates - 1).astype(np.int64),
+        "cr_item_sk": cs_items_np[cret_idx].astype(np.int64),
+        "cr_order_number": cs_orders_np[cret_idx].astype(np.int64),
         "cr_catalog_page_sk": rng.integers(0, n_cp, n_cr).astype(np.int64),
-        "cr_returning_customer_sk":
+        "cr_returning_customer_sk": cs_custs_np[cret_idx].astype(np.int64),
+        "cr_returning_addr_sk":
             rng.integers(0, n_cust, n_cr).astype(np.int64),
+        "cr_call_center_sk": rng.integers(0, n_cc, n_cr).astype(np.int64),
+        "cr_reason_sk": rng.integers(0, n_reason, n_cr).astype(np.int64),
+        "cr_return_quantity": rng.integers(1, 50, n_cr).astype(np.int64),
         "cr_return_amount": cr_amt,
+        "cr_refunded_cash":
+            np.round(cr_amt * rng.uniform(0.5, 1.0, n_cr), 2),
         "cr_net_loss": np.round(cr_amt * rng.uniform(0.3, 1.0, n_cr), 2),
     }, schema=pa.schema([
         ("cr_returned_date_sk", pa.int64()), ("cr_item_sk", pa.int64()),
+        ("cr_order_number", pa.int64()),
         ("cr_catalog_page_sk", pa.int64()),
         ("cr_returning_customer_sk", pa.int64()),
-        ("cr_return_amount", pa.float64()), ("cr_net_loss", pa.float64()),
+        ("cr_returning_addr_sk", pa.int64()),
+        ("cr_call_center_sk", pa.int64()), ("cr_reason_sk", pa.int64()),
+        ("cr_return_quantity", pa.int64()),
+        ("cr_return_amount", pa.float64()),
+        ("cr_refunded_cash", pa.float64()),
+        ("cr_net_loss", pa.float64()),
     ]))
 
     ww, wl, ws_p, wqty, wqf = sales_money(n_ws)
     w_ext = np.round(ws_p * wqf, 2)
+    ws_date = rng.integers(0, n_dates, n_ws)
     web_sales = pa.RecordBatch.from_pydict({
-        "ws_sold_date_sk": rng.integers(0, n_dates, n_ws).astype(np.int64),
+        "ws_sold_date_sk": ws_date.astype(np.int64),
+        "ws_sold_time_sk": rng.integers(0, n_time, n_ws).astype(np.int64),
+        "ws_ship_date_sk":
+            np.minimum(ws_date + rng.integers(1, 120, n_ws),
+                       n_dates - 1).astype(np.int64),
         "ws_item_sk": rng.integers(0, n_item, n_ws).astype(np.int64),
         "ws_bill_customer_sk":
             rng.integers(0, n_cust, n_ws).astype(np.int64),
+        # ~8% null ship-customer fk (null-channel counts, q76 shape)
+        "ws_ship_customer_sk": pa.array(
+            rng.integers(0, n_cust, n_ws).astype(np.int64),
+            mask=rng.random(n_ws) < 0.08),
+        "ws_ship_addr_sk": rng.integers(0, n_cust, n_ws).astype(np.int64),
+        "ws_bill_hdemo_sk": rng.integers(0, n_hd, n_ws).astype(np.int64),
+        "ws_web_page_sk": rng.integers(0, n_wp, n_ws).astype(np.int64),
         "ws_web_site_sk": rng.integers(0, n_site, n_ws).astype(np.int64),
+        "ws_ship_mode_sk": rng.integers(0, n_sm, n_ws).astype(np.int64),
+        "ws_warehouse_sk": rng.integers(0, n_wh, n_ws).astype(np.int64),
         "ws_promo_sk": rng.integers(0, n_promo, n_ws).astype(np.int64),
+        "ws_order_number":
+            rng.integers(0, max(n_ws // 4, 8), n_ws).astype(np.int64),
         "ws_quantity": wqty,
+        "ws_wholesale_cost": ww,
+        "ws_list_price": wl,
         "ws_sales_price": ws_p,
+        "ws_ext_discount_amt": np.round((wl - ws_p) * wqf, 2),
         "ws_ext_sales_price": w_ext,
+        "ws_ext_wholesale_cost": np.round(ww * wqf, 2),
+        "ws_ext_list_price": np.round(wl * wqf, 2),
+        "ws_ext_ship_cost": _money(rng, 0.0, 100.0, n_ws),
+        "ws_net_paid": w_ext,
         "ws_net_profit": np.round(w_ext - np.round(ww * wqf, 2), 2),
     }, schema=pa.schema([
-        ("ws_sold_date_sk", pa.int64()), ("ws_item_sk", pa.int64()),
+        ("ws_sold_date_sk", pa.int64()), ("ws_sold_time_sk", pa.int64()),
+        ("ws_ship_date_sk", pa.int64()), ("ws_item_sk", pa.int64()),
         ("ws_bill_customer_sk", pa.int64()),
-        ("ws_web_site_sk", pa.int64()), ("ws_promo_sk", pa.int64()),
-        ("ws_quantity", pa.int64()), ("ws_sales_price", pa.float64()),
+        ("ws_ship_customer_sk", pa.int64()),
+        ("ws_ship_addr_sk", pa.int64()), ("ws_bill_hdemo_sk", pa.int64()),
+        ("ws_web_page_sk", pa.int64()), ("ws_web_site_sk", pa.int64()),
+        ("ws_ship_mode_sk", pa.int64()), ("ws_warehouse_sk", pa.int64()),
+        ("ws_promo_sk", pa.int64()), ("ws_order_number", pa.int64()),
+        ("ws_quantity", pa.int64()), ("ws_wholesale_cost", pa.float64()),
+        ("ws_list_price", pa.float64()), ("ws_sales_price", pa.float64()),
+        ("ws_ext_discount_amt", pa.float64()),
         ("ws_ext_sales_price", pa.float64()),
+        ("ws_ext_wholesale_cost", pa.float64()),
+        ("ws_ext_list_price", pa.float64()),
+        ("ws_ext_ship_cost", pa.float64()),
+        ("ws_net_paid", pa.float64()),
         ("ws_net_profit", pa.float64()),
     ]))
 
+    # Web returns reference actual web sales rows (order + item line up).
+    wret_idx = rng.integers(0, n_ws, n_wr)
+    ws_dates_np = np.asarray(web_sales.column("ws_sold_date_sk"))
+    ws_items_np = np.asarray(web_sales.column("ws_item_sk"))
+    ws_orders_np = np.asarray(web_sales.column("ws_order_number"))
+    ws_custs_np = np.asarray(web_sales.column("ws_bill_customer_sk"))
     wr_amt = _money(rng, 1.0, 4000.0, n_wr)
     web_returns = pa.RecordBatch.from_pydict({
         "wr_returned_date_sk":
-            rng.integers(0, n_dates, n_wr).astype(np.int64),
-        "wr_item_sk": rng.integers(0, n_item, n_wr).astype(np.int64),
+            np.minimum(ws_dates_np[wret_idx] + rng.integers(1, 90, n_wr),
+                       n_dates - 1).astype(np.int64),
+        "wr_item_sk": ws_items_np[wret_idx].astype(np.int64),
+        "wr_order_number": ws_orders_np[wret_idx].astype(np.int64),
+        "wr_returning_customer_sk": ws_custs_np[wret_idx].astype(np.int64),
+        "wr_refunded_cdemo_sk":
+            rng.integers(0, n_cd, n_wr).astype(np.int64),
+        "wr_refunded_addr_sk":
+            rng.integers(0, n_cust, n_wr).astype(np.int64),
+        "wr_returning_cdemo_sk":
+            rng.integers(0, n_cd, n_wr).astype(np.int64),
+        "wr_web_page_sk": rng.integers(0, n_wp, n_wr).astype(np.int64),
         "wr_web_site_sk": rng.integers(0, n_site, n_wr).astype(np.int64),
+        "wr_reason_sk": rng.integers(0, n_reason, n_wr).astype(np.int64),
+        "wr_return_quantity": rng.integers(1, 50, n_wr).astype(np.int64),
         "wr_return_amt": wr_amt,
+        "wr_fee": _money(rng, 0.5, 100.0, n_wr),
+        "wr_refunded_cash":
+            np.round(wr_amt * rng.uniform(0.5, 1.0, n_wr), 2),
         "wr_net_loss": np.round(wr_amt * rng.uniform(0.3, 1.0, n_wr), 2),
     }, schema=pa.schema([
         ("wr_returned_date_sk", pa.int64()), ("wr_item_sk", pa.int64()),
-        ("wr_web_site_sk", pa.int64()), ("wr_return_amt", pa.float64()),
+        ("wr_order_number", pa.int64()),
+        ("wr_returning_customer_sk", pa.int64()),
+        ("wr_refunded_cdemo_sk", pa.int64()),
+        ("wr_refunded_addr_sk", pa.int64()),
+        ("wr_returning_cdemo_sk", pa.int64()),
+        ("wr_web_page_sk", pa.int64()), ("wr_web_site_sk", pa.int64()),
+        ("wr_reason_sk", pa.int64()), ("wr_return_quantity", pa.int64()),
+        ("wr_return_amt", pa.float64()), ("wr_fee", pa.float64()),
+        ("wr_refunded_cash", pa.float64()),
         ("wr_net_loss", pa.float64()),
+    ]))
+
+    inventory = pa.RecordBatch.from_pydict({
+        "inv_date_sk": (rng.integers(0, n_dates // 7, n_inv) * 7
+                        ).astype(np.int64),
+        "inv_item_sk": rng.integers(0, n_item, n_inv).astype(np.int64),
+        "inv_warehouse_sk": rng.integers(0, n_wh, n_inv).astype(np.int64),
+        "inv_quantity_on_hand":
+            rng.integers(0, 1000, n_inv).astype(np.int64),
+    }, schema=pa.schema([
+        ("inv_date_sk", pa.int64()), ("inv_item_sk", pa.int64()),
+        ("inv_warehouse_sk", pa.int64()),
+        ("inv_quantity_on_hand", pa.int64()),
     ]))
 
     return {"date_dim": date_dim, "item": item, "store": store,
@@ -436,6 +691,10 @@ def gen_tables(store_sales_rows: int = 1 << 20, seed: int = 42) -> dict:
             "customer_demographics": cd, "household_demographics": hd,
             "promotion": promotion, "time_dim": time_dim,
             "web_site": web_site, "catalog_page": catalog_page,
+            "income_band": income_band, "warehouse": warehouse,
+            "ship_mode": ship_mode, "reason": reason,
+            "call_center": call_center, "web_page": web_page,
+            "inventory": inventory,
             "store_sales": store_sales, "store_returns": store_returns,
             "catalog_sales": catalog_sales,
             "catalog_returns": catalog_returns,
@@ -1329,8 +1588,856 @@ def q98(t):
             .limit(100))
 
 
-QUERIES = {"q3": q3, "q5": q5, "q6": q6, "q7": q7, "q13": q13, "q15": q15,
-           "q19": q19, "q25": q25, "q26": q26, "q27": q27, "q29": q29,
-           "q34": q34, "q42": q42, "q46": q46, "q48": q48, "q52": q52,
+def q1(t):
+    """Q1: customers whose store returns exceed 1.2x their store's average
+    (correlated avg subquery -> per-store aggregate join)."""
+    d = t["date_dim"].where(_eq(col("d_year"), lit(2000)))
+    ctr = (t["store_returns"]
+           .join(d, on=_eq(col("sr_returned_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .group_by(col("sr_customer_sk"), col("sr_store_sk"))
+           .agg(_sum(col("sr_return_amt"), "ctr_total"))
+           .select(col("sr_customer_sk").alias("ctr_customer_sk"),
+                   col("sr_store_sk").alias("ctr_store_sk"),
+                   col("ctr_total")))
+    avg_store = (ctr.group_by(col("ctr_store_sk"))
+                 .agg(_avg(col("ctr_total"), "store_avg"))
+                 .select(col("ctr_store_sk").alias("as_store_sk"),
+                         col("store_avg")))
+    return (ctr
+            .join(avg_store,
+                  on=_eq(col("ctr_store_sk"), col("as_store_sk")),
+                  how="inner")
+            .where(P.GreaterThan(col("ctr_total"),
+                                 Multiply(lit(1.2), col("store_avg"))))
+            .join(t["store"].where(_eq(col("s_state"), lit("TN"))),
+                  on=_eq(col("ctr_store_sk"), col("s_store_sk")),
+                  how="inner")
+            .join(t["customer"],
+                  on=_eq(col("ctr_customer_sk"), col("c_customer_sk")),
+                  how="inner")
+            .select(col("c_customer_id"))
+            .sort(SortOrder(col("c_customer_id")))
+            .limit(100))
+
+
+def q2(t):
+    """Q2: web+catalog weekly revenue by day of week, year-over-year
+    ratios via a week_seq self-join."""
+    wscs = (t["web_sales"]
+            .select(col("ws_sold_date_sk").alias("sold_date_sk"),
+                    col("ws_ext_sales_price").alias("sales_price"))
+            .union(t["catalog_sales"]
+                   .select(col("cs_sold_date_sk").alias("sold_date_sk"),
+                           col("cs_ext_sales_price").alias("sales_price"))))
+
+    def day_sum(day, name):
+        return _sum(If(_eq(col("d_day_name"), lit(day)),
+                       col("sales_price"), lit(0.0)), name)
+
+    wswscs = (wscs
+              .join(t["date_dim"],
+                    on=_eq(col("sold_date_sk"), col("d_date_sk")),
+                    how="inner")
+              .group_by(col("d_week_seq"))
+              .agg(day_sum("Sunday", "sun_sales"),
+                   day_sum("Monday", "mon_sales"),
+                   day_sum("Tuesday", "tue_sales"),
+                   day_sum("Wednesday", "wed_sales"),
+                   day_sum("Thursday", "thu_sales"),
+                   day_sum("Friday", "fri_sales"),
+                   day_sum("Saturday", "sat_sales")))
+    weeks_y1 = (t["date_dim"].where(_eq(col("d_year"), lit(1998)))
+                .select(col("d_week_seq").alias("w1")).distinct())
+    weeks_y2 = (t["date_dim"].where(_eq(col("d_year"), lit(1999)))
+                .select(col("d_week_seq").alias("w2")).distinct())
+    y = (wswscs.join(weeks_y1, on=_eq(col("d_week_seq"), col("w1")),
+                     how="inner")
+         .select(col("d_week_seq").alias("wk1"),
+                 col("sun_sales").alias("sun1"),
+                 col("mon_sales").alias("mon1"),
+                 col("tue_sales").alias("tue1"),
+                 col("wed_sales").alias("wed1"),
+                 col("thu_sales").alias("thu1"),
+                 col("fri_sales").alias("fri1"),
+                 col("sat_sales").alias("sat1")))
+    z = (wswscs.join(weeks_y2, on=_eq(col("d_week_seq"), col("w2")),
+                     how="inner")
+         .select(col("d_week_seq").alias("wk2"),
+                 col("sun_sales").alias("sun2"),
+                 col("mon_sales").alias("mon2"),
+                 col("tue_sales").alias("tue2"),
+                 col("wed_sales").alias("wed2"),
+                 col("thu_sales").alias("thu2"),
+                 col("fri_sales").alias("fri2"),
+                 col("sat_sales").alias("sat2")))
+    return (y.join(z, on=_eq(col("wk1"),
+                             Subtract(col("wk2"), lit(52))),
+                   how="inner")
+            .select(col("wk1"),
+                    Divide(col("sun1"), col("sun2")).alias("r_sun"),
+                    Divide(col("mon1"), col("mon2")).alias("r_mon"),
+                    Divide(col("tue1"), col("tue2")).alias("r_tue"),
+                    Divide(col("wed1"), col("wed2")).alias("r_wed"),
+                    Divide(col("thu1"), col("thu2")).alias("r_thu"),
+                    Divide(col("fri1"), col("fri2")).alias("r_fri"),
+                    Divide(col("sat1"), col("sat2")).alias("r_sat"))
+            .sort(SortOrder(col("wk1")))
+            .limit(100))
+
+
+def q8(t):
+    """Q8: store net profit for stores whose zip prefix has >10 preferred
+    customers (having-filtered zip aggregate -> prefix join)."""
+    zips = (t["customer_address"]
+            .join(t["customer"].where(
+                _eq(col("c_preferred_cust_flag"), lit("Y"))),
+                on=_eq(col("ca_address_sk"), col("c_current_addr_sk")),
+                how="inner")
+            .group_by(Substring(col("ca_zip"), lit(1),
+                                lit(5)).alias("zip5"))
+            .agg(_cnt("cnt"))
+            .where(P.GreaterThan(col("cnt"), lit(10)))
+            .select(Substring(col("zip5"), lit(1), lit(2)).alias("zip2"))
+            .distinct())
+    d = t["date_dim"].where(P.And(_eq(col("d_qoy"), lit(2)),
+                                  _eq(col("d_year"), lit(1998))))
+    return (t["store_sales"]
+            .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["store"], on=_eq(col("ss_store_sk"), col("s_store_sk")),
+                  how="inner")
+            .join(zips,
+                  on=_eq(Substring(col("s_zip"), lit(1), lit(2)),
+                         col("zip2")),
+                  how="left_semi")
+            .group_by(col("s_store_name"))
+            .agg(_sum(col("ss_net_profit"), "profit"))
+            .sort(SortOrder(col("s_store_name")))
+            .limit(100))
+
+
+def q9(t):
+    """Q9: five quantity-bucket conditional averages picked by bucket
+    population (scalar subqueries -> 1-row cross joins off reason)."""
+    buckets = [(1, 20, 74129), (21, 40, 122840), (41, 60, 56580),
+               (61, 80, 10097), (81, 100, 165306)]
+    legs = None
+    for i, (lo, hi, _) in enumerate(buckets, 1):
+        leg = (t["store_sales"]
+               .where(_between(col("ss_quantity"), lit(lo), lit(hi)))
+               .group_by()
+               .agg(_cnt(f"cnt{i}"),
+                    _avg(col("ss_ext_discount_amt"), f"disc{i}"),
+                    _avg(col("ss_net_paid"), f"paid{i}")))
+        legs = leg if legs is None else legs.join(leg, how="cross")
+    anchor = t["reason"].where(_eq(col("r_reason_sk"), lit(1))) \
+        .select(col("r_reason_sk"))
+    out = anchor.join(legs, how="cross")
+    proj = [If(P.GreaterThan(col(f"cnt{i}"), lit(float(th))),
+               col(f"disc{i}"), col(f"paid{i}")).alias(f"bucket{i}")
+            for i, (_, _, th) in enumerate(buckets, 1)]
+    return out.select(*proj)
+
+
+def q11(t):
+    """Q11: customers whose web yearly spend grew faster than store spend
+    (4 per-customer year totals joined, growth-ratio filter)."""
+    def year_total(sales, cust, date, price, year, name):
+        d = t["date_dim"].where(_eq(col("d_year"), lit(year)))
+        return (t[sales]
+                .join(d, on=_eq(col(date), col("d_date_sk")), how="inner")
+                .group_by(col(cust))
+                .agg(_sum(col(price), name))
+                .select(col(cust).alias(name + "_cust"), col(name)))
+
+    ss1 = year_total("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                     "ss_ext_list_price", 1998, "ss_y1")
+    ss2 = year_total("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                     "ss_ext_list_price", 1999, "ss_y2")
+    ws1 = year_total("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                     "ws_ext_list_price", 1998, "ws_y1")
+    ws2 = year_total("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                     "ws_ext_list_price", 1999, "ws_y2")
+    return (ss1
+            .join(ss2, on=_eq(col("ss_y1_cust"), col("ss_y2_cust")),
+                  how="inner")
+            .join(ws1, on=_eq(col("ss_y1_cust"), col("ws_y1_cust")),
+                  how="inner")
+            .join(ws2, on=_eq(col("ss_y1_cust"), col("ws_y2_cust")),
+                  how="inner")
+            .where(P.And(P.GreaterThan(col("ss_y1"), lit(0.0)),
+                         P.GreaterThan(col("ws_y1"), lit(0.0))))
+            .where(P.GreaterThan(Divide(col("ws_y2"), col("ws_y1")),
+                                 Divide(col("ss_y2"), col("ss_y1"))))
+            .join(t["customer"],
+                  on=_eq(col("ss_y1_cust"), col("c_customer_sk")),
+                  how="inner")
+            .select(col("c_customer_id"), col("c_first_name"),
+                    col("c_last_name"))
+            .sort(SortOrder(col("c_customer_id")))
+            .limit(100))
+
+
+def q12(t):
+    """Q12: web item revenue with class-share window over a 30-day
+    window (q98's shape on the web channel)."""
+    agg = (t["web_sales"]
+           .join(t["date_dim"].where(_between(col("d_date_sk"), lit(730),
+                                              lit(760))),
+                 on=_eq(col("ws_sold_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .join(t["item"].where(P.In(col("i_category"),
+                                      ["Sports", "Books", "Home"])),
+                 on=_eq(col("ws_item_sk"), col("i_item_sk")), how="inner")
+           .group_by(col("i_item_id"), col("i_category"), col("i_class"),
+                     col("i_current_price"))
+           .agg(_sum(col("ws_ext_sales_price"), "itemrevenue")))
+    w = Window.partition_by("i_class")
+    return (agg
+            .with_column("classrevenue", over(A.Sum(col("itemrevenue")), w))
+            .with_column("revenueratio",
+                         Divide(Multiply(col("itemrevenue"), lit(100.0)),
+                                col("classrevenue")))
+            .sort(SortOrder(col("i_category")), SortOrder(col("i_class")),
+                  SortOrder(col("i_item_id")),
+                  SortOrder(col("revenueratio")))
+            .limit(100))
+
+
+def q16(t):
+    """Q16: catalog orders shipped from 2+ warehouses with no return
+    (EXISTS -> left-semi on multi-warehouse orders, NOT EXISTS ->
+    left-anti on returns), ship-cost / profit totals + order count."""
+    base = (t["catalog_sales"]
+            .join(t["date_dim"].where(_between(col("d_date_sk"), lit(750),
+                                               lit(810))),
+                  on=_eq(col("cs_ship_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["customer_address"].where(_eq(col("ca_state"),
+                                                  lit("GA"))),
+                  on=_eq(col("cs_ship_addr_sk"), col("ca_address_sk")),
+                  how="inner")
+            .join(t["call_center"],
+                  on=_eq(col("cs_call_center_sk"),
+                         col("cc_call_center_sk")),
+                  how="inner"))
+    multi_wh = (t["catalog_sales"]
+                .select(col("cs_order_number").alias("mw_order"),
+                        col("cs_warehouse_sk").alias("mw_wh"))
+                .distinct()
+                .group_by(col("mw_order"))
+                .agg(_cnt("wh_cnt"))
+                .where(P.GreaterThanOrEqual(col("wh_cnt"), lit(2))))
+    filtered = (base
+                .join(multi_wh,
+                      on=_eq(col("cs_order_number"), col("mw_order")),
+                      how="left_semi")
+                .join(t["catalog_returns"],
+                      on=_eq(col("cs_order_number"),
+                             col("cr_order_number")),
+                      how="left_anti"))
+    totals = (filtered.group_by()
+              .agg(_sum(col("cs_ext_ship_cost"), "total_ship"),
+                   _sum(col("cs_net_profit"), "total_profit")))
+    orders = (filtered.select(col("cs_order_number")).distinct()
+              .group_by().agg(_cnt("order_count")))
+    return orders.join(totals, how="cross")
+
+
+def q17(t):
+    """Q17: quantity mean/stdev/cov across the sale -> return ->
+    catalog re-purchase chain, by item and state (stdev via the
+    sum-of-squares identity on device)."""
+    d1 = t["date_dim"].where(P.And(_eq(col("d_year"), lit(1998)),
+                                   _eq(col("d_qoy"), lit(1))))
+    d23 = t["date_dim"].where(P.And(_eq(col("d_year"), lit(1998)),
+                                    P.LessThanOrEqual(col("d_qoy"),
+                                                      lit(3))))
+    chain = (t["store_sales"]
+             .join(d1, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                   how="inner")
+             .join(t["store_returns"],
+                   on=P.And(
+                       _eq(col("ss_ticket_number"),
+                           col("sr_ticket_number")),
+                       P.And(_eq(col("ss_item_sk"), col("sr_item_sk")),
+                             _eq(col("ss_customer_sk"),
+                                 col("sr_customer_sk")))),
+                   how="inner")
+             .join(d23.select(col("d_date_sk").alias("d2_sk")),
+                   on=_eq(col("sr_returned_date_sk"), col("d2_sk")),
+                   how="inner")
+             .join(t["catalog_sales"],
+                   on=P.And(_eq(col("sr_customer_sk"),
+                                col("cs_bill_customer_sk")),
+                            _eq(col("sr_item_sk"), col("cs_item_sk"))),
+                   how="inner")
+             .join(t["item"], on=_eq(col("ss_item_sk"), col("i_item_sk")),
+                   how="inner")
+             .join(t["store"], on=_eq(col("ss_store_sk"),
+                                      col("s_store_sk")),
+                   how="inner"))
+
+    def stats(prefix, qty):
+        qd = Cast(col(qty), T.DOUBLE)
+        return [_cnt(prefix + "_count"),
+                _avg(col(qty), prefix + "_mean"),
+                _sum(Multiply(qd, qd), prefix + "_sumsq"),
+                _sum(qd, prefix + "_sum")]
+
+    agg = (chain.group_by(col("i_item_id"), col("s_state"))
+           .agg(*(stats("ss", "ss_quantity") + stats("sr",
+                                                     "sr_return_quantity")
+                  + stats("cs", "cs_quantity"))))
+
+    def stdev(prefix):
+        n = Cast(col(prefix + "_count"), T.DOUBLE)
+        mean = col(prefix + "_mean")
+        return Sqrt(Divide(
+            Subtract(col(prefix + "_sumsq"),
+                     Multiply(n, Multiply(mean, mean))),
+            Subtract(n, lit(1.0))))
+
+    return (agg
+            .select(col("i_item_id"), col("s_state"),
+                    col("ss_count"), col("ss_mean"),
+                    stdev("ss").alias("ss_stdev"),
+                    col("sr_count"), col("sr_mean"),
+                    stdev("sr").alias("sr_stdev"),
+                    col("cs_count"), col("cs_mean"),
+                    stdev("cs").alias("cs_stdev"))
+            .sort(SortOrder(col("i_item_id")), SortOrder(col("s_state")))
+            .limit(100))
+
+
+def q18(t):
+    """Q18: catalog demographics averages with ROLLUP over
+    country/state/county/item (real grouping sets through Expand)."""
+    cd1 = t["customer_demographics"].where(P.And(
+        _eq(col("cd_gender"), lit("F")),
+        _eq(col("cd_education_status"), lit("College"))))
+    c = t["customer"].where(P.In(col("c_birth_month"), [1, 3, 7, 11]))
+    d = t["date_dim"].where(_eq(col("d_year"), lit(1998)))
+    base = (t["catalog_sales"]
+            .join(cd1, on=_eq(col("cs_bill_cdemo_sk"), col("cd_demo_sk")),
+                  how="inner")
+            .join(c, on=_eq(col("cs_bill_customer_sk"),
+                            col("c_customer_sk")), how="inner")
+            .join(t["customer_address"],
+                  on=_eq(col("c_current_addr_sk"), col("ca_address_sk")),
+                  how="inner")
+            .join(d, on=_eq(col("cs_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["item"], on=_eq(col("cs_item_sk"), col("i_item_sk")),
+                  how="inner"))
+    return (base
+            .rollup("i_item_id", "ca_country", "ca_state", "ca_county")
+            .agg(_avg(col("cs_quantity"), "agg1"),
+                 _avg(col("cs_list_price"), "agg2"),
+                 _avg(col("cs_coupon_amt"), "agg3"),
+                 _avg(col("cs_sales_price"), "agg4"))
+            .sort(SortOrder(col("ca_country")), SortOrder(col("ca_state")),
+                  SortOrder(col("ca_county")), SortOrder(col("i_item_id")))
+            .limit(100))
+
+
+def q20(t):
+    """Q20: catalog item revenue with class share (q98 shape, catalog
+    channel)."""
+    agg = (t["catalog_sales"]
+           .join(t["date_dim"].where(_between(col("d_date_sk"), lit(730),
+                                              lit(760))),
+                 on=_eq(col("cs_sold_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .join(t["item"].where(P.In(col("i_category"),
+                                      ["Sports", "Books", "Home"])),
+                 on=_eq(col("cs_item_sk"), col("i_item_sk")), how="inner")
+           .group_by(col("i_item_id"), col("i_category"), col("i_class"),
+                     col("i_current_price"))
+           .agg(_sum(col("cs_ext_sales_price"), "itemrevenue")))
+    w = Window.partition_by("i_class")
+    return (agg
+            .with_column("classrevenue", over(A.Sum(col("itemrevenue")), w))
+            .with_column("revenueratio",
+                         Divide(Multiply(col("itemrevenue"), lit(100.0)),
+                                col("classrevenue")))
+            .sort(SortOrder(col("i_category")), SortOrder(col("i_class")),
+                  SortOrder(col("i_item_id")),
+                  SortOrder(col("revenueratio")))
+            .limit(100))
+
+
+def q21(t):
+    """Q21: warehouse inventory before/after a date, ratio-banded."""
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(700), lit(760)))
+    pivot_date = 730
+    base = (t["inventory"]
+            .join(t["warehouse"],
+                  on=_eq(col("inv_warehouse_sk"), col("w_warehouse_sk")),
+                  how="inner")
+            .join(t["item"].where(_between(col("i_current_price"),
+                                           lit(0.99), lit(1.49))),
+                  on=_eq(col("inv_item_sk"), col("i_item_sk")),
+                  how="inner")
+            .join(d, on=_eq(col("inv_date_sk"), col("d_date_sk")),
+                  how="inner"))
+    agg = (base.group_by(col("w_warehouse_name"), col("i_item_id"))
+           .agg(_sum(If(P.LessThan(col("d_date_sk"),
+                                   lit(pivot_date)),
+                        col("inv_quantity_on_hand"), lit(0)),
+                     "inv_before"),
+                _sum(If(P.GreaterThanOrEqual(col("d_date_sk"),
+                                             lit(pivot_date)),
+                        col("inv_quantity_on_hand"), lit(0)),
+                     "inv_after")))
+    ratio = Divide(Cast(col("inv_after"), T.DOUBLE),
+                   Cast(col("inv_before"), T.DOUBLE))
+    return (agg
+            .where(P.GreaterThan(col("inv_before"), lit(0)))
+            .where(P.And(P.GreaterThanOrEqual(ratio, lit(2.0 / 3.0)),
+                         P.LessThanOrEqual(ratio, lit(1.5))))
+            .sort(SortOrder(col("w_warehouse_name")),
+                  SortOrder(col("i_item_id")))
+            .limit(100))
+
+
+def q22(t):
+    """Q22: average inventory quantity with ROLLUP over the item
+    hierarchy (product_name/brand/class/category)."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+    return (t["inventory"]
+            .join(d, on=_eq(col("inv_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["item"], on=_eq(col("inv_item_sk"), col("i_item_sk")),
+                  how="inner")
+            .rollup("i_product_name", "i_brand", "i_class", "i_category")
+            .agg(_avg(col("inv_quantity_on_hand"), "qoh"))
+            .sort(SortOrder(col("qoh")), SortOrder(col("i_product_name")),
+                  SortOrder(col("i_brand")), SortOrder(col("i_class")),
+                  SortOrder(col("i_category")))
+            .limit(100))
+
+
+def q28(t):
+    """Q28: six list-price-bucket (avg, count, distinct-count) legs
+    cross-joined (scalar subqueries -> 1-row frames)."""
+    bands = [(0, 5, 11, 16), (6, 10, 91, 96), (11, 15, 66, 71),
+             (16, 20, 142, 147), (21, 25, 135, 140), (26, 30, 28, 33)]
+    legs = None
+    for i, (qlo, qhi, plo, phi) in enumerate(bands, 1):
+        filt = (t["store_sales"]
+                .where(_between(col("ss_quantity"), lit(qlo), lit(qhi)))
+                .where(P.Or(
+                    _between(col("ss_list_price"), lit(float(plo)),
+                             lit(float(phi))),
+                    P.Or(_between(col("ss_coupon_amt"), lit(plo * 10.0),
+                                  lit(plo * 10.0 + 1000.0)),
+                         _between(col("ss_wholesale_cost"), lit(float(qlo)),
+                                  lit(qlo + 20.0))))))
+        stats = (filt.group_by()
+                 .agg(_avg(col("ss_list_price"), f"b{i}_lp"),
+                      _cnt(f"b{i}_cnt")))
+        distinct = (filt.select(col("ss_list_price")).distinct()
+                    .group_by().agg(_cnt(f"b{i}_cntd")))
+        leg = stats.join(distinct, how="cross")
+        legs = leg if legs is None else legs.join(leg, how="cross")
+    return legs
+
+
+def q30(t):
+    """Q30: web-return customers above 1.2x their state's average
+    (q1's shape on the web channel, with customer detail output)."""
+    d = t["date_dim"].where(_eq(col("d_year"), lit(2000)))
+    ctr = (t["web_returns"]
+           .join(d, on=_eq(col("wr_returned_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .join(t["customer_address"],
+                 on=_eq(col("wr_refunded_addr_sk"), col("ca_address_sk")),
+                 how="inner")
+           .group_by(col("wr_returning_customer_sk"), col("ca_state"))
+           .agg(_sum(col("wr_return_amt"), "ctr_total"))
+           .select(col("wr_returning_customer_sk").alias("ctr_cust"),
+                   col("ca_state").alias("ctr_state"), col("ctr_total")))
+    avg_state = (ctr.group_by(col("ctr_state"))
+                 .agg(_avg(col("ctr_total"), "state_avg"))
+                 .select(col("ctr_state").alias("avg_state"),
+                         col("state_avg")))
+    return (ctr
+            .join(avg_state, on=_eq(col("ctr_state"), col("avg_state")),
+                  how="inner")
+            .where(P.GreaterThan(col("ctr_total"),
+                                 Multiply(lit(1.2), col("state_avg"))))
+            .join(t["customer"],
+                  on=_eq(col("ctr_cust"), col("c_customer_sk")),
+                  how="inner")
+            .select(col("c_customer_id"), col("c_salutation"),
+                    col("c_first_name"), col("c_last_name"),
+                    col("ctr_total"))
+            .sort(SortOrder(col("c_customer_id")),
+                  SortOrder(col("ctr_total")))
+            .limit(100))
+
+
+def q31(t):
+    """Q31: counties where web sales grew faster than store sales across
+    consecutive quarters (six quarter legs joined on county)."""
+    def leg(fact, date_col, cust_addr, price, qoy, name):
+        d = t["date_dim"].where(P.And(_eq(col("d_qoy"), lit(qoy)),
+                                      _eq(col("d_year"), lit(2000))))
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(t["customer_address"],
+                      on=_eq(col(cust_addr), col("ca_address_sk")),
+                      how="inner")
+                .group_by(col("ca_county"))
+                .agg(_sum(col(price), name))
+                .select(col("ca_county").alias(name + "_cty"), col(name)))
+
+    ss1 = leg("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+              "ss_ext_sales_price", 1, "ss_q1")
+    ss2 = leg("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+              "ss_ext_sales_price", 2, "ss_q2")
+    ss3 = leg("store_sales", "ss_sold_date_sk", "ss_addr_sk",
+              "ss_ext_sales_price", 3, "ss_q3")
+    ws1 = leg("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+              "ws_ext_sales_price", 1, "ws_q1")
+    ws2 = leg("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+              "ws_ext_sales_price", 2, "ws_q2")
+    ws3 = leg("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk",
+              "ws_ext_sales_price", 3, "ws_q3")
+    return (ss1
+            .join(ss2, on=_eq(col("ss_q1_cty"), col("ss_q2_cty")),
+                  how="inner")
+            .join(ss3, on=_eq(col("ss_q1_cty"), col("ss_q3_cty")),
+                  how="inner")
+            .join(ws1, on=_eq(col("ss_q1_cty"), col("ws_q1_cty")),
+                  how="inner")
+            .join(ws2, on=_eq(col("ss_q1_cty"), col("ws_q2_cty")),
+                  how="inner")
+            .join(ws3, on=_eq(col("ss_q1_cty"), col("ws_q3_cty")),
+                  how="inner")
+            .where(P.And(P.GreaterThan(col("ss_q1"), lit(0.0)),
+                         P.GreaterThan(col("ws_q1"), lit(0.0))))
+            .where(P.And(
+                P.GreaterThan(Divide(col("ws_q2"), col("ws_q1")),
+                              Divide(col("ss_q2"), col("ss_q1"))),
+                P.GreaterThan(Divide(col("ws_q3"), col("ws_q2")),
+                              Divide(col("ss_q3"), col("ss_q2")))))
+            .select(col("ss_q1_cty").alias("county"),
+                    Divide(col("ws_q2"), col("ws_q1")).alias("web_g1"),
+                    Divide(col("ss_q2"), col("ss_q1")).alias("store_g1"))
+            .sort(SortOrder(col("county")))
+            .limit(100))
+
+
+def q32(t):
+    """Q32: excess catalog discount — rows above 1.3x their item's
+    average discount in a 90-day window (correlated avg -> join)."""
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(700), lit(790)))
+    base = (t["catalog_sales"]
+            .join(d, on=_eq(col("cs_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["item"].where(_between(col("i_manufact_id"), lit(20),
+                                           lit(40))),
+                  on=_eq(col("cs_item_sk"), col("i_item_sk")),
+                  how="inner"))
+    item_avg = (base.group_by(col("cs_item_sk"))
+                .agg(_avg(col("cs_ext_discount_amt"), "disc_avg"))
+                .select(col("cs_item_sk").alias("ia_item"),
+                        col("disc_avg")))
+    return (base
+            .join(item_avg, on=_eq(col("cs_item_sk"), col("ia_item")),
+                  how="inner")
+            .where(P.GreaterThan(col("cs_ext_discount_amt"),
+                                 Multiply(lit(1.3), col("disc_avg"))))
+            .group_by()
+            .agg(_sum(col("cs_ext_discount_amt"), "excess_discount")))
+
+
+def q33(t):
+    """Q33: manufacturer revenue across all three channels for one month
+    (three union legs, agg by manufact id)."""
+    def leg(fact, date_col, item_col, price):
+        d = t["date_dim"].where(P.And(_eq(col("d_year"), lit(1998)),
+                                      _eq(col("d_moy"), lit(5))))
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(t["item"].where(_eq(col("i_category"),
+                                          lit("Books"))),
+                      on=_eq(col(item_col), col("i_item_sk")),
+                      how="inner")
+                .group_by(col("i_manufact_id"))
+                .agg(_sum(col(price), "total_sales"))
+                .select(col("i_manufact_id"), col("total_sales")))
+
+    all_legs = (leg("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                    "ss_ext_sales_price")
+                .union(leg("catalog_sales", "cs_sold_date_sk",
+                           "cs_item_sk", "cs_ext_sales_price"))
+                .union(leg("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                           "ws_ext_sales_price")))
+    return (all_legs
+            .group_by(col("i_manufact_id"))
+            .agg(_sum(col("total_sales"), "total"))
+            .sort(SortOrder(col("total")), SortOrder(col("i_manufact_id")))
+            .limit(100))
+
+
+def q36(t):
+    """Q36: gross-margin ROLLUP over category/class with a rank window
+    partitioned by the grouping-id lochierarchy (GpuExpandExec +
+    GpuWindowExec interplay)."""
+    d = t["date_dim"].where(_eq(col("d_year"), lit(1998)))
+    base = (t["store_sales"]
+            .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["item"], on=_eq(col("ss_item_sk"), col("i_item_sk")),
+                  how="inner")
+            .join(t["store"].where(P.In(col("s_state"),
+                                        ["TN", "CA", "TX", "OH"])),
+                  on=_eq(col("ss_store_sk"), col("s_store_sk")),
+                  how="inner"))
+    agg = (base
+           .rollup("i_category", "i_class", grouping_id="lochierarchy")
+           .agg(_sum(col("ss_net_profit"), "profit"),
+                _sum(col("ss_ext_sales_price"), "sales")))
+    w = (Window.partition_by(col("lochierarchy"), If(
+        _eq(col("lochierarchy"), lit(1)), col("i_category"), lit("")))
+        .order_by(SortOrder(Divide(col("profit"), col("sales"))))
+    )
+    return (agg
+            .with_column("gross_margin", Divide(col("profit"),
+                                                col("sales")))
+            .with_column("rank_within_parent", over(Rank(), w))
+            .select(col("gross_margin"), col("i_category"), col("i_class"),
+                    col("lochierarchy"), col("rank_within_parent"))
+            .sort(SortOrder(col("lochierarchy"), ascending=False),
+                  SortOrder(col("i_category")),
+                  SortOrder(col("rank_within_parent")))
+            .limit(100))
+
+
+def q37(t):
+    """Q37: items with 100-500 on hand in a 60-day window that also sold
+    on catalog (inventory gate + semi join)."""
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(700), lit(760)))
+    inv_ok = (t["inventory"]
+              .where(_between(col("inv_quantity_on_hand"), lit(100),
+                              lit(500)))
+              .join(d, on=_eq(col("inv_date_sk"), col("d_date_sk")),
+                    how="inner")
+              .select(col("inv_item_sk")).distinct())
+    return (t["item"]
+            .where(_between(col("i_current_price"), lit(20.0), lit(50.0)))
+            .where(_between(col("i_manufact_id"), lit(30), lit(70)))
+            .join(inv_ok, on=_eq(col("i_item_sk"), col("inv_item_sk")),
+                  how="left_semi")
+            .join(t["catalog_sales"],
+                  on=_eq(col("i_item_sk"), col("cs_item_sk")),
+                  how="left_semi")
+            .select(col("i_item_id"), col("i_item_sk"),
+                    col("i_current_price"))
+            .group_by(col("i_item_id"))
+            .agg(A.AggregateExpression(A.Min(col("i_current_price")),
+                                       "min_price"))
+            .sort(SortOrder(col("i_item_id")))
+            .limit(100))
+
+
+def q38(t):
+    """Q38: customers active in ALL three channels in a period (INTERSECT
+    -> chained left-semi joins on name+date identity), counted."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+
+    def leg(fact, date_col, cust_col):
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(t["customer"],
+                      on=_eq(col(cust_col), col("c_customer_sk")),
+                      how="inner")
+                .select(col("c_last_name"), col("c_first_name"),
+                        col("d_date"))
+                .distinct())
+
+    ss = leg("store_sales", "ss_sold_date_sk", "ss_customer_sk")
+    cs = leg("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk")
+    ws = leg("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk")
+    key = [col("c_last_name"), col("c_first_name"), col("d_date")]
+    inter = (ss.join(cs, on=[k.name for k in key], how="left_semi")
+             .join(ws, on=[k.name for k in key], how="left_semi"))
+    return inter.group_by().agg(_cnt("cnt"))
+
+
+def q39(t):
+    """Q39: warehouse/item monthly inventory mean + coefficient of
+    variation, consecutive-month pairs with cov > 1.5 (stdev via the
+    sum-of-squares identity)."""
+    d = t["date_dim"].where(P.And(_eq(col("d_year"), lit(1998)),
+                                  P.LessThanOrEqual(col("d_moy"),
+                                                    lit(5))))
+    q = Cast(col("inv_quantity_on_hand"), T.DOUBLE)
+    monthly = (t["inventory"]
+               .join(d, on=_eq(col("inv_date_sk"), col("d_date_sk")),
+                     how="inner")
+               .join(t["item"], on=_eq(col("inv_item_sk"),
+                                       col("i_item_sk")), how="inner")
+               .join(t["warehouse"],
+                     on=_eq(col("inv_warehouse_sk"),
+                            col("w_warehouse_sk")), how="inner")
+               .group_by(col("w_warehouse_sk"), col("i_item_sk"),
+                         col("d_moy"))
+               .agg(_cnt("n"), _avg(col("inv_quantity_on_hand"), "mean"),
+                    _sum(Multiply(q, q), "sumsq")))
+    nn = Cast(col("n"), T.DOUBLE)
+    var = Divide(Subtract(col("sumsq"),
+                          Multiply(nn, Multiply(col("mean"),
+                                                col("mean")))),
+                 Subtract(nn, lit(1.0)))
+    banded = (monthly
+              .where(P.GreaterThan(col("n"), lit(1)))
+              .where(P.GreaterThan(col("mean"), lit(0.0)))
+              .with_column("cov", Divide(Sqrt(var), col("mean")))
+              .where(P.GreaterThan(col("cov"), lit(0.5))))
+    m1 = banded.select(col("w_warehouse_sk").alias("wh1"),
+                       col("i_item_sk").alias("it1"),
+                       col("d_moy").alias("moy1"), col("cov").alias("cov1"))
+    m2 = banded.select(col("w_warehouse_sk").alias("wh2"),
+                       col("i_item_sk").alias("it2"),
+                       col("d_moy").alias("moy2"), col("cov").alias("cov2"))
+    return (m1.join(m2,
+                    on=P.And(_eq(col("wh1"), col("wh2")),
+                             P.And(_eq(col("it1"), col("it2")),
+                                   _eq(Add(col("moy1"), lit(1)),
+                                       col("moy2")))),
+                    how="inner")
+            .select(col("wh1"), col("it1"), col("moy1"), col("cov1"),
+                    col("moy2"), col("cov2"))
+            .sort(SortOrder(col("wh1")), SortOrder(col("it1")),
+                  SortOrder(col("moy1")))
+            .limit(100))
+
+
+def q40(t):
+    """Q40: catalog sales net of returns by warehouse state, split
+    before/after a pivot date (left join to returns on order+item)."""
+    pivot = 730
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(700), lit(760)))
+    cr = t["catalog_returns"].select(
+        col("cr_order_number").alias("r_order"),
+        col("cr_item_sk").alias("r_item"),
+        col("cr_refunded_cash"))
+    base = (t["catalog_sales"]
+            .join(cr, on=P.And(_eq(col("cs_order_number"), col("r_order")),
+                               _eq(col("cs_item_sk"), col("r_item"))),
+                  how="left")
+            .join(t["warehouse"],
+                  on=_eq(col("cs_warehouse_sk"), col("w_warehouse_sk")),
+                  how="inner")
+            .join(t["item"].where(_between(col("i_current_price"),
+                                           lit(0.99), lit(1.49))),
+                  on=_eq(col("cs_item_sk"), col("i_item_sk")),
+                  how="inner")
+            .join(d, on=_eq(col("cs_sold_date_sk"), col("d_date_sk")),
+                  how="inner"))
+    net = Subtract(col("cs_sales_price"),
+                   Coalesce(col("cr_refunded_cash"), lit(0.0)))
+    return (base
+            .group_by(col("w_state"), col("i_item_id"))
+            .agg(_sum(If(P.LessThan(col("d_date_sk"), lit(pivot)), net,
+                         lit(0.0)), "sales_before"),
+                 _sum(If(P.GreaterThanOrEqual(col("d_date_sk"),
+                                              lit(pivot)), net,
+                         lit(0.0)), "sales_after"))
+            .sort(SortOrder(col("w_state")), SortOrder(col("i_item_id")))
+            .limit(100))
+
+
+def q41(t):
+    """Q41: distinct product names in a manufact band with a sibling-item
+    existence gate (correlated EXISTS -> self semi join)."""
+    sibling = (t["item"]
+               .where(P.In(col("i_category"), ["Women", "Men", "Shoes"]))
+               .select(col("i_manufact").alias("sib_manufact"))
+               .distinct())
+    return (t["item"]
+            .where(_between(col("i_manufact_id"), lit(40), lit(80)))
+            .join(sibling, on=_eq(col("i_manufact"), col("sib_manufact")),
+                  how="left_semi")
+            .select(col("i_product_name")).distinct()
+            .sort(SortOrder(col("i_product_name")))
+            .limit(100))
+
+
+def q43(t):
+    """Q43: store sales pivoted by day-of-week name per store."""
+    d = t["date_dim"].where(_eq(col("d_year"), lit(1998)))
+
+    def day_sum(day, name):
+        return _sum(If(_eq(col("d_day_name"), lit(day)),
+                       col("ss_sales_price"), lit(0.0)), name)
+
+    return (t["store_sales"]
+            .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["store"], on=_eq(col("ss_store_sk"),
+                                     col("s_store_sk")), how="inner")
+            .group_by(col("s_store_name"), col("s_store_id"))
+            .agg(day_sum("Sunday", "sun_sales"),
+                 day_sum("Monday", "mon_sales"),
+                 day_sum("Tuesday", "tue_sales"),
+                 day_sum("Wednesday", "wed_sales"),
+                 day_sum("Thursday", "thu_sales"),
+                 day_sum("Friday", "fri_sales"),
+                 day_sum("Saturday", "sat_sales"))
+            .sort(SortOrder(col("s_store_name")),
+                  SortOrder(col("s_store_id")))
+            .limit(100))
+
+
+def q44(t):
+    """Q44: best and worst performing items per store by avg net profit
+    (asc + desc rank windows joined on rank)."""
+    perf = (t["store_sales"]
+            .where(_eq(col("ss_store_sk"), lit(4)))
+            .group_by(col("ss_item_sk"))
+            .agg(_avg(col("ss_net_profit"), "rank_col")))
+    asc_w = Window.partition_by().order_by(SortOrder(col("rank_col")))
+    desc_w = Window.partition_by().order_by(
+        SortOrder(col("rank_col"), ascending=False))
+    best = (perf.with_column("rnk", over(Rank(), desc_w))
+            .where(P.LessThanOrEqual(col("rnk"), lit(10)))
+            .select(col("rnk").alias("b_rnk"),
+                    col("ss_item_sk").alias("best_item")))
+    worst = (perf.with_column("rnk", over(Rank(), asc_w))
+             .where(P.LessThanOrEqual(col("rnk"), lit(10)))
+             .select(col("rnk").alias("w_rnk"),
+                     col("ss_item_sk").alias("worst_item")))
+    i1 = t["item"].select(col("i_item_sk").alias("i1_sk"),
+                          col("i_product_name").alias("best_performing"))
+    i2 = t["item"].select(col("i_item_sk").alias("i2_sk"),
+                          col("i_product_name").alias("worst_performing"))
+    return (best.join(worst, on=_eq(col("b_rnk"), col("w_rnk")),
+                      how="inner")
+            .join(i1, on=_eq(col("best_item"), col("i1_sk")), how="inner")
+            .join(i2, on=_eq(col("worst_item"), col("i2_sk")), how="inner")
+            .select(col("b_rnk").alias("rnk"), col("best_performing"),
+                    col("worst_performing"))
+            .sort(SortOrder(col("rnk")))
+            .limit(100))
+
+
+QUERIES = {"q1": q1, "q2": q2, "q3": q3, "q5": q5, "q6": q6, "q7": q7,
+           "q8": q8, "q9": q9, "q11": q11, "q12": q12, "q13": q13,
+           "q15": q15, "q16": q16, "q17": q17, "q18": q18,
+           "q19": q19, "q20": q20, "q21": q21, "q22": q22,
+           "q25": q25, "q26": q26, "q27": q27, "q28": q28, "q29": q29,
+           "q30": q30, "q31": q31, "q32": q32, "q33": q33,
+           "q34": q34, "q36": q36, "q37": q37, "q38": q38, "q39": q39,
+           "q40": q40, "q41": q41, "q42": q42, "q43": q43, "q44": q44,
+           "q46": q46, "q48": q48, "q52": q52,
            "q55": q55, "q59": q59, "q61": q61, "q65": q65, "q68": q68,
            "q79": q79, "q96": q96, "q98": q98}

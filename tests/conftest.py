@@ -54,6 +54,13 @@ def pytest_configure(config):
     # for the CPU tier entirely.
     os.environ["JAX_ENABLE_COMPILATION_CACHE"] = "false"
     os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    # The axon remote-compile helper serves XLA:CPU executables AOT-compiled
+    # on machines with CPU features this host may lack (+avx512*,
+    # +prefer-no-gather) — running one SIGILLs/segfaults mid-suite (observed
+    # twice in round 3, once in round 4, always under backend_compile_and_load
+    # or the persistent-cache read). The CPU tier must compile locally.
+    os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -12,22 +12,21 @@ N_SS = 1 << 13
 
 
 @pytest.fixture(scope="module")
-def tables():
-    return tpcds.gen_tables(N_SS, seed=11)
-
-
-@pytest.fixture(scope="module")
-def sessions():
-    return (TpuSession({"spark.rapids.sql.enabled": False}),
-            TpuSession({"spark.rapids.sql.enabled": True,
-                        "spark.rapids.sql.variableFloatAgg.enabled": True}))
+def envs():
+    tables = tpcds.gen_tables(N_SS, seed=11)
+    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+    tpu = TpuSession({"spark.rapids.sql.enabled": True,
+                      "spark.rapids.sql.variableFloatAgg.enabled": True})
+    # Tables cache ONCE per module — re-caching 17 tables per query was
+    # the dominant suite cost.
+    return tpcds.load(cpu, tables), tpcds.load(tpu, tables)
 
 
 @pytest.mark.parametrize("name", sorted(tpcds.QUERIES))
-def test_query_differential(tables, sessions, name):
-    cpu, tpu = sessions
+def test_query_differential(envs, name):
+    cpu_t, tpu_t = envs
     q = tpcds.QUERIES[name]
     from spark_rapids_tpu.workloads.compare import tables_match
-    cpu_result = q(tpcds.load(cpu, tables)).collect()
-    tpu_result = q(tpcds.load(tpu, tables)).collect()
+    cpu_result = q(cpu_t).collect()
+    tpu_result = q(tpu_t).collect()
     assert tables_match(tpu_result, cpu_result, rel_tol=1e-9, abs_tol=1e-9)
