@@ -20,12 +20,15 @@ import jax
 # array is created anywhere in the package.
 jax.config.update("jax_enable_x64", True)
 
-# Persistent XLA compilation cache: query kernels compile once per machine,
-# not once per process — the pre-compiled-kernel-library property of the
-# reference's libcudf substrate (SURVEY.md §2.10). Opt out or relocate with
-# SPARK_RAPIDS_TPU_COMPILE_CACHE=off|<dir>.
-_cache_dir = os.environ.get("SPARK_RAPIDS_TPU_COMPILE_CACHE",
-                            os.path.expanduser("~/.cache/spark_rapids_tpu"))
+# Persistent XLA compilation cache — opt-IN via
+# SPARK_RAPIDS_TPU_COMPILE_CACHE=<dir>. Default is OFF: in this
+# environment compile requests can be served by a remote helper whose AOT
+# results target CPU features this machine lacks (+avx512*,
+# +prefer-no-gather); setting jax_compilation_cache_dir also activates
+# XLA-internal executable caches that replay those foreign binaries even
+# when jax_enable_compilation_cache is False — observed as mid-suite
+# SIGILL/segfaults under cpu_aot_loader.cc in rounds 3-4.
+_cache_dir = os.environ.get("SPARK_RAPIDS_TPU_COMPILE_CACHE", "off")
 if _cache_dir.lower() != "off":
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)

@@ -29,7 +29,7 @@ import pyarrow as pa
 
 from ..ops import aggregates as A
 from ..ops import predicates as P
-from ..ops.arithmetic import Add, Divide, Multiply, Subtract
+from ..ops.arithmetic import Abs, Add, Divide, Multiply, Subtract
 from ..ops.cast import Cast
 from ..ops.conditional import Coalesce, If
 from ..ops.expression import col, lit
@@ -2430,6 +2430,1338 @@ def q44(t):
             .limit(100))
 
 
+def q45(t):
+    """Q45: web revenue by zip/city for listed zip prefixes OR listed
+    items (disjunctive gate across a join)."""
+    d = t["date_dim"].where(P.And(_eq(col("d_qoy"), lit(2)),
+                                  _eq(col("d_year"), lit(2000))))
+    zip_ok = P.In(Substring(col("ca_zip"), lit(1), lit(5)),
+                  ["85669", "86197", "88274", "83405", "86475"])
+    item_ok = P.In(col("i_item_sk"), [2, 3, 5, 7, 11, 13, 17, 19, 23, 29])
+    return (t["web_sales"]
+            .join(t["customer"],
+                  on=_eq(col("ws_bill_customer_sk"), col("c_customer_sk")),
+                  how="inner")
+            .join(t["customer_address"],
+                  on=_eq(col("c_current_addr_sk"), col("ca_address_sk")),
+                  how="inner")
+            .join(d, on=_eq(col("ws_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["item"], on=_eq(col("ws_item_sk"), col("i_item_sk")),
+                  how="inner")
+            .where(P.Or(zip_ok, item_ok))
+            .group_by(col("ca_zip"), col("ca_city"))
+            .agg(_sum(col("ws_sales_price"), "web_sales"))
+            .sort(SortOrder(col("ca_zip")), SortOrder(col("ca_city")))
+            .limit(100))
+
+
+def _monthly_windowed(base, part_cols, year):
+    """Shared q47/q57 core: monthly sums, per-year average window,
+    row-number self-joins for prev/next month."""
+    monthly = (base
+               .group_by(*[col(c) for c in part_cols],
+                         col("d_year"), col("d_moy"))
+               .agg(_sum(col("sales_price"), "sum_sales")))
+    avg_w = Window.partition_by(*(part_cols + ["d_year"]))
+    rn_w = (Window.partition_by(*part_cols)
+            .order_by(SortOrder(col("d_year")), SortOrder(col("d_moy"))))
+    v1 = (monthly
+          .with_windows(avg_monthly_sales=over(A.Average(col("sum_sales")),
+                                               avg_w),
+                        rn=over(RowNumber(), rn_w)))
+    lag = v1.select(*([col(c).alias("lag_" + c) for c in part_cols]
+                      + [col("rn").alias("lag_rn"),
+                         col("sum_sales").alias("psum")]))
+    lead = v1.select(*([col(c).alias("lead_" + c) for c in part_cols]
+                       + [col("rn").alias("lead_rn"),
+                          col("sum_sales").alias("nsum")]))
+    cond_lag = _eq(col(part_cols[0]), col("lag_" + part_cols[0]))
+    for c in part_cols[1:]:
+        cond_lag = P.And(cond_lag, _eq(col(c), col("lag_" + c)))
+    cond_lag = P.And(cond_lag, _eq(col("rn"), Add(col("lag_rn"), lit(1))))
+    cond_lead = _eq(col(part_cols[0]), col("lead_" + part_cols[0]))
+    for c in part_cols[1:]:
+        cond_lead = P.And(cond_lead, _eq(col(c), col("lead_" + c)))
+    cond_lead = P.And(cond_lead,
+                      _eq(col("rn"), Subtract(col("lead_rn"), lit(1))))
+    dev = Divide(Abs(Subtract(col("sum_sales"),
+                              col("avg_monthly_sales"))),
+                 col("avg_monthly_sales"))
+    return (v1.join(lag, on=cond_lag, how="inner")
+            .join(lead, on=cond_lead, how="inner")
+            .where(_eq(col("d_year"), lit(year)))
+            .where(P.GreaterThan(col("avg_monthly_sales"), lit(0.0)))
+            .where(P.GreaterThan(dev, lit(0.1)))
+            .select(*([col(c) for c in part_cols]
+                      + [col("d_year"), col("d_moy"), col("sum_sales"),
+                         col("avg_monthly_sales"), col("psum"),
+                         col("nsum")]))
+            .sort(SortOrder(Subtract(col("sum_sales"),
+                                     col("avg_monthly_sales"))),
+                  *[SortOrder(col(c)) for c in part_cols],
+                  SortOrder(col("d_moy")))
+            .limit(100))
+
+
+def q47(t):
+    """Q47: store monthly sales vs yearly average with prev/next month
+    columns (window avg + row-number self joins)."""
+    d = t["date_dim"].where(P.In(col("d_year"), [1998, 1999]))
+    base = (t["store_sales"]
+            .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["item"], on=_eq(col("ss_item_sk"), col("i_item_sk")),
+                  how="inner")
+            .join(t["store"], on=_eq(col("ss_store_sk"),
+                                     col("s_store_sk")), how="inner")
+            .select(col("i_category"), col("i_brand"), col("s_store_name"),
+                    col("d_year"), col("d_moy"),
+                    col("ss_sales_price").alias("sales_price")))
+    return _monthly_windowed(base, ["i_category", "i_brand",
+                                    "s_store_name"], 1999)
+
+
+def q57(t):
+    """Q57: q47's shape on the catalog channel with call centers."""
+    d = t["date_dim"].where(P.In(col("d_year"), [1998, 1999]))
+    base = (t["catalog_sales"]
+            .join(d, on=_eq(col("cs_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["item"], on=_eq(col("cs_item_sk"), col("i_item_sk")),
+                  how="inner")
+            .join(t["call_center"],
+                  on=_eq(col("cs_call_center_sk"),
+                         col("cc_call_center_sk")), how="inner")
+            .select(col("i_category"), col("i_brand"), col("cc_name"),
+                    col("d_year"), col("d_moy"),
+                    col("cs_sales_price").alias("sales_price")))
+    return _monthly_windowed(base, ["i_category", "i_brand", "cc_name"],
+                             1999)
+
+
+def q49(t):
+    """Q49: worst return ratios per channel, rank windows unioned."""
+    def channel(sales, returns, s_item, s_order, s_qty, s_price, r_item,
+                r_order, r_qty, r_amt, date_col, label):
+        d = t["date_dim"].where(P.And(_eq(col("d_year"), lit(1998)),
+                                      _eq(col("d_moy"), lit(12))))
+        r = t[returns].select(col(r_item).alias("r_item"),
+                              col(r_order).alias("r_order"),
+                              col(r_qty).alias("r_qty"),
+                              col(r_amt).alias("r_amt"))
+        joined = (t[sales]
+                  .where(P.GreaterThan(col(s_price), lit(0.0)))
+                  .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                        how="inner")
+                  .join(r, on=P.And(_eq(col(s_item), col("r_item")),
+                                    _eq(col(s_order), col("r_order"))),
+                        how="inner"))
+        agg = (joined.group_by(col(s_item))
+               .agg(_sum(Cast(col("r_qty"), T.DOUBLE), "ret_qty"),
+                    _sum(Cast(col(s_qty), T.DOUBLE), "sale_qty"),
+                    _sum(col("r_amt"), "ret_amt"),
+                    _sum(col(s_price), "sale_amt")))
+        ratio_w = Window.partition_by().order_by(
+            SortOrder(Divide(col("ret_qty"), col("sale_qty")),
+                      ascending=False))
+        curr_w = Window.partition_by().order_by(
+            SortOrder(Divide(col("ret_amt"), col("sale_amt")),
+                      ascending=False))
+        return (agg
+                .with_windows(return_rank=over(Rank(), ratio_w),
+                              currency_rank=over(Rank(), curr_w))
+                .where(P.Or(P.LessThanOrEqual(col("return_rank"),
+                                              lit(10)),
+                            P.LessThanOrEqual(col("currency_rank"),
+                                              lit(10))))
+                .with_column("channel", lit(label))
+                .select(col("channel"), col(s_item).alias("item"),
+                        Divide(col("ret_qty"),
+                               col("sale_qty")).alias("return_ratio"),
+                        col("return_rank"), col("currency_rank")))
+
+    web = channel("web_sales", "web_returns", "ws_item_sk",
+                  "ws_order_number", "ws_quantity", "ws_net_paid",
+                  "wr_item_sk", "wr_order_number", "wr_return_quantity",
+                  "wr_return_amt", "ws_sold_date_sk", "web")
+    cat = channel("catalog_sales", "catalog_returns", "cs_item_sk",
+                  "cs_order_number", "cs_quantity", "cs_net_paid",
+                  "cr_item_sk", "cr_order_number", "cr_return_quantity",
+                  "cr_return_amount", "cs_sold_date_sk", "catalog")
+    sto = channel("store_sales", "store_returns", "ss_item_sk",
+                  "ss_ticket_number", "ss_quantity", "ss_net_paid",
+                  "sr_item_sk", "sr_ticket_number", "sr_return_quantity",
+                  "sr_return_amt", "ss_sold_date_sk", "store")
+    return (web.union(cat).union(sto)
+            .sort(SortOrder(col("channel")), SortOrder(col("return_rank")),
+                  SortOrder(col("currency_rank")), SortOrder(col("item")))
+            .limit(100))
+
+
+def q50(t):
+    """Q50: sale-to-return latency buckets per store."""
+    d2 = t["date_dim"].where(P.And(_eq(col("d_year"), lit(2000)),
+                                   _eq(col("d_moy"), lit(8))))
+    lat = Subtract(col("sr_returned_date_sk"), col("ss_sold_date_sk"))
+
+    def bucket(cond, name):
+        return _sum(If(cond, lit(1), lit(0)), name)
+
+    return (t["store_sales"]
+            .join(t["store_returns"],
+                  on=P.And(_eq(col("ss_ticket_number"),
+                               col("sr_ticket_number")),
+                           P.And(_eq(col("ss_item_sk"), col("sr_item_sk")),
+                                 _eq(col("ss_customer_sk"),
+                                     col("sr_customer_sk")))),
+                  how="inner")
+            .join(d2, on=_eq(col("sr_returned_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["store"], on=_eq(col("ss_store_sk"),
+                                     col("s_store_sk")), how="inner")
+            .group_by(col("s_store_name"), col("s_store_id"),
+                      col("s_city"), col("s_county"), col("s_state"),
+                      col("s_zip"))
+            .agg(bucket(P.LessThanOrEqual(lat, lit(30)), "d30"),
+                 bucket(P.And(P.GreaterThan(lat, lit(30)),
+                              P.LessThanOrEqual(lat, lit(60))), "d60"),
+                 bucket(P.And(P.GreaterThan(lat, lit(60)),
+                              P.LessThanOrEqual(lat, lit(90))), "d90"),
+                 bucket(P.And(P.GreaterThan(lat, lit(90)),
+                              P.LessThanOrEqual(lat, lit(120))), "d120"),
+                 bucket(P.GreaterThan(lat, lit(120)), "d120plus"))
+            .sort(SortOrder(col("s_store_name")),
+                  SortOrder(col("s_store_id")))
+            .limit(100))
+
+
+def q51(t):
+    """Q51: running web vs store cumulative sales per item (full outer
+    join + running-sum windows)."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(18)))
+    run_w = (Window.partition_by("item_sk_w")
+             .order_by(SortOrder(col("date_w"))))
+    web = (t["web_sales"]
+           .join(d, on=_eq(col("ws_sold_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .group_by(col("ws_item_sk"), col("d_date_sk"))
+           .agg(_sum(col("ws_sales_price"), "w_sales"))
+           .select(col("ws_item_sk").alias("item_sk_w"),
+                   col("d_date_sk").alias("date_w"), col("w_sales"))
+           .with_column("web_cumulative", over(A.Sum(col("w_sales")),
+                                               run_w)))
+    run_s = (Window.partition_by("item_sk_s")
+             .order_by(SortOrder(col("date_s"))))
+    store = (t["store_sales"]
+             .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                   how="inner")
+             .group_by(col("ss_item_sk"), col("d_date_sk"))
+             .agg(_sum(col("ss_sales_price"), "s_sales"))
+             .select(col("ss_item_sk").alias("item_sk_s"),
+                     col("d_date_sk").alias("date_s"), col("s_sales"))
+             .with_column("store_cumulative", over(A.Sum(col("s_sales")),
+                                                   run_s)))
+    return (web.join(store,
+                     on=P.And(_eq(col("item_sk_w"), col("item_sk_s")),
+                              _eq(col("date_w"), col("date_s"))),
+                     how="full")
+            .select(Coalesce(col("item_sk_w"),
+                             col("item_sk_s")).alias("item_sk"),
+                    Coalesce(col("date_w"), col("date_s")).alias("d_date"),
+                    Coalesce(col("web_cumulative"),
+                             lit(0.0)).alias("web_sales"),
+                    Coalesce(col("store_cumulative"),
+                             lit(0.0)).alias("store_sales"))
+            .where(P.GreaterThan(col("web_sales"), col("store_sales")))
+            .sort(SortOrder(col("item_sk")), SortOrder(col("d_date")))
+            .limit(100))
+
+
+def q53(t):
+    """Q53: quarterly manufacturer sales vs their average (window over
+    aggregate, deviation filter)."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+    agg = (t["store_sales"]
+           .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .join(t["item"].where(_between(col("i_manufact_id"), lit(20),
+                                          lit(60))),
+                 on=_eq(col("ss_item_sk"), col("i_item_sk")), how="inner")
+           .join(t["store"], on=_eq(col("ss_store_sk"),
+                                    col("s_store_sk")), how="inner")
+           .group_by(col("i_manufact_id"), col("d_qoy"))
+           .agg(_sum(col("ss_sales_price"), "sum_sales")))
+    w = Window.partition_by("i_manufact_id")
+    dev = Divide(Abs(Subtract(col("sum_sales"), col("avg_quarterly"))),
+                 col("avg_quarterly"))
+    return (agg
+            .with_column("avg_quarterly", over(A.Average(col("sum_sales")),
+                                               w))
+            .where(P.GreaterThan(col("avg_quarterly"), lit(0.0)))
+            .where(P.GreaterThan(dev, lit(0.1)))
+            .select(col("i_manufact_id"), col("sum_sales"),
+                    col("avg_quarterly"))
+            .sort(SortOrder(col("avg_quarterly")),
+                  SortOrder(col("sum_sales")),
+                  SortOrder(col("i_manufact_id")))
+            .limit(100))
+
+
+def q58(t):
+    """Q58: items whose revenue is balanced across all three channels in
+    a window (three aggregate legs, mutual 0.9-1.1 band filters)."""
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(740), lit(747)))
+
+    def leg(fact, date_col, item_col, price, name):
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(t["item"], on=_eq(col(item_col), col("i_item_sk")),
+                      how="inner")
+                .group_by(col("i_item_id"))
+                .agg(_sum(col(price), name))
+                .select(col("i_item_id").alias(name + "_id"), col(name)))
+
+    ss = leg("store_sales", "ss_sold_date_sk", "ss_item_sk",
+             "ss_ext_sales_price", "ss_rev")
+    cs = leg("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+             "cs_ext_sales_price", "cs_rev")
+    ws = leg("web_sales", "ws_sold_date_sk", "ws_item_sk",
+             "ws_ext_sales_price", "ws_rev")
+
+    def band(a, b):
+        return P.And(
+            P.GreaterThanOrEqual(a, Multiply(lit(0.9), b)),
+            P.LessThanOrEqual(a, Multiply(lit(1.1), b)))
+
+    return (ss
+            .join(cs, on=_eq(col("ss_rev_id"), col("cs_rev_id")),
+                  how="inner")
+            .join(ws, on=_eq(col("ss_rev_id"), col("ws_rev_id")),
+                  how="inner")
+            .where(P.And(band(col("ss_rev"), col("cs_rev")),
+                         P.And(band(col("ss_rev"), col("ws_rev")),
+                               P.And(band(col("cs_rev"), col("ss_rev")),
+                                     band(col("ws_rev"),
+                                          col("ss_rev"))))))
+            .select(col("ss_rev_id").alias("item_id"), col("ss_rev"),
+                    col("cs_rev"), col("ws_rev"))
+            .sort(SortOrder(col("item_id")), SortOrder(col("ss_rev")))
+            .limit(100))
+
+
+def q60(t):
+    """Q60: item revenue across three channels for a month + timezone
+    (q33's shape grouped by item id)."""
+    def leg(fact, date_col, item_col, price):
+        d = t["date_dim"].where(P.And(_eq(col("d_year"), lit(1998)),
+                                      _eq(col("d_moy"), lit(9))))
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(t["item"].where(_eq(col("i_category"),
+                                          lit("Music"))),
+                      on=_eq(col(item_col), col("i_item_sk")),
+                      how="inner")
+                .group_by(col("i_item_id"))
+                .agg(_sum(col(price), "total_sales"))
+                .select(col("i_item_id"), col("total_sales")))
+
+    return (leg("store_sales", "ss_sold_date_sk", "ss_item_sk",
+                "ss_ext_sales_price")
+            .union(leg("catalog_sales", "cs_sold_date_sk", "cs_item_sk",
+                       "cs_ext_sales_price"))
+            .union(leg("web_sales", "ws_sold_date_sk", "ws_item_sk",
+                       "ws_ext_sales_price"))
+            .group_by(col("i_item_id"))
+            .agg(_sum(col("total_sales"), "total"))
+            .sort(SortOrder(col("i_item_id")), SortOrder(col("total")))
+            .limit(100))
+
+
+def q62(t):
+    """Q62: web shipping-latency buckets by warehouse / ship mode /
+    site."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+    lat = Subtract(col("ws_ship_date_sk"), col("ws_sold_date_sk"))
+
+    def bucket(cond, name):
+        return _sum(If(cond, lit(1), lit(0)), name)
+
+    return (t["web_sales"]
+            .join(d, on=_eq(col("ws_ship_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["warehouse"],
+                  on=_eq(col("ws_warehouse_sk"), col("w_warehouse_sk")),
+                  how="inner")
+            .join(t["ship_mode"],
+                  on=_eq(col("ws_ship_mode_sk"), col("sm_ship_mode_sk")),
+                  how="inner")
+            .join(t["web_site"],
+                  on=_eq(col("ws_web_site_sk"), col("web_site_sk")),
+                  how="inner")
+            .group_by(Substring(col("w_warehouse_name"), lit(1),
+                                lit(20)).alias("wh"),
+                      col("sm_type"), col("web_name"))
+            .agg(bucket(P.LessThanOrEqual(lat, lit(30)), "d30"),
+                 bucket(P.And(P.GreaterThan(lat, lit(30)),
+                              P.LessThanOrEqual(lat, lit(60))), "d60"),
+                 bucket(P.And(P.GreaterThan(lat, lit(60)),
+                              P.LessThanOrEqual(lat, lit(90))), "d90"),
+                 bucket(P.And(P.GreaterThan(lat, lit(90)),
+                              P.LessThanOrEqual(lat, lit(120))), "d120"),
+                 bucket(P.GreaterThan(lat, lit(120)), "d120plus"))
+            .sort(SortOrder(col("wh")), SortOrder(col("sm_type")),
+                  SortOrder(col("web_name")))
+            .limit(100))
+
+
+def q63(t):
+    """Q63: manager monthly sales vs their average (q53's shape by
+    manager)."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+    agg = (t["store_sales"]
+           .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .join(t["item"].where(_between(col("i_manager_id"), lit(20),
+                                          lit(60))),
+                 on=_eq(col("ss_item_sk"), col("i_item_sk")), how="inner")
+           .join(t["store"], on=_eq(col("ss_store_sk"),
+                                    col("s_store_sk")), how="inner")
+           .group_by(col("i_manager_id"), col("d_moy"))
+           .agg(_sum(col("ss_sales_price"), "sum_sales")))
+    w = Window.partition_by("i_manager_id")
+    dev = Divide(Abs(Subtract(col("sum_sales"), col("avg_monthly"))),
+                 col("avg_monthly"))
+    return (agg
+            .with_column("avg_monthly", over(A.Average(col("sum_sales")),
+                                             w))
+            .where(P.GreaterThan(col("avg_monthly"), lit(0.0)))
+            .where(P.GreaterThan(dev, lit(0.1)))
+            .select(col("i_manager_id"), col("sum_sales"),
+                    col("avg_monthly"))
+            .sort(SortOrder(col("i_manager_id")),
+                  SortOrder(col("avg_monthly")),
+                  SortOrder(col("sum_sales")))
+            .limit(100))
+
+
+def q66(t):
+    """Q66: warehouse monthly sales pivot, web + catalog legs unioned."""
+    d = t["date_dim"].where(_eq(col("d_year"), lit(1998)))
+    tm = t["time_dim"].where(_between(col("t_hour"), lit(8), lit(16)))
+    sm = t["ship_mode"].where(P.In(col("sm_carrier"), ["UPS", "DHL"]))
+
+    def leg(fact, date_col, time_col, sm_col, wh_col, qty, price):
+        months = [_sum(If(_eq(col("d_moy"), lit(m + 1)),
+                          Multiply(Cast(col(qty), T.DOUBLE), col(price)),
+                          lit(0.0)), f"m{m + 1}_sales")
+                  for m in range(12)]
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(tm, on=_eq(col(time_col), col("t_time_sk")),
+                      how="inner")
+                .join(sm, on=_eq(col(sm_col), col("sm_ship_mode_sk")),
+                      how="left_semi")
+                .join(t["warehouse"],
+                      on=_eq(col(wh_col), col("w_warehouse_sk")),
+                      how="inner")
+                .group_by(col("w_warehouse_name"),
+                          col("w_warehouse_sq_ft"), col("w_city"),
+                          col("w_county"), col("w_state"),
+                          col("w_country"))
+                .agg(*months))
+
+    web = leg("web_sales", "ws_sold_date_sk", "ws_sold_time_sk",
+              "ws_ship_mode_sk", "ws_warehouse_sk", "ws_quantity",
+              "ws_ext_sales_price")
+    cat = leg("catalog_sales", "cs_sold_date_sk", "cs_sold_time_sk",
+              "cs_ship_mode_sk", "cs_warehouse_sk", "cs_quantity",
+              "cs_ext_sales_price")
+    months = [_sum(col(f"m{m + 1}_sales"), f"tot_m{m + 1}")
+              for m in range(12)]
+    return (web.union(cat)
+            .group_by(col("w_warehouse_name"), col("w_warehouse_sq_ft"),
+                      col("w_city"), col("w_county"), col("w_state"),
+                      col("w_country"))
+            .agg(*months)
+            .sort(SortOrder(col("w_warehouse_name")))
+            .limit(100))
+
+
+def q67(t):
+    """Q67: sales ROLLUP over the full item/date/store hierarchy with a
+    per-category rank window (the widest Expand in the suite)."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+    base = (t["store_sales"]
+            .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["item"], on=_eq(col("ss_item_sk"), col("i_item_sk")),
+                  how="inner")
+            .join(t["store"], on=_eq(col("ss_store_sk"),
+                                     col("s_store_sk")), how="inner")
+            .select(col("i_category"), col("i_class"), col("i_brand"),
+                    col("i_product_name"), col("d_year"), col("d_qoy"),
+                    col("d_moy"), col("s_store_id"),
+                    Multiply(Cast(col("ss_quantity"), T.DOUBLE),
+                             col("ss_sales_price")).alias("sales_amt")))
+    agg = (base
+           .rollup("i_category", "i_class", "i_brand", "i_product_name",
+                   "d_year", "d_qoy", "d_moy", "s_store_id")
+           .agg(_sum(col("sales_amt"), "sumsales")))
+    w = (Window.partition_by("i_category")
+         .order_by(SortOrder(col("sumsales"), ascending=False)))
+    return (agg
+            .with_column("rk", over(Rank(), w))
+            .where(P.LessThanOrEqual(col("rk"), lit(10)))
+            .sort(SortOrder(col("i_category")), SortOrder(col("rk")),
+                  SortOrder(col("sumsales"), ascending=False),
+                  SortOrder(col("i_product_name")),
+                  SortOrder(col("s_store_id")))
+            .limit(100))
+
+
+def q69(t):
+    """Q69: demographics of store customers absent from web and catalog
+    in the period (semi + double anti joins)."""
+    d = t["date_dim"].where(P.And(_eq(col("d_year"), lit(1999)),
+                                  P.LessThanOrEqual(col("d_qoy"),
+                                                    lit(2))))
+    ss_cust = (t["store_sales"]
+               .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                     how="inner")
+               .select(col("ss_customer_sk").alias("active_sk"))
+               .distinct())
+    ws_cust = (t["web_sales"]
+               .join(d, on=_eq(col("ws_sold_date_sk"), col("d_date_sk")),
+                     how="inner")
+               .select(col("ws_bill_customer_sk").alias("web_sk"))
+               .distinct())
+    cs_cust = (t["catalog_sales"]
+               .join(d, on=_eq(col("cs_sold_date_sk"), col("d_date_sk")),
+                     how="inner")
+               .select(col("cs_bill_customer_sk").alias("cat_sk"))
+               .distinct())
+    return (t["customer"]
+            .join(t["customer_address"].where(P.In(col("ca_state"),
+                                                   ["KY", "GA", "NM"])),
+                  on=_eq(col("c_current_addr_sk"), col("ca_address_sk")),
+                  how="inner")
+            .join(ss_cust, on=_eq(col("c_customer_sk"), col("active_sk")),
+                  how="left_semi")
+            .join(ws_cust, on=_eq(col("c_customer_sk"), col("web_sk")),
+                  how="left_anti")
+            .join(cs_cust, on=_eq(col("c_customer_sk"), col("cat_sk")),
+                  how="left_anti")
+            .join(t["customer_demographics"],
+                  on=_eq(col("c_current_cdemo_sk"), col("cd_demo_sk")),
+                  how="inner")
+            .group_by(col("cd_gender"), col("cd_marital_status"),
+                      col("cd_education_status"))
+            .agg(_cnt("cnt"))
+            .sort(SortOrder(col("cd_gender")),
+                  SortOrder(col("cd_marital_status")),
+                  SortOrder(col("cd_education_status")))
+            .limit(100))
+
+
+def q70(t):
+    """Q70: profit ROLLUP over state/county, restricted to the top-5
+    profit states (rank-window subquery gate)."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+    state_rank_w = Window.partition_by().order_by(
+        SortOrder(col("state_profit"), ascending=False))
+    top_states = (t["store_sales"]
+                  .join(d, on=_eq(col("ss_sold_date_sk"),
+                                  col("d_date_sk")), how="inner")
+                  .join(t["store"], on=_eq(col("ss_store_sk"),
+                                           col("s_store_sk")),
+                        how="inner")
+                  .group_by(col("s_state"))
+                  .agg(_sum(col("ss_net_profit"), "state_profit"))
+                  .with_column("state_rank", over(Rank(), state_rank_w))
+                  .where(P.LessThanOrEqual(col("state_rank"), lit(5)))
+                  .select(col("s_state").alias("top_state")))
+    base = (t["store_sales"]
+            .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["store"], on=_eq(col("ss_store_sk"),
+                                     col("s_store_sk")), how="inner")
+            .join(top_states, on=_eq(col("s_state"), col("top_state")),
+                  how="left_semi"))
+    return (base
+            .rollup("s_state", "s_county", grouping_id="lochierarchy")
+            .agg(_sum(col("ss_net_profit"), "total_sum"))
+            .sort(SortOrder(col("lochierarchy"), ascending=False),
+                  SortOrder(col("s_state")), SortOrder(col("s_county")),
+                  SortOrder(col("total_sum")))
+            .limit(100))
+
+
+def q71(t):
+    """Q71: brand revenue during breakfast/dinner hours across all three
+    channels."""
+    d = t["date_dim"].where(P.And(_eq(col("d_moy"), lit(12)),
+                                  _eq(col("d_year"), lit(1998))))
+    tm = t["time_dim"].where(P.In(col("t_hour"), [8, 9, 17, 18]))
+    item = t["item"].where(_eq(col("i_manager_id"), lit(1)))
+
+    def leg(fact, date_col, time_col, item_col, price):
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(item, on=_eq(col(item_col), col("i_item_sk")),
+                      how="inner")
+                .select(col("i_brand_id"), col("i_brand"),
+                        col(time_col).alias("time_sk"),
+                        col(price).alias("ext_price")))
+
+    allc = (leg("web_sales", "ws_sold_date_sk", "ws_sold_time_sk",
+                "ws_item_sk", "ws_ext_sales_price")
+            .union(leg("catalog_sales", "cs_sold_date_sk",
+                       "cs_sold_time_sk", "cs_item_sk",
+                       "cs_ext_sales_price"))
+            .union(leg("store_sales", "ss_sold_date_sk",
+                       "ss_sold_time_sk", "ss_item_sk",
+                       "ss_ext_sales_price")))
+    return (allc
+            .join(tm, on=_eq(col("time_sk"), col("t_time_sk")),
+                  how="inner")
+            .group_by(col("i_brand_id"), col("i_brand"), col("t_hour"),
+                      col("t_minute"))
+            .agg(_sum(col("ext_price"), "ext_price_sum"))
+            .sort(SortOrder(col("ext_price_sum"), ascending=False),
+                  SortOrder(col("i_brand_id")), SortOrder(col("t_hour")),
+                  SortOrder(col("t_minute")))
+            .limit(100))
+
+
+def q73(t):
+    """Q73: households with 1-5 tickets under demographic gates."""
+    d = t["date_dim"].where(P.In(col("d_year"), [1998, 1999]))
+    hd = t["household_demographics"].where(P.And(
+        P.In(col("hd_buy_potential"), [">10000", "Unknown"]),
+        P.GreaterThan(col("hd_vehicle_count"), lit(0))))
+    tickets = (t["store_sales"]
+               .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                     how="inner")
+               .join(hd, on=_eq(col("ss_hdemo_sk"), col("hd_demo_sk")),
+                     how="inner")
+               .join(t["store"].where(P.In(col("s_county"),
+                                           ["Fairview County",
+                                            "Midway County",
+                                            "Riverside County"])),
+                     on=_eq(col("ss_store_sk"), col("s_store_sk")),
+                     how="left_semi")
+               .group_by(col("ss_ticket_number"), col("ss_customer_sk"))
+               .agg(_cnt("cnt"))
+               .where(_between(col("cnt"), lit(1), lit(5))))
+    return (tickets
+            .join(t["customer"],
+                  on=_eq(col("ss_customer_sk"), col("c_customer_sk")),
+                  how="inner")
+            .select(col("c_last_name"), col("c_first_name"),
+                    col("c_salutation"), col("c_preferred_cust_flag"),
+                    col("ss_ticket_number"), col("cnt"))
+            .sort(SortOrder(col("cnt"), ascending=False),
+                  SortOrder(col("c_last_name")),
+                  SortOrder(col("ss_ticket_number")))
+            .limit(100))
+
+
+def q74(t):
+    """Q74: q11's year-over-year growth comparison on net paid."""
+    def year_total(sales, cust, date, price, year, name):
+        d = t["date_dim"].where(_eq(col("d_year"), lit(year)))
+        return (t[sales]
+                .join(d, on=_eq(col(date), col("d_date_sk")), how="inner")
+                .group_by(col(cust))
+                .agg(_sum(col(price), name))
+                .select(col(cust).alias(name + "_cust"), col(name)))
+
+    ss1 = year_total("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                     "ss_net_paid", 1998, "ss_y1")
+    ss2 = year_total("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                     "ss_net_paid", 1999, "ss_y2")
+    ws1 = year_total("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                     "ws_net_paid", 1998, "ws_y1")
+    ws2 = year_total("web_sales", "ws_bill_customer_sk", "ws_sold_date_sk",
+                     "ws_net_paid", 1999, "ws_y2")
+    return (ss1
+            .join(ss2, on=_eq(col("ss_y1_cust"), col("ss_y2_cust")),
+                  how="inner")
+            .join(ws1, on=_eq(col("ss_y1_cust"), col("ws_y1_cust")),
+                  how="inner")
+            .join(ws2, on=_eq(col("ss_y1_cust"), col("ws_y2_cust")),
+                  how="inner")
+            .where(P.And(P.GreaterThan(col("ss_y1"), lit(0.0)),
+                         P.GreaterThan(col("ws_y1"), lit(0.0))))
+            .where(P.GreaterThan(Divide(col("ws_y2"), col("ws_y1")),
+                                 Divide(col("ss_y2"), col("ss_y1"))))
+            .join(t["customer"],
+                  on=_eq(col("ss_y1_cust"), col("c_customer_sk")),
+                  how="inner")
+            .select(col("c_customer_id"), col("c_first_name"),
+                    col("c_last_name"))
+            .sort(SortOrder(col("c_customer_id")))
+            .limit(100))
+
+
+def q76(t):
+    """Q76: sales rows with NULL foreign keys counted per channel."""
+    def leg(fact, null_col, date_col, item_col, price, channel):
+        return (t[fact]
+                .where(P.IsNull(col(null_col)))
+                .join(t["item"], on=_eq(col(item_col), col("i_item_sk")),
+                      how="inner")
+                .join(t["date_dim"],
+                      on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .select(lit(channel).alias("channel"),
+                        lit(null_col).alias("col_name"), col("d_year"),
+                        col("d_qoy"), col("i_category"),
+                        col(price).alias("ext_sales_price")))
+
+    allc = (leg("store_sales", "ss_promo_sk", "ss_sold_date_sk",
+                "ss_item_sk", "ss_ext_sales_price", "store")
+            .union(leg("web_sales", "ws_ship_customer_sk",
+                       "ws_sold_date_sk", "ws_item_sk",
+                       "ws_ext_sales_price", "web"))
+            .union(leg("catalog_sales", "cs_ship_addr_sk",
+                       "cs_sold_date_sk", "cs_item_sk",
+                       "cs_ext_sales_price", "catalog")))
+    return (allc
+            .group_by(col("channel"), col("col_name"), col("d_year"),
+                      col("d_qoy"), col("i_category"))
+            .agg(_cnt("sales_cnt"),
+                 _sum(col("ext_sales_price"), "sales_amt"))
+            .sort(SortOrder(col("channel")), SortOrder(col("col_name")),
+                  SortOrder(col("d_year")), SortOrder(col("d_qoy")),
+                  SortOrder(col("i_category")))
+            .limit(100))
+
+
+def q77(t):
+    """Q77: per-channel sales & returns profit with a channel/id ROLLUP
+    grand total (Expand over a three-channel union)."""
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(730), lit(760)))
+
+    def sales_leg(fact, date_col, key, price, profit, key_out):
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .group_by(col(key))
+                .agg(_sum(col(price), "sales"),
+                     _sum(col(profit), "profit"))
+                .select(col(key).alias(key_out), col("sales"),
+                        col("profit")))
+
+    def returns_leg(fact, date_col, key, amt, loss, key_out):
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .group_by(col(key))
+                .agg(_sum(col(amt), "returns_"),
+                     _sum(col(loss), "profit_loss"))
+                .select(col(key).alias(key_out), col("returns_"),
+                        col("profit_loss")))
+
+    ss = sales_leg("store_sales", "ss_sold_date_sk", "ss_store_sk",
+                   "ss_ext_sales_price", "ss_net_profit", "ss_key")
+    sr = returns_leg("store_returns", "sr_returned_date_sk", "sr_store_sk",
+                     "sr_return_amt", "sr_net_loss", "sr_key")
+    store_ch = (ss.join(sr, on=_eq(col("ss_key"), col("sr_key")),
+                        how="left")
+                .select(lit("store channel").alias("channel"),
+                        col("ss_key").alias("id"), col("sales"),
+                        Coalesce(col("returns_"),
+                                 lit(0.0)).alias("returns_"),
+                        Subtract(col("profit"),
+                                 Coalesce(col("profit_loss"),
+                                          lit(0.0))).alias("profit")))
+    cs = sales_leg("catalog_sales", "cs_sold_date_sk",
+                   "cs_call_center_sk", "cs_ext_sales_price",
+                   "cs_net_profit", "cs_key")
+    cr = returns_leg("catalog_returns", "cr_returned_date_sk",
+                     "cr_call_center_sk", "cr_return_amount",
+                     "cr_net_loss", "cr_key")
+    cat_ch = (cs.join(cr, on=_eq(col("cs_key"), col("cr_key")),
+                      how="left")
+              .select(lit("catalog channel").alias("channel"),
+                      col("cs_key").alias("id"), col("sales"),
+                      Coalesce(col("returns_"),
+                               lit(0.0)).alias("returns_"),
+                      Subtract(col("profit"),
+                               Coalesce(col("profit_loss"),
+                                        lit(0.0))).alias("profit")))
+    ws = sales_leg("web_sales", "ws_sold_date_sk", "ws_web_page_sk",
+                   "ws_ext_sales_price", "ws_net_profit", "ws_key")
+    wr = returns_leg("web_returns", "wr_returned_date_sk",
+                     "wr_web_page_sk", "wr_return_amt", "wr_net_loss",
+                     "wr_key")
+    web_ch = (ws.join(wr, on=_eq(col("ws_key"), col("wr_key")),
+                      how="left")
+              .select(lit("web channel").alias("channel"),
+                      col("ws_key").alias("id"), col("sales"),
+                      Coalesce(col("returns_"),
+                               lit(0.0)).alias("returns_"),
+                      Subtract(col("profit"),
+                               Coalesce(col("profit_loss"),
+                                        lit(0.0))).alias("profit")))
+    return (store_ch.union(cat_ch).union(web_ch)
+            .rollup("channel", "id")
+            .agg(_sum(col("sales"), "sales_sum"),
+                 _sum(col("returns_"), "returns_sum"),
+                 _sum(col("profit"), "profit_sum"))
+            .sort(SortOrder(col("channel")), SortOrder(col("id")),
+                  SortOrder(col("sales_sum")))
+            .limit(100))
+
+
+def q80(t):
+    """Q80: channel sales net of returns with promo gate and a
+    channel/id ROLLUP."""
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(730), lit(760)))
+    promo = t["promotion"].where(_eq(col("p_channel_email"), lit("N")))
+
+    def leg(fact, ret, date_col, key, item_col, promo_col, price, profit,
+            r_key1, r_key2, s_key1, s_key2, r_amt, r_loss, label, id_col):
+        r = t[ret].select(col(r_key1).alias("rk1"),
+                          col(r_key2).alias("rk2"),
+                          col(r_amt).alias("r_amt"),
+                          col(r_loss).alias("r_loss"))
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(t["item"].where(P.GreaterThan(
+                    col("i_current_price"), lit(50.0))),
+                    on=_eq(col(item_col), col("i_item_sk")), how="inner")
+                .join(promo, on=_eq(col(promo_col), col("p_promo_sk")),
+                      how="left_semi")
+                .join(r, on=P.And(_eq(col(s_key1), col("rk1")),
+                                  _eq(col(s_key2), col("rk2"))),
+                      how="left")
+                .group_by(col(key))
+                .agg(_sum(col(price), "sales"),
+                     _sum(Coalesce(col("r_amt"), lit(0.0)), "returns_"),
+                     _sum(Subtract(col(profit),
+                                   Coalesce(col("r_loss"), lit(0.0))),
+                          "profit"))
+                .select(lit(label).alias("channel"),
+                        col(key).alias("id"), col("sales"),
+                        col("returns_"), col("profit")))
+
+    store = leg("store_sales", "store_returns", "ss_sold_date_sk",
+                "ss_store_sk", "ss_item_sk", "ss_promo_sk",
+                "ss_ext_sales_price", "ss_net_profit",
+                "sr_ticket_number", "sr_item_sk", "ss_ticket_number",
+                "ss_item_sk", "sr_return_amt", "sr_net_loss",
+                "store channel", "ss_store_sk")
+    cat = leg("catalog_sales", "catalog_returns", "cs_sold_date_sk",
+              "cs_catalog_page_sk", "cs_item_sk", "cs_promo_sk",
+              "cs_ext_sales_price", "cs_net_profit",
+              "cr_order_number", "cr_item_sk", "cs_order_number",
+              "cs_item_sk", "cr_return_amount", "cr_net_loss",
+              "catalog channel", "cs_catalog_page_sk")
+    web = leg("web_sales", "web_returns", "ws_sold_date_sk",
+              "ws_web_site_sk", "ws_item_sk", "ws_promo_sk",
+              "ws_ext_sales_price", "ws_net_profit",
+              "wr_order_number", "wr_item_sk", "ws_order_number",
+              "ws_item_sk", "wr_return_amt", "wr_net_loss",
+              "web channel", "ws_web_site_sk")
+    return (store.union(cat).union(web)
+            .rollup("channel", "id")
+            .agg(_sum(col("sales"), "sales_sum"),
+                 _sum(col("returns_"), "returns_sum"),
+                 _sum(col("profit"), "profit_sum"))
+            .sort(SortOrder(col("channel")), SortOrder(col("id")),
+                  SortOrder(col("sales_sum")))
+            .limit(100))
+
+
+def q78(t):
+    """Q78: yearly customer/item sales excluding returned lines across
+    store and web channels (anti joins + per-year aggregate join)."""
+    d = t["date_dim"].where(_eq(col("d_year"), lit(1998)))
+    ss = (t["store_sales"]
+          .join(t["store_returns"]
+                .select(col("sr_ticket_number").alias("r_tick"),
+                        col("sr_item_sk").alias("r_item")),
+                on=P.And(_eq(col("ss_ticket_number"), col("r_tick")),
+                         _eq(col("ss_item_sk"), col("r_item"))),
+                how="left_anti")
+          .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                how="inner")
+          .group_by(col("ss_customer_sk"), col("ss_item_sk"))
+          .agg(_sum(Cast(col("ss_quantity"), T.DOUBLE), "ss_qty"),
+               _sum(col("ss_wholesale_cost"), "ss_wc"),
+               _sum(col("ss_sales_price"), "ss_sp"))
+          .select(col("ss_customer_sk").alias("ss_cust"),
+                  col("ss_item_sk").alias("ss_item"), col("ss_qty"),
+                  col("ss_wc"), col("ss_sp")))
+    ws = (t["web_sales"]
+          .join(t["web_returns"]
+                .select(col("wr_order_number").alias("r_ord"),
+                        col("wr_item_sk").alias("r_item")),
+                on=P.And(_eq(col("ws_order_number"), col("r_ord")),
+                         _eq(col("ws_item_sk"), col("r_item"))),
+                how="left_anti")
+          .join(d, on=_eq(col("ws_sold_date_sk"), col("d_date_sk")),
+                how="inner")
+          .group_by(col("ws_bill_customer_sk"), col("ws_item_sk"))
+          .agg(_sum(Cast(col("ws_quantity"), T.DOUBLE), "ws_qty"),
+               _sum(col("ws_wholesale_cost"), "ws_wc"),
+               _sum(col("ws_sales_price"), "ws_sp"))
+          .select(col("ws_bill_customer_sk").alias("ws_cust"),
+                  col("ws_item_sk").alias("ws_item"), col("ws_qty"),
+                  col("ws_wc"), col("ws_sp")))
+    return (ss
+            .join(ws, on=P.And(_eq(col("ss_cust"), col("ws_cust")),
+                               _eq(col("ss_item"), col("ws_item"))),
+                  how="inner")
+            .where(P.GreaterThan(col("ws_qty"), lit(0.0)))
+            .select(col("ss_cust"), col("ss_item"),
+                    Divide(col("ss_qty"),
+                           col("ws_qty")).alias("ratio"),
+                    col("ss_qty"), col("ss_wc"), col("ss_sp"))
+            .sort(SortOrder(col("ss_qty"), ascending=False),
+                  SortOrder(col("ss_wc")), SortOrder(col("ss_cust")),
+                  SortOrder(col("ss_item")))
+            .limit(100))
+
+
+def q81(t):
+    """Q81: catalog-return customers above 1.2x their state average
+    (q30's shape on the catalog channel)."""
+    d = t["date_dim"].where(_eq(col("d_year"), lit(2000)))
+    ctr = (t["catalog_returns"]
+           .join(d, on=_eq(col("cr_returned_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .join(t["customer_address"],
+                 on=_eq(col("cr_returning_addr_sk"), col("ca_address_sk")),
+                 how="inner")
+           .group_by(col("cr_returning_customer_sk"), col("ca_state"))
+           .agg(_sum(col("cr_return_amount"), "ctr_total"))
+           .select(col("cr_returning_customer_sk").alias("ctr_cust"),
+                   col("ca_state").alias("ctr_state"), col("ctr_total")))
+    avg_state = (ctr.group_by(col("ctr_state"))
+                 .agg(_avg(col("ctr_total"), "state_avg"))
+                 .select(col("ctr_state").alias("avg_state"),
+                         col("state_avg")))
+    return (ctr
+            .join(avg_state, on=_eq(col("ctr_state"), col("avg_state")),
+                  how="inner")
+            .where(P.GreaterThan(col("ctr_total"),
+                                 Multiply(lit(1.2), col("state_avg"))))
+            .join(t["customer"],
+                  on=_eq(col("ctr_cust"), col("c_customer_sk")),
+                  how="inner")
+            .select(col("c_customer_id"), col("c_first_name"),
+                    col("c_last_name"), col("ctr_total"))
+            .sort(SortOrder(col("c_customer_id")),
+                  SortOrder(col("ctr_total")))
+            .limit(100))
+
+
+def q82(t):
+    """Q82: q37's inventory-gated item list for the store channel."""
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(700), lit(760)))
+    inv_ok = (t["inventory"]
+              .where(_between(col("inv_quantity_on_hand"), lit(100),
+                              lit(500)))
+              .join(d, on=_eq(col("inv_date_sk"), col("d_date_sk")),
+                    how="inner")
+              .select(col("inv_item_sk")).distinct())
+    return (t["item"]
+            .where(_between(col("i_current_price"), lit(30.0), lit(60.0)))
+            .where(_between(col("i_manufact_id"), lit(10), lit(50)))
+            .join(inv_ok, on=_eq(col("i_item_sk"), col("inv_item_sk")),
+                  how="left_semi")
+            .join(t["store_sales"],
+                  on=_eq(col("i_item_sk"), col("ss_item_sk")),
+                  how="left_semi")
+            .group_by(col("i_item_id"))
+            .agg(A.AggregateExpression(A.Min(col("i_current_price")),
+                                       "min_price"))
+            .sort(SortOrder(col("i_item_id")))
+            .limit(100))
+
+
+def q83(t):
+    """Q83: matched item return quantities across the three return
+    channels with mutual share ratios."""
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(720), lit(750)))
+
+    def leg(fact, date_col, item_col, qty, name):
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(t["item"], on=_eq(col(item_col), col("i_item_sk")),
+                      how="inner")
+                .group_by(col("i_item_id"))
+                .agg(_sum(Cast(col(qty), T.DOUBLE), name))
+                .select(col("i_item_id").alias(name + "_id"), col(name)))
+
+    sr = leg("store_returns", "sr_returned_date_sk", "sr_item_sk",
+             "sr_return_quantity", "sr_qty")
+    cr = leg("catalog_returns", "cr_returned_date_sk", "cr_item_sk",
+             "cr_return_quantity", "cr_qty")
+    wr = leg("web_returns", "wr_returned_date_sk", "wr_item_sk",
+             "wr_return_quantity", "wr_qty")
+    total = Add(Add(col("sr_qty"), col("cr_qty")), col("wr_qty"))
+    third = Divide(Cast(total, T.DOUBLE), lit(3.0))
+    return (sr
+            .join(cr, on=_eq(col("sr_qty_id"), col("cr_qty_id")),
+                  how="inner")
+            .join(wr, on=_eq(col("sr_qty_id"), col("wr_qty_id")),
+                  how="inner")
+            .select(col("sr_qty_id").alias("item_id"), col("sr_qty"),
+                    col("cr_qty"), col("wr_qty"),
+                    Multiply(Divide(col("sr_qty"), total),
+                             lit(100.0)).alias("sr_dev"),
+                    third.alias("average"))
+            .sort(SortOrder(col("item_id")), SortOrder(col("sr_qty")))
+            .limit(100))
+
+
+def q85(t):
+    """Q85: web-return reason stats under paired-demographics and
+    address gates."""
+    cd1 = (t["customer_demographics"]
+           .select(col("cd_demo_sk").alias("cd1_sk"),
+                   col("cd_marital_status").alias("cd1_marital"),
+                   col("cd_education_status").alias("cd1_edu")))
+    cd2 = (t["customer_demographics"]
+           .select(col("cd_demo_sk").alias("cd2_sk"),
+                   col("cd_marital_status").alias("cd2_marital"),
+                   col("cd_education_status").alias("cd2_edu")))
+    d = t["date_dim"].where(_eq(col("d_year"), lit(1998)))
+    return (t["web_sales"]
+            .join(t["web_returns"],
+                  on=P.And(_eq(col("ws_order_number"),
+                               col("wr_order_number")),
+                           _eq(col("ws_item_sk"), col("wr_item_sk"))),
+                  how="inner")
+            .join(d, on=_eq(col("ws_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["web_page"],
+                  on=_eq(col("ws_web_page_sk"), col("wp_web_page_sk")),
+                  how="inner")
+            .join(cd1, on=_eq(col("wr_refunded_cdemo_sk"), col("cd1_sk")),
+                  how="inner")
+            .join(cd2, on=_eq(col("wr_returning_cdemo_sk"),
+                              col("cd2_sk")), how="inner")
+            .join(t["customer_address"].where(P.In(col("ca_state"),
+                                                   ["CA", "TX", "OH"])),
+                  on=_eq(col("wr_refunded_addr_sk"), col("ca_address_sk")),
+                  how="inner")
+            .join(t["reason"],
+                  on=_eq(col("wr_reason_sk"), col("r_reason_sk")),
+                  how="inner")
+            .where(P.And(_eq(col("cd1_marital"), col("cd2_marital")),
+                         _eq(col("cd1_edu"), col("cd2_edu"))))
+            .group_by(col("r_reason_desc"))
+            .agg(_avg(col("ws_quantity"), "avg_qty"),
+                 _avg(col("wr_refunded_cash"), "avg_refund"),
+                 _avg(col("wr_fee"), "avg_fee"))
+            .sort(SortOrder(col("r_reason_desc")))
+            .limit(100))
+
+
+def q86(t):
+    """Q86: web net-paid ROLLUP over category/class with the per-level
+    rank window (q36's shape on the web channel)."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+    agg = (t["web_sales"]
+           .join(d, on=_eq(col("ws_sold_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .join(t["item"], on=_eq(col("ws_item_sk"), col("i_item_sk")),
+                 how="inner")
+           .rollup("i_category", "i_class", grouping_id="lochierarchy")
+           .agg(_sum(col("ws_net_paid"), "total_sum")))
+    w = (Window.partition_by(col("lochierarchy"), If(
+        _eq(col("lochierarchy"), lit(1)), col("i_category"), lit("")))
+        .order_by(SortOrder(col("total_sum"), ascending=False)))
+    return (agg
+            .with_column("rank_within_parent", over(Rank(), w))
+            .sort(SortOrder(col("lochierarchy"), ascending=False),
+                  SortOrder(col("i_category")),
+                  SortOrder(col("rank_within_parent")))
+            .limit(100))
+
+
+def q87(t):
+    """Q87: customers in the store channel but NOT catalog or web
+    (EXCEPT -> anti-join chain), counted."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+
+    def leg(fact, date_col, cust_col):
+        return (t[fact]
+                .join(d, on=_eq(col(date_col), col("d_date_sk")),
+                      how="inner")
+                .join(t["customer"],
+                      on=_eq(col(cust_col), col("c_customer_sk")),
+                      how="inner")
+                .select(col("c_last_name"), col("c_first_name"),
+                        col("d_date"))
+                .distinct())
+
+    ss = leg("store_sales", "ss_sold_date_sk", "ss_customer_sk")
+    cs = leg("catalog_sales", "cs_sold_date_sk", "cs_bill_customer_sk")
+    ws = leg("web_sales", "ws_sold_date_sk", "ws_bill_customer_sk")
+    keys = ["c_last_name", "c_first_name", "d_date"]
+    remaining = (ss.join(cs, on=keys, how="left_anti")
+                 .join(ws, on=keys, how="left_anti"))
+    return remaining.group_by().agg(_cnt("num_cool"))
+
+
+def q88(t):
+    """Q88: store traffic in eight half-hour slots (eight 1-row counts
+    cross-joined)."""
+    hd = t["household_demographics"].where(P.Or(
+        _eq(col("hd_dep_count"), lit(3)),
+        _eq(col("hd_vehicle_count"), lit(1))))
+    store = t["store"].where(_eq(col("s_store_name"), lit("able0")))
+    slots = [(8, 30), (9, 0), (9, 30), (10, 0), (10, 30), (11, 0),
+             (11, 30), (12, 0)]
+    legs = None
+    for i, (h, m) in enumerate(slots, 1):
+        tm = t["time_dim"].where(P.And(
+            _eq(col("t_hour"), lit(h)),
+            _between(col("t_minute"), lit(m), lit(m + 29))))
+        leg = (t["store_sales"]
+               .join(hd, on=_eq(col("ss_hdemo_sk"), col("hd_demo_sk")),
+                     how="left_semi")
+               .join(tm, on=_eq(col("ss_sold_time_sk"), col("t_time_sk")),
+                     how="left_semi")
+               .join(store, on=_eq(col("ss_store_sk"), col("s_store_sk")),
+                     how="left_semi")
+               .group_by().agg(_cnt(f"h{i}")))
+        legs = leg if legs is None else legs.join(leg, how="cross")
+    return legs
+
+
+def q89(t):
+    """Q89: monthly class sales deviation from the yearly average
+    (window avg over brand/store partitions)."""
+    d = t["date_dim"].where(_eq(col("d_year"), lit(1998)))
+    cat_ok = P.Or(
+        P.And(P.In(col("i_category"), ["Books", "Electronics", "Sports"]),
+              P.In(col("i_class"), ["fiction", "portable", "football"])),
+        P.And(P.In(col("i_category"), ["Men", "Jewelry", "Women"]),
+              P.In(col("i_class"), ["accent", "diamonds", "dresses"])))
+    agg = (t["store_sales"]
+           .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                 how="inner")
+           .join(t["item"].where(cat_ok),
+                 on=_eq(col("ss_item_sk"), col("i_item_sk")), how="inner")
+           .join(t["store"], on=_eq(col("ss_store_sk"),
+                                    col("s_store_sk")), how="inner")
+           .group_by(col("i_category"), col("i_class"), col("i_brand"),
+                     col("s_store_name"), col("s_company_id"),
+                     col("d_moy"))
+           .agg(_sum(col("ss_sales_price"), "sum_sales")))
+    w = Window.partition_by("i_category", "i_brand", "s_store_name",
+                            "s_company_id")
+    return (agg
+            .with_column("avg_monthly_sales",
+                         over(A.Average(col("sum_sales")), w))
+            .where(P.GreaterThan(col("avg_monthly_sales"), lit(0.0)))
+            .where(P.GreaterThan(
+                Divide(Abs(Subtract(col("sum_sales"),
+                                    col("avg_monthly_sales"))),
+                       col("avg_monthly_sales")), lit(0.1)))
+            .sort(SortOrder(Subtract(col("sum_sales"),
+                                     col("avg_monthly_sales"))),
+                  SortOrder(col("s_store_name")),
+                  SortOrder(col("i_category")), SortOrder(col("i_class")),
+                  SortOrder(col("i_brand")), SortOrder(col("d_moy")))
+            .limit(100))
+
+
+def q90(t):
+    """Q90: web AM/PM order ratio (two 1-row counts cross-joined)."""
+    hd = t["household_demographics"].where(_eq(col("hd_dep_count"),
+                                               lit(3)))
+    wp = t["web_page"].where(_between(col("wp_char_count"), lit(2500),
+                                      lit(5500)))
+
+    def leg(h_lo, h_hi, name):
+        tm = t["time_dim"].where(_between(col("t_hour"), lit(h_lo),
+                                          lit(h_hi)))
+        return (t["web_sales"]
+                .join(tm, on=_eq(col("ws_sold_time_sk"),
+                                 col("t_time_sk")), how="left_semi")
+                .join(hd, on=_eq(col("ws_bill_hdemo_sk"),
+                                 col("hd_demo_sk")), how="left_semi")
+                .join(wp, on=_eq(col("ws_web_page_sk"),
+                                 col("wp_web_page_sk")), how="left_semi")
+                .group_by().agg(_cnt(name)))
+
+    am = leg(8, 9, "amc")
+    pm = leg(19, 20, "pmc")
+    return (am.join(pm, how="cross")
+            .select(Divide(Cast(col("amc"), T.DOUBLE),
+                           Cast(col("pmc"), T.DOUBLE))
+                    .alias("am_pm_ratio")))
+
+
+def q91(t):
+    """Q91: call-center catalog-return losses by demographic segment."""
+    d = t["date_dim"].where(P.And(_eq(col("d_year"), lit(1999)),
+                                  _eq(col("d_moy"), lit(11))))
+    cd = t["customer_demographics"].where(P.Or(
+        P.And(_eq(col("cd_marital_status"), lit("M")),
+              _eq(col("cd_education_status"), lit("Unknown"))),
+        P.And(_eq(col("cd_marital_status"), lit("W")),
+              _eq(col("cd_education_status"), lit("Advanced Degree")))))
+    hd = t["household_demographics"].where(
+        _eq(col("hd_buy_potential"), lit("Unknown")))
+    ca = t["customer_address"].where(_eq(col("ca_gmt_offset"), lit(-7.0)))
+    return (t["catalog_returns"]
+            .join(d, on=_eq(col("cr_returned_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["call_center"],
+                  on=_eq(col("cr_call_center_sk"),
+                         col("cc_call_center_sk")), how="inner")
+            .join(t["customer"],
+                  on=_eq(col("cr_returning_customer_sk"),
+                         col("c_customer_sk")), how="inner")
+            .join(cd, on=_eq(col("c_current_cdemo_sk"), col("cd_demo_sk")),
+                  how="inner")
+            .join(hd, on=_eq(col("c_current_hdemo_sk"),
+                             col("hd_demo_sk")), how="left_semi")
+            .join(ca, on=_eq(col("c_current_addr_sk"),
+                             col("ca_address_sk")), how="left_semi")
+            .group_by(col("cc_call_center_id"), col("cc_name"),
+                      col("cc_manager"), col("cd_marital_status"),
+                      col("cd_education_status"))
+            .agg(_sum(col("cr_net_loss"), "returns_loss"))
+            .sort(SortOrder(col("returns_loss"), ascending=False),
+                  SortOrder(col("cc_call_center_id")))
+            .limit(100))
+
+
+def q92(t):
+    """Q92: excess web discount vs 1.3x the item average (q32's shape on
+    the web channel)."""
+    d = t["date_dim"].where(_between(col("d_date_sk"), lit(700), lit(790)))
+    base = (t["web_sales"]
+            .join(d, on=_eq(col("ws_sold_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["item"].where(_between(col("i_manufact_id"), lit(20),
+                                           lit(40))),
+                  on=_eq(col("ws_item_sk"), col("i_item_sk")),
+                  how="inner"))
+    item_avg = (base.group_by(col("ws_item_sk"))
+                .agg(_avg(col("ws_ext_discount_amt"), "disc_avg"))
+                .select(col("ws_item_sk").alias("ia_item"),
+                        col("disc_avg")))
+    return (base
+            .join(item_avg, on=_eq(col("ws_item_sk"), col("ia_item")),
+                  how="inner")
+            .where(P.GreaterThan(col("ws_ext_discount_amt"),
+                                 Multiply(lit(1.3), col("disc_avg"))))
+            .group_by()
+            .agg(_sum(col("ws_ext_discount_amt"), "excess_discount")))
+
+
+def q93(t):
+    """Q93: per-customer net sales with returned quantities backed out
+    (left join to returns via a reason gate)."""
+    r = (t["store_returns"]
+         .join(t["reason"].where(_eq(col("r_reason_desc"),
+                                     lit("reason 28"))),
+               on=_eq(col("sr_reason_sk"), col("r_reason_sk")),
+               how="left_semi")
+         .select(col("sr_ticket_number").alias("r_tick"),
+                 col("sr_item_sk").alias("r_item"),
+                 col("sr_return_quantity")))
+    act = If(P.IsNull(col("sr_return_quantity")),
+             Multiply(Cast(col("ss_quantity"), T.DOUBLE),
+                      col("ss_sales_price")),
+             Multiply(Cast(Subtract(col("ss_quantity"),
+                                    col("sr_return_quantity")), T.DOUBLE),
+                      col("ss_sales_price")))
+    return (t["store_sales"]
+            .join(r, on=P.And(_eq(col("ss_ticket_number"), col("r_tick")),
+                              _eq(col("ss_item_sk"), col("r_item"))),
+                  how="left")
+            .group_by(col("ss_customer_sk"))
+            .agg(_sum(act, "sumsales"))
+            .sort(SortOrder(col("sumsales")),
+                  SortOrder(col("ss_customer_sk")))
+            .limit(100))
+
+
+def q97(t):
+    """Q97: customer/item overlap between store and catalog channels
+    (full outer join on distinct month pairs)."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+    ss = (t["store_sales"]
+          .join(d, on=_eq(col("ss_sold_date_sk"), col("d_date_sk")),
+                how="inner")
+          .select(col("ss_customer_sk").alias("ss_cust"),
+                  col("ss_item_sk").alias("ss_item")).distinct())
+    cs = (t["catalog_sales"]
+          .join(d, on=_eq(col("cs_sold_date_sk"), col("d_date_sk")),
+                how="inner")
+          .select(col("cs_bill_customer_sk").alias("cs_cust"),
+                  col("cs_item_sk").alias("cs_item")).distinct())
+    joined = ss.join(cs, on=P.And(_eq(col("ss_cust"), col("cs_cust")),
+                                  _eq(col("ss_item"), col("cs_item"))),
+                     how="full")
+    return (joined.group_by()
+            .agg(_sum(If(P.And(P.IsNotNull(col("ss_cust")),
+                               P.IsNull(col("cs_cust"))),
+                         lit(1), lit(0)), "store_only"),
+                 _sum(If(P.And(P.IsNull(col("ss_cust")),
+                               P.IsNotNull(col("cs_cust"))),
+                         lit(1), lit(0)), "catalog_only"),
+                 _sum(If(P.And(P.IsNotNull(col("ss_cust")),
+                               P.IsNotNull(col("cs_cust"))),
+                         lit(1), lit(0)), "store_and_catalog")))
+
+
+def q99(t):
+    """Q99: catalog shipping-latency buckets by warehouse / ship mode /
+    call center (q62's shape on the catalog channel)."""
+    d = t["date_dim"].where(_between(col("d_month_seq"), lit(12), lit(23)))
+    lat = Subtract(col("cs_ship_date_sk"), col("cs_sold_date_sk"))
+
+    def bucket(cond, name):
+        return _sum(If(cond, lit(1), lit(0)), name)
+
+    return (t["catalog_sales"]
+            .join(d, on=_eq(col("cs_ship_date_sk"), col("d_date_sk")),
+                  how="inner")
+            .join(t["warehouse"],
+                  on=_eq(col("cs_warehouse_sk"), col("w_warehouse_sk")),
+                  how="inner")
+            .join(t["ship_mode"],
+                  on=_eq(col("cs_ship_mode_sk"), col("sm_ship_mode_sk")),
+                  how="inner")
+            .join(t["call_center"],
+                  on=_eq(col("cs_call_center_sk"),
+                         col("cc_call_center_sk")), how="inner")
+            .group_by(Substring(col("w_warehouse_name"), lit(1),
+                                lit(20)).alias("wh"),
+                      col("sm_type"), col("cc_name"))
+            .agg(bucket(P.LessThanOrEqual(lat, lit(30)), "d30"),
+                 bucket(P.And(P.GreaterThan(lat, lit(30)),
+                              P.LessThanOrEqual(lat, lit(60))), "d60"),
+                 bucket(P.And(P.GreaterThan(lat, lit(60)),
+                              P.LessThanOrEqual(lat, lit(90))), "d90"),
+                 bucket(P.And(P.GreaterThan(lat, lit(90)),
+                              P.LessThanOrEqual(lat, lit(120))), "d120"),
+                 bucket(P.GreaterThan(lat, lit(120)), "d120plus"))
+            .sort(SortOrder(col("wh")), SortOrder(col("sm_type")),
+                  SortOrder(col("cc_name")))
+            .limit(100))
+
+
 QUERIES = {"q1": q1, "q2": q2, "q3": q3, "q5": q5, "q6": q6, "q7": q7,
            "q8": q8, "q9": q9, "q11": q11, "q12": q12, "q13": q13,
            "q15": q15, "q16": q16, "q17": q17, "q18": q18,
@@ -2438,6 +3770,10 @@ QUERIES = {"q1": q1, "q2": q2, "q3": q3, "q5": q5, "q6": q6, "q7": q7,
            "q30": q30, "q31": q31, "q32": q32, "q33": q33,
            "q34": q34, "q36": q36, "q37": q37, "q38": q38, "q39": q39,
            "q40": q40, "q41": q41, "q42": q42, "q43": q43, "q44": q44,
-           "q46": q46, "q48": q48, "q52": q52,
-           "q55": q55, "q59": q59, "q61": q61, "q65": q65, "q68": q68,
-           "q79": q79, "q96": q96, "q98": q98}
+           "q45": q45, "q46": q46, "q47": q47, "q48": q48, "q49": q49,
+           "q50": q50, "q51": q51, "q52": q52, "q53": q53,
+           "q55": q55, "q57": q57, "q58": q58, "q59": q59, "q60": q60,
+           "q61": q61, "q62": q62, "q63": q63, "q65": q65, "q66": q66,
+           "q67": q67, "q68": q68, "q69": q69, "q70": q70, "q71": q71,
+           "q73": q73, "q74": q74, "q76": q76, "q77": q77,
+           "q79": q79, "q80": q80, "q96": q96, "q98": q98}
