@@ -30,19 +30,41 @@ from .column import DeviceColumn, bucket_capacity
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class ColumnarBatch:
-    """A device-resident table slice with a dynamic live-row count."""
+    """A device-resident table slice with a dynamic live-row count.
+
+    Liveness has two representations:
+
+    * **physical** (``live is None``): rows ``[0, n_rows)`` are live — the
+      compacted form every positional consumer (concat, slice, download,
+      serialize) requires.
+    * **lazy** (``live`` is a ``bool[capacity]`` mask): live rows sit
+      scattered at their original positions and ``n_rows`` is their traced
+      COUNT. A filter then costs one mask AND instead of a full sort-based
+      compaction (the dominant cost of filter-heavy plans); mask-native
+      consumers (aggregate, join, sort, further filters) read
+      :meth:`row_mask` and never pay the compaction. Positional consumers
+      call :func:`..ops.kernels.rowops.physical` first.
+    """
 
     columns: tuple  # tuple[DeviceColumn]
-    n_rows: jax.Array  # int32 scalar, traced
+    n_rows: jax.Array  # int32 scalar, traced — COUNT of live rows
     schema: T.Schema  # static
+    live: Optional[jax.Array] = None  # bool[capacity]; None = physical
 
     def tree_flatten(self):
-        return (self.columns, self.n_rows), (self.schema,)
+        if self.live is None:
+            return (self.columns, self.n_rows), (self.schema, False)
+        return (self.columns, self.n_rows, self.live), (self.schema, True)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
+        schema, has_live = aux
+        if has_live:
+            columns, n_rows, live = children
+            return cls(columns=tuple(columns), n_rows=n_rows, schema=schema,
+                       live=live)
         columns, n_rows = children
-        return cls(columns=tuple(columns), n_rows=n_rows, schema=aux[0])
+        return cls(columns=tuple(columns), n_rows=n_rows, schema=schema)
 
     @property
     def num_columns(self) -> int:
@@ -61,10 +83,13 @@ class ColumnarBatch:
 
     def with_columns(self, columns: Sequence[DeviceColumn],
                      schema: T.Schema) -> "ColumnarBatch":
-        return ColumnarBatch(tuple(columns), self.n_rows, schema)
+        return ColumnarBatch(tuple(columns), self.n_rows, schema,
+                             live=self.live)
 
     def row_mask(self) -> jax.Array:
         """bool[capacity] — True for live rows."""
+        if self.live is not None:
+            return self.live
         return jnp.arange(self.capacity, dtype=jnp.int32) < self.n_rows
 
     # -- host interchange ---------------------------------------------------
@@ -85,9 +110,11 @@ class ColumnarBatch:
         when live rows occupy a smaller capacity bucket, then ONE batched
         ``jax.device_get`` for every buffer of every column.
         """
-        n = int(self.n_rows)
+        from ..ops.kernels.rowops import physical_jit
+        batch = physical_jit(self)
+        n = int(batch.n_rows)
         cap = bucket_capacity(max(n, 1))
-        batch = _shrink_batch(self, cap) if cap < self.capacity else self
+        batch = _shrink_batch(batch, cap) if cap < batch.capacity else batch
         host = jax.device_get([c.device_buffers() for c in batch.columns])
         arrays = [c.arrow_from_host(bufs, n)
                   for c, bufs in zip(batch.columns, host)]

@@ -549,11 +549,15 @@ def null_column(dtype: T.DataType, capacity: int) -> DeviceColumn:
 
 
 def scalar_column(value, dtype: T.DataType, capacity: int,
-                  n_rows) -> DeviceColumn:
+                  live) -> DeviceColumn:
     """Broadcast a literal into a column (GpuLiteral expansion,
-    reference literals.scala:128)."""
+    reference literals.scala:128). ``live`` is the batch's row MASK —
+    lazy-filtered batches have scattered live rows, so a prefix
+    (iota < n_rows) would mark the wrong lanes valid."""
+    import jax.numpy as _jnp
     if value is None:
         return null_column(dtype, capacity)
+    live = _jnp.asarray(live)
     if dtype is T.STRING:
         # Dict-encoded: ONE dictionary entry, every live row points at it —
         # O(1) payload instead of a capacity-wide tiled buffer.
@@ -562,7 +566,7 @@ def scalar_column(value, dtype: T.DataType, capacity: int,
         byte_cap = bucket_capacity(max(ln, 1), 8)
         payload = np.zeros(byte_cap, dtype=np.uint8)
         payload[:ln] = raw
-        valid = jnp.arange(capacity) < n_rows
+        valid = live
         return DeviceColumn(
             data=jnp.asarray(payload),
             validity=valid,
@@ -571,6 +575,6 @@ def scalar_column(value, dtype: T.DataType, capacity: int,
             max_bytes=bucket_capacity(max(ln, 1), 8),
             codes=jnp.zeros(capacity, dtype=jnp.int32),
             dict_sorted=True)
-    valid = jnp.arange(capacity) < n_rows
+    valid = live
     data = jnp.where(valid, jnp.asarray(value, dtype=dtype.np_dtype), 0)
     return DeviceColumn(data=data.astype(dtype.np_dtype), validity=valid, dtype=dtype)

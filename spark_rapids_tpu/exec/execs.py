@@ -197,6 +197,10 @@ class TpuProjectExec(TpuExec):
             def build_nd():
                 def project_nd(batch: ColumnarBatch, row_base, pid
                                ) -> ColumnarBatch:
+                    # Positional expressions (monotonic id, rand stream)
+                    # number LOGICAL rows — scattered lazy rows must
+                    # compact first to match the oracle's numbering.
+                    batch = KR.physical(batch)
                     with ND.eval_context(pid, row_base):
                         cols = tuple(e.eval_device(batch) for e in bound)
                     return batch.with_columns(cols, out_schema)
@@ -306,7 +310,8 @@ class TpuUnionExec(TpuExec):
         for c in self.children:
             def relabel(p):
                 for db in p:
-                    yield ColumnarBatch(db.columns, db.n_rows, self._schema)
+                    yield ColumnarBatch(db.columns, db.n_rows,
+                                        self._schema, live=db.live)
             parts.extend(relabel(p) for p in c.execute(ctx))
         return parts
 
@@ -322,6 +327,7 @@ def _limit_stream(batches, n: int, in_fusion: bool):
     if in_fusion:
         remaining = jnp.asarray(n, jnp.int32)
         for db in batches:
+            db = KR.physical(db)  # truncation is positional
             take = jnp.minimum(db.n_rows, remaining)
             yield _truncate(db, take)
             remaining = remaining - take
@@ -333,7 +339,10 @@ def _limit_stream(batches, n: int, in_fusion: bool):
         rows = int(db.n_rows)
         take = min(rows, remaining)
         remaining -= take
-        yield db if take == rows else _truncate(db, take)
+        if take == rows:
+            yield db
+        else:
+            yield _truncate(KR.physical_jit(db), take)
 
 
 class TpuLocalLimitExec(TpuExec):
@@ -516,6 +525,9 @@ class TpuGenerateExec(TpuExec):
             from ..data.column import bucket_capacity
             t0 = _time.perf_counter()
             for db in part:
+                # Explode liveness is positional (flat_r < n_rows).
+                db = KR.physical(db) if ctx.in_fusion \
+                    else KR.physical_jit(db)
                 arr = eval_arr(db)
                 cap, w = arr.data.shape
                 tile_rows = cap if cap * w <= self.TILE_LANES else \
@@ -674,6 +686,8 @@ def _coalesce_device(batches: List[ColumnarBatch]) -> ColumnarBatch:
     The output is at most one capacity bucket larger than a row-exact concat.
     """
     if len(batches) == 1:
+        # Stays lazy: mask-native consumers (agg, join, sort, filter)
+        # read row_mask(); positional consumers materialize themselves.
         return batches[0]
     total = sum(b.capacity for b in batches)
     cap = bucket_capacity(max(total, 1))
@@ -819,7 +833,8 @@ def finalize_agg_kernel(n_keys: int, aggregates: List[AGG.AggregateExpression],
                 bi += len(specs)
                 result_expr = a.func.evaluate(refs)
                 cols.append(result_expr.eval_device(b))
-            return ColumnarBatch(tuple(cols), b.n_rows, out_schema)
+            return ColumnarBatch(tuple(cols), b.n_rows, out_schema,
+                                 live=b.live)
         return final
     return cached_kernel(
         "agg_final",
@@ -869,7 +884,7 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
     triples = [(v, val, op) for v, val, op, _ in inputs]
     if keys:
         key_cols, results, n_groups, group_live = KG.grouped_aggregate(
-            keys, batch.n_rows, triples)
+            keys, live, triples)
     else:
         key_cols, results, n_groups, group_live = KG.global_aggregate(
             capacity, live, triples)
@@ -911,10 +926,10 @@ def hash_join_kernel(jt: str, lkeys: List[Expression],
             # search (full joins need the build hit mask, which this path
             # can't produce without sorting the probe side).
             lo, counts, build_at_rank = KJ.join_match_binsearch(
-                bk[0], pk[0], build.n_rows, probe.n_rows)
+                bk[0], pk[0], build.row_mask(), probe.row_mask())
         else:
             lo, counts, build_at_rank, hits = KJ.join_match(
-                bk, pk, build.n_rows, probe.n_rows,
+                bk, pk, build.row_mask(), probe.row_mask(),
                 need_build_hits=(jt == "full"))
         live_p = probe.row_mask()
         counts = jnp.where(live_p, counts, 0)
@@ -966,7 +981,8 @@ def unmatched_build_kernel(left_schema: T.Schema, out_schema: T.Schema):
             null_left = [_null_col(f.data_type, build.capacity)
                          for f in left_schema]
             cols = tuple(null_left) + compacted.columns
-            return ColumnarBatch(cols, compacted.n_rows, out_schema)
+            return ColumnarBatch(cols, compacted.n_rows, out_schema,
+                                 live=compacted.live)
         return kernel
     return cached_kernel("join_unmatched_build",
                          kernel_key(left_schema, out_schema), builder)
@@ -1012,7 +1028,8 @@ class TpuShuffledHashJoinExec(TpuExec):
             def reorder(p):
                 for db in p:
                     cols = db.columns[n_right:] + db.columns[:n_right]
-                    yield ColumnarBatch(cols, db.n_rows, out_schema)
+                    yield ColumnarBatch(cols, db.n_rows, out_schema,
+                                        live=db.live)
             return [reorder(p) for p in parts]
 
         lkeys = _bind_all(self.left_keys, left.schema)
@@ -1031,7 +1048,8 @@ class TpuShuffledHashJoinExec(TpuExec):
             # session reads ONCE per query — no per-batch host syncs.
             if jt in ("left_semi", "left_anti"):
                 out, hits = kernel(probe, build, probe.capacity)
-                return ColumnarBatch(out.columns, out.n_rows, out_schema), hits
+                return ColumnarBatch(out.columns, out.n_rows, out_schema,
+                                     live=out.live), hits
             site = ctx.next_join_site()
             out_cap = ctx.join_caps.get(site) or bucket_capacity(
                 max(int(probe.capacity * self.growth * ctx.join_growth), 128))
@@ -1060,7 +1078,7 @@ class TpuShuffledHashJoinExec(TpuExec):
                                                      len(right.schema))
                         elif jt == "left_anti":
                             yield ColumnarBatch(probe.columns, probe.n_rows,
-                                                out_schema)
+                                                out_schema, live=probe.live)
                         continue
                     out, hits = join_batch(probe, build)
                     if hit_acc is None:
@@ -1087,7 +1105,8 @@ def _null_extend_right(probe: ColumnarBatch, schema: T.Schema,
     null_cols = tuple(_null_col(schema[len(probe.columns) + i].data_type,
                                 probe.capacity)
                       for i in range(n_right))
-    return ColumnarBatch(probe.columns + null_cols, probe.n_rows, schema)
+    return ColumnarBatch(probe.columns + null_cols, probe.n_rows, schema,
+                         live=probe.live)
 
 
 def _swap_schema(schema: T.Schema, n_first: int) -> T.Schema:

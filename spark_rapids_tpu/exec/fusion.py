@@ -140,7 +140,8 @@ def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int):
         if not outs:
             # Statically empty (no batches at all) — no device work needed.
             return (None, flags, None), None
-        batch = _coalesce_device(outs)
+        from ..ops.kernels import rowops as KR
+        batch = KR.physical(_coalesce_device(outs))
         guess_cap = min(batch.capacity, bucket_capacity(guess_rows))
         shrunk = _shrink_batch(batch, guess_cap) \
             if guess_cap < batch.capacity else batch

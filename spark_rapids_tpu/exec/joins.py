@@ -157,7 +157,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             n_pairs = pcap * bcap
             p_idx = jnp.repeat(jnp.arange(pcap, dtype=jnp.int32), bcap)
             b_idx = jnp.tile(jnp.arange(bcap, dtype=jnp.int32), pcap)
-            live = (p_idx < probe.n_rows) & (b_idx < build.n_rows)
+            live = probe.row_mask()[p_idx] & build.row_mask()[b_idx]
             pcols = [KR.gather_column(c, p_idx, live) for c in probe.columns]
             bcols = [KR.gather_column(c, b_idx, live) for c in build.columns]
             pairs = ColumnarBatch(tuple(pcols + bcols),
@@ -218,12 +218,13 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                                                          n_right)
                             else:
                                 yield ColumnarBatch(probe.columns,
-                                                    probe.n_rows, out_schema)
+                                                    probe.n_rows, out_schema,
+                                                    live=probe.live)
                         continue
                     if jt in ("left_semi", "left_anti"):
                         out, _ = kernel(probe, build, 0)
                         yield ColumnarBatch(out.columns, out.n_rows,
-                                            out_schema)
+                                            out_schema, live=out.live)
                         continue
                     # Optimistic sizing + deferred overflow flag — same
                     # no-sync discipline as TpuShuffledHashJoinExec; the

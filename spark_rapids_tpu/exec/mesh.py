@@ -255,7 +255,8 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
             def reorder(env, flags):
                 b = inner(env, flags)
                 cols = b.columns[n_right:] + b.columns[:n_right]
-                return ColumnarBatch(cols, b.n_rows, out_schema)
+                return ColumnarBatch(cols, b.n_rows, out_schema,
+                                     live=b.live)
             return reorder
 
         from .joins import TpuBroadcastExchangeExec
@@ -304,7 +305,8 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
                 max(int(probe.capacity * node.growth * bucket_growth), 128))
             if jt in ("left_semi", "left_anti"):
                 out, _ = kernel(probe, build, out_cap)
-                out = ColumnarBatch(out.columns, out.n_rows, out_schema)
+                out = ColumnarBatch(out.columns, out.n_rows, out_schema,
+                                    live=out.live)
             else:
                 (out, hits), total = kernel(probe, build, out_cap)
                 flags.append(jax.lax.psum(
@@ -495,7 +497,9 @@ def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
         else:  # scan source: execute now (host decode + upload)
             batches = [b for p in s.execute(ctx) for b in p]
         if batches:
-            batch = _coalesce_device(batches)
+            # _shard_source lays rows out positionally — materialize any
+            # lazily-filtered cached batch first.
+            batch = KR.physical_jit(_coalesce_device(batches))
         else:
             import pyarrow as _pa
             rb = _pa.RecordBatch.from_arrays(
@@ -540,6 +544,9 @@ def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
                                        schemas[i])
             flags: List = []
             out = fn(env, flags)
+            # Host assembly slices each shard's [0, n) prefix — a lazy
+            # (mask-live) root must materialize inside the SPMD program.
+            out = KR.physical(out)
             flag = jnp.any(jnp.stack(flags)) if flags else \
                 jnp.zeros((), jnp.bool_)
             # Dict output columns: the code lane shards; the dictionary

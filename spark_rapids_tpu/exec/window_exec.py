@@ -63,6 +63,10 @@ class TpuWindowExec(TpuExec):
 
         def build():
             def window_all(batch: ColumnarBatch) -> ColumnarBatch:
+                # Window evaluation is positional (prefix run bounds,
+                # identity perm for the no-key case) — materialize lazy
+                # batches first.
+                batch = KR.physical(batch)
                 out_cols = list(batch.columns)
                 for name, func, part, orders, frame in bound:
                     out_cols.append(_eval_window(batch, func, part,

@@ -273,7 +273,8 @@ class Literal(Expression):
         return pa.scalar(self.value, type=T.to_arrow_type(self._dtype))
 
     def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
-        return scalar_column(self.value, self._dtype, batch.capacity, batch.n_rows)
+        return scalar_column(self.value, self._dtype, batch.capacity,
+                             batch.row_mask())
 
     def __str__(self) -> str:
         return repr(self.value)

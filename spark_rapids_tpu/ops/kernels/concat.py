@@ -160,6 +160,8 @@ def concat_batches(batches: List[ColumnarBatch],
     Caller sizes out_capacity >= sum of live rows (sync or worst-case sum of
     capacities)."""
     assert batches
+    from .rowops import physical
+    batches = [physical(b) for b in batches]
     if len(batches) == 1 and batches[0].capacity == out_capacity:
         return batches[0]
     schema = batches[0].schema

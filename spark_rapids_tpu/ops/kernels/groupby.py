@@ -111,7 +111,7 @@ def _minmax_reinstate_nan(res: jnp.ndarray, nan_cnt: jnp.ndarray,
 _DICT_GROUP_LIMIT = 4096
 
 
-def grouped_aggregate(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray,
+def grouped_aggregate(keys: Sequence[DeviceColumn], live: jnp.ndarray,
                       inputs: Sequence[Tuple[jnp.ndarray, jnp.ndarray, str]]
                       ) -> Tuple[List[DeviceColumn],
                                  List[Tuple[jnp.ndarray, jnp.ndarray]],
@@ -146,10 +146,9 @@ def grouped_aggregate(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray,
         for k in keys:
             n_slots *= k.dict_size + 1  # slot 0 = null
         if n_slots <= _DICT_GROUP_LIMIT:
-            return _dict_grouped_aggregate(keys, n_rows, inputs, n_slots)
+            return _dict_grouped_aggregate(keys, live, inputs, n_slots)
     capacity = keys[0].capacity
     iota = jnp.arange(capacity, dtype=jnp.int32)
-    live = iota < n_rows
     # -- ONE narrow grouping argsort --------------------------------------
     # Grouping needs equal keys ADJACENT and dead rows at the end — any
     # total order does. So every per-key null bucket folds into ONE leading
@@ -187,7 +186,9 @@ def grouped_aggregate(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray,
     for o in key_ops_sorted:
         prev = jnp.concatenate([o[:1], o[:-1]])
         eq = eq & (o == prev)
-    live_sorted = live  # dead rows sank to the end under the live bucket
+    # Dead rows sank to the end under the live bucket; the mask itself
+    # must still be permuted (a lazy-filter mask is scattered pre-sort).
+    live_sorted = live[perm]
     boundary = (~eq | (iota == 0)) & live_sorted
     n_groups = jnp.sum(boundary.astype(jnp.int32))
     group_live = iota < n_groups
@@ -249,7 +250,7 @@ def grouped_aggregate(keys: Sequence[DeviceColumn], n_rows: jnp.ndarray,
 
 
 def _dict_grouped_aggregate(keys: Sequence[DeviceColumn],
-                            n_rows: jnp.ndarray,
+                            live: jnp.ndarray,
                             inputs: Sequence[Tuple[jnp.ndarray, jnp.ndarray,
                                                    str]],
                             n_slots: int
@@ -263,7 +264,6 @@ def _dict_grouped_aggregate(keys: Sequence[DeviceColumn],
     from ...data.column import bucket_capacity
     capacity = keys[0].capacity
     iota = jnp.arange(capacity, dtype=jnp.int32)
-    live = iota < n_rows
     gid = jnp.zeros(capacity, dtype=jnp.int32)
     for k in keys:
         slot = jnp.where(k.validity, k.codes + 1, 0)

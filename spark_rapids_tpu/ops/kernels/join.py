@@ -93,7 +93,7 @@ def dense_key_ids(build_keys: Sequence[DeviceColumn],
 
 def join_match(build_keys: Sequence[DeviceColumn],
                probe_keys: Sequence[DeviceColumn],
-               n_build: jnp.ndarray, n_probe: jnp.ndarray,
+               live_build: jnp.ndarray, live_probe: jnp.ndarray,
                need_build_hits: bool = False
                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray,
                           Optional[jnp.ndarray]]:
@@ -127,9 +127,7 @@ def join_match(build_keys: Sequence[DeviceColumn],
     operands: List[jnp.ndarray] = []
     null_key = jnp.zeros(total, dtype=jnp.bool_)
     is_build = jnp.arange(total, dtype=jnp.int32) < cap_b
-    live = jnp.concatenate([
-        jnp.arange(cap_b, dtype=jnp.int32) < n_build,
-        jnp.arange(cap_p, dtype=jnp.int32) < n_probe])
+    live = jnp.concatenate([live_build, live_probe])
     for b, p in zip(build_keys, probe_keys):
         null_key = null_key | ~jnp.concatenate([b.validity, p.validity])
         if b.is_string:
@@ -215,7 +213,7 @@ def join_match(build_keys: Sequence[DeviceColumn],
 
 
 def join_match_binsearch(build_key: DeviceColumn, probe_key: DeviceColumn,
-                         n_build: jnp.ndarray, n_probe: jnp.ndarray
+                         live_b: jnp.ndarray, live_p: jnp.ndarray
                          ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Single non-string, non-float equi-key fast path: sort ONLY the build
     side (typically the small dimension table) and match every probe row by
@@ -231,7 +229,6 @@ def join_match_binsearch(build_key: DeviceColumn, probe_key: DeviceColumn,
     cap_b, cap_p = build_key.capacity, probe_key.capacity
     kb, _ = orderable_key(build_key)
     kp, _ = orderable_key(probe_key)
-    live_b = jnp.arange(cap_b, dtype=jnp.int32) < n_build
     usable_b = live_b & build_key.validity
     sentinel = jnp.iinfo(jnp.int64).max
     kb = jnp.where(usable_b, kb.astype(jnp.int64), sentinel)
@@ -248,7 +245,6 @@ def join_match_binsearch(build_key: DeviceColumn, probe_key: DeviceColumn,
     hi = jnp.searchsorted(sorted_kb, kp64, side="right").astype(jnp.int32)
     lo = jnp.minimum(lo, n_usable)
     hi = jnp.minimum(hi, n_usable)
-    live_p = jnp.arange(cap_p, dtype=jnp.int32) < n_probe
     usable_p = live_p & probe_key.validity
     counts = jnp.where(usable_p, hi - lo, 0).astype(jnp.int32)
     return lo, counts, build_at_rank

@@ -251,12 +251,38 @@ def _permute_by_sort(batch: ColumnarBatch, key_operands: List[jnp.ndarray],
 
 
 def compact(batch: ColumnarBatch, keep: jnp.ndarray) -> ColumnarBatch:
-    """Filter: move kept rows to the front, shrink n_rows. ``keep`` is a
-    bool[capacity] mask (already False for dead/invalid-predicate rows)."""
+    """Filter: LAZY — record the kept-row mask instead of physically
+    moving rows (a full sort-based compaction, the dominant cost of
+    filter-heavy plans). ``n_rows`` becomes the traced live COUNT;
+    mask-native consumers read ``row_mask()``, positional ones call
+    :func:`physical` first."""
     keep = keep & batch.row_mask()
     n_kept = jnp.sum(keep.astype(jnp.int32))
-    drop = (~keep).astype(jnp.int8)
-    return _permute_by_sort(batch, [drop], n_kept)
+    return ColumnarBatch(batch.columns, n_kept, batch.schema, live=keep)
+
+
+def physical(batch: ColumnarBatch) -> ColumnarBatch:
+    """Materialize a lazily-filtered batch: live rows move to the front
+    (one stable partition sort), ``live`` clears. No-op when already
+    physical."""
+    if batch.live is None:
+        return batch
+    drop = (~batch.live).astype(jnp.int8)
+    src = ColumnarBatch(batch.columns, batch.n_rows, batch.schema)
+    return _permute_by_sort(src, [drop], batch.n_rows)
+
+
+@jax.jit
+def _physical_kernel(batch: ColumnarBatch) -> ColumnarBatch:
+    return physical(batch)
+
+
+def physical_jit(batch: ColumnarBatch) -> ColumnarBatch:
+    """Eager-context physical(): jitted (cached per treedef/avals) so host
+    callers like ``to_arrow`` don't pay op-by-op dispatch."""
+    if batch.live is None:
+        return batch
+    return _physical_kernel(batch)
 
 
 def sort_batch_by_columns(batch: ColumnarBatch,
@@ -264,9 +290,11 @@ def sort_batch_by_columns(batch: ColumnarBatch,
                           ascending: Sequence[bool],
                           nulls_first: Sequence[bool]) -> ColumnarBatch:
     """Sort a batch by evaluated key columns, carrying payload through the
-    one sort (see :func:`_permute_by_sort`)."""
+    one sort (see :func:`_permute_by_sort`). Lazy-filtered inputs are
+    handled natively: their scattered dead rows sink to the tail through
+    the same dead-row operand, so no separate compaction pass is paid."""
     capacity = batch.capacity
-    live = jnp.arange(capacity, dtype=jnp.int32) < batch.n_rows
+    live = batch.row_mask()
     operands: List[jnp.ndarray] = [jnp.where(live, 0, 1).astype(jnp.int8)]
     for k, a, n in zip(keys, ascending, nulls_first):
         if k.is_string:

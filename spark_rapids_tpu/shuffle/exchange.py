@@ -242,7 +242,14 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         n_parts = self.n_parts
 
         def build():
+            from .partitioners import RoundRobinPartitioner
+
             def partition_sort(batch: ColumnarBatch):
+                if isinstance(partitioner, RoundRobinPartitioner):
+                    # Round-robin ids are POSITIONAL — a lazy batch must
+                    # compact first so device assignment matches the host
+                    # oracle's row-order assignment.
+                    batch = KR.physical(batch)
                 ids = partitioner.device_ids(batch)
                 live = batch.row_mask()
                 ids = jnp.where(live, ids, n_parts)

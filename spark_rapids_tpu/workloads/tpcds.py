@@ -12,8 +12,10 @@ aggregation pattern — through the public DataFrame API.
 Subquery forms follow the same rewrites the reference's Scala DataFrame
 versions use: correlated scalar subqueries become aggregate + join, EXISTS
 becomes left-semi, NOT IN becomes left-anti, scalar aggregates become
-cross joins. ROLLUP grouping sets (q5/q27's final rollup) are expressed as
-plain GROUP BYs — a documented divergence.
+cross joins, INTERSECT/EXCEPT become semi/anti chains. ROLLUP / CUBE
+grouping sets run through the real Expand path
+(``DataFrame.rollup``/``cube`` -> ``TpuExpandExec``, the
+GpuExpandExec.scala:66 design) — q18/q22/q36/q67/q70/q77/q80/q86 use it.
 
 Used as differential tests (tests/test_tpcds.py) on both tiers and as
 bench entries (BASELINE config 1: the q5-shaped join+agg is ``q5``).
@@ -3775,5 +3777,8 @@ QUERIES = {"q1": q1, "q2": q2, "q3": q3, "q5": q5, "q6": q6, "q7": q7,
            "q55": q55, "q57": q57, "q58": q58, "q59": q59, "q60": q60,
            "q61": q61, "q62": q62, "q63": q63, "q65": q65, "q66": q66,
            "q67": q67, "q68": q68, "q69": q69, "q70": q70, "q71": q71,
-           "q73": q73, "q74": q74, "q76": q76, "q77": q77,
-           "q79": q79, "q80": q80, "q96": q96, "q98": q98}
+           "q73": q73, "q74": q74, "q76": q76, "q77": q77, "q78": q78,
+           "q79": q79, "q80": q80, "q81": q81, "q82": q82, "q83": q83,
+           "q85": q85, "q86": q86, "q87": q87, "q88": q88, "q89": q89,
+           "q90": q90, "q91": q91, "q92": q92, "q93": q93,
+           "q96": q96, "q97": q97, "q98": q98, "q99": q99}
