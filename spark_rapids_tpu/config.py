@@ -284,6 +284,13 @@ TPU_FUSION_ENABLED = conf_bool(
     "Trace an entire device plan into one compiled XLA program (whole-stage "
     "fusion): one dispatch and one device->host transfer per query.")
 
+TPU_FUSION_INLINE_JOINS = conf_bool(
+    "spark.rapids.tpu.fusion.inlineJoins", True,
+    "Inline hash joins into the fused whole-stage program instead of "
+    "running each join as an eager boundary: removes per-join dispatches "
+    "and intermediate materialization. Disable when a slow remote compile "
+    "helper makes many-sort fused programs too expensive to build.")
+
 TPU_MESH_ENABLED = conf_bool(
     "spark.rapids.tpu.mesh.enabled", False,
     "Run mesh-capable queries as ONE SPMD program over all devices "
@@ -355,6 +362,10 @@ class TpuConf:
     @property
     def fusion_enabled(self) -> bool:
         return self.get(TPU_FUSION_ENABLED)
+
+    @property
+    def fusion_inline_joins(self) -> bool:
+        return self.get(TPU_FUSION_INLINE_JOINS)
 
     @property
     def mesh_enabled(self) -> bool:

@@ -77,43 +77,56 @@ class FusedInputExec(TpuExec):
 
 #: Execs whose execute() path is fully traceable (no host syncs, no host
 #: data): these are inlined into the fused program. Everything else columnar
-#: becomes a boundary input. Joins are deliberately NOT inlined: a fused
-#: multi-join program accumulates enough lax.sort stages to exhaust the
-#: remote TPU compile helper; as boundaries they run through their own
-#: process-cached (and persistently disk-cached) kernels that amortize
-#: across queries.
+#: becomes a boundary input.
 _INLINE = (TpuProjectExec, TpuFilterExec, TpuHashAggregateExec,
            TpuCoalesceBatchesExec, TpuExpandExec,
            TpuUnionExec, TpuLimitExec, TpuLocalLimitExec, FusedInputExec)
 
 
-def _is_boundary(p) -> bool:
-    if isinstance(p, _INLINE):
+def _inline_types():
+    """Joins inline too when the conf allows: one fused program per query
+    instead of per-join boundary dispatches + intermediate
+    materialization. Default ON for locally-compiled backends; the conf
+    exists because a fused multi-join program accumulates enough lax.sort
+    stages to strain SLOW remote compile helpers (tpu tunnel) — boundaries
+    amortize their per-join kernels across queries there."""
+    from .execs import TpuShuffledHashJoinExec
+    return _INLINE + (TpuShuffledHashJoinExec,)
+
+
+def _is_boundary(p, inline=None) -> bool:
+    if isinstance(p, inline or _INLINE):
         return False
     return bool(getattr(p, "columnar", False))
 
 
-def _split(plan, boundaries: List) -> TpuExec:
+def _split(plan, boundaries: List, inline=None) -> TpuExec:
     """Rebuild the device subtree with every boundary subtree replaced by a
     :class:`FusedInputExec` leaf; boundary nodes append to ``boundaries`` in
     deterministic traversal order (the fused program's argument order)."""
-    if _is_boundary(plan):
+    inline = inline or _INLINE
+    if _is_boundary(plan, inline):
         boundaries.append(plan)
         return FusedInputExec(len(boundaries) - 1, plan.schema)
-    if not isinstance(plan, _INLINE):
+    if not isinstance(plan, inline):
         raise _NotFusable(type(plan).__name__)
-    kids = [_split(c, boundaries) for c in plan.children]
+    kids = [_split(c, boundaries, inline) for c in plan.children]
     return plan.with_children(kids) if kids else plan
 
 
-def fusable(root) -> bool:
+def _conf_inline(conf):
+    return _inline_types() if conf is not None \
+        and conf.fusion_inline_joins else _INLINE
+
+
+def fusable(root, conf=None) -> bool:
     if not isinstance(root, DeviceToHostExec):
         return False
     child = root.children[0]
     if not getattr(child, "columnar", False):
         return False
     try:
-        _split(child, [])
+        _split(child, [], _conf_inline(conf))
     except _NotFusable:
         return False
     return True
@@ -160,7 +173,7 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     learned exact join capacities (``ctx.join_caps``)."""
     device_plan = root.children[0]
     boundaries: List = []
-    fused_plan = _split(device_plan, boundaries)
+    fused_plan = _split(device_plan, boundaries, _conf_inline(ctx.conf))
     guess_rows = ctx.conf.collect_guess_rows
     sig = (_plan_sig(fused_plan), float(ctx.join_growth), guess_rows)
     fn = _FUSED_CACHE.get(sig)

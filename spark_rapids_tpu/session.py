@@ -220,7 +220,7 @@ class TpuSession:
                 return _mesh().mesh_collect(physical, ctx)
             if mode == "deferred" and self.conf.sql_enabled \
                     and self.conf.fusion_enabled \
-                    and fusion.fusable(physical):
+                    and fusion.fusable(physical, self.conf):
                 table, overflowed = fusion.fused_collect(physical, ctx)
                 # Boundary subtrees (windows, broadcasts, ...) executed
                 # eagerly with THIS ctx: their deferred flags gate too.
