@@ -139,10 +139,14 @@ def clear_fused_cache() -> None:
     _FUSED_CACHE.clear()
 
 
-def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int):
+def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int,
+                 join_caps=None):
+    caps = dict(join_caps or {})
+
     def run(inputs):
         ictx = ExecContext(conf, catalog=None)
         ictx.join_growth = join_growth
+        ictx.join_caps = dict(caps)
         ictx.fused_inputs = inputs
         ictx.in_fusion = True
         outs = []
@@ -150,17 +154,22 @@ def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int):
             outs.extend(part)
         flags = (jnp.stack(ictx.overflow_flags) if ictx.overflow_flags
                  else jnp.zeros((0,), jnp.bool_))
+        # Inlined joins' observed match totals ride the head transfer as a
+        # static-keyed dict so the session's capacity learning still works
+        # (without it every overflow repeats the growth-escalation ladder,
+        # and each rung is a fresh whole-program compile).
+        totals = {site: t for site, t in ictx.join_totals}
         if not outs:
             # Statically empty (no batches at all) — no device work needed.
-            return (None, flags, None), None
+            return (None, flags, totals, None), None
         from ..ops.kernels import rowops as KR
         batch = KR.physical(_coalesce_device(outs))
         guess_cap = min(batch.capacity, bucket_capacity(guess_rows))
         shrunk = _shrink_batch(batch, guess_cap) \
             if guess_cap < batch.capacity else batch
-        # The head triple is the single downloaded transfer; the full batch
+        # The head tuple is the single downloaded transfer; the full batch
         # stays device-resident for the (rare) guess-miss second pass.
-        return (batch.n_rows, flags, shrunk), batch
+        return (batch.n_rows, flags, totals, shrunk), batch
     return jax.jit(run)
 
 
@@ -175,17 +184,24 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     boundaries: List = []
     fused_plan = _split(device_plan, boundaries, _conf_inline(ctx.conf))
     guess_rows = ctx.conf.collect_guess_rows
-    sig = (_plan_sig(fused_plan), float(ctx.join_growth), guess_rows)
+    caps = tuple(sorted(ctx.join_caps.items())) if ctx.join_caps else ()
+    sig = (_plan_sig(fused_plan), float(ctx.join_growth), guess_rows, caps)
     fn = _FUSED_CACHE.get(sig)
     if fn is None:
-        fn = _build_fused(fused_plan, ctx.conf, ctx.join_growth, guess_rows)
+        fn = _build_fused(fused_plan, ctx.conf, ctx.join_growth, guess_rows,
+                          ctx.join_caps)
         _FUSED_CACHE[sig] = fn
     # Boundary subtrees run eagerly (uploads, windows, shuffles, ...); their
     # materialized batches are the fused program's positional arguments.
     inputs = tuple(tuple(tuple(p) for p in b.execute(ctx))
                    for b in boundaries)
     head, full = fn(inputs)
-    n_rows_np, flags_np, shrunk_np = jax.device_get(head)  # ONE round trip
+    n_rows_np, flags_np, totals_np, shrunk_np = \
+        jax.device_get(head)  # ONE round trip
+    # Surface inlined joins' observed totals for the session's capacity
+    # learning (both on overflow and for the success-path cache ratchet).
+    for site, t in totals_np.items():
+        ctx.join_totals.append((site, t))
     if flags_np.size and bool(np.any(flags_np)):
         return None, True
     arrow_schema = T.schema_to_arrow(root.schema)

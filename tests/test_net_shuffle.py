@@ -172,7 +172,105 @@ time.sleep(30)
 """
 
 
+MAP_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, os.getcwd())
+os.environ["JAX_PLATFORM_NAME"] = "cpu"
+os.environ["JAX_ENABLE_COMPILATION_CACHE"] = "false"
+os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import pyarrow as pa
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.ops.expression import col
+from spark_rapids_tpu.ops import aggregates as A
+from spark_rapids_tpu.shuffle.exchange import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.net import NetShuffleServer
+from spark_rapids_tpu.shuffle.serializer import serialize_batch
+from spark_rapids_tpu.shuffle.codec import get_codec
+
+# MAP side of a two-stage aggregate: each input split runs a device
+# partial aggregate, hash-partitions its group rows into reduce blocks,
+# and serves them over the wire (RapidsCachingWriter role).
+rng = np.random.default_rng(77)
+k = rng.integers(0, 40, 4000)
+v = rng.normal(0, 10, 4000)
+s = TpuSession({"spark.rapids.sql.enabled": True})
+cat = ShuffleBufferCatalog()
+N_REDUCE = 2
+for m, sl in enumerate((slice(0, 1500), slice(1500, 4000))):
+    part = pa.table({"k": k[sl], "v": v[sl]})
+    partial = (s.create_dataframe(part).group_by(col("k"))
+               .agg(A.AggregateExpression(A.Sum(col("v")), "sv"),
+                    A.AggregateExpression(A.Count(), "c"))
+               .collect())
+    kk = np.asarray(partial.column("k"))
+    for r in range(N_REDUCE):
+        piece = partial.filter(pa.array(kk % N_REDUCE == r))
+        if piece.num_rows == 0:
+            continue
+        rb = piece.combine_chunks().to_batches()[0]
+        cat.add_block(3, m, r, serialize_batch(rb, get_codec("lz4")))
+srv = NetShuffleServer(cat)
+print(srv.address[1], flush=True)
+time.sleep(60)
+"""
+
+
 class TestCrossProcess:
+    def test_two_process_aggregate_query(self):
+        """End-to-end query across two processes: process A maps (partial
+        aggregate + hash partition + serve), this process reduces (fetch,
+        merge aggregate) — and the result matches a single-process oracle
+        (reference read path role, RapidsCachingReader.scala:49)."""
+        import numpy as np
+        import pyarrow as pa
+
+        from spark_rapids_tpu.session import TpuSession
+        from spark_rapids_tpu.ops.expression import col
+        from spark_rapids_tpu.ops import aggregates as A
+        from spark_rapids_tpu.shuffle.serializer import deserialize_batch
+
+        proc = subprocess.Popen(
+            [sys.executable, "-c", MAP_CHILD], stdout=subprocess.PIPE,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            text=True)
+        try:
+            port = int(proc.stdout.readline())
+            s = TpuSession({"spark.rapids.sql.enabled": True})
+            outs = []
+            for r in range(2):
+                payloads = list(RetryingBlockIterator(
+                    ("127.0.0.1", port), 3, r))
+                rbs = [deserialize_batch(p)[1] for p in payloads]
+                merged = pa.Table.from_batches(rbs)
+                outs.append(
+                    (s.create_dataframe(merged.combine_chunks()
+                                        .to_batches()[0])
+                     .group_by(col("k"))
+                     .agg(A.AggregateExpression(A.Sum(col("sv")), "sv"),
+                          A.AggregateExpression(A.Sum(col("c")), "c"))
+                     .collect()))
+            got = pa.concat_tables(outs).sort_by("k").to_pydict()
+            # Oracle: same data, one process, one aggregate.
+            rng = np.random.default_rng(77)
+            k = rng.integers(0, 40, 4000)
+            v = rng.normal(0, 10, 4000)
+            cpu = TpuSession({"spark.rapids.sql.enabled": False})
+            exp = (cpu.create_dataframe(pa.table({"k": k, "v": v}))
+                   .group_by(col("k"))
+                   .agg(A.AggregateExpression(A.Sum(col("v")), "sv"),
+                        A.AggregateExpression(A.Count(), "c"))
+                   .collect().sort_by("k").to_pydict())
+            assert got["k"] == exp["k"]
+            assert got["c"] == exp["c"]
+            assert np.allclose(got["sv"], exp["sv"], rtol=1e-9)
+        finally:
+            proc.kill()
+            proc.wait()
+
     def test_fetch_from_another_process(self):
         proc = subprocess.Popen(
             [sys.executable, "-c", CHILD], stdout=subprocess.PIPE,
