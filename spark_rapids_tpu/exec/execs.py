@@ -915,9 +915,15 @@ def hash_join_kernel(jt: str, lkeys: List[Expression],
     semi/anti return a compacted probe; left/full expand unmatched probe
     rows with nulls; full also returns the build-side hit mask for the
     caller's unmatched-build pass."""
-    def kernel_impl(probe, build, out_cap):
+    def kernel_impl(probe, build, out_cap, dense=False):
         pk = [e.eval_device(probe) for e in lkeys]
         bk = [e.eval_device(build) for e in rkeys]
+        if dense:
+            # Direct-address fast path (unique int build keys): returns a
+            # lazy probe-capacity batch + a dense-fail flag the retry
+            # machinery consumes; no overflow possible.
+            return KJ.dense_join(jt, probe, build, pk[0], bk[0],
+                                 out_schema)
         hits = None
         if jt != "full" and len(bk) == 1 \
                 and KJ.binsearch_joinable(bk[0]) \
@@ -953,7 +959,7 @@ def hash_join_kernel(jt: str, lkeys: List[Expression],
 
     return cached_kernel(
         "hash_join", kernel_key(jt, lkeys, rkeys, out_schema),
-        lambda: kernel_impl, static_argnums=(2,))
+        lambda: kernel_impl, static_argnums=(2, 3))
 
 
 def join_post_filter(condition: Optional[Expression], out_schema: T.Schema):
@@ -1039,6 +1045,9 @@ class TpuShuffledHashJoinExec(TpuExec):
         kernel = hash_join_kernel(jt, lkeys, rkeys, out_schema)
         post_filter = join_post_filter(self.condition, out_schema)
 
+        dense_eligible = KJ.dense_joinable(jt, _bind_all(
+            self.right_keys, right.schema)) and self.condition is None
+
         def join_batch(probe, build):
             # Optimistic output sizing: allocate from the learned exact
             # capacity for this join site when a previous run of this plan
@@ -1046,11 +1055,20 @@ class TpuShuffledHashJoinExec(TpuExec):
             # overflow-learning retry), else from the probe capacity. The
             # real match count stays a deferred device-side observation the
             # session reads ONCE per query — no per-batch host syncs.
+            site = ctx.next_join_site()
+            if dense_eligible and not ctx.eager_overflow \
+                    and site not in ctx.no_dense:
+                # Direct-address path: optimistic like the capacity guess —
+                # a dense-fail flag (dup/out-of-range build keys) re-runs
+                # this site through the general kernel.
+                out, fail = kernel(probe, build, 0, True)
+                ctx.overflow_flags.append(fail)
+                ctx.dense_fails.append((site, fail))
+                return out, None
             if jt in ("left_semi", "left_anti"):
                 out, hits = kernel(probe, build, probe.capacity)
                 return ColumnarBatch(out.columns, out.n_rows, out_schema,
                                      live=out.live), hits
-            site = ctx.next_join_site()
             out_cap = ctx.join_caps.get(site) or bucket_capacity(
                 max(int(probe.capacity * self.growth * ctx.join_growth), 128))
             (out, hits), total = kernel(probe, build, out_cap)

@@ -140,13 +140,15 @@ def clear_fused_cache() -> None:
 
 
 def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int,
-                 join_caps=None):
+                 join_caps=None, no_dense=frozenset()):
     caps = dict(join_caps or {})
+    nd = frozenset(no_dense)
 
     def run(inputs):
         ictx = ExecContext(conf, catalog=None)
         ictx.join_growth = join_growth
         ictx.join_caps = dict(caps)
+        ictx.no_dense = nd
         ictx.fused_inputs = inputs
         ictx.in_fusion = True
         outs = []
@@ -159,9 +161,10 @@ def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int,
         # (without it every overflow repeats the growth-escalation ladder,
         # and each rung is a fresh whole-program compile).
         totals = {site: t for site, t in ictx.join_totals}
+        dfails = {site: f for site, f in ictx.dense_fails}
         if not outs:
             # Statically empty (no batches at all) — no device work needed.
-            return (None, flags, totals, None), None
+            return (None, flags, totals, dfails, None), None
         from ..ops.kernels import rowops as KR
         batch = KR.physical(_coalesce_device(outs))
         guess_cap = min(batch.capacity, bucket_capacity(guess_rows))
@@ -169,7 +172,7 @@ def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int,
             if guess_cap < batch.capacity else batch
         # The head tuple is the single downloaded transfer; the full batch
         # stays device-resident for the (rare) guess-miss second pass.
-        return (batch.n_rows, flags, totals, shrunk), batch
+        return (batch.n_rows, flags, totals, dfails, shrunk), batch
     return jax.jit(run)
 
 
@@ -185,23 +188,26 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     fused_plan = _split(device_plan, boundaries, _conf_inline(ctx.conf))
     guess_rows = ctx.conf.collect_guess_rows
     caps = tuple(sorted(ctx.join_caps.items())) if ctx.join_caps else ()
-    sig = (_plan_sig(fused_plan), float(ctx.join_growth), guess_rows, caps)
+    sig = (_plan_sig(fused_plan), float(ctx.join_growth), guess_rows, caps,
+           tuple(sorted(ctx.no_dense)))
     fn = _FUSED_CACHE.get(sig)
     if fn is None:
         fn = _build_fused(fused_plan, ctx.conf, ctx.join_growth, guess_rows,
-                          ctx.join_caps)
+                          ctx.join_caps, ctx.no_dense)
         _FUSED_CACHE[sig] = fn
     # Boundary subtrees run eagerly (uploads, windows, shuffles, ...); their
     # materialized batches are the fused program's positional arguments.
     inputs = tuple(tuple(tuple(p) for p in b.execute(ctx))
                    for b in boundaries)
     head, full = fn(inputs)
-    n_rows_np, flags_np, totals_np, shrunk_np = \
+    n_rows_np, flags_np, totals_np, dfails_np, shrunk_np = \
         jax.device_get(head)  # ONE round trip
-    # Surface inlined joins' observed totals for the session's capacity
-    # learning (both on overflow and for the success-path cache ratchet).
+    # Surface inlined joins' observed totals and dense-fail flags for the
+    # session's learning (capacity ratchet + no_dense re-planning).
     for site, t in totals_np.items():
         ctx.join_totals.append((site, t))
+    for site, f in dfails_np.items():
+        ctx.dense_fails.append((site, f))
     if flags_np.size and bool(np.any(flags_np)):
         return None, True
     arrow_schema = T.schema_to_arrow(root.schema)

@@ -250,6 +250,89 @@ def join_match_binsearch(build_key: DeviceColumn, probe_key: DeviceColumn,
     return lo, counts, build_at_rank
 
 
+#: Direct-address table size = build capacity x this factor. Dimension
+#: surrogate keys are dense 0..n-1, so 4x covers filtered builds whose key
+#: range exceeds their live count.
+_DENSE_TABLE_FACTOR = 4
+
+
+def dense_joinable(jt: str, keys) -> bool:
+    """Static eligibility for the direct-address join: probe-preserving
+    join type + a single fixed-width integer equi key (``keys`` are bound
+    EXPRESSIONS — this check runs before any column exists). Runtime
+    conditions (unique usable build keys inside the table range) are
+    checked on device and reported through the dense-fail flag."""
+    from ... import types as T
+    if jt not in ("inner", "left", "left_semi", "left_anti") \
+            or len(keys) != 1:
+        return False
+    dt = keys[0].data_type
+    return dt is not T.STRING and not dt.is_floating \
+        and not isinstance(dt, (T.ArrayType, T.StructType))
+
+
+def dense_join(jt: str, probe, build, pk: DeviceColumn, bk: DeviceColumn,
+               out_schema):
+    """Direct-address (perfect-hash) equi join for UNIQUE integer build
+    keys — the fact-to-dimension shape that dominates TPC-H/DS/xBB.
+
+    Scatter build row ids into a table indexed by key value, then every
+    probe row's match is two gathers — no ``lax.sort`` and no
+    ``searchsorted``, both of which are order-of-magnitude slower than a
+    memory pass on XLA (CPU: a 1M-row sort ~850ms, searchsorted ~450ms,
+    vs ~20ms per gather). The output stays LAZY at probe capacity (live =
+    match mask), so no compaction pass is paid either; with unique build
+    keys the output can never exceed the probe row count, so this path
+    cannot overflow.
+
+    Returns ``(out_batch, fail)`` where ``fail`` is a traced bool: build
+    keys were duplicated or out of table range — the caller's retry
+    machinery re-runs the site with the general kernel (ctx.no_dense).
+    """
+    from ...data.batch import ColumnarBatch
+    cap_b = bk.capacity
+    tbl = cap_b * _DENSE_TABLE_FACTOR
+    live_b = build.row_mask()
+    usable_b = live_b & bk.validity
+    kb = bk.data.astype(jnp.int64)
+    in_range_b = (kb >= 0) & (kb < tbl)
+    ok_b = usable_b & in_range_b
+    slot = jnp.where(ok_b, kb, tbl).astype(jnp.int32)
+    cnt_tbl = jax.ops.segment_sum(ok_b.astype(jnp.int32), slot,
+                                  num_segments=tbl + 1)[:tbl]
+    iota_b = jnp.arange(cap_b, dtype=jnp.int32)
+    row_tbl = jax.ops.segment_min(jnp.where(ok_b, iota_b, cap_b), slot,
+                                  num_segments=tbl + 1)[:tbl]
+    fail = jnp.any(usable_b & ~in_range_b) | jnp.any(cnt_tbl > 1)
+
+    live_p = probe.row_mask()
+    usable_p = live_p & pk.validity
+    kp = pk.data.astype(jnp.int64)
+    in_range_p = usable_p & (kp >= 0) & (kp < tbl)
+    pslot = jnp.where(in_range_p, kp, 0).astype(jnp.int32)
+    matched = in_range_p & (cnt_tbl[pslot] > 0)
+
+    if jt == "left_semi":
+        keep = matched
+        return ColumnarBatch(probe.columns,
+                             jnp.sum(keep.astype(jnp.int32)), out_schema,
+                             live=keep), fail
+    if jt == "left_anti":
+        keep = live_p & ~matched
+        return ColumnarBatch(probe.columns,
+                             jnp.sum(keep.astype(jnp.int32)), out_schema,
+                             live=keep), fail
+    build_row = jnp.clip(row_tbl[pslot], 0, cap_b - 1)
+    bvalid = matched
+    from .rowops import gather_column
+    bcols = tuple(gather_column(c, build_row, bvalid)
+                  for c in build.columns)
+    keep = matched if jt == "inner" else live_p
+    return ColumnarBatch(tuple(probe.columns) + bcols,
+                         jnp.sum(keep.astype(jnp.int32)), out_schema,
+                         live=keep), fail
+
+
 def binsearch_joinable(key: DeviceColumn) -> bool:
     """True when a key column qualifies for the single-key binary-search
     join path: fixed-width, non-string (dictionary codes are not comparable

@@ -64,6 +64,13 @@ class ExecContext:
     #: (site ordinal, traced total-match-count scalar) per deferred join
     #: batch — the observations join_caps learns from.
     join_totals: list = dataclasses.field(default_factory=list)
+    #: Join sites where the optimistic dense (direct-address) join path
+    #: failed a previous attempt (duplicate or out-of-range build keys);
+    #: those sites use the general sort-based kernel on retry.
+    no_dense: frozenset = frozenset()
+    #: (site ordinal, traced dense-ineligible flag) observations feeding
+    #: no_dense, mirroring join_totals.
+    dense_fails: list = dataclasses.field(default_factory=list)
     _join_site: int = 0
 
     def next_join_site(self) -> int:

@@ -1,0 +1,491 @@
+"""TPCxBB-like workload: retail + clickstream schema and query shapes.
+
+The reference's headline benchmark is its TPCxBB-like suite
+(``integration_tests/.../tpcxbb/TpcxbbLikeSpark.scala:1`` — 2,071 LoC,
+with ``docs/img/tpcxbb-like-results.png`` as the product chart). This
+module is the standalone analog: seeded generators produce the TPCxBB
+retail schema (store/web sales, web clickstreams, product reviews, items,
+customers) and each ``qN`` builder expresses the official query's SHAPE —
+basket analysis self-joins, clickstream sessionization through window
+functions, cross-channel path analysis, review/sales affinity — through
+the public DataFrame API.
+
+Sessionization follows the DataFrame re-expression of the reference's
+approach: clicks sort per user by time, a session-boundary flag marks
+gaps above the threshold, and the session id is the running sum of
+boundary flags (row-number self-join supplies the lag)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+from ..ops import aggregates as A
+from ..ops import predicates as P
+from ..ops.arithmetic import Add, Divide, Multiply, Subtract
+from ..ops.cast import Cast
+from ..ops.conditional import Coalesce, If
+from ..ops.expression import col, lit
+from ..ops.windows import RowNumber, Window, over
+from ..plan.logical import SortOrder
+from .. import types as T
+
+_CATEGORIES = np.array(["Books", "Electronics", "Home", "Jewelry", "Men",
+                        "Music", "Shoes", "Sports", "Children", "Women"])
+
+SESSION_GAP = 3600  # seconds, the official sessionize timeout
+
+
+def gen_tables(n_clicks: int = 1 << 18, seed: int = 42) -> dict:
+    rng = np.random.default_rng(seed)
+    n_item = max(n_clicks // 100, 64)
+    n_user = max(n_clicks // 50, 64)
+    n_ss = max(n_clicks // 2, 128)
+    n_ws = max(n_clicks // 4, 128)
+    n_pr = max(n_clicks // 20, 64)
+    n_dates = 365 * 2
+
+    cat_idx = rng.integers(0, len(_CATEGORIES), n_item)
+    item = pa.RecordBatch.from_pydict({
+        "i_item_sk": np.arange(n_item, dtype=np.int64),
+        "i_category_id": cat_idx.astype(np.int64),
+        "i_category": _CATEGORIES[cat_idx],
+        "i_current_price": np.round(rng.uniform(0.5, 200.0, n_item), 2),
+    }, schema=pa.schema([
+        ("i_item_sk", pa.int64()), ("i_category_id", pa.int64()),
+        ("i_category", pa.string()), ("i_current_price", pa.float64()),
+    ]))
+
+    customer = pa.RecordBatch.from_pydict({
+        "c_customer_sk": np.arange(n_user, dtype=np.int64),
+        "c_age": rng.integers(18, 80, n_user).astype(np.int64),
+        "c_income": np.round(rng.uniform(2e4, 2e5, n_user), 2),
+    }, schema=pa.schema([
+        ("c_customer_sk", pa.int64()), ("c_age", pa.int64()),
+        ("c_income", pa.float64()),
+    ]))
+
+    # Clickstream: ~5% of clicks convert to a sale (non-null sales sk);
+    # ~10% anonymous (null user).
+    wcs_user = pa.array(rng.integers(0, n_user, n_clicks).astype(np.int64),
+                        mask=rng.random(n_clicks) < 0.10)
+    wcs_sales = pa.array(
+        rng.integers(0, n_ws, n_clicks).astype(np.int64),
+        mask=rng.random(n_clicks) >= 0.05)
+    web_clickstreams = pa.RecordBatch.from_pydict({
+        "wcs_click_date_sk":
+            rng.integers(0, n_dates, n_clicks).astype(np.int64),
+        "wcs_click_time_sk":
+            rng.integers(0, 86400, n_clicks).astype(np.int64),
+        "wcs_user_sk": wcs_user,
+        "wcs_item_sk": rng.integers(0, n_item, n_clicks).astype(np.int64),
+        "wcs_sales_sk": wcs_sales,
+    }, schema=pa.schema([
+        ("wcs_click_date_sk", pa.int64()),
+        ("wcs_click_time_sk", pa.int64()), ("wcs_user_sk", pa.int64()),
+        ("wcs_item_sk", pa.int64()), ("wcs_sales_sk", pa.int64()),
+    ]))
+
+    qty = rng.integers(1, 20, n_ss).astype(np.int64)
+    price = np.round(rng.uniform(1.0, 100.0, n_ss), 2)
+    store_sales = pa.RecordBatch.from_pydict({
+        "ss_sold_date_sk": rng.integers(0, n_dates, n_ss).astype(np.int64),
+        "ss_customer_sk": rng.integers(0, n_user, n_ss).astype(np.int64),
+        "ss_item_sk": rng.integers(0, n_item, n_ss).astype(np.int64),
+        "ss_ticket_number":
+            rng.integers(0, max(n_ss // 5, 8), n_ss).astype(np.int64),
+        "ss_quantity": qty,
+        "ss_net_paid": np.round(price * qty, 2),
+    }, schema=pa.schema([
+        ("ss_sold_date_sk", pa.int64()), ("ss_customer_sk", pa.int64()),
+        ("ss_item_sk", pa.int64()), ("ss_ticket_number", pa.int64()),
+        ("ss_quantity", pa.int64()), ("ss_net_paid", pa.float64()),
+    ]))
+
+    wqty = rng.integers(1, 20, n_ws).astype(np.int64)
+    wprice = np.round(rng.uniform(1.0, 100.0, n_ws), 2)
+    web_sales = pa.RecordBatch.from_pydict({
+        "ws_sold_date_sk": rng.integers(0, n_dates, n_ws).astype(np.int64),
+        "ws_bill_customer_sk":
+            rng.integers(0, n_user, n_ws).astype(np.int64),
+        "ws_item_sk": rng.integers(0, n_item, n_ws).astype(np.int64),
+        "ws_quantity": wqty,
+        "ws_net_paid": np.round(wprice * wqty, 2),
+    }, schema=pa.schema([
+        ("ws_sold_date_sk", pa.int64()),
+        ("ws_bill_customer_sk", pa.int64()), ("ws_item_sk", pa.int64()),
+        ("ws_quantity", pa.int64()), ("ws_net_paid", pa.float64()),
+    ]))
+
+    product_reviews = pa.RecordBatch.from_pydict({
+        "pr_item_sk": rng.integers(0, n_item, n_pr).astype(np.int64),
+        "pr_user_sk": rng.integers(0, n_user, n_pr).astype(np.int64),
+        "pr_review_rating": rng.integers(1, 6, n_pr).astype(np.int64),
+        "pr_review_date_sk":
+            rng.integers(0, n_dates, n_pr).astype(np.int64),
+    }, schema=pa.schema([
+        ("pr_item_sk", pa.int64()), ("pr_user_sk", pa.int64()),
+        ("pr_review_rating", pa.int64()), ("pr_review_date_sk", pa.int64()),
+    ]))
+
+    return {"item": item, "customer": customer,
+            "web_clickstreams": web_clickstreams,
+            "store_sales": store_sales, "web_sales": web_sales,
+            "product_reviews": product_reviews}
+
+
+def load(session, tables: dict, cache: bool = True) -> dict:
+    return {name: (session.create_dataframe(rb).cache() if cache
+                   else session.create_dataframe(rb))
+            for name, rb in tables.items()}
+
+
+def _sum(e, name):
+    return A.AggregateExpression(A.Sum(e), name)
+
+
+def _avg(e, name):
+    return A.AggregateExpression(A.Average(e), name)
+
+
+def _cnt(name):
+    return A.AggregateExpression(A.Count(), name)
+
+
+def _eq(a, b):
+    return P.EqualTo(a, b)
+
+
+def _sessionized(t):
+    """Shared sessionization core (official q2/q8/q30 machinery): clicks
+    of identified users get a per-user session id = running count of
+    gaps > SESSION_GAP, via row-number self-join for the lag."""
+    clicks = (t["web_clickstreams"]
+              .where(P.IsNotNull(col("wcs_user_sk")))
+              .select(col("wcs_user_sk").alias("user"),
+                      Add(Multiply(col("wcs_click_date_sk"), lit(86400)),
+                          col("wcs_click_time_sk")).alias("ts"),
+                      col("wcs_item_sk").alias("item"),
+                      col("wcs_sales_sk").alias("sales_sk")))
+    rn_w = Window.partition_by("user").order_by(SortOrder(col("ts")))
+    v = clicks.with_column("rn", over(RowNumber(), rn_w))
+    prev = v.select(col("user").alias("p_user"), col("ts").alias("p_ts"),
+                    col("rn").alias("p_rn"))
+    flagged = (v.join(prev,
+                      on=P.And(_eq(col("user"), col("p_user")),
+                               _eq(col("rn"), Add(col("p_rn"), lit(1)))),
+                      how="left")
+               .with_column(
+                   "boundary",
+                   If(P.Or(P.IsNull(col("p_ts")),
+                           P.GreaterThan(Subtract(col("ts"), col("p_ts")),
+                                         lit(SESSION_GAP))),
+                      lit(1), lit(0))))
+    sess_w = (Window.partition_by("user").order_by(SortOrder(col("rn")))
+              .rows_between(Window.unbounded_preceding,
+                            Window.current_row))
+    return flagged.with_column("session_id",
+                               over(A.Sum(col("boundary")), sess_w))
+
+
+def q01(t):
+    """Q1: basket analysis — item pairs bought in the same store ticket,
+    by pair frequency (official q01's self-join shape)."""
+    a = t["store_sales"].select(col("ss_ticket_number").alias("t1"),
+                                col("ss_item_sk").alias("item_a"))
+    b = t["store_sales"].select(col("ss_ticket_number").alias("t2"),
+                                col("ss_item_sk").alias("item_b"))
+    return (a.join(b, on=_eq(col("t1"), col("t2")), how="inner")
+            .where(P.LessThan(col("item_a"), col("item_b")))
+            .group_by(col("item_a"), col("item_b"))
+            .agg(_cnt("cnt"))
+            .where(P.GreaterThanOrEqual(col("cnt"), lit(3)))
+            .sort(SortOrder(col("cnt"), ascending=False),
+                  SortOrder(col("item_a")), SortOrder(col("item_b")))
+            .limit(100))
+
+
+def q02(t):
+    """Q2: items clicked in the same session as a pivot item
+    (sessionized clickstream self-join)."""
+    s = _sessionized(t).select(col("user"), col("session_id"),
+                               col("item"))
+    pivot = (s.where(_eq(col("item"), lit(10)))
+             .select(col("user").alias("pv_user"),
+                     col("session_id").alias("pv_sess")).distinct())
+    return (s.join(pivot,
+                   on=P.And(_eq(col("user"), col("pv_user")),
+                            _eq(col("session_id"), col("pv_sess"))),
+                   how="left_semi")
+            .where(P.NotEqual(col("item"), lit(10)))
+            .group_by(col("item"))
+            .agg(_cnt("cnt"))
+            .sort(SortOrder(col("cnt"), ascending=False),
+                  SortOrder(col("item")))
+            .limit(30))
+
+
+def q03(t):
+    """Q3: items viewed within 10 days before a purchase of a target
+    category (click -> sale path join)."""
+    sales = (t["store_sales"]
+             .join(t["item"].where(_eq(col("i_category_id"), lit(3))),
+                   on=_eq(col("ss_item_sk"), col("i_item_sk")),
+                   how="inner")
+             .select(col("ss_customer_sk").alias("buyer"),
+                     col("ss_sold_date_sk").alias("sale_date"),
+                     col("ss_item_sk").alias("bought")))
+    clicks = (t["web_clickstreams"]
+              .where(P.IsNotNull(col("wcs_user_sk")))
+              .select(col("wcs_user_sk").alias("clicker"),
+                      col("wcs_click_date_sk").alias("click_date"),
+                      col("wcs_item_sk").alias("viewed")))
+    return (sales
+            .join(clicks,
+                  on=P.And(_eq(col("buyer"), col("clicker")),
+                           P.And(
+                               P.LessThanOrEqual(col("click_date"),
+                                                 col("sale_date")),
+                               P.GreaterThan(col("click_date"),
+                                             Subtract(col("sale_date"),
+                                                      lit(10))))),
+                  how="inner")
+            .group_by(col("viewed"))
+            .agg(_cnt("views_before_purchase"))
+            .sort(SortOrder(col("views_before_purchase"),
+                            ascending=False),
+                  SortOrder(col("viewed")))
+            .limit(100))
+
+
+def q04(t):
+    """Q4: shopping-cart abandonment — sessions whose clicks never
+    convert, as a share per category."""
+    s = _sessionized(t)
+    sess = (s.group_by(col("user"), col("session_id"))
+            .agg(_cnt("clicks"),
+                 _sum(If(P.IsNotNull(col("sales_sk")), lit(1), lit(0)),
+                      "conversions")))
+    return (sess
+            .group_by()
+            .agg(_cnt("sessions"),
+                 _sum(If(_eq(col("conversions"), lit(0)), lit(1), lit(0)),
+                      "abandoned"),
+                 _avg(col("clicks"), "avg_clicks")))
+
+
+def q05(t):
+    """Q5: logistic-regression feature build — per-user category click
+    counts + label (bought in category), the ML-handoff shape."""
+    clicks = (t["web_clickstreams"]
+              .where(P.IsNotNull(col("wcs_user_sk")))
+              .join(t["item"],
+                    on=_eq(col("wcs_item_sk"), col("i_item_sk")),
+                    how="inner"))
+    feats = []
+    for cid in range(6):
+        feats.append(_sum(If(_eq(col("i_category_id"), lit(cid)),
+                             lit(1), lit(0)), f"f{cid}"))
+    per_user = (clicks.group_by(col("wcs_user_sk"))
+                .agg(*feats, _cnt("total_clicks")))
+    buyers = (t["web_sales"]
+              .join(t["item"].where(_eq(col("i_category_id"), lit(3))),
+                    on=_eq(col("ws_item_sk"), col("i_item_sk")),
+                    how="inner")
+              .select(col("ws_bill_customer_sk").alias("buyer"))
+              .distinct()
+              .with_column("label", lit(1)))
+    return (per_user
+            .join(buyers, on=_eq(col("wcs_user_sk"), col("buyer")),
+                  how="left")
+            .select(col("wcs_user_sk"),
+                    *[col(f"f{c}") for c in range(6)],
+                    col("total_clicks"),
+                    Coalesce(col("label"), lit(0)).alias("label"))
+            .sort(SortOrder(col("wcs_user_sk")))
+            .limit(1000))
+
+
+def q06(t):
+    """Q6: customers whose web spend grew faster than store spend between
+    two periods (cross-channel year-over-year, official q06 shape)."""
+    def period_total(fact, cust, date_col, paid, lo, hi, name):
+        return (t[fact]
+                .where(P.And(P.GreaterThanOrEqual(col(date_col), lit(lo)),
+                             P.LessThan(col(date_col), lit(hi))))
+                .group_by(col(cust))
+                .agg(_sum(col(paid), name))
+                .select(col(cust).alias(name + "_cust"), col(name)))
+
+    ss1 = period_total("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                       "ss_net_paid", 0, 365, "ss_p1")
+    ss2 = period_total("store_sales", "ss_customer_sk", "ss_sold_date_sk",
+                       "ss_net_paid", 365, 730, "ss_p2")
+    ws1 = period_total("web_sales", "ws_bill_customer_sk",
+                       "ws_sold_date_sk", "ws_net_paid", 0, 365, "ws_p1")
+    ws2 = period_total("web_sales", "ws_bill_customer_sk",
+                       "ws_sold_date_sk", "ws_net_paid", 365, 730, "ws_p2")
+    return (ss1
+            .join(ss2, on=_eq(col("ss_p1_cust"), col("ss_p2_cust")),
+                  how="inner")
+            .join(ws1, on=_eq(col("ss_p1_cust"), col("ws_p1_cust")),
+                  how="inner")
+            .join(ws2, on=_eq(col("ss_p1_cust"), col("ws_p2_cust")),
+                  how="inner")
+            .where(P.And(P.GreaterThan(col("ss_p1"), lit(0.0)),
+                         P.GreaterThan(col("ws_p1"), lit(0.0))))
+            .where(P.GreaterThan(Divide(col("ws_p2"), col("ws_p1")),
+                                 Divide(col("ss_p2"), col("ss_p1"))))
+            .select(col("ss_p1_cust").alias("customer"),
+                    Divide(col("ws_p2"), col("ws_p1")).alias("web_growth"))
+            .sort(SortOrder(col("web_growth"), ascending=False),
+                  SortOrder(col("customer")))
+            .limit(100))
+
+
+def q07(t):
+    """Q7: categories where >= 10 items are priced above 1.2x the
+    category average (correlated avg subquery shape)."""
+    cat_avg = (t["item"].group_by(col("i_category_id"))
+               .agg(_avg(col("i_current_price"), "cat_avg"))
+               .select(col("i_category_id").alias("ca_cat"),
+                       col("cat_avg")))
+    return (t["item"]
+            .join(cat_avg, on=_eq(col("i_category_id"), col("ca_cat")),
+                  how="inner")
+            .where(P.GreaterThan(col("i_current_price"),
+                                 Multiply(lit(1.2), col("cat_avg"))))
+            .group_by(col("i_category"))
+            .agg(_cnt("pricey_items"))
+            .where(P.GreaterThanOrEqual(col("pricey_items"), lit(10)))
+            .sort(SortOrder(col("pricey_items"), ascending=False),
+                  SortOrder(col("i_category")))
+            .limit(100))
+
+
+def q08(t):
+    """Q8: web sales of review-readers vs non-readers (EXISTS against
+    product_reviews per buyer)."""
+    readers = (t["product_reviews"]
+               .select(col("pr_user_sk").alias("reader")).distinct())
+    ws = t["web_sales"]
+    read_sales = (ws.join(readers,
+                          on=_eq(col("ws_bill_customer_sk"),
+                                 col("reader")),
+                          how="left_semi")
+                  .group_by().agg(_sum(col("ws_net_paid"), "reader_paid"),
+                                  _cnt("reader_orders")))
+    nonread_sales = (ws.join(readers,
+                             on=_eq(col("ws_bill_customer_sk"),
+                                    col("reader")),
+                             how="left_anti")
+                     .group_by().agg(_sum(col("ws_net_paid"),
+                                          "nonreader_paid"),
+                                     _cnt("nonreader_orders")))
+    return read_sales.join(nonread_sales, how="cross")
+
+
+def q09(t):
+    """Q9: store revenue under layered demographic/price disjunctions
+    (official q09's conditional aggregate shape)."""
+    joined = (t["store_sales"]
+              .join(t["customer"],
+                    on=_eq(col("ss_customer_sk"), col("c_customer_sk")),
+                    how="inner"))
+    ok = P.Or(
+        P.And(P.GreaterThanOrEqual(col("c_age"), lit(40)),
+              P.GreaterThan(col("c_income"), lit(1e5))),
+        P.Or(P.And(P.LessThan(col("c_age"), lit(30)),
+                   P.GreaterThan(col("ss_quantity"), lit(10))),
+             P.GreaterThan(col("ss_net_paid"), lit(900.0))))
+    return (joined.where(ok)
+            .group_by()
+            .agg(_sum(col("ss_net_paid"), "revenue"), _cnt("rows")))
+
+
+def q10(t):
+    """Q10: items whose average review rating trails their category's
+    (review sentiment stand-in, grouped-vs-parent comparison)."""
+    item_avg = (t["product_reviews"]
+                .group_by(col("pr_item_sk"))
+                .agg(_avg(col("pr_review_rating"), "item_rating"),
+                     _cnt("n_reviews")))
+    cat = (item_avg
+           .join(t["item"], on=_eq(col("pr_item_sk"), col("i_item_sk")),
+                 how="inner"))
+    cat_avg = (cat.group_by(col("i_category_id"))
+               .agg(_avg(col("item_rating"), "cat_rating"))
+               .select(col("i_category_id").alias("ca_cat"),
+                       col("cat_rating")))
+    return (cat
+            .join(cat_avg, on=_eq(col("i_category_id"), col("ca_cat")),
+                  how="inner")
+            .where(P.GreaterThanOrEqual(col("n_reviews"), lit(3)))
+            .where(P.LessThan(col("item_rating"),
+                              Subtract(col("cat_rating"), lit(0.5))))
+            .select(col("pr_item_sk"), col("i_category"),
+                    col("item_rating"), col("cat_rating"))
+            .sort(SortOrder(col("item_rating")),
+                  SortOrder(col("pr_item_sk")))
+            .limit(100))
+
+
+def q11(t):
+    """Q11: per-item review count vs web sales (correlation feed — the
+    official computes corr(); the shape is the two-aggregate join)."""
+    reviews = (t["product_reviews"].group_by(col("pr_item_sk"))
+               .agg(_cnt("n_reviews"),
+                    _avg(col("pr_review_rating"), "rating")))
+    sales = (t["web_sales"].group_by(col("ws_item_sk"))
+             .agg(_sum(col("ws_net_paid"), "revenue")))
+    return (reviews
+            .join(sales, on=_eq(col("pr_item_sk"), col("ws_item_sk")),
+                  how="inner")
+            .select(col("pr_item_sk"),
+                    Cast(col("n_reviews"), T.DOUBLE).alias("x"),
+                    col("rating"), col("revenue"))
+            .group_by()
+            .agg(_cnt("n"), _sum(col("x"), "sum_x"),
+                 _sum(col("revenue"), "sum_y"),
+                 _sum(Multiply(col("x"), col("revenue")), "sum_xy"),
+                 _sum(Multiply(col("x"), col("x")), "sum_xx"),
+                 _sum(Multiply(col("revenue"), col("revenue")), "sum_yy")))
+
+
+def q12(t):
+    """Q12: click in a category then store purchase in that category
+    within 90 days (cross-channel path, official q12 shape)."""
+    clicks = (t["web_clickstreams"]
+              .where(P.IsNotNull(col("wcs_user_sk")))
+              .join(t["item"].where(P.In(col("i_category_id"), [1, 3, 5])),
+                    on=_eq(col("wcs_item_sk"), col("i_item_sk")),
+                    how="inner")
+              .select(col("wcs_user_sk").alias("u"),
+                      col("wcs_click_date_sk").alias("cd"),
+                      col("i_category_id").alias("cat")))
+    sales = (t["store_sales"]
+             .join(t["item"].where(P.In(col("i_category_id"), [1, 3, 5])),
+                   on=_eq(col("ss_item_sk"), col("i_item_sk")),
+                   how="inner")
+             .select(col("ss_customer_sk").alias("b"),
+                     col("ss_sold_date_sk").alias("sd"),
+                     col("i_category_id").alias("scat")))
+    return (clicks
+            .join(sales,
+                  on=P.And(_eq(col("u"), col("b")),
+                           P.And(_eq(col("cat"), col("scat")),
+                                 P.And(P.GreaterThan(col("sd"), col("cd")),
+                                       P.LessThanOrEqual(
+                                           col("sd"),
+                                           Add(col("cd"), lit(90)))))),
+                  how="left_semi")
+            .select(col("u"), col("cat")).distinct()
+            .group_by(col("cat"))
+            .agg(_cnt("converting_users"))
+            .sort(SortOrder(col("cat")))
+            .limit(100))
+
+
+QUERIES = {"q01": q01, "q02": q02, "q03": q03, "q04": q04, "q05": q05,
+           "q06": q06, "q07": q07, "q08": q08, "q09": q09, "q10": q10,
+           "q11": q11, "q12": q12}
