@@ -206,8 +206,8 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
         child = _compile(node.children[0], sources, n_parts, bucket_growth,
                          conf)
         child_schema = node.children[0].schema
-        _require(bool(node.groupings), "global agg needs no shuffle; "
-                 "mesh path expects grouped agg here")
+        if not node.groupings:
+            return _compile_global_agg(node, child, child_schema)
         from ..ops.expression import Alias, AttributeReference, \
             BoundReference
         for g in node.groupings:
@@ -322,6 +322,56 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
     raise NotMeshCapable(type(node).__name__)
 
 
+def _compile_global_agg(node, child, child_schema):
+    """Global (no-key) aggregate over the mesh: local partial buffers per
+    shard, then ONE cross-chip collective per buffer (psum/pmin/pmax over
+    ICI — no keyed exchange needed), finalize, and emit the single row on
+    chip 0 only."""
+    from ..ops import aggregates as AGG
+    from ..ops.kernels.groupby import _max_value, _min_value
+    aggs = [AGG.AggregateExpression(a.func.bind(child_schema), a.name)
+            for a in node.aggregates]
+    buf_schema = node._buffer_schema()
+    merge_ops = [s.merge_op for a in aggs for s in a.func.buffers()]
+    for op in merge_ops:
+        _require(op in ("sum", "count", "min", "max"),
+                 f"global-agg merge op {op!r} over the mesh")
+    final = finalize_agg_kernel(0, node.aggregates, buf_schema,
+                                node.schema)
+
+    def gagg(env, flags):
+        local = child(env, flags)
+        part = _aggregate_batch(local, [], aggs, buf_schema, 0,
+                                update_mode=True)
+        row0 = jnp.arange(part.capacity, dtype=jnp.int32) == 0
+        cols = []
+        for c, op in zip(part.columns, merge_ops):
+            valid = c.validity & row0
+            any_valid = jax.lax.pmax(valid.astype(jnp.int32),
+                                     PART_AXIS) > 0
+            if op in ("sum", "count"):
+                data = jax.lax.psum(
+                    jnp.where(valid, c.data, jnp.zeros((), c.data.dtype)),
+                    PART_AXIS)
+            elif op == "min":
+                data = jax.lax.pmin(
+                    jnp.where(valid, c.data, _max_value(c.data.dtype)),
+                    PART_AXIS)
+            else:
+                data = jax.lax.pmax(
+                    jnp.where(valid, c.data, _min_value(c.data.dtype)),
+                    PART_AXIS)
+            v = any_valid & row0
+            cols.append(DeviceColumn(
+                data=jnp.where(v, data, jnp.zeros((), data.dtype)),
+                validity=v, dtype=c.dtype))
+        mine = jax.lax.axis_index(PART_AXIS) == 0
+        n = jnp.where(mine, 1, 0).astype(jnp.int32)
+        merged = ColumnarBatch(tuple(cols), n, buf_schema)
+        return final(merged)
+    return gagg
+
+
 def _replicate(batch: ColumnarBatch) -> ColumnarBatch:
     """all_gather every chip's shard and compact: the mesh broadcast —
     every chip ends up with the full (small) table resident locally.
@@ -375,6 +425,32 @@ def _encoding_fingerprint(node) -> tuple:
     return tuple(out)
 
 
+def _split_tail(plan):
+    """Split trailing single-chip finishers (sort / limit / project /
+    coalesce above the last wide op) off the mesh core: the core's result
+    is tiny (post-aggregate), so the tail runs on the collected output
+    through the ordinary streaming path — the reference likewise finishes
+    ORDER BY/LIMIT driver-side after its accelerated stages."""
+    from .execs import TpuLimitExec, TpuLocalLimitExec, TpuSortExec
+    peelable = (TpuSortExec, TpuLimitExec, TpuLocalLimitExec,
+                TpuProjectExec, TpuCoalesceBatchesExec)
+    ordered = (TpuSortExec, TpuLimitExec, TpuLocalLimitExec)
+
+    def prefix_has_ordered(n):
+        while isinstance(n, peelable):
+            if isinstance(n, ordered):
+                return True
+            n = n.children[0]
+        return False
+
+    tail = []
+    node = plan
+    while isinstance(node, peelable) and prefix_has_ordered(node):
+        tail.append(node)
+        node = node.children[0]
+    return tail, node
+
+
 def mesh_capable(root, conf) -> bool:
     if not isinstance(root, DeviceToHostExec):
         return False
@@ -383,7 +459,8 @@ def mesh_capable(root, conf) -> bool:
     cached = _MESH_CACHE.get(sig)
     if cached is None:
         try:
-            _compile(root.children[0], [], 2, 1.0, conf)
+            _, core = _split_tail(root.children[0])
+            _compile(core, [], 2, 1.0, conf)
             cached = True
         except NotMeshCapable:
             cached = False
@@ -473,7 +550,29 @@ def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
                  ) -> Tuple[Optional[pa.Table], bool]:
     """Run a mesh-capable plan as one SPMD program over the device mesh.
     Returns (table, overflowed)."""
-    device_plan = root.children[0]
+    tail, core = _split_tail(root.children[0])
+    if tail:
+        table, overflowed = _mesh_core_collect(core, ctx, mesh)
+        if overflowed or table is None:
+            return None, True
+        # Finish sort/limit/project on the (small) collected result via
+        # the ordinary streaming path.
+        from ..plan.physical import collect_partitions
+        src = DeviceSourceExec(
+            [[ColumnarBatch.from_arrow(rb)
+              for rb in table.combine_chunks().to_batches()]],
+            core.schema)
+        plan = src
+        for op in reversed(tail):
+            plan = op.with_children([plan])
+        out = collect_partitions(DeviceToHostExec(plan), ctx)
+        return out, False
+    return _mesh_core_collect(core, ctx, mesh)
+
+
+def _mesh_core_collect(device_plan, ctx: ExecContext,
+                       mesh: Optional[Mesh] = None
+                       ) -> Tuple[Optional[pa.Table], bool]:
     mesh = mesh or make_mesh()
     n_parts = mesh.devices.size
     bucket_growth = float(ctx.join_growth)
@@ -575,7 +674,7 @@ def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
         (out_bufs, out_counts, out_flags))
     if bool(np.any(flags_np)):
         return None, True
-    out_schema = root.schema
+    out_schema = device_plan.schema
     arrow_schema = T.schema_to_arrow(out_schema)
     shard_out_cap = got_bufs[0][0].shape[0] // n_parts if got_bufs else 0
     batches = []

@@ -2921,7 +2921,12 @@ def q67(t):
             .sort(SortOrder(col("i_category")), SortOrder(col("rk")),
                   SortOrder(col("sumsales"), ascending=False),
                   SortOrder(col("i_product_name")),
-                  SortOrder(col("s_store_id")))
+                  SortOrder(col("s_store_id")),
+                  # full tie-break: equal (rank, sumsales) rollup rows
+                  # otherwise make the LIMIT row set engine-dependent
+                  SortOrder(col("i_class")), SortOrder(col("i_brand")),
+                  SortOrder(col("d_year")), SortOrder(col("d_qoy")),
+                  SortOrder(col("d_moy")))
             .limit(100))
 
 

@@ -1,0 +1,29 @@
+"""TPCxBB-like suite as differential tests — the reference's headline
+benchmark harness (TpcxbbLikeSpark.scala:1) applied through the
+differential oracle."""
+
+import pytest
+
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.workloads import tpcxbb
+
+N_CLICKS = 1 << 14
+
+
+@pytest.fixture(scope="module")
+def envs():
+    tables = tpcxbb.gen_tables(N_CLICKS, seed=23)
+    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+    tpu = TpuSession({"spark.rapids.sql.enabled": True,
+                      "spark.rapids.sql.variableFloatAgg.enabled": True})
+    return tpcxbb.load(cpu, tables), tpcxbb.load(tpu, tables)
+
+
+@pytest.mark.parametrize("name", sorted(tpcxbb.QUERIES))
+def test_query_differential(envs, name):
+    cpu_t, tpu_t = envs
+    q = tpcxbb.QUERIES[name]
+    from spark_rapids_tpu.workloads.compare import tables_match
+    cpu_result = q(cpu_t).collect()
+    tpu_result = q(tpu_t).collect()
+    assert tables_match(tpu_result, cpu_result, rel_tol=1e-9, abs_tol=1e-9)
