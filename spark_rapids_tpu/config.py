@@ -198,6 +198,15 @@ AUTO_BROADCAST_JOIN_ROWS = conf_int(
     "plan as broadcast hash joins; -1 disables (row-count analog of "
     "spark.sql.autoBroadcastJoinThreshold).")
 
+ORC_DEVICE_DECODE = conf_bool(
+    "spark.rapids.sql.orc.deviceDecode.enabled", True,
+    "Decode ORC stripes ON DEVICE: the host parses the protobuf tail, "
+    "stripe footers, and RLEv2 run headers into compact run tables; "
+    "traced kernels expand runs to rows, scatter non-null slots through "
+    "the PRESENT bitmask, and gather dictionary codes (the GpuOrcScan "
+    "stripe-reassembly split, GpuOrcScan.scala:65,211). Stripes outside "
+    "the decoder's scope fall back to the host reader per stripe.")
+
 PARQUET_DEVICE_DECODE = conf_bool(
     "spark.rapids.sql.parquet.deviceDecode.enabled", True,
     "Decode parquet pages ON DEVICE: the host parses footers/page headers "

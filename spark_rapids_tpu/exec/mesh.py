@@ -478,9 +478,11 @@ def clear_mesh_cache() -> None:
 def _is_scan_source(node) -> bool:
     """Upload-at-execution source nodes: a host scan behind its upload
     transition, or the device parquet decoder."""
+    from ..io.orc_device import TpuOrcScanExec
     from ..io.parquet_device import TpuParquetScanExec
     from .execs import HostToDeviceExec
-    return isinstance(node, (HostToDeviceExec, TpuParquetScanExec))
+    return isinstance(node, (HostToDeviceExec, TpuParquetScanExec,
+                             TpuOrcScanExec))
 
 
 def _collect_sources(node, out: List) -> None:

@@ -635,19 +635,34 @@ class TpuOverrides:
 
 
 def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
-    """Swap an uploadable parquet host scan for the device decoder
-    (io/parquet_device.py) when every row group qualifies."""
-    from ..config import PARQUET_DEVICE_DECODE
+    """Swap an uploadable parquet/ORC host scan for the device decoder
+    (io/parquet_device.py, io/orc_device.py) when every unit qualifies."""
+    from ..config import ORC_DEVICE_DECODE, PARQUET_DEVICE_DECODE
     from ..io.files import CpuFileScanExec
-    if conf is None or not conf.get(PARQUET_DEVICE_DECODE):
+    if conf is None or not isinstance(node, CpuFileScanExec):
         return None
-    if not isinstance(node, CpuFileScanExec) or node.fmt != "parquet":
-        return None
-    if node.pushed_filters:
-        return None
-    if node.emit_file_meta:
+    if node.pushed_filters or node.emit_file_meta:
         # input_file_name() queries synthesize metadata columns host-side;
         # the host scan + upload path handles them.
+        return None
+    if node.fmt == "orc" and conf.get(ORC_DEVICE_DECODE):
+        from ..io import orc_device as OD
+        files = OD.scan_files(node.paths)
+        if not files:
+            return None
+        tails = {}
+        for f in files:
+            try:
+                tail = OD.read_tail(f)
+            except Exception:
+                return None
+            if not OD.device_decodable(f, node.schema, tail):
+                return None
+            tails[f] = tail
+        return OD.TpuOrcScanExec(files, node.schema, tails)
+    if not conf.get(PARQUET_DEVICE_DECODE):
+        return None
+    if node.fmt != "parquet":
         return None
     from ..io import parquet_device as PD
     files = PD.scan_files(node.paths)

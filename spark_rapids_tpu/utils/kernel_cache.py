@@ -64,7 +64,8 @@ def _expr_signature(e) -> tuple:
 
 
 #: Exec attributes that are per-instance data, not structure.
-PLAN_SIG_SKIP_ATTRS = frozenset({"children", "partitions", "_pf_cache"})
+PLAN_SIG_SKIP_ATTRS = frozenset({"children", "partitions", "_pf_cache",
+                                 "_tails"})
 
 
 def plan_signature(p) -> tuple:
