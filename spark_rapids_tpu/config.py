@@ -230,6 +230,14 @@ ADAPTIVE_TARGET_SIZE = conf_int(
     "coalescing/splitting (spark.sql.adaptive.advisoryPartitionSizeInBytes "
     "analog).")
 
+ADAPTIVE_BROADCAST_THRESHOLD = conf_int(
+    "spark.rapids.sql.adaptive.autoBroadcastThresholdBytes", 10 << 20,
+    "Re-plan a shuffled exchange whose OBSERVED output is at most this "
+    "many serialized bytes into a broadcast-style mapper-local read "
+    "(PartialMapper specs, ShuffledBatchRDD.scala:31-105): reduce-side "
+    "routing is skipped and downstream joins build from the whole "
+    "(small) output. Range exchanges never convert (order contract).")
+
 ADAPTIVE_SKEW_FACTOR = conf_float(
     "spark.rapids.sql.adaptive.skewedPartitionFactor", 5.0,
     "A reduce partition is skewed when its size exceeds this multiple of "

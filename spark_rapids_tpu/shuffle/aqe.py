@@ -50,6 +50,22 @@ class PartialReducerSpec:
     map_end: int
 
 
+@dataclasses.dataclass(frozen=True)
+class PartialMapperSpec:
+    """Read EVERY reduce id of map ids [map_start, map_end) — the
+    mapper-local read AQE uses when a shuffled exchange re-plans to a
+    broadcast-style consumer (PartialMapperPartitionSpec,
+    ShuffledBatchRDD.scala:31-105): no reduce-side routing, each output
+    partition is a mapper's whole output."""
+
+    map_start: int
+    map_end: int
+
+
+def plan_mapper_specs(n_maps: int) -> List["PartialMapperSpec"]:
+    return [PartialMapperSpec(m, m + 1) for m in range(max(n_maps, 1))]
+
+
 def _median(xs: List[int]) -> float:
     s = sorted(xs)
     n = len(s)

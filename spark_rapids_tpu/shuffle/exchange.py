@@ -298,17 +298,35 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         # (GpuCustomShuffleReaderExec analog; see shuffle/aqe.py). Skew
         # split only for round-robin exchanges, which carry no
         # co-partitioning guarantee downstream.
-        from ..config import (ADAPTIVE_ENABLED, ADAPTIVE_SKEW_FACTOR,
+        from ..config import (ADAPTIVE_BROADCAST_THRESHOLD,
+                              ADAPTIVE_ENABLED, ADAPTIVE_SKEW_FACTOR,
                               ADAPTIVE_SKEW_THRESHOLD, ADAPTIVE_TARGET_SIZE)
         from . import aqe
         if ctx.conf.get(ADAPTIVE_ENABLED) and n_parts > 1:
-            specs = aqe.plan_specs(
-                catalog.sizes_for_shuffle(shuffle_id), n_parts, map_id,
-                ctx.conf.get(ADAPTIVE_TARGET_SIZE),
-                ctx.conf.get(ADAPTIVE_SKEW_FACTOR),
-                ctx.conf.get(ADAPTIVE_SKEW_THRESHOLD),
-                allow_skew_split=getattr(self.partitioner_factory, "mode",
-                                         None) == "round_robin")
+            sizes = catalog.sizes_for_shuffle(shuffle_id)
+            total_bytes = sum(sizes.values())
+            from .partitioners import RangePartitioner
+            # Range partitioning carries an ORDER contract downstream
+            # (partition p's keys < partition p+1's) — never convert it.
+            convertible = not isinstance(partitioner, RangePartitioner)
+            if convertible and total_bytes <= ctx.conf.get(
+                    ADAPTIVE_BROADCAST_THRESHOLD):
+                # Re-plan shuffled -> broadcast-style: the observed output
+                # is small enough to replicate, so skip reduce-side
+                # routing entirely and read mapper-local (PartialMapper,
+                # ShuffledBatchRDD.scala:31-105). Downstream joins
+                # accumulate the whole build side regardless, so dropping
+                # co-partitioning is safe in this single-process engine.
+                specs = aqe.plan_mapper_specs(map_id)
+                ctx.metric("TpuShuffleExchange", "aqeBroadcastConverted", 1)
+            else:
+                specs = aqe.plan_specs(
+                    sizes, n_parts, map_id,
+                    ctx.conf.get(ADAPTIVE_TARGET_SIZE),
+                    ctx.conf.get(ADAPTIVE_SKEW_FACTOR),
+                    ctx.conf.get(ADAPTIVE_SKEW_THRESHOLD),
+                    allow_skew_split=getattr(self.partitioner_factory,
+                                             "mode", None) == "round_robin")
             ctx.metric("TpuShuffleExchange", "aqeOutputPartitions",
                        len(specs))
         else:
@@ -320,6 +338,10 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 if isinstance(spec, aqe.PartialReducerSpec):
                     pieces = [(spec.reduce_id,
                                (spec.map_start, spec.map_end))]
+                elif isinstance(spec, aqe.PartialMapperSpec):
+                    # mapper-local: every reduce id of this map range
+                    pieces = [(p, (spec.map_start, spec.map_end))
+                              for p in range(n_parts)]
                 else:
                     pieces = [(p, None)
                               for p in range(spec.start, spec.end)]

@@ -69,6 +69,10 @@ AQE_CONF = {
     "spark.rapids.sql.adaptive.enabled": True,
     "spark.rapids.sql.adaptive.targetPartitionSizeBytes": 4096,
     "spark.rapids.sql.adaptive.skewedPartitionThresholdBytes": 2048,
+    # coalesce/skew tests exercise their own specs; broadcast conversion
+    # (tested separately below) would otherwise swallow these tiny
+    # exchanges first
+    "spark.rapids.sql.adaptive.autoBroadcastThresholdBytes": 0,
 }
 
 
@@ -117,3 +121,70 @@ class TestAdaptiveExchange:
         for k in rb.column(0).to_pylist():
             want[k] = want.get(k, 0) + 1
         assert {r["k"]: r["count"] for r in got} == want
+
+
+class TestBroadcastReplan:
+    """Shuffled -> broadcast re-planning on observed sizes: a small
+    exchange reads mapper-local through PartialMapper specs
+    (ShuffledBatchRDD.scala:31-105) and the query still matches the
+    oracle."""
+
+    def test_small_exchange_replans_to_mapper_local(self):
+        conf = {
+            "spark.rapids.sql.adaptive.enabled": True,
+            "spark.rapids.sql.adaptive.autoBroadcastThresholdBytes":
+                10 << 20,
+        }
+        s = tpu_session(**{**conf, "spark.rapids.sql.test.enabled": False})
+        small = s.create_dataframe(_skewed_batch(400, seed=3)) \
+            .repartition(8, col("k"))
+        big = s.create_dataframe(_skewed_batch(4000, seed=4))
+        out = (big.join(small, on="k", how="left_semi")
+               .group_by(col("k")).count())
+        from spark_rapids_tpu.plan import physical as P
+        physical = s.plan(out._plan)
+        ctx = P.ExecContext(s.conf, catalog=s.device_manager.catalog)
+        try:
+            from spark_rapids_tpu.plan.physical import collect_partitions
+            got = collect_partitions(physical, ctx)
+            metrics = ctx.metrics.get("TpuShuffleExchange", {})
+        finally:
+            ctx.close()
+        assert metrics.get("aqeBroadcastConverted"), \
+            f"small exchange must convert to mapper-local: {metrics}"
+        # correctness vs oracle
+        assert_tpu_and_cpu_are_equal(
+            lambda ss: (ss.create_dataframe(_skewed_batch(4000, seed=4))
+                        .join(ss.create_dataframe(_skewed_batch(400,
+                                                                seed=3))
+                              .repartition(8, col("k")),
+                              on="k", how="left_semi")
+                        .group_by(col("k")).count()),
+            conf=conf)
+
+    def test_partial_mapper_specs_cover_all_blocks(self):
+        specs = aqe.plan_mapper_specs(3)
+        assert specs == [aqe.PartialMapperSpec(0, 1),
+                         aqe.PartialMapperSpec(1, 2),
+                         aqe.PartialMapperSpec(2, 3)]
+
+    def test_range_exchange_never_converts(self):
+        conf = {
+            "spark.rapids.sql.adaptive.enabled": True,
+            "spark.rapids.sql.adaptive.autoBroadcastThresholdBytes":
+                10 << 20,
+        }
+        s = tpu_session(**{**conf, "spark.rapids.sql.test.enabled": False})
+        df = s.create_dataframe(_skewed_batch(500, seed=5)) \
+            .repartition_by_range(4, "v")
+        from spark_rapids_tpu.plan import physical as P
+        physical = s.plan(df._plan)
+        ctx = P.ExecContext(s.conf, catalog=s.device_manager.catalog)
+        try:
+            from spark_rapids_tpu.plan.physical import collect_partitions
+            collect_partitions(physical, ctx)
+            metrics = ctx.metrics.get("TpuShuffleExchange", {})
+        finally:
+            ctx.close()
+        assert not metrics.get("aqeBroadcastConverted"), \
+            "range exchange must keep its order contract"
