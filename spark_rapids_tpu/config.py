@@ -230,6 +230,15 @@ ADAPTIVE_TARGET_SIZE = conf_int(
     "coalescing/splitting (spark.sql.adaptive.advisoryPartitionSizeInBytes "
     "analog).")
 
+WINDOW_EXTERNAL_THRESHOLD = conf_int(
+    "spark.rapids.sql.window.externalThresholdBytes", 0,
+    "Window inputs above this many device bytes evaluate in bounded "
+    "chunks: the input external-sorts by the (shared) partition-by keys "
+    "through the spill catalog and complete key groups stream one chunk "
+    "at a time (GpuWindowExec + spill store interplay). 0 = a quarter "
+    "of the device spill budget. Chunked output rows arrive partition-"
+    "sorted rather than in input order.")
+
 ADAPTIVE_BROADCAST_THRESHOLD = conf_int(
     "spark.rapids.sql.adaptive.autoBroadcastThresholdBytes", 10 << 20,
     "Re-plan a shuffled exchange whose OBSERVED output is at most this "

@@ -77,17 +77,138 @@ class TpuWindowExec(TpuExec):
         window_all = cached_kernel("window", kernel_key(bound, out_schema),
                                    build)
 
+        # Bounded-memory chunking applies when every window expression
+        # shares ONE non-empty partition-by key list: the input external-
+        # sorts by those keys through the spill catalog, and complete key
+        # groups evaluate chunk by chunk — the device never holds the
+        # whole dataset (GpuWindowExec + spill store interplay,
+        # GpuWindowExec.scala:92).
+        part_sigs = {kernel_key(pt) for _, _, pt, _, _ in bound}
+        common_parts = bound[0][2] if bound and len(part_sigs) == 1 \
+            and bound[0][2] else None
+
         def run(parts):
-            # Windows require whole window partitions; the child's physical
-            # partitioning is arbitrary (e.g. round-robin repartition), so
-            # collect ALL partitions before evaluating — the global-sort
-            # pattern. Spark gets this via ClusteredDistribution(partitionBy)
-            # + an exchange; a distributed mesh plan re-introduces that.
+            from ..config import WINDOW_EXTERNAL_THRESHOLD
+            from ..memory import spill as SP
+            catalog = getattr(ctx, "catalog", None)
             batches = [db for part in parts for db in part]
             if not batches:
                 return
-            yield window_all(_coalesce_device(batches))
+            threshold = None
+            if catalog is not None and not ctx.in_fusion \
+                    and common_parts is not None:
+                threshold = ctx.conf.get(WINDOW_EXTERNAL_THRESHOLD) or \
+                    catalog.device_budget // 4
+            total = sum(b.device_size_bytes for b in batches)
+            if threshold is None or total <= threshold:
+                yield window_all(_coalesce_device(batches))
+                return
+            for piece in _chunked_pieces(batches, common_parts,
+                                         child_schema, catalog, ctx,
+                                         threshold):
+                ctx.metric("TpuWindow", "chunkedWindow", 1)
+                yield window_all(piece)
         return [run(self.children[0].execute(ctx))]
+
+
+def _chunked_pieces(batches, part_exprs, child_schema, catalog, ctx,
+                    threshold):
+    """Stream complete partition-key groups under a bounded device
+    footprint: external-sort the input by the partition keys (runs spill
+    through the catalog), then walk the globally sorted chunks carrying
+    the trailing (possibly incomplete) key group into the next chunk.
+    Each yielded piece holds only COMPLETE groups — except the final one,
+    which flushes the remainder."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..plan.logical import SortOrder
+    from .execs import _coalesce_device
+    from .external_sort import ExternalSorter, _slice_kernel
+
+    orders = [SortOrder(e) for e in part_exprs]
+    sorter = ExternalSorter(orders, child_schema, catalog,
+                            key_exprs=list(part_exprs))
+    try:
+        slice_k = _slice_kernel(child_schema)
+        from ..data.column import bucket_capacity
+        for b in batches:
+            # The upstream coalesce (RequireSingleBatch goal) may hand us
+            # one oversized batch; re-slice so sorted runs (and therefore
+            # the merged chunk stream) stay threshold-bounded.
+            per_row = max(b.device_size_bytes // max(b.capacity, 1), 1)
+            rows_per = bucket_capacity(
+                max(int(threshold // per_row) or 128, 128))
+            if b.capacity <= rows_per:
+                sorter.add_batch(b)
+                continue
+            b = KR.physical_jit(b)
+            total = int(jax.device_get(b.n_rows))
+            off = 0
+            while off < total:
+                take = min(rows_per, total - off)
+                sorter.add_batch(slice_k(
+                    b, jnp.asarray(off, jnp.int32),
+                    jnp.asarray(take, jnp.int32),
+                    bucket_capacity(max(take, 128))))
+                off += take
+
+        def build_split():
+            def n_complete(piece):
+                keys = [e.eval_device(piece) for e in part_exprs]
+                live = piece.row_mask()
+                last = jnp.clip(piece.n_rows - 1, 0, piece.capacity - 1)
+                eq_last = live
+                for k in keys:
+                    if k.is_string:
+                        if k.is_dict:
+                            same = k.codes == k.codes[last]
+                        else:
+                            from ..ops.strings_util import char_matrix
+                            m = char_matrix(k)
+                            same = jnp.all(m == m[last], axis=1)
+                        vsame = k.validity == k.validity[last]
+                        eq_last = eq_last & vsame & \
+                            jnp.where(k.validity[last], same, True)
+                    else:
+                        vsame = k.validity == k.validity[last]
+                        dsame = k.data == k.data[last]
+                        eq_last = eq_last & vsame & \
+                            jnp.where(k.validity[last], dsame, True)
+                return piece.n_rows - jnp.sum(eq_last.astype(jnp.int32))
+            return n_complete
+        n_complete_k = cached_kernel(
+            "window_chunk_split", kernel_key(list(part_exprs)), build_split)
+
+        carry = None
+        chunks = iter(sorter.sorted_chunks())
+        chunk = next(chunks, None)
+        while chunk is not None:
+            piece = _coalesce_device([carry, chunk]) if carry is not None \
+                else chunk
+            nxt = next(chunks, None)
+            if nxt is None:
+                yield piece
+                return
+            n_c = int(jax.device_get(n_complete_k(piece)))
+            total = int(jax.device_get(piece.n_rows))
+            if n_c > 0:
+                from ..data.column import bucket_capacity
+                head = slice_k(piece, jnp.asarray(0, jnp.int32),
+                               jnp.asarray(n_c, jnp.int32),
+                               bucket_capacity(max(n_c, 128)))
+                yield head
+                rest = total - n_c
+                carry = slice_k(piece, jnp.asarray(n_c, jnp.int32),
+                                jnp.asarray(rest, jnp.int32),
+                                bucket_capacity(max(rest, 128)))
+            else:
+                carry = piece
+            chunk = nxt
+        if carry is not None:
+            yield carry
+    finally:
+        sorter.release()
 
 
 def _eval_window(batch: ColumnarBatch, func: Expression,

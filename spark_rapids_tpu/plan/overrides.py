@@ -514,13 +514,10 @@ def _make_broadcast_join(n: "P.CpuBroadcastHashJoinExec", ch):
 def _shuffle_tag(meta: ExecMeta, conf: TpuConf):
     factory = meta.node.partitioner_factory
     if factory.mode == "range":
+        # String keys range-partition on device via the byte-lexicographic
+        # bound comparison (GpuRangePartitioner.scala:237 parity).
         _no_complex_keys(meta, [o.child for o in (factory.orders or [])],
                          "range partitioning key")
-        for o in factory.orders:
-            if o.child.data_type is T.STRING:
-                meta.will_not_work(
-                    "range partitioning on string keys is not supported on "
-                    "the device yet")
 
 
 def _register_shuffle_rule():

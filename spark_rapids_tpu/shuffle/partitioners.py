@@ -179,18 +179,15 @@ class RangePartitioner(Partitioner):
             key = ~key
         return bucket, key
 
-    def _ids(self, cols_bucket_key, xp, n_rows_cap: int):
+    def _ids(self, col_cmps, xp, n_rows_cap: int):
+        """Combine per-key (gt, eq) [rows x bounds] matrices
+        lexicographically into partition ids."""
         nb = len(self.bounds.rows)
         if nb == 0:
             return xp.zeros(n_rows_cap, xp.int32)
         gt = xp.zeros((n_rows_cap, nb), bool)
         eq = xp.ones((n_rows_cap, nb), bool)
-        for ki, (rb, rk) in enumerate(cols_bucket_key):
-            bb, bk = self._bound_scalars(ki, xp)
-            col_gt = (rb[:, None] > bb[None, :]) | \
-                ((rb[:, None] == bb[None, :]) & (rk[:, None] > bk[None, :]))
-            col_eq = (rb[:, None] == bb[None, :]) & \
-                (rk[:, None] == bk[None, :])
+        for col_gt, col_eq in col_cmps:
             gt = gt | (eq & col_gt)
             eq = eq & col_eq
         # Rows equal to a boundary go to the right partition (upper bound
@@ -198,26 +195,121 @@ class RangePartitioner(Partitioner):
         beyond = gt | eq
         return xp.sum(beyond.astype(xp.int32), axis=1)
 
+    def _fixed_cmp(self, ki, rb, rk, xp):
+        bb, bk = self._bound_scalars(ki, xp)
+        col_gt = (rb[:, None] > bb[None, :]) | \
+            ((rb[:, None] == bb[None, :]) & (rk[:, None] > bk[None, :]))
+        col_eq = (rb[:, None] == bb[None, :]) & \
+            (rk[:, None] == bk[None, :])
+        return col_gt, col_eq
+
+    # -- string keys --------------------------------------------------------
+    def _string_bound_bytes(self, ki: int):
+        """Boundary values of key ki as (validity, list[bytes])."""
+        vals = [row[ki] for row in self.bounds.rows]
+        validity = np.array([v is not None for v in vals])
+        enc = [(v.encode("utf-8") if isinstance(v, str) else (v or b""))
+               for v in vals]
+        return validity, enc
+
+    def _string_cmp_device(self, ki: int, c, asc: bool, nf: bool):
+        """Byte-lexicographic (gt, eq) of every row vs every boundary —
+        the GpuRangePartitioner string path (GpuRangePartitioner.scala:237
+        range-partitions strings on device; here the comparison is one
+        vectorized [rows x bounds x W] byte walk, W = the column's byte
+        bucket)."""
+        from ..ops.strings_util import char_matrix
+        validity_b, enc = self._string_bound_bytes(ki)
+        w = max(c.max_bytes, max((len(e) for e in enc), default=1), 1)
+        m = char_matrix(c, w)  # [cap, W] int16, PAD(-1) past end
+        bm = np.full((len(enc), w), -1, np.int16)
+        for i, e in enumerate(enc):
+            arr = np.frombuffer(e[:w], np.uint8)
+            bm[i, : len(arr)] = arr
+        bmat = jnp.asarray(bm)
+        # lexicographic compare row vs bound over W byte lanes
+        r = m[:, None, :].astype(jnp.int16)
+        b = bmat[None, :, :]
+        byte_eq = r == b
+        byte_gt = r > b
+        prefix_eq = jnp.cumprod(byte_eq.astype(jnp.int8), axis=2) > 0
+        eq_all = prefix_eq[:, :, -1]
+        shifted = jnp.concatenate(
+            [jnp.ones(prefix_eq.shape[:2] + (1,), bool),
+             prefix_eq[:, :, :-1]], axis=2)
+        gt_str = jnp.any(shifted & byte_gt, axis=2)
+        row_valid = c.validity
+        bval = jnp.asarray(validity_b)
+        null_lt = bool(nf)  # nulls_first: null sorts before every value
+        rv = row_valid[:, None]
+        bv = bval[None, :]
+        both = rv & bv
+        col_eq = (both & eq_all) | (~rv & ~bv)
+        mixed_gt = ((rv & ~bv) & null_lt) | ((~rv & bv) & (not null_lt))
+        col_gt = jnp.where(both, gt_str, mixed_gt)
+        if not asc:
+            col_gt = ~col_gt & ~col_eq
+        return col_gt, col_eq
+
+    def _string_cmp_host(self, ki: int, arr, asc: bool, nf: bool,
+                         n_rows: int):
+        validity_b, enc = self._string_bound_bytes(ki)
+        vals = arr.to_pylist()
+        rv = np.array([v is not None for v in vals])
+        raw = np.array([(v or "").encode("utf-8") for v in vals],
+                       dtype=object)
+        nb = len(enc)
+        gt = np.zeros((n_rows, nb), bool)
+        eq = np.zeros((n_rows, nb), bool)
+        benc = np.array(enc, dtype=object)
+        for j in range(nb):
+            if validity_b[j]:
+                gt[:, j] = rv & (raw > benc[j])
+                eq[:, j] = rv & (raw == benc[j])
+                if nf:
+                    pass  # null row < valid bound -> neither gt nor eq
+                else:
+                    gt[:, j] |= ~rv  # nulls last: null row > valid bound
+            else:
+                if nf:
+                    gt[:, j] = rv  # valid row > null bound (nulls first)
+                eq[:, j] = ~rv
+        if not asc:
+            ngt = ~gt & ~eq
+            gt = ngt
+        return gt, eq
+
     def device_ids(self, batch):
-        cols = []
-        for e, asc, nf in zip(self._bound_exprs, self.bounds.ascending,
-                              self.bounds.nulls_first):
+        cmps = []
+        for ki, (e, asc, nf) in enumerate(zip(self._bound_exprs,
+                                              self.bounds.ascending,
+                                              self.bounds.nulls_first)):
             c = e.eval_device(batch)
-            cols.append(self._key_arrays(c.data, c.validity, c.dtype, asc, nf,
-                                         jnp))
-        return self._ids(cols, jnp, batch.capacity)
+            if c.is_string:
+                cmps.append(self._string_cmp_device(ki, c, asc, nf))
+            else:
+                rb, rk = self._key_arrays(c.data, c.validity, c.dtype, asc,
+                                          nf, jnp)
+                cmps.append(self._fixed_cmp(ki, rb, rk, jnp))
+        return self._ids(cmps, jnp, batch.capacity)
 
     def host_ids(self, hb):
-        cols = []
-        for e, asc, nf, dt in zip(self._bound_exprs, self.bounds.ascending,
-                                  self.bounds.nulls_first, self.bounds.dtypes):
+        cmps = []
+        for ki, (e, asc, nf, dt) in enumerate(zip(
+                self._bound_exprs, self.bounds.ascending,
+                self.bounds.nulls_first, self.bounds.dtypes)):
             arr = host_to_array(e.eval_host(hb), hb.num_rows)
+            if dt is T.STRING:
+                cmps.append(self._string_cmp_host(ki, arr, asc, nf,
+                                                  hb.num_rows))
+                continue
             validity = np.array([v is not None for v in arr.to_pylist()])
             np_dt = dt.np_dtype
             raw = np.array([0 if v is None else v for v in arr.to_pylist()],
                            dtype=np_dt)
-            cols.append(self._key_arrays(raw, validity, dt, asc, nf, np))
-        return self._ids(cols, np, hb.num_rows)
+            rb, rk = self._key_arrays(raw, validity, dt, asc, nf, np)
+            cmps.append(self._fixed_cmp(ki, rb, rk, np))
+        return self._ids(cmps, np, hb.num_rows)
 
 
 def _np_orderable(data: np.ndarray, dtype: T.DataType) -> np.ndarray:

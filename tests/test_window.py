@@ -220,3 +220,105 @@ def test_window_over_repartitioned_child():
     got = dict(zip(out.column("k").to_pylist(),
                    out.column("total").to_pylist()))
     assert got == {1: 8, 2: 4}
+
+
+class TestChunkedWindow:
+    """Bounded-memory window: inputs above the external threshold sort by
+    the shared partition keys through the spill catalog and evaluate
+    complete key groups chunk by chunk (round-4 VERDICT item 10)."""
+
+    def test_chunked_matches_oracle_and_spills(self, tmp_path):
+        import numpy as np
+        import pyarrow as pa
+
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+        from spark_rapids_tpu.ops.windows import Window, over
+        from spark_rapids_tpu.plan.logical import SortOrder
+        from spark_rapids_tpu.session import TpuSession
+
+        rng = np.random.default_rng(17)
+        n = 120_000
+        rb = pa.RecordBatch.from_pydict({
+            "g": pa.array(rng.integers(0, 300, n), pa.int64()),
+            "t": pa.array(rng.integers(0, 10_000, n), pa.int64()),
+            "v": pa.array(rng.normal(size=n)),
+        })
+        w = (Window.partition_by("g")
+             .order_by(SortOrder(col("t")), SortOrder(col("v")))
+             .rows_between(Window.unbounded_preceding, Window.current_row))
+        w_tot = Window.partition_by("g")
+
+        def q(s):
+            return (s.create_dataframe(rb)
+                    .with_windows(
+                        running=over(AGG.Sum(col("v")), w),
+                        total=over(AGG.Sum(col("v")), w_tot))
+                    .select(col("g"), col("t"), col("v"), col("running"),
+                            col("total")))
+        cpu = TpuSession({"spark.rapids.sql.enabled": False})
+        tpu = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.window.externalThresholdBytes": 1 << 19,
+            "spark.rapids.sql.batchSizeRows": 1 << 14,
+            "spark.rapids.sql.variableFloatAgg.enabled": True,
+            "spark.rapids.memory.tpu.spillDir": str(tmp_path),
+            "spark.rapids.tpu.fusion.enabled": False})
+        from spark_rapids_tpu.plan import physical as P
+        physical = tpu.plan(q(tpu)._plan)
+        ctx = P.ExecContext(tpu.conf, catalog=tpu.device_manager.catalog)
+        try:
+            got = P.collect_partitions(physical, ctx)
+            chunked = ctx.metrics.get("TpuWindow", {}).get("chunkedWindow",
+                                                           0)
+        finally:
+            ctx.close()
+        assert chunked > 1, f"expected chunked evaluation, got {chunked}"
+        want = q(cpu).collect()
+        keys = [("g", "ascending"), ("t", "ascending"), ("v", "ascending")]
+        g = got.sort_by(keys).to_pydict()
+        e = want.sort_by(keys).to_pydict()
+        assert g["g"] == e["g"]
+        assert np.allclose(g["running"], e["running"], rtol=1e-9)
+        assert np.allclose(g["total"], e["total"], rtol=1e-9)
+
+    def test_mixed_partition_specs_fall_back_whole(self):
+        import numpy as np
+        import pyarrow as pa
+
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+        from spark_rapids_tpu.ops.windows import Window, over
+        from spark_rapids_tpu.session import TpuSession
+
+        rng = np.random.default_rng(5)
+        n = 30_000
+        rb = pa.RecordBatch.from_pydict({
+            "a": pa.array(rng.integers(0, 20, n), pa.int64()),
+            "b": pa.array(rng.integers(0, 7, n), pa.int64()),
+            "v": pa.array(rng.normal(size=n)),
+        })
+
+        def q(s):
+            return (s.create_dataframe(rb)
+                    .with_windows(
+                        sa=over(AGG.Sum(col("v")), Window.partition_by("a")),
+                        sb=over(AGG.Sum(col("v")),
+                                Window.partition_by("b"))))
+        cpu = TpuSession({"spark.rapids.sql.enabled": False})
+        tpu = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.window.externalThresholdBytes": 1 << 16,
+            "spark.rapids.sql.variableFloatAgg.enabled": True,
+            "spark.rapids.tpu.fusion.enabled": False})
+        got = q(tpu).collect().sort_by([("a", "ascending"),
+                                        ("b", "ascending"),
+                                        ("v", "ascending")])
+        want = q(cpu).collect().sort_by([("a", "ascending"),
+                                         ("b", "ascending"),
+                                         ("v", "ascending")])
+        import numpy as _np
+        assert _np.allclose(got.column("sa").to_numpy(),
+                            want.column("sa").to_numpy())
+        assert _np.allclose(got.column("sb").to_numpy(),
+                            want.column("sb").to_numpy())
