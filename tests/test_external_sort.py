@@ -105,6 +105,7 @@ class TestExternalSort:
         assert len(catalog.leak_report()) == before, \
             "abandoned external-sort stream leaked spill registrations"
 
+    @pytest.mark.slow
     def test_ten_times_budget_spills_and_stays_bounded(self, tmp_path):
         # ~16 MB of sort input against a 1.5 MB device budget: runs must
         # spill and the device store must never exceed its budget.

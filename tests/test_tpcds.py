@@ -22,7 +22,21 @@ def envs():
     return tpcds.load(cpu, tables), tpcds.load(tpu, tables)
 
 
-@pytest.mark.parametrize("name", sorted(tpcds.QUERIES))
+#: Default-tier subset: every operator family the suite exercises
+#: (scan/filter/agg, deep join trees, rollup/cube Expand, rank/running
+#: windows, intersect/except semi-anti chains, inventory, null-fk counts,
+#: full-outer overlap, bucket cross-joins). The long tail runs under
+#: ``-m "slow or not slow"``.
+FAST = {"q1", "q3", "q5", "q6", "q7", "q9", "q13", "q18", "q21", "q22",
+        "q27", "q36", "q38", "q43", "q44", "q47", "q49", "q51", "q59",
+        "q62", "q67", "q70", "q76", "q77", "q87", "q88", "q96", "q97",
+        "q98"}
+
+
+@pytest.mark.parametrize(
+    "name",
+    [n if n in FAST else pytest.param(n, marks=pytest.mark.slow)
+     for n in sorted(tpcds.QUERIES)])
 def test_query_differential(envs, name):
     cpu_t, tpu_t = envs
     q = tpcds.QUERIES[name]
