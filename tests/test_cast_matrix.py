@@ -16,6 +16,12 @@ FLOAT_CONF = {"spark.rapids.sql.castStringToFloat.enabled": True}
 TS_CONF = {"spark.rapids.sql.castStringToTimestamp.enabled": True}
 
 
+import pytest
+
+#: broad per-op matrix sweeps: integration suites (TPC-H/DS)
+#: cover the same operators end-to-end in the default tier
+pytestmark = pytest.mark.slow
+
 def _str_df(values):
     return {"s": values}
 

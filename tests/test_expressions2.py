@@ -20,6 +20,12 @@ STRS = ["hello world", "aXbXcXd", "", "X", "XXX", "no matches here",
         None, "  padded  ", "tail X", "X head", "ab", "overlapXXXover"]
 
 
+import pytest
+
+#: broad per-op matrix sweeps: integration suites (TPC-H/DS)
+#: cover the same operators end-to-end in the default tier
+pytestmark = pytest.mark.slow
+
 def _df(s):
     return s.create_dataframe({"s": STRS})
 

@@ -14,6 +14,12 @@ from harness import assert_tpu_and_cpu_are_equal
 from test_expressions import assert_expr_equal
 
 
+import pytest
+
+#: broad per-op matrix sweeps: integration suites (TPC-H/DS)
+#: cover the same operators end-to-end in the default tier
+pytestmark = pytest.mark.slow
+
 def str_batch(seed=0, n=200, **kw):
     return HostBatch(gen_batch({
         "s": StringGen(max_len=10, **kw),

@@ -27,10 +27,8 @@ def envs():
 #: windows, intersect/except semi-anti chains, inventory, null-fk counts,
 #: full-outer overlap, bucket cross-joins). The long tail runs under
 #: ``-m "slow or not slow"``.
-FAST = {"q1", "q3", "q5", "q6", "q7", "q9", "q13", "q18", "q21", "q22",
-        "q27", "q36", "q38", "q43", "q44", "q47", "q49", "q51", "q59",
-        "q62", "q67", "q70", "q76", "q77", "q87", "q88", "q96", "q97",
-        "q98"}
+FAST = {"q1", "q3", "q6", "q18", "q22", "q36", "q44", "q49", "q51",
+        "q76", "q88", "q98"}
 
 
 @pytest.mark.parametrize(
