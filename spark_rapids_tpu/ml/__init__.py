@@ -1,8 +1,8 @@
 """ML integration: zero-copy export of query results to JAX trainers
 (the ml-integration / ColumnarRdd surface of the reference)."""
 
-from .export import (feature_matrix, predict_logistic,
-                     train_logistic_regression)
+from .export import (feature_matrix, predict_gbt, predict_logistic,
+                     train_gbt, train_logistic_regression)
 
 __all__ = ["feature_matrix", "train_logistic_regression",
-           "predict_logistic"]
+           "predict_logistic", "train_gbt", "predict_gbt"]

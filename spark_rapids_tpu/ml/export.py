@@ -110,3 +110,133 @@ def train_logistic_regression(x, y, mask, steps: int = 100, lr: float = 0.1):
 def predict_logistic(model, x):
     xs = (x - model["mean"]) / model["scale"]
     return jax.nn.sigmoid(xs @ model["w"] + model["b"])
+
+
+# ---------------------------------------------------------------------------
+# Gradient-boosted trees (the XGBoost-on-Spark handoff, BASELINE config 4)
+# ---------------------------------------------------------------------------
+
+
+def train_gbt(x, y, mask, *, n_trees: int = 20, max_depth: int = 4,
+              n_bins: int = 32, learning_rate: float = 0.3,
+              reg_lambda: float = 1.0, objective: str = "binary"):
+    """Histogram-based gradient-boosted trees trained ENTIRELY on device —
+    the consumer the reference hands query output to via XGBoost-on-Spark
+    (docs/ml-integration.md; ColumnarRdd.scala:41-49 -> here a jax pytree).
+
+    XLA-shaped like the reference's GPU hist algorithm: features quantize
+    to ``n_bins`` once; every level builds (node, feature, bin)
+    gradient/hessian histograms with one ``segment_sum`` scatter, split
+    gains come from bin cumsums, and trees grow level-wise to a STATIC
+    ``max_depth`` — no
+    data-dependent control flow, one compiled program for the whole
+    boosting loop. Masked rows carry zero gradients.
+
+    objective: "binary" (logistic) or "regression" (squared error).
+    Returns a model dict for :func:`predict_gbt`.
+    """
+    n, d = x.shape
+    xf = x.astype(jnp.float32)
+    m = mask.astype(jnp.float32)
+
+    # -- quantile binning (once) -------------------------------------------
+    xm = jnp.where(mask[:, None], xf, jnp.nan)
+    qs = jnp.linspace(0.0, 1.0, n_bins + 1)[1:-1]
+    edges = jnp.nanquantile(xm, qs, axis=0)          # [n_bins-1, d]
+    edges = jnp.where(jnp.isnan(edges), jnp.inf, edges)
+    bins = jax.vmap(jnp.searchsorted, in_axes=(1, 1))(
+        edges, xf).astype(jnp.int32).T               # [n, d] in 0..n_bins-1
+
+    max_w = 1 << (max_depth - 1)
+    yf = y.astype(jnp.float32)
+
+    def fit_tree(g, h):
+        node = jnp.zeros(n, jnp.int32)
+        feats = jnp.zeros((max_depth, max_w), jnp.int32)
+        ths = jnp.zeros((max_depth, max_w), jnp.int32)
+        fidx = jnp.arange(d, dtype=jnp.int32)
+        rows = jnp.arange(n, dtype=jnp.int32)
+        for depth in range(max_depth):
+            n_nodes = 1 << depth
+            flat = ((node[:, None] * d + fidx[None, :]) * n_bins
+                    + bins)                          # [n, d]
+            segs = n_nodes * d * n_bins
+            G = jax.ops.segment_sum(
+                jnp.broadcast_to(g[:, None], (n, d)).reshape(-1),
+                flat.reshape(-1), num_segments=segs
+            ).reshape(n_nodes, d, n_bins)
+            H = jax.ops.segment_sum(
+                jnp.broadcast_to(h[:, None], (n, d)).reshape(-1),
+                flat.reshape(-1), num_segments=segs
+            ).reshape(n_nodes, d, n_bins)
+            Gc = jnp.cumsum(G, axis=2)[:, :, :-1]    # left sums per split
+            Hc = jnp.cumsum(H, axis=2)[:, :, :-1]
+            Gt = jnp.sum(G, axis=2)[:, :, None]
+            Ht = jnp.sum(H, axis=2)[:, :, None]
+            GR, HR = Gt - Gc, Ht - Hc
+            gain = (Gc ** 2 / (Hc + reg_lambda)
+                    + GR ** 2 / (HR + reg_lambda)
+                    - Gt ** 2 / (Ht + reg_lambda))
+            gain_f = gain.reshape(n_nodes, d * (n_bins - 1))
+            best = jnp.argmax(gain_f, axis=1)
+            bf = (best // (n_bins - 1)).astype(jnp.int32)
+            bt = (best % (n_bins - 1)).astype(jnp.int32)
+            feats = feats.at[depth, :n_nodes].set(bf)
+            ths = ths.at[depth, :n_nodes].set(bt)
+            go_right = bins[rows, bf[node]] > bt[node]
+            node = node * 2 + go_right.astype(jnp.int32)
+        n_leaves = 1 << max_depth
+        Gl = jax.ops.segment_sum(g, node, num_segments=n_leaves)
+        Hl = jax.ops.segment_sum(h, node, num_segments=n_leaves)
+        leaf = -Gl / (Hl + reg_lambda)
+        return feats, ths, leaf, leaf[node]
+
+    def boost():
+        F0 = jnp.zeros(n, jnp.float32)
+
+        def step(carry, _):
+            F, = carry
+            if objective == "binary":
+                p = jax.nn.sigmoid(F)
+                g = (p - yf) * m
+                h = jnp.maximum(p * (1 - p), 1e-6) * m
+            else:
+                g = (F - yf) * m
+                h = m
+            feats, ths, leaf, pred = fit_tree(g, h)
+            return (F + learning_rate * pred,), (feats, ths, leaf)
+
+        (_,), trees = jax.lax.scan(step, (F0,), None, length=n_trees)
+        return trees
+
+    feats, ths, leaves = jax.jit(boost)()
+    return {"edges": edges, "feats": feats, "ths": ths, "leaves": leaves,
+            "lr": learning_rate, "max_depth": max_depth,
+            "objective": objective}
+
+
+def predict_gbt(model, x):
+    """Apply a :func:`train_gbt` model on device: re-bin, walk every
+    tree's level arrays by gathers, sum leaf values."""
+    xf = x.astype(jnp.float32)
+    n = xf.shape[0]
+    bins = jax.vmap(jnp.searchsorted, in_axes=(1, 1))(
+        model["edges"], xf).astype(jnp.int32).T
+    rows = jnp.arange(n, dtype=jnp.int32)
+    max_depth = model["max_depth"]
+
+    def one_tree(carry, tree):
+        feats, ths, leaf = tree
+        node = jnp.zeros(n, jnp.int32)
+        for depth in range(max_depth):
+            bf = feats[depth][node]
+            bt = ths[depth][node]
+            go_right = bins[rows, bf] > bt
+            node = node * 2 + go_right.astype(jnp.int32)
+        return carry + model["lr"] * leaf[node], None
+
+    F, _ = jax.lax.scan(one_tree, jnp.zeros(n, jnp.float32),
+                        (model["feats"], model["ths"], model["leaves"]))
+    if model["objective"] == "binary":
+        return jax.nn.sigmoid(F)
+    return F

@@ -97,3 +97,47 @@ class TestZeroCopyTraining:
         batches = df.to_device_batches()
         x, _, mask = ml.feature_matrix(batches, ["v", "w"])
         assert int(np.asarray(mask).sum()) == 200
+
+
+class TestGbtTrainer:
+    """BASELINE config 4: query output -> zero-copy handoff -> JAX GBT
+    trainer (XGBoost-on-Spark role; ColumnarRdd.scala:41-49)."""
+
+    def test_gbt_from_query_output_beats_linear(self):
+        import jax.numpy as jnp
+        rng = np.random.default_rng(4)
+        n = 8000
+        a = rng.normal(size=n)
+        b = rng.normal(size=n)
+        c = rng.normal(size=n)
+        label = ((a * b > 0) ^ (c > 0.3)).astype(np.int64)
+        s = _session()
+        df = (s.create_dataframe({"a": a, "b": b, "c": c,
+                                  "y": label.tolist()})
+              .where(col("a") > col("a") * 0.0))  # keep a device op above
+        batches = df.to_device_batches()
+        x, y, mask = ml.feature_matrix(batches, ["a", "b", "c"], "y")
+        model = ml.train_gbt(x, y, mask, n_trees=25, max_depth=4)
+        p = ml.predict_gbt(model, x)
+        m = np.asarray(mask)
+        acc = float(np.mean((np.asarray(p)[m] > 0.5)
+                            == (np.asarray(y)[m] > 0.5)))
+        assert acc > 0.9, acc
+        lin = ml.train_logistic_regression(x, y, mask, steps=150)
+        pl = ml.predict_logistic(lin, x)
+        acc_lin = float(np.mean((np.asarray(pl)[m] > 0.5)
+                                == (np.asarray(y)[m] > 0.5)))
+        assert acc > acc_lin + 0.2, (acc, acc_lin)
+
+    def test_gbt_regression_objective(self):
+        rng = np.random.default_rng(9)
+        n = 6000
+        x = rng.normal(size=(n, 3)).astype(np.float32)
+        yr = (x[:, 0] ** 2 + 2 * x[:, 1]).astype(np.float32)
+        import jax.numpy as jnp
+        model = ml.train_gbt(jnp.asarray(x), jnp.asarray(yr),
+                             jnp.ones(n, bool), n_trees=30,
+                             objective="regression")
+        pr = np.asarray(ml.predict_gbt(model, jnp.asarray(x)))
+        r2 = 1 - float(np.mean((pr - yr) ** 2)) / float(np.var(yr))
+        assert r2 > 0.85, r2
