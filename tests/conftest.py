@@ -61,6 +61,18 @@ def pytest_configure(config):
     # or the persistent-cache read). The CPU tier must compile locally.
     os.environ.pop("PALLAS_AXON_REMOTE_COMPILE", None)
     os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    # The full suite JIT-compiles thousands of XLA executables; each maps
+    # several code regions, and once the process crosses the kernel's
+    # vm.max_map_count (default 65530 — observed ~4k maps/minute here) a
+    # failed mmap inside XLA's loader SIGSEGVs mid-suite. Root-only best
+    # effort; harmless when already high or not permitted.
+    try:
+        with open("/proc/sys/vm/max_map_count") as f:
+            if int(f.read()) < (1 << 20):
+                with open("/proc/sys/vm/max_map_count", "w") as g:
+                    g.write(str(1 << 20))
+    except (OSError, ValueError):
+        pass
     import jax
 
     jax.config.update("jax_platforms", "cpu")
