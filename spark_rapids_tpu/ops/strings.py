@@ -30,7 +30,8 @@ from ..data.column import DeviceColumn, bucket_capacity
 from .expression import (Expression, UnaryExpression, host_to_array,
                          make_column)
 from .kernels.rowops import strings_from_matrix
-from .strings_util import PAD, char_matrix, lengths
+from .strings_util import (PAD, _matrix_from_offsets, char_matrix,
+                           lengths)
 
 
 class StringUnary(Expression):
@@ -228,6 +229,33 @@ class Contains(_FixMatch):
         return hits
 
 
+def _like_dp(m: jnp.ndarray, toks) -> jnp.ndarray:
+    """Vectorized SQL-LIKE wildcard DP over a [N, W] byte matrix (PAD past
+    each string's end). One boolean lane per pattern position; W x P
+    unrolled vector ops — every lane stays batch-wide, XLA fuses the whole
+    walk into a few kernels."""
+    n, w = m.shape
+    p = len(toks)
+    dp = [jnp.ones(n, jnp.bool_)]
+    for i in range(1, p + 1):
+        dp.append(dp[i - 1] & (toks[i - 1][0] == 2))
+    for j in range(w):
+        c = m[:, j]
+        valid = c >= 0
+        ndp = [jnp.zeros(n, jnp.bool_)]
+        for i in range(1, p + 1):
+            kind, lit = toks[i - 1]
+            if kind == 2:
+                nd = ndp[i - 1] | dp[i] | dp[i - 1]
+            elif kind == 1:
+                nd = dp[i - 1]
+            else:
+                nd = dp[i - 1] & (c == lit)
+            ndp.append(nd)
+        dp = [jnp.where(valid, a, b) for a, b in zip(ndp, dp)]
+    return dp[p]
+
+
 class Like(Expression):
     """SQL LIKE with %/_ wildcards. Device support: patterns reducible to
     prefix/suffix/contains/exact; general patterns tagged to CPU."""
@@ -264,20 +292,61 @@ class Like(Expression):
         v = host_to_array(self.children[0].eval_host(batch), batch.num_rows)
         return pc.match_like(v, pattern=self.pattern)
 
+    def tokens(self):
+        """Pattern as byte-level tokens: (kind, byte) with kind 0=literal,
+        1=_ (any one byte), 2=% (any run); escape makes the next byte
+        literal. Consecutive % collapse."""
+        pb = self.pattern.encode("utf-8")
+        esc = self.escape.encode("utf-8")[0] if self.escape else None
+        toks = []
+        i = 0
+        while i < len(pb):
+            b = pb[i]
+            if esc is not None and b == esc and i + 1 < len(pb):
+                toks.append((0, pb[i + 1]))
+                i += 2
+                continue
+            if b == 0x25:  # %
+                if not toks or toks[-1] != (2, 0):
+                    toks.append((2, 0))
+            elif b == 0x5F:  # _
+                toks.append((1, 0))
+            else:
+                toks.append((0, b))
+            i += 1
+        return toks
+
     def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
         form = self.simple_form()
-        if form is None:
-            raise NotImplementedError("general LIKE runs on CPU")
-        kind, literal = form
-        impl = {"contains": Contains, "prefix": StartsWith,
-                "suffix": EndsWith}.get(kind)
-        if impl is not None:
-            return impl(self.children[0], literal).eval_device(batch)
-        # exact
-        from .predicates import EqualTo
-        from .expression import Literal
-        return EqualTo(self.children[0],
-                       Literal(literal, T.STRING)).eval_device(batch)
+        if form is not None:
+            kind, literal = form
+            impl = {"contains": Contains, "prefix": StartsWith,
+                    "suffix": EndsWith}.get(kind)
+            if impl is not None:
+                return impl(self.children[0], literal).eval_device(batch)
+            # exact
+            from .predicates import EqualTo
+            from .expression import Literal
+            return EqualTo(self.children[0],
+                           Literal(literal, T.STRING)).eval_device(batch)
+        # General %/_ pattern: vectorized wildcard DP over the byte matrix
+        # (the GpuLike role, stringFunctions.scala:862 — cudf's kernel is
+        # this same NFA walk). Dictionary columns run the DP once over the
+        # (small) dictionary and gather by code. Byte-level semantics:
+        # '_' consumes one BYTE, so non-ASCII '_' matches diverge (same
+        # caveat family as the reference's regexp byte/char notes).
+        toks = self.tokens()
+        col = self.children[0].eval_device(batch)
+        from .expression import make_column
+        if col.is_dict:
+            dm = _matrix_from_offsets(col.data, col.offsets,
+                                      max(col.max_bytes, 1))
+            hit = _like_dp(dm, toks)
+            res = hit[jnp.clip(col.codes, 0, dm.shape[0] - 1)]
+        else:
+            res = _like_dp(char_matrix(col), toks)
+        res = res & col.validity
+        return make_column(res, col.validity, T.BOOLEAN)
 
 
 class ConcatStrings(Expression):

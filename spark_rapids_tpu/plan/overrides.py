@@ -107,9 +107,12 @@ for _cls in [AGG.Min, AGG.Max, AGG.Sum, AGG.Count, AGG.Average, AGG.First,
 
 
 def _like_tag(e: "STR.Like", conf: TpuConf) -> Optional[str]:
-    if e.simple_form() is None:
-        return "only %-wildcard prefix/suffix/contains LIKE patterns run on " \
-               "the device (reference limits RegExp similarly)"
+    # General %/_ patterns run the device wildcard DP (W x P unrolled
+    # vector ops); pathologically long patterns would bloat the compiled
+    # program, so they keep the CPU path.
+    if len(e.tokens()) > 48:
+        return "LIKE pattern longer than 48 tokens runs on CPU (compiled " \
+               "wildcard-DP program size)"
     return None
 
 

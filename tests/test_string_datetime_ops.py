@@ -60,6 +60,14 @@ class TestStrings:
     def test_like_simple(self, pattern):
         assert_expr_equal(S.Like(col("t"), pattern), str_batch())
 
+    @pytest.mark.parametrize("pattern", [
+        "a_c%", "%b%d%", "a%c%e", "_", "%", "he__o%", "%l_", "_b_",
+        "a\\%b", "%_%_%", "ab%ba", ""])
+    def test_like_general_wildcards(self, pattern):
+        # general %/_ patterns: the device wildcard-DP path (round 4;
+        # reference GpuLike, stringFunctions.scala:862)
+        assert_expr_equal(S.Like(col("t"), pattern), str_batch())
+
     def test_concat(self):
         hb = str_batch()
         assert_expr_equal(S.ConcatStrings(col("s"), lit("-"), col("t")), hb)
