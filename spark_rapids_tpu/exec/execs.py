@@ -915,15 +915,21 @@ def hash_join_kernel(jt: str, lkeys: List[Expression],
     semi/anti return a compacted probe; left/full expand unmatched probe
     rows with nulls; full also returns the build-side hit mask for the
     caller's unmatched-build pass."""
-    def kernel_impl(probe, build, out_cap, dense=False):
+    def kernel_impl(probe, build, out_cap, dense=0):
         pk = [e.eval_device(probe) for e in lkeys]
         bk = [e.eval_device(build) for e in rkeys]
-        if dense:
-            # Direct-address fast path (unique int build keys): returns a
-            # lazy probe-capacity batch + a dense-fail flag the retry
-            # machinery consumes; no overflow possible.
+        if dense == 1:
+            # Direct-address fast path (unique int build keys; semi/anti
+            # tolerate duplicates): returns a lazy probe-capacity batch +
+            # a dense-fail flag the retry machinery consumes; no overflow
+            # possible.
             return KJ.dense_join(jt, probe, build, pk[0], bk[0],
                                  out_schema)
+        if dense == 2:
+            # Swapped mode (inner only): the table builds over the
+            # UNIQUE-keyed probe side — the dim.join(fact) shape.
+            return KJ.dense_join_swapped(probe, build, pk[0], bk[0],
+                                         out_schema)
         hits = None
         if jt != "full" and len(bk) == 1 \
                 and KJ.binsearch_joinable(bk[0]) \
@@ -1056,14 +1062,30 @@ class TpuShuffledHashJoinExec(TpuExec):
             # real match count stays a deferred device-side observation the
             # session reads ONCE per query — no per-batch host syncs.
             site = ctx.next_join_site()
-            if dense_eligible and not ctx.eager_overflow \
-                    and site not in ctx.no_dense:
-                # Direct-address path: optimistic like the capacity guess —
-                # a dense-fail flag (dup/out-of-range build keys) re-runs
-                # this site through the general kernel.
-                out, fail = kernel(probe, build, 0, True)
+            mode = 1 + ctx.dense_modes.get(site, 0)
+            if mode == 2 and jt != "inner":
+                mode = 3  # swapped mode only exists for inner joins
+            if dense_eligible and not ctx.eager_overflow and mode <= 2:
+                # Direct-address path: optimistic like the capacity
+                # guess — a dense-fail flag (out-of-range keys; duplicate
+                # build keys for inner/left) escalates this site's mode
+                # (1 = build-side table, 2 = swapped probe-side table,
+                # then the general kernel).
+                out, fail = kernel(probe, build, 0, mode)
                 ctx.overflow_flags.append(fail)
                 ctx.dense_fails.append((site, fail))
+                if not ctx.in_fusion and out.capacity >= 4 * 128:
+                    # Streaming mode: shrink sparse lazy outputs to their
+                    # live bucket — downstream capacity-proportional ops
+                    # (the group-by argsort, sorts) would otherwise pay
+                    # the full probe/build capacity for a few live rows.
+                    # One row-count sync per probe batch, same cadence as
+                    # the reference's per-batch sizing.
+                    total = int(jax.device_get(out.n_rows))
+                    cap = bucket_capacity(max(total, 128))
+                    if cap * 4 <= out.capacity:
+                        from ..data.batch import _shrink_batch
+                        out = _shrink_batch(KR.physical_jit(out), cap)
                 return out, None
             if jt in ("left_semi", "left_anti"):
                 out, hits = kernel(probe, build, probe.capacity)

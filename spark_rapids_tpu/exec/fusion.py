@@ -140,15 +140,15 @@ def clear_fused_cache() -> None:
 
 
 def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int,
-                 join_caps=None, no_dense=frozenset()):
+                 join_caps=None, dense_modes=None):
     caps = dict(join_caps or {})
-    nd = frozenset(no_dense)
+    nd = dict(dense_modes or {})
 
     def run(inputs):
         ictx = ExecContext(conf, catalog=None)
         ictx.join_growth = join_growth
         ictx.join_caps = dict(caps)
-        ictx.no_dense = nd
+        ictx.dense_modes = dict(nd)
         ictx.fused_inputs = inputs
         ictx.in_fusion = True
         outs = []
@@ -189,11 +189,11 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     guess_rows = ctx.conf.collect_guess_rows
     caps = tuple(sorted(ctx.join_caps.items())) if ctx.join_caps else ()
     sig = (_plan_sig(fused_plan), float(ctx.join_growth), guess_rows, caps,
-           tuple(sorted(ctx.no_dense)))
+           tuple(sorted(ctx.dense_modes.items())))
     fn = _FUSED_CACHE.get(sig)
     if fn is None:
         fn = _build_fused(fused_plan, ctx.conf, ctx.join_growth, guess_rows,
-                          ctx.join_caps, ctx.no_dense)
+                          ctx.join_caps, ctx.dense_modes)
         _FUSED_CACHE[sig] = fn
     # Boundary subtrees run eagerly (uploads, windows, shuffles, ...); their
     # materialized batches are the fused program's positional arguments.

@@ -271,6 +271,44 @@ def dense_joinable(jt: str, keys) -> bool:
         and not isinstance(dt, (T.ArrayType, T.StructType))
 
 
+def dense_join_swapped(probe, build, pk: DeviceColumn, bk: DeviceColumn,
+                       out_schema):
+    """INNER-join dense mode 2: the PROBE side's keys are unique, so the
+    table builds over the probe and every BUILD row gathers its (single)
+    probe match — the dim.join(fact) shape where the huge fact sits on
+    the build side. Output at BUILD capacity, lazy, probe columns first
+    (schema order preserved)."""
+    from ...data.batch import ColumnarBatch
+    from .rowops import gather_column
+    cap_p = pk.capacity
+    tbl = cap_p * _DENSE_TABLE_FACTOR
+    live_p = probe.row_mask()
+    usable_p = live_p & pk.validity
+    kp = pk.data.astype(jnp.int64)
+    in_range_p = (kp >= 0) & (kp < tbl)
+    ok_p = usable_p & in_range_p
+    slot = jnp.where(ok_p, kp, tbl).astype(jnp.int32)
+    cnt_tbl = jax.ops.segment_sum(ok_p.astype(jnp.int32), slot,
+                                  num_segments=tbl + 1)[:tbl]
+    iota_p = jnp.arange(cap_p, dtype=jnp.int32)
+    row_tbl = jax.ops.segment_min(jnp.where(ok_p, iota_p, cap_p), slot,
+                                  num_segments=tbl + 1)[:tbl]
+    fail = jnp.any(usable_p & ~in_range_p) | jnp.any(cnt_tbl > 1)
+
+    live_b = build.row_mask()
+    usable_b = live_b & bk.validity
+    kb = bk.data.astype(jnp.int64)
+    in_range_b = usable_b & (kb >= 0) & (kb < tbl)
+    bslot = jnp.where(in_range_b, kb, 0).astype(jnp.int32)
+    matched = in_range_b & (cnt_tbl[bslot] > 0)
+    probe_row = jnp.clip(row_tbl[bslot], 0, cap_p - 1)
+    pcols = tuple(gather_column(c, probe_row, matched)
+                  for c in probe.columns)
+    return ColumnarBatch(pcols + tuple(build.columns),
+                         jnp.sum(matched.astype(jnp.int32)), out_schema,
+                         live=matched), fail
+
+
 def dense_join(jt: str, probe, build, pk: DeviceColumn, bk: DeviceColumn,
                out_schema):
     """Direct-address (perfect-hash) equi join for UNIQUE integer build
@@ -303,7 +341,12 @@ def dense_join(jt: str, probe, build, pk: DeviceColumn, bk: DeviceColumn,
     iota_b = jnp.arange(cap_b, dtype=jnp.int32)
     row_tbl = jax.ops.segment_min(jnp.where(ok_b, iota_b, cap_b), slot,
                                   num_segments=tbl + 1)[:tbl]
-    fail = jnp.any(usable_b & ~in_range_b) | jnp.any(cnt_tbl > 1)
+    # semi/anti only test MEMBERSHIP — duplicate build keys are fine
+    # there (the fact-side build of an EXISTS), and only out-of-range
+    # keys disqualify the table.
+    fail = jnp.any(usable_b & ~in_range_b)
+    if jt in ("inner", "left"):
+        fail = fail | jnp.any(cnt_tbl > 1)
 
     live_p = probe.row_mask()
     usable_p = live_p & pk.validity

@@ -64,10 +64,11 @@ class ExecContext:
     #: (site ordinal, traced total-match-count scalar) per deferred join
     #: batch — the observations join_caps learns from.
     join_totals: list = dataclasses.field(default_factory=list)
-    #: Join sites where the optimistic dense (direct-address) join path
-    #: failed a previous attempt (duplicate or out-of-range build keys);
-    #: those sites use the general sort-based kernel on retry.
-    no_dense: frozenset = frozenset()
+    #: Per-site dense-join mode escalation (site -> fail count): 0 = try
+    #: the build-side direct-address table, 1 = try the swapped probe-side
+    #: table (inner joins), 2+ = the general sort-based kernel. Learned
+    #: through dense_fails exactly like join_caps.
+    dense_modes: dict = dataclasses.field(default_factory=dict)
     #: (site ordinal, traced dense-ineligible flag) observations feeding
     #: no_dense, mirroring join_totals.
     dense_fails: list = dataclasses.field(default_factory=list)

@@ -126,8 +126,8 @@ class TpuSession:
         from .data.column import bucket_capacity
         cached = self._JOIN_CAP_CACHE.get(plan_sig) \
             if plan_sig is not None else None
-        caps, no_dense = (dict(cached[0]), set(cached[1])) \
-            if cached is not None else ({}, set())
+        caps, dense_modes = (dict(cached[0]), dict(cached[1])) \
+            if cached is not None else ({}, {})
         attempts = 1 if eager_only else self._MAX_LEARN_ATTEMPTS + 1
         # Growth escalation covers paths that size from ctx.join_growth but
         # report no per-site totals (the mesh SPMD path, exec/mesh.py):
@@ -142,7 +142,7 @@ class TpuSession:
                 ctx = P.ExecContext(self.conf,
                                     catalog=self.device_manager.catalog)
                 ctx.join_caps = caps
-                ctx.no_dense = frozenset(no_dense)
+                ctx.dense_modes = dict(dense_modes)
                 ctx.join_growth = growth
                 ctx.eager_overflow = eager
                 try:
@@ -161,12 +161,12 @@ class TpuSession:
                 finally:
                     ctx.close()
             if not overflowed:
-                if plan_sig is not None and (caps or no_dense):
+                if plan_sig is not None and (caps or dense_modes):
                     if len(self._JOIN_CAP_CACHE) > 512:
                         self._JOIN_CAP_CACHE.pop(
                             next(iter(self._JOIN_CAP_CACHE)))
                     self._JOIN_CAP_CACHE[plan_sig] = (caps,
-                                                      frozenset(no_dense))
+                                                      dict(dense_modes))
                 return result
             # Learn exact capacities from this run's observations (one
             # batched download). Totals observed downstream of a truncated
@@ -177,14 +177,13 @@ class TpuSession:
             # shape, and the cache itself is bounded at 512 entries.)
             learned = False
             if ctx.dense_fails:
-                # Dense-path ineligibility (dup / out-of-range build keys)
-                # observed this run: those sites re-plan onto the general
-                # kernel next attempt.
+                # Dense-path ineligibility observed this run: escalate the
+                # site's mode (build-table -> swapped table -> general).
                 sites_d = [s for s, _ in ctx.dense_fails]
                 fails = jax.device_get([f for _, f in ctx.dense_fails])
                 for s, f in zip(sites_d, fails):
-                    if bool(f) and s not in no_dense:
-                        no_dense.add(s)
+                    if bool(f):
+                        dense_modes[s] = dense_modes.get(s, 0) + 1
                         learned = True
             if ctx.join_totals:
                 sites = [s for s, _ in ctx.join_totals]
