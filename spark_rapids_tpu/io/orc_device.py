@@ -27,7 +27,9 @@ reference's graceful degradation):
 from __future__ import annotations
 
 import dataclasses
+import functools
 import os
+import struct
 import zlib
 from typing import Dict, List, Optional, Tuple
 
@@ -51,6 +53,9 @@ _S_PRESENT, _S_DATA, _S_LENGTH, _S_DICT = 0, 1, 2, 3
 #: column encodings
 _E_DIRECT, _E_DICT, _E_DIRECT_V2, _E_DICT_V2 = 0, 1, 2, 3
 
+#: decode-path observability (tests assert rare encodings were exercised)
+decode_stats = {"patched_base_runs": 0}
+
 #: RLEv2 5-bit width-code table (ORC spec "Closest fixed bit sizes").
 _WIDTH_TABLE = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16,
                 17, 18, 19, 20, 21, 22, 23, 24, 26, 28, 30, 32, 40, 48,
@@ -61,11 +66,26 @@ class NotOrcDecodable(Exception):
     pass
 
 
+def _parse_boundary(fn):
+    """Malformed/truncated input makes the hand-rolled parsers raise bare
+    IndexError/ValueError/KeyError; translate those to NotOrcDecodable at
+    the parser boundary so decode_stripe's fallback catch can stay
+    narrow (decoder-logic regressions elsewhere still fail loudly)."""
+    @functools.wraps(fn)
+    def wrap(*a, **kw):
+        try:
+            return fn(*a, **kw)
+        except (IndexError, ValueError, KeyError, struct.error) as e:
+            raise NotOrcDecodable(f"{fn.__name__}: {e!r}") from e
+    return wrap
+
+
 # ---------------------------------------------------------------------------
 # protobuf + file tail
 # ---------------------------------------------------------------------------
 
 
+@_parse_boundary
 def _proto_fields(b: bytes) -> List[Tuple[int, int, object]]:
     out, i, n = [], 0, len(b)
     while i < n:
@@ -147,6 +167,7 @@ def read_tail(path: str) -> OrcTail:
     return OrcTail(compression, block_size, stripes, kinds, names)
 
 
+@_parse_boundary
 def _decompress_all(compression: int, raw: bytes) -> bytes:
     """Undo ORC's block framing: 3-byte little-endian header per block,
     (length << 1) | is_original."""
@@ -252,6 +273,7 @@ def _unpack_be(b: bytes, i: int, count: int, width: int
     return vals, i + nbytes
 
 
+@_parse_boundary
 def parse_rlev2(b: bytes, signed: bool, expected: int) -> _Runs:
     """Parse an RLEv2 byte stream into a run table; values count must
     reach ``expected``."""
@@ -308,6 +330,7 @@ def parse_rlev2(b: bytes, signed: bool, expected: int) -> _Runs:
                 runs.add_direct(vals)
             produced += count
         else:  # enc == 2, PATCHED_BASE — materialize host-side
+            decode_stats["patched_base_runs"] += 1
             wcode = (hdr >> 1) & 0x1F
             width = _WIDTH_TABLE[wcode]
             count = ((hdr & 1) << 8 | b[i + 1]) + 1
@@ -324,7 +347,10 @@ def parse_rlev2(b: bytes, signed: bool, expected: int) -> _Runs:
                 base = -(base & (msb - 1))
             vals, i = _unpack_be(b, i, count, width)
             vals = vals.astype(np.int64)
-            pcombined, i = _unpack_be(b, i, pll, pgw + pw)
+            # writers pack patch entries with getClosestFixedBits(pgw+pw),
+            # not the raw sum (e.g. 25 -> 26)
+            pe_width = next((w for w in _WIDTH_TABLE if w >= pgw + pw), 64)
+            pcombined, i = _unpack_be(b, i, pll, pe_width)
             gap_pos = 0
             for pc in pcombined:
                 gap_pos += int(pc) >> pw
@@ -337,6 +363,7 @@ def parse_rlev2(b: bytes, signed: bool, expected: int) -> _Runs:
     return runs
 
 
+@_parse_boundary
 def parse_byte_rle_bits(b: bytes, n_rows: int) -> np.ndarray:
     """PRESENT stream: byte-RLE over MSB-first bit-packed bytes ->
     packed uint8 bitmask of n_rows bits."""
@@ -465,18 +492,22 @@ def _decode_float_column(vals: np.ndarray, bits, n_rows: int,
 
 def _dict_from_blob(blob: bytes, lengths: np.ndarray
                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """(sorted unique payload, offsets, code remap old->sorted)."""
+    """(sorted unique payload, offsets, code remap old->sorted).
+
+    Entries are deduped: the dict_sorted contract (data/column.py) needs
+    code equality == string equality, and DIRECT_V2 feeds every row's
+    value through here (duplicates guaranteed)."""
     offs = np.zeros(len(lengths) + 1, np.int64)
     np.cumsum(lengths, out=offs[1:])
     entries = [blob[offs[k]:offs[k + 1]] for k in range(len(lengths))]
-    order = sorted(range(len(entries)), key=lambda k: entries[k])
-    sorted_entries = [entries[k] for k in order]
-    remap = np.empty(len(entries), np.int32)
-    for rank, old in enumerate(order):
-        remap[old] = rank
-    payload = b"".join(sorted_entries)
-    soffs = np.zeros(len(sorted_entries) + 1, np.int32)
-    np.cumsum([len(e) for e in sorted_entries], out=soffs[1:])
+    uniq = sorted(set(entries))
+    rank = {e: r for r, e in enumerate(uniq)}
+    remap = np.fromiter((rank[e] for e in entries), np.int32,
+                        count=len(entries)) if entries else \
+        np.zeros(0, np.int32)
+    payload = b"".join(uniq)
+    soffs = np.zeros(len(uniq) + 1, np.int32)
+    np.cumsum([len(e) for e in uniq], out=soffs[1:])
     return (np.frombuffer(payload, np.uint8) if payload else
             np.zeros(0, np.uint8), soffs, remap)
 
@@ -696,6 +727,9 @@ class TpuOrcScanExec:
                 with trace_range("orc.device_decode_stripe"):
                     return decode_stripe(path, tail, si, self._schema)
             except NotOrcDecodable:
+                # parsers translate malformed-input errors to
+                # NotOrcDecodable at their boundary (_parse_boundary);
+                # decoder-logic bugs elsewhere still fail loudly
                 ctx.metric(self.node_name(), "stripeHostFallback", 1)
                 return self._host_stripe(path, tail, si)
 

@@ -233,7 +233,13 @@ def _like_dp(m: jnp.ndarray, toks) -> jnp.ndarray:
     """Vectorized SQL-LIKE wildcard DP over a [N, W] byte matrix (PAD past
     each string's end). One boolean lane per pattern position; W x P
     unrolled vector ops — every lane stays batch-wide, XLA fuses the whole
-    walk into a few kernels."""
+    walk into a few kernels.
+
+    '_' is character-aware: it consumes one UTF-8 lead byte and then any
+    continuation bytes extend the same state, so multi-byte characters
+    match Spark's one-character semantics. '%' needs no special casing —
+    a literal following '%' starts with a lead byte and can never match
+    at a mid-character (continuation-byte) position."""
     n, w = m.shape
     p = len(toks)
     dp = [jnp.ones(n, jnp.bool_)]
@@ -242,13 +248,14 @@ def _like_dp(m: jnp.ndarray, toks) -> jnp.ndarray:
     for j in range(w):
         c = m[:, j]
         valid = c >= 0
+        cont = (c & 0xC0) == 0x80  # UTF-8 continuation byte
         ndp = [jnp.zeros(n, jnp.bool_)]
         for i in range(1, p + 1):
             kind, lit = toks[i - 1]
             if kind == 2:
                 nd = ndp[i - 1] | dp[i] | dp[i - 1]
             elif kind == 1:
-                nd = dp[i - 1]
+                nd = (dp[i - 1] & ~cont) | (dp[i] & cont)
             else:
                 nd = dp[i - 1] & (c == lit)
             ndp.append(nd)
@@ -332,9 +339,8 @@ class Like(Expression):
         # General %/_ pattern: vectorized wildcard DP over the byte matrix
         # (the GpuLike role, stringFunctions.scala:862 — cudf's kernel is
         # this same NFA walk). Dictionary columns run the DP once over the
-        # (small) dictionary and gather by code. Byte-level semantics:
-        # '_' consumes one BYTE, so non-ASCII '_' matches diverge (same
-        # caveat family as the reference's regexp byte/char notes).
+        # (small) dictionary and gather by code. '_' is UTF-8
+        # character-aware (continuation bytes extend the state).
         toks = self.tokens()
         col = self.children[0].eval_device(batch)
         from .expression import make_column

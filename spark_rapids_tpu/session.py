@@ -10,7 +10,7 @@ TpuOverrides rewrite.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
@@ -102,11 +102,12 @@ class TpuSession:
         cpu_plan = plan_physical(prune_columns(logical), self.conf)
         return self._overrides.apply(cpu_plan)
 
-    #: plan signature -> {join site ordinal: exact output capacity}. Learned
-    #: from observed match totals the first time a plan's optimistic sizing
-    #: overflows; persists for the session so re-running the same query
-    #: shape executes exactly once (no retry ladder, no re-compiles).
-    _JOIN_CAP_CACHE: Dict[tuple, dict] = {}
+    #: plan signature -> ({join site ordinal: exact output capacity},
+    #: {join site ordinal: dense-mode escalation}). Learned from observed
+    #: match totals the first time a plan's optimistic sizing overflows;
+    #: persists for the session so re-running the same query shape
+    #: executes exactly once (no retry ladder, no re-compiles).
+    _JOIN_CAP_CACHE: Dict[tuple, Tuple[dict, dict]] = {}
 
     #: Deferred overflow attempts before the guaranteed eager rung: each
     #: attempt learns exact capacities for every join it reached, so a

@@ -253,3 +253,18 @@ class TestLiterals:
     def test_string_literal_roundtrip(self):
         hb = HostBatch(gen_batch({"s": StringGen()}, n=64, seed=1))
         assert_expr_equal(P.EqualTo(col("s"), lit("abc")), hb)
+
+
+class TestLikeUtf8:
+    @pytest.mark.parametrize("pattern", [
+        "_", "__", "___", "a_", "_é", "é_", "%_", "_%é%", "caf_", "_af_"])
+    def test_like_underscore_utf8(self, pattern):
+        # '_' must match one CHARACTER, not one byte: multi-byte UTF-8
+        # values (é = 2 bytes, 日 = 3 bytes) exercise the
+        # continuation-byte extension in the wildcard DP (round-5
+        # advisor fix; default-tier on purpose)
+        from spark_rapids_tpu.ops import strings as S
+        hb = HostBatch(gen_batch({
+            "t": StringGen(max_len=4, alphabet="aé日"),
+        }, n=120, seed=7))
+        assert_expr_equal(S.Like(col("t"), pattern), hb)
