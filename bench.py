@@ -107,7 +107,9 @@ def run_suite():
     cpu_u = tpch.load(cpu, tables, cache=False)
     tpu_u = tpch.load(tpu, tables, cache=False)
 
-    ratios, tpu_times, uncached_ratios = [], [], []
+    from spark_rapids_tpu.data import upload_cache
+
+    ratios, tpu_times, uncached_ratios, cold_ratios = [], [], [], []
     # Subset: every operator shape (scan/filter/project/agg, 1-4 joins,
     # semi join, disjunctive band join, conditional sums, float scoring)
     # without double-paying remote-compile time for shapes q5/q3 already
@@ -126,17 +128,29 @@ def run_suite():
         stats1 = KC.cache_stats()
         cpu_time = timed(lambda: q(cpu_t).collect())
         tpu_time = timed(lambda: q(tpu_t).collect())
+        # uncached: re-collect over the same (immutable) host tables —
+        # the upload memo legally skips re-encoding/re-uploading bytes
+        # the device has already seen (VERDICT r4 item 1c)
         ucpu = timed(lambda: q(cpu_u).collect(), reps=1)
         utpu = timed(lambda: q(tpu_u).collect(), reps=1)
+        # cold: upload memo dropped first, so host-side prep + transfer
+        # land fully inside the timed region (transparency companion to
+        # the memoized number)
+
+        def cold_run():
+            upload_cache.clear()
+            return q(tpu_u).collect()
+        ctpu = timed(cold_run, reps=1)
         ratios.append(cpu_time / tpu_time)
         uncached_ratios.append(ucpu / utpu)
+        cold_ratios.append(ucpu / ctpu)
         tpu_times.append(tpu_time)
         # Perf evidence (VERDICT r3 item 1b): kernels compiled for this
         # query's warmup, fused-program count, and steady-state dispatch
         # counts — "compiles and matches" AND "how it runs".
         print(f"[bench] {name}: cpu={cpu_time*1e3:.1f}ms "
               f"tpu={tpu_time*1e3:.1f}ms ratio={cpu_time/tpu_time:.2f} "
-              f"uncached_ratio={ucpu/utpu:.2f} "
+              f"uncached_ratio={ucpu/utpu:.2f} cold_ratio={ucpu/ctpu:.2f} "
               f"kernels_compiled={stats1['misses'] - stats0['misses']} "
               f"fused_programs={len(fusion._FUSED_CACHE)} "
               f"(warmup+compile {time.perf_counter()-t0:.0f}s)",
@@ -146,14 +160,18 @@ def run_suite():
     geo_r = _geo(ratios)
     print(f"[bench] geomean ratio cached={geo_r:.3f} "
           f"uncached={_geo(uncached_ratios):.3f} "
+          f"cold={_geo(cold_ratios):.3f} "
           f"(>1 = device wins; cached pins tables HBM-resident, uncached "
-          f"re-uploads per run)", file=sys.stderr)
+          f"re-collects over the same host tables with the upload memo "
+          f"warm, cold clears the memo so prep+transfer are fully timed)",
+          file=sys.stderr)
     return {
         "metric": f"tpchlike_{len(tpu_times)}q_1Mrow_geomean_device_time",
         "value": round(geo_t * 1000, 2),
         "unit": "ms",
         "vs_baseline": round(geo_r, 3),
         "uncached_vs_baseline": round(_geo(uncached_ratios), 3),
+        "cold_vs_baseline": round(_geo(cold_ratios), 3),
     }
 
 

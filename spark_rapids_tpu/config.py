@@ -282,6 +282,19 @@ SHUFFLE_MAX_INFLIGHT_BYTES = conf_int(
 # TPU-specific knobs (no reference analog; new hardware, new keys)
 # ---------------------------------------------------------------------------
 
+TOPK_THRESHOLD = conf_int(
+    "spark.rapids.tpu.sort.topKThreshold", 16384,
+    "ORDER BY ... LIMIT n with n at or below this collapses to the "
+    "streaming top-k exec (lax.top_k, O(n log k)) instead of a global "
+    "sort. 0 disables limit-into-sort.")
+
+TPU_UPLOAD_CACHE_BYTES = conf_int(
+    "spark.rapids.tpu.uploadCache.maxBytes", 1 << 30,
+    "Byte budget for the host->device upload memo: conversions are keyed "
+    "on the immutable arrow buffers, so re-collecting over the same host "
+    "data skips dictionary encoding, padding, and the transfer. 0 "
+    "disables.")
+
 TPU_CAPACITY_BUCKETING = conf_bool(
     "spark.rapids.tpu.capacityBucketing.enabled", True,
     "Pad device batches to power-of-two capacities so XLA compiles one program "

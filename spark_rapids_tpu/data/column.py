@@ -240,9 +240,22 @@ class DeviceColumn:
     @staticmethod
     def from_arrow(arr: pa.Array, capacity: int) -> "DeviceColumn":
         """Upload a pyarrow array (the host interchange format, like
-        JCudfSerialization host buffers in the reference)."""
-        dtype = T.from_arrow_type(arr.type)
+        JCudfSerialization host buffers in the reference). Conversions are
+        memoized on the immutable arrow buffers (see data/upload_cache.py)
+        so re-uploading data the device has already seen skips both the
+        host-side prep and the transfer."""
+        from . import upload_cache
         arr = arr.combine_chunks() if isinstance(arr, pa.ChunkedArray) else arr
+        hit = upload_cache.lookup(arr, capacity)
+        if hit is not None:
+            return hit
+        col = DeviceColumn._from_arrow_uncached(arr, capacity)
+        upload_cache.insert(arr, capacity, col)
+        return col
+
+    @staticmethod
+    def _from_arrow_uncached(arr: pa.Array, capacity: int) -> "DeviceColumn":
+        dtype = T.from_arrow_type(arr.type)
         if isinstance(dtype, T.ArrayType):
             return DeviceColumn.array_from_arrow(arr, dtype, capacity)
         if isinstance(dtype, T.StructType):

@@ -105,7 +105,9 @@ class HostToDeviceExec(TpuExec):
                     pending, pending_rows = [], 0
             if pending:
                 yield self._upload(pending)
-        return [run(p) for p in self.children[0].execute(ctx)]
+        from ..utils.prefetch import prefetch_iter
+        return [prefetch_iter(run(p))
+                for p in self.children[0].execute(ctx)]
 
     def _upload(self, rbs: List[pa.RecordBatch]) -> ColumnarBatch:
         with trace_range("HostToDevice.upload"):
@@ -638,6 +640,81 @@ class TpuSortExec(TpuExec):
         return [gen()]
 
 
+class TpuTopKExec(TpuExec):
+    """Limit-into-sort: ORDER BY ... LIMIT n keeps a running top-k batch
+    instead of globally sorting the input (the reference gets the same
+    shape from cudf partial sorts under GpuSortExec.scala:50 +
+    GpuCollectLimitExec; planned by the CpuLimitExec rule when n is
+    under spark.rapids.tpu.sort.topKThreshold).
+
+    Streaming: each incoming batch reduces to its top-k (single-key
+    keys ride one int64 lane through ``lax.top_k``, O(n log k)); the
+    running best merges pairwise, so the device never holds more than
+    (batch + 2k) rows for the sort tail."""
+
+    children_coalesce_goals = ["target"]
+
+    def __init__(self, child: PhysicalPlan, orders: List[SortOrder],
+                 n: int):
+        self.children = [child]
+        self.orders = orders
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.children[0].schema
+
+    def describe(self):
+        return f"TpuTopK n={self.n}"
+
+    def execute(self, ctx):
+        schema = self.schema
+        key_exprs = [o.child.bind(schema) for o in self.orders]
+        asc = [o.ascending for o in self.orders]
+        nf = [o.effective_nulls_first for o in self.orders]
+
+        def build(fast):
+            def do_topk(b):
+                keys = [e.eval_device(b) for e in key_exprs]
+                top, ok = KR.topk_batch_by_columns(
+                    b, keys, asc, nf, self.n, allow_data_fallback=fast)
+                # literal True would jit-box into a device array; None
+                # survives jit so the static-exact case stays sync-free
+                return top, (None if ok is True else ok)
+            return do_topk
+
+        def gen():
+            # The float64-lane fast path is optimistic for float/int64
+            # keys (exactness is data-dependent); its deferred fail flag
+            # rides the same session dense-mode retry as the dense
+            # joins/aggs — no per-batch host syncs, fusion-safe.
+            site = ctx.next_join_site()
+            fast = not ctx.eager_overflow \
+                and ctx.dense_modes.get(site, 0) == 0
+            do_topk = cached_kernel(
+                "topk", kernel_key(key_exprs, asc, nf) + (self.n, fast),
+                lambda: build(fast))
+
+            def reduce_one(b):
+                top, ok = do_topk(b)
+                if ok is not None:
+                    fail = ~ok
+                    ctx.overflow_flags.append(fail)
+                    ctx.dense_fails.append((site, fail))
+                return top
+
+            best = None
+            for part in self.children[0].execute(ctx):
+                for db in part:
+                    top = reduce_one(db)
+                    best = top if best is None else \
+                        reduce_one(_coalesce_device([best, top]))
+            if best is not None:
+                ctx.metric(self.node_name(), "numOutputBatches", 1)
+                yield best
+        return [gen()]
+
+
 def _accumulate_spillable(child: PhysicalPlan, ctx,
                           label: str) -> Optional[ColumnarBatch]:
     """Collect ALL of a child's batches into one, registering each with the
@@ -744,24 +821,49 @@ class TpuHashAggregateExec(TpuExec):
         agg_key = kernel_key(groupings, [(a.name, a.func) for a in aggs],
                              buf_schema)
 
-        def build_partial():
-            def partial(batch: ColumnarBatch) -> ColumnarBatch:
+        def build_partial(dense_mode):
+            def partial(batch: ColumnarBatch):
                 return _aggregate_batch(batch, groupings, aggs, buf_schema,
-                                        n_keys, update_mode=True)
+                                        n_keys, update_mode=True,
+                                        dense_mode=dense_mode)
             return partial
 
-        def build_merge():
-            def merge(batch: ColumnarBatch) -> ColumnarBatch:
+        def build_merge(dense_mode):
+            def merge(batch: ColumnarBatch):
                 key_refs = [BoundReference(i, f.data_type, f.nullable)
                             for i, f in enumerate(buf_schema)][:n_keys]
                 return _aggregate_batch(batch, key_refs, aggs, buf_schema,
-                                        n_keys, update_mode=False)
+                                        n_keys, update_mode=False,
+                                        dense_mode=dense_mode)
             return merge
 
-        partial = cached_kernel("agg_partial", agg_key, build_partial)
-        merge = cached_kernel("agg_merge", agg_key, build_merge)
-
         def gen():
+            # Dense/hash grouping is optimistic like the dense joins:
+            # a deferred fail flag (key span or collision sidecar
+            # overflow) escalates this site to the sort path via the
+            # session's dense-mode retry.
+            site = ctx.next_join_site()
+            dense_mode = 1 if ctx.eager_overflow else \
+                min(ctx.dense_modes.get(site, 0), 1)
+            partial_k = cached_kernel(
+                "agg_partial", agg_key + (dense_mode,),
+                lambda: build_partial(dense_mode))
+            merge_k = cached_kernel(
+                "agg_merge", agg_key + (dense_mode,),
+                lambda: build_merge(dense_mode))
+
+            def run_k(k, b):
+                out, fail = k(b)
+                if fail is not None:
+                    ctx.overflow_flags.append(fail)
+                    ctx.dense_fails.append((site, fail))
+                return out
+
+            def partial(b):
+                return run_k(partial_k, b)
+
+            def merge(b):
+                return run_k(merge_k, b)
             # Merge-sort-style reduction stack: merge two partials only when
             # the newer one has caught up in capacity. With capacity-sum
             # concat sizing (no row-count syncs), a linear state-accumulator
@@ -846,7 +948,7 @@ def finalize_agg_kernel(n_keys: int, aggregates: List[AGG.AggregateExpression],
 def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
                      aggs: List[AGG.AggregateExpression],
                      buf_schema: T.Schema, n_keys: int,
-                     update_mode: bool) -> ColumnarBatch:
+                     update_mode: bool, dense_mode: int = 1):
     """One grouping pass. update_mode: inputs are raw rows (evaluate agg
     children, apply update ops). merge mode: inputs are buffer columns.
 
@@ -882,9 +984,13 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
             inputs.append((values, validity, op, spec))
         bi += len(specs)
     triples = [(v, val, op) for v, val, op, _ in inputs]
+    fail = None
     if keys:
-        key_cols, results, n_groups, group_live = KG.grouped_aggregate(
-            keys, live, triples)
+        key_cols, results, n_groups, group_live, fail = \
+            KG.grouped_aggregate(keys, live, triples,
+                                 dense_mode=dense_mode)
+        if fail is False:
+            fail = None  # statically exact path: nothing to observe
     else:
         key_cols, results, n_groups, group_live = KG.global_aggregate(
             capacity, live, triples)
@@ -898,7 +1004,7 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
             validity_out = (counts > 0) & group_live
         out_cols.append(make_column(data.astype(spec.dtype.np_dtype),
                                     validity_out, spec.dtype))
-    return ColumnarBatch(tuple(out_cols), n_groups, buf_schema)
+    return ColumnarBatch(tuple(out_cols), n_groups, buf_schema), fail
 
 
 # ---------------------------------------------------------------------------

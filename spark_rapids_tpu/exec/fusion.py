@@ -47,7 +47,7 @@ from ..utils.kernel_cache import plan_signature as _plan_sig
 from .coalesce import TpuCoalesceBatchesExec
 from .execs import (DeviceToHostExec, TpuExec, TpuExpandExec, TpuFilterExec,
                     TpuHashAggregateExec, TpuLimitExec, TpuLocalLimitExec,
-                    TpuProjectExec,
+                    TpuProjectExec, TpuTopKExec,
                     TpuUnionExec, _coalesce_device)
 
 
@@ -78,9 +78,14 @@ class FusedInputExec(TpuExec):
 #: Execs whose execute() path is fully traceable (no host syncs, no host
 #: data): these are inlined into the fused program. Everything else columnar
 #: becomes a boundary input.
+#: TpuTopKExec is deliberately NOT inlined: as a boundary it keeps its
+#: child subtree on the streaming path, where dense-join outputs shrink
+#: to their live buckets between operators — for join-chain plans that
+#: beats one fused program running every stage at full lazy capacity.
 _INLINE = (TpuProjectExec, TpuFilterExec, TpuHashAggregateExec,
            TpuCoalesceBatchesExec, TpuExpandExec,
-           TpuUnionExec, TpuLimitExec, TpuLocalLimitExec, FusedInputExec)
+           TpuUnionExec, TpuLimitExec, TpuLocalLimitExec,
+           FusedInputExec)
 
 
 def _inline_types():
@@ -161,7 +166,11 @@ def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int,
         # (without it every overflow repeats the growth-escalation ladder,
         # and each rung is a fresh whole-program compile).
         totals = {site: t for site, t in ictx.join_totals}
-        dfails = {site: f for site, f in ictx.dense_fails}
+        # OR per-site: one agg site reports a fail per batch + merge pass,
+        # and a single True must survive to teach the dense-mode retry
+        dfails: dict = {}
+        for site, f in ictx.dense_fails:
+            dfails[site] = f if site not in dfails else (dfails[site] | f)
         if not outs:
             # Statically empty (no batches at all) — no device work needed.
             return (None, flags, totals, dfails, None), None

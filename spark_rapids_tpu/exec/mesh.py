@@ -229,14 +229,18 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
 
         def agg(env, flags):
             local = child(env, flags)
-            part = _aggregate_batch(local, groupings, aggs, buf_schema,
-                                    n_keys, update_mode=True)
+            # Mesh stays on the always-exact sort path (dense_mode=1):
+            # its growth-escalation retry cannot learn dense-mode flags.
+            part, _ = _aggregate_batch(local, groupings, aggs, buf_schema,
+                                       n_keys, update_mode=True,
+                                       dense_mode=1)
             cap = max(part.capacity // n_parts, 128)
             shuffled = _exchange_by_key(
                 part, key_refs, n_parts,
                 bucket_capacity(int(cap * bucket_growth)), flags)
-            merged = _aggregate_batch(shuffled, key_refs, aggs, buf_schema,
-                                      n_keys, update_mode=False)
+            merged, _ = _aggregate_batch(shuffled, key_refs, aggs,
+                                         buf_schema, n_keys,
+                                         update_mode=False, dense_mode=1)
             return final(merged)
         return agg
 
@@ -341,8 +345,8 @@ def _compile_global_agg(node, child, child_schema):
 
     def gagg(env, flags):
         local = child(env, flags)
-        part = _aggregate_batch(local, [], aggs, buf_schema, 0,
-                                update_mode=True)
+        part, _ = _aggregate_batch(local, [], aggs, buf_schema, 0,
+                                   update_mode=True, dense_mode=1)
         row0 = jnp.arange(part.capacity, dtype=jnp.int32) == 0
         cols = []
         for c, op in zip(part.columns, merge_ops):

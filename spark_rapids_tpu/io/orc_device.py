@@ -738,7 +738,8 @@ class TpuOrcScanExec:
                 b = read(*u)
                 ctx.metric(self.node_name(), "numOutputBatches", 1)
                 yield b
-        return [gen()]
+        from ..utils.prefetch import prefetch_iter
+        return [prefetch_iter(gen())]
 
     def _host_stripe(self, path, tail, si) -> ColumnarBatch:
         import pyarrow.orc as orc

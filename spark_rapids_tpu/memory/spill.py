@@ -285,6 +285,14 @@ class BufferCatalog:
                 self._spill_device_entry(entry)
 
     def _ensure_device_budget(self, exclude: Optional[int] = None):
+        # The upload memo's device bytes count against the budget too;
+        # as a pure cache it is the cheapest thing to evict (LRU) before
+        # any real buffer spills.
+        from ..data import upload_cache
+        over = self.device_bytes + upload_cache.cache_bytes() \
+            - self.device_budget
+        if over > 0:
+            upload_cache.shrink_by(over)
         while self.device_bytes > self.device_budget:
             entry = self._pop_spillable(self._device_heap, StorageTier.DEVICE,
                                         exclude=exclude)

@@ -471,7 +471,7 @@ EXEC_RULES: Dict[Type[P.PhysicalPlan], ExecRule] = {
     P.CpuLimitExec: ExecRule(
         "GlobalLimit",
         lambda n: [],
-        lambda n, ch, conf: E.TpuLimitExec(ch[0], n.n)),
+        lambda n, ch, conf: _make_global_limit(n, ch, conf)),
     P.CpuLocalLimitExec: ExecRule(
         "LocalLimit",
         lambda n: [],
@@ -504,6 +504,20 @@ EXEC_RULES: Dict[Type[P.PhysicalPlan], ExecRule] = {
 def _make_window(n: "P.CpuWindowExec", ch):
     from ..exec.window_exec import TpuWindowExec
     return TpuWindowExec(ch[0], n.window_exprs, n.schema)
+
+
+def _make_global_limit(n: "P.CpuLimitExec", ch, conf):
+    """GlobalLimit over a device sort collapses LocalLimit+Sort into the
+    top-k exec (limit-into-sort; the reference's cudf partial-sort
+    analog) when n is small enough that top-k beats a global sort."""
+    from ..config import TOPK_THRESHOLD
+    inner = ch[0]
+    if (0 < n.n <= conf.get(TOPK_THRESHOLD)
+            and isinstance(inner, E.TpuLocalLimitExec)
+            and isinstance(inner.children[0], E.TpuSortExec)):
+        sort = inner.children[0]
+        return E.TpuTopKExec(sort.children[0], sort.orders, n.n)
+    return E.TpuLimitExec(ch[0], n.n)
 
 
 def _make_broadcast_join(n: "P.CpuBroadcastHashJoinExec", ch):

@@ -56,6 +56,9 @@ class TpuSession:
         self.conf = TpuConf(conf)
         self.device_manager = DeviceManager.get_or_create(self.conf)
         self._overrides = TpuOverrides(self.conf)
+        from .config import TPU_UPLOAD_CACHE_BYTES
+        from .data import upload_cache
+        upload_cache.set_budget(self.conf.get(TPU_UPLOAD_CACHE_BYTES))
 
     # -- conf ---------------------------------------------------------------
     def with_conf(self, **kv) -> "TpuSession":

@@ -1,0 +1,67 @@
+"""Background prefetch for host->device pipelines.
+
+Double buffering: while the device computes over batch k, a worker
+thread decodes/converts/uploads batch k+1 (JAX dispatch is thread-safe;
+uploads enqueue on the transfer stream). This is the TPU-native analog
+of the reference's overlapped scan — its parquet reader assembles the
+next host buffer while cudf decodes the previous one on the GPU stream
+(GpuParquetScan.scala:314 readPartFile / Table.readParquet split).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterable, Iterator
+
+_STOP = object()
+
+
+def prefetch_iter(src: Iterable, depth: int = 2) -> Iterator:
+    """Iterate ``src`` on a worker thread, keeping up to ``depth`` items
+    ready. Exceptions re-raise at the consumer's next().
+
+    Abandonment-safe: when the consumer stops early (a LIMIT that never
+    drains the stream, generator GC), the finally block signals the
+    worker and drains the queue, so neither the thread nor its queued
+    device batches outlive the consumer."""
+    q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
+    cancelled = threading.Event()
+
+    def put(item) -> bool:
+        while not cancelled.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def work():
+        try:
+            for item in src:
+                if not put(item):
+                    return
+        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+            put((_STOP, e))
+            return
+        put((_STOP, None))
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if isinstance(item, tuple) and len(item) == 2 \
+                    and item[0] is _STOP:
+                if item[1] is not None:
+                    raise item[1]
+                return
+            yield item
+    finally:
+        cancelled.set()
+        try:
+            while True:
+                q.get_nowait()
+        except queue.Empty:
+            pass

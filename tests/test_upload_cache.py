@@ -1,0 +1,118 @@
+"""Upload memo cache: host->device conversions keyed on immutable arrow
+buffers (data/upload_cache.py). Re-collecting over the same host data
+must skip re-encoding/re-uploading; distinct data must never alias."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.data import upload_cache as UC
+from spark_rapids_tpu.data.batch import ColumnarBatch
+from spark_rapids_tpu.data.column import DeviceColumn
+from spark_rapids_tpu.session import TpuSession
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    UC.clear()
+    UC.set_budget(1 << 30)
+    yield
+    UC.clear()
+
+
+def _arr(vals, ty=None):
+    return pa.array(vals, type=ty)
+
+
+class TestMemo:
+    def test_hit_returns_same_column(self):
+        a = _arr([1, 2, 3, None], pa.int64())
+        c1 = DeviceColumn.from_arrow(a, 128)
+        c2 = DeviceColumn.from_arrow(a, 128)
+        assert c1 is c2
+        assert UC.stats["hits"] >= 1
+
+    def test_different_capacity_misses(self):
+        a = _arr([1, 2, 3], pa.int64())
+        c1 = DeviceColumn.from_arrow(a, 128)
+        c2 = DeviceColumn.from_arrow(a, 256)
+        assert c1 is not c2
+        assert int(c1.data.shape[0]) == 128
+        assert int(c2.data.shape[0]) == 256
+
+    def test_different_data_never_aliases(self):
+        a = _arr(list(range(100)), pa.int64())
+        b = _arr(list(range(100, 200)), pa.int64())
+        ca = DeviceColumn.from_arrow(a, 128)
+        cb = DeviceColumn.from_arrow(b, 128)
+        assert int(ca.data[0]) == 0 and int(cb.data[0]) == 100
+
+    def test_sliced_array_offset_in_key(self):
+        base = _arr(list(range(100)), pa.int64())
+        s1, s2 = base.slice(0, 50), base.slice(50, 50)
+        c1 = DeviceColumn.from_arrow(s1, 128)
+        c2 = DeviceColumn.from_arrow(s2, 128)
+        assert int(c1.data[0]) == 0 and int(c2.data[0]) == 50
+
+    def test_string_column_memoized(self):
+        a = _arr(["x", "y", "x", None, "zz"] * 50)
+        c1 = DeviceColumn.from_arrow(a, 256)
+        c2 = DeviceColumn.from_arrow(a, 256)
+        assert c1 is c2
+        assert c1.is_dict
+
+    def test_budget_eviction_lru(self):
+        a = _arr(np.arange(1000), pa.int64())
+        col = DeviceColumn.from_arrow(a, 1024)
+        UC.set_budget(col.size_bytes + 1)  # room for ~one entry
+        UC.clear()
+        c1 = DeviceColumn.from_arrow(a, 1024)
+        b = _arr(np.arange(1000, 2000), pa.int64())
+        DeviceColumn.from_arrow(b, 1024)  # evicts a
+        c3 = DeviceColumn.from_arrow(a, 1024)
+        assert c3 is not c1  # was evicted, rebuilt
+        assert UC.stats["evictions"] >= 1
+
+    def test_zero_budget_disables(self):
+        UC.set_budget(0)
+        a = _arr([1, 2, 3], pa.int64())
+        c1 = DeviceColumn.from_arrow(a, 128)
+        c2 = DeviceColumn.from_arrow(a, 128)
+        assert c1 is not c2
+
+
+class TestEndToEnd:
+    def test_repeat_collect_hits_memo_same_results(self):
+        rng = np.random.default_rng(3)
+        rb = pa.RecordBatch.from_pydict({
+            "k": rng.integers(0, 10, 5000),
+            "v": rng.normal(size=5000),
+            "s": np.array(["a", "bb", "ccc"])[rng.integers(0, 3, 5000)],
+        })
+        tpu = TpuSession({"spark.rapids.sql.enabled": True})
+        cpu = TpuSession({"spark.rapids.sql.enabled": False})
+
+        def q(s):
+            from spark_rapids_tpu.ops import aggregates as A
+            from spark_rapids_tpu.ops.expression import col
+            return (s.create_dataframe(rb).group_by(col("s"))
+                    .agg(A.AggregateExpression(A.Count(), "c")).sort("s"))
+        first = q(tpu).collect()
+        h0 = UC.stats["hits"]
+        second = q(tpu).collect()
+        assert UC.stats["hits"] > h0, "second collect must hit the memo"
+        assert first.equals(second)
+        assert first.equals(q(cpu).collect())
+
+    def test_memory_pressure_clears_memo(self):
+        from spark_rapids_tpu.memory.spill import BufferCatalog
+        rb = pa.RecordBatch.from_pydict(
+            {"v": np.arange(4096, dtype=np.int64)})
+        DeviceColumn.from_arrow(rb.column(0), 4096)
+        assert UC.cache_bytes() > 0
+        cat = BufferCatalog(device_budget_bytes=1,
+                            host_budget_bytes=1 << 20)
+        big = ColumnarBatch.from_arrow(rb)
+        cat.register_batch(big)  # over budget -> memo dropped first
+        assert UC.cache_bytes() == 0
