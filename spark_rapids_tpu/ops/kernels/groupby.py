@@ -118,25 +118,23 @@ _DICT_GROUP_LIMIT = 4096
 _DENSE_AGG_SLOTS = 1 << 21
 
 
-def _dense_eligible(keys: Sequence[DeviceColumn],
-                    inputs: Sequence[tuple]) -> bool:
-    """True when the single-key direct-offset path applies: one int-like
-    key (ints/date/bool/dict codes — not floats, whose value span is
-    meaningless as an address space) and plain numeric reduction lanes.
+def _dense_eligible(keys, inputs) -> bool:
+    """True when the packed direct-offset path applies: every key
+    int-like (ints/date/bool/dict codes — not floats, whose value span
+    is meaningless as an address space) and plain numeric reduction
+    lanes. Multi-key groupings pack mixed-radix; the data-dependent
+    span-product check is the kernel's fail flag.
 
-    Multi-key groupings stay on the sort path: a hashed variant with an
-    exact collision sidecar was measured (round 5) to LOSE to the
-    grouping sort at realistic capacities — its fixed costs (2^21-slot
-    segment tables per lane, an unconditional sidecar sort in the traced
-    program) exceed the ~20ms the sort actually takes once dense-join
-    outputs have shrunk to their live buckets."""
-    if len(keys) != 1:
+    (A hashed multi-key variant with an exact collision sidecar was
+    measured (round 5) to LOSE to the grouping sort at realistic
+    capacities; exact packing has none of its fixed costs.)"""
+    if not keys or len(keys) > 6:  # radix product hopeless beyond a few
         return False
-    k = keys[0]
-    if k.is_complex or (k.dtype.is_floating and not k.is_dict):
-        return False
-    if k.is_string and not (k.is_dict and k.dict_sorted):
-        return False
+    for k in keys:
+        if k.is_complex or (k.dtype.is_floating and not k.is_dict):
+            return False
+        if k.is_string and not (k.is_dict and k.dict_sorted):
+            return False
     for v, val, _ in inputs:
         if v.ndim != 1 or not (jnp.issubdtype(v.dtype, jnp.number)
                                or v.dtype == jnp.bool_):
@@ -167,67 +165,82 @@ def _compact_slots(occupied: jnp.ndarray, capacity: int
     return n_groups, slot_of_group, group_live
 
 
-def _slot_reductions(inputs, live, slot, n_slots, capacity,
-                     take) -> List[Tuple[jnp.ndarray, jnp.ndarray]]:
-    """Per-input segment reductions over slot space; ``take`` maps a
-    full [n_slots(+1)] lane to dense group rows."""
-    iota = jnp.arange(capacity, dtype=jnp.int32)
-
-    def seg(x, op="sum"):
-        f = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
-             "max": jax.ops.segment_max}[op]
-        return take(f(x, slot, num_segments=n_slots + 1)[:n_slots])
-
+def _segment_reduce_inputs(inputs, seg, iota, capacity, live,
+                           pre=None, post=None):
+    """THE per-op aggregate dispatch: one copy of the count/sum/min/max/
+    first/last semantics (Spark NaN handling included) shared by every
+    grouping strategy — sort, packed-dict, and dense-slot paths inject
+    their mechanics and reuse these semantics, so an op fix lands
+    everywhere at once. ``pre`` maps row-space lanes (the sort path's
+    permutation gather), ``seg(x, op)`` reduces a row lane into dense
+    group rows, ``iota`` positions first/last in pre-space, ``post``
+    masks dead group lanes. (global_aggregate is the no-segment variant
+    and keeps its whole-array reductions.)"""
+    pre = pre or (lambda x: x)
+    post = post or (lambda x: x)
     results = []
     for v, val, op in inputs:
-        contrib = val & live
-        cnt = seg(contrib.astype(jnp.int64))
+        v_p = pre(v)
+        contrib = pre(val) & live
+        cnt = seg(contrib.astype(jnp.int64), "sum")
         if op == "count":
             res = cnt
         elif op == "sum":
-            res = seg(jnp.where(contrib, v, jnp.zeros((), v.dtype)))
+            res = seg(jnp.where(contrib, v_p, jnp.zeros((), v_p.dtype)),
+                      "sum")
         elif op in ("min", "max"):
-            floating = jnp.issubdtype(v.dtype, jnp.floating)
-            vv = _minmax_strip_nan(v, op) if floating else v
+            floating = jnp.issubdtype(v_p.dtype, jnp.floating)
+            vv = _minmax_strip_nan(v_p, op) if floating else v_p
             neutral = _max_value(vv.dtype) if op == "min" \
                 else _min_value(vv.dtype)
             res = seg(jnp.where(contrib, vv, neutral), op)
             if floating:
-                nan_cnt = seg((jnp.isnan(v) & contrib).astype(jnp.int64))
+                nan_cnt = seg((jnp.isnan(v_p) & contrib)
+                              .astype(jnp.int64), "sum")
                 res = _minmax_reinstate_nan(res, nan_cnt, cnt, op)
         elif op in ("first", "last"):
             if op == "first":
                 pos = seg(jnp.where(contrib, iota, capacity), "min")
             else:
                 pos = seg(jnp.where(contrib, iota, -1), "max")
-            res = v[jnp.clip(pos, 0, capacity - 1)]
+            res = v_p[jnp.clip(pos, 0, capacity - 1)]
         else:
             raise ValueError(op)
-        results.append((res, cnt))
+        results.append((post(res), post(cnt)))
     return results
 
 
-def _dense_int_aggregate(key: DeviceColumn, live: jnp.ndarray,
-                         inputs: Sequence[Tuple[jnp.ndarray, jnp.ndarray,
-                                                str]]):
-    """Direct-offset grouping for one int-like key: slot = value - min + 1
-    (slot 0 = null). O(n) scatters replace the grouping sort entirely;
-    slot order == the sort path's nulls-first ascending group order. The
-    fail flag trips when the observed key span exceeds the slot table —
-    the session's dense-mode escalation re-runs on the sort path (same
-    learning loop as the dense joins)."""
+def _dense_int_aggregate(keys, live, inputs):
+    """Direct-offset grouping for int-like keys packed mixed-radix into
+    one slot id: per key, lane = value - min + 1 (0 = null); the packed
+    id is exact by construction (injective while the span product fits
+    the slot table), so unlike a hashed scheme there are no collisions
+    to detect and no sidecar. O(n) scatters replace the grouping sort
+    entirely; packed order == the sort path's nulls-first ascending
+    group order. The fail flag trips when the observed span product
+    exceeds the slot table — the session's dense-mode escalation
+    re-runs on the sort path (same learning loop as the dense joins)."""
     S = _DENSE_AGG_SLOTS
-    capacity = key.capacity
-    v64 = _key_lane(key)
-    lv = live & key.validity
-    any_valid = lv.any()
+    capacity = keys[0].capacity
     big = jnp.int64(2**62)
-    vmin = jnp.where(any_valid, jnp.min(jnp.where(lv, v64, big)), 0)
-    vmax = jnp.where(any_valid, jnp.max(jnp.where(lv, v64, -big)), 0)
-    diff = vmax - vmin  # wraps negative if the true span overflows int64
-    fail = (diff < 0) | (diff >= jnp.int64(S - 1))
-    off = jnp.clip(v64 - vmin + 1, 0, S - 1).astype(jnp.int32)
-    slot = jnp.where(key.validity, off, 0)
+    packed = jnp.zeros(capacity, jnp.int64)
+    prod = jnp.int64(1)
+    fail = jnp.bool_(False)
+    for key in keys:
+        v64 = _key_lane(key)
+        lv = live & key.validity
+        any_valid = lv.any()
+        vmin = jnp.where(any_valid, jnp.min(jnp.where(lv, v64, big)), 0)
+        vmax = jnp.where(any_valid, jnp.max(jnp.where(lv, v64, -big)), 0)
+        diff = vmax - vmin  # wraps negative when the span overflows int64
+        fail = fail | (diff < 0) | (diff >= jnp.int64(S - 1))
+        span = jnp.clip(diff, 0, S - 1) + 2  # +1 bias, +1 null lane
+        lane = jnp.where(key.validity,
+                         jnp.clip(v64 - vmin + 1, 0, S - 1), 0)
+        packed = packed * span + lane
+        prod = jnp.minimum(prod * span, jnp.int64(S) + 1)
+    fail = fail | (prod > jnp.int64(S))
+    slot = jnp.clip(packed, 0, S - 1).astype(jnp.int32)
     slot = jnp.where(live, slot, S)  # dead rows -> spare slot
     rows_per_slot = jax.ops.segment_sum(live.astype(jnp.int32), slot,
                                         num_segments=S + 1)[:S]
@@ -237,12 +250,15 @@ def _dense_int_aggregate(key: DeviceColumn, live: jnp.ndarray,
     rep = jax.ops.segment_min(jnp.where(live, iota, capacity), slot,
                               num_segments=S + 1)[:S]
     rep_g = jnp.clip(rep[slot_of_group], 0, capacity - 1)
-    key_cols = [gather_column(key, rep_g, group_live)]
+    key_cols = [gather_column(key, rep_g, group_live) for key in keys]
 
-    def take(full):
+    def seg(x, op="sum"):
+        f = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+             "max": jax.ops.segment_max}[op]
+        full = f(x, slot, num_segments=S + 1)[:S]
         return jnp.where(group_live, full[slot_of_group],
                          jnp.zeros((), full.dtype))
-    results = _slot_reductions(inputs, live, slot, S, capacity, take)
+    results = _segment_reduce_inputs(inputs, seg, iota, capacity, live)
     return key_cols, results, n_groups, group_live, fail
 
 
@@ -293,7 +309,7 @@ def grouped_aggregate(keys: Sequence[DeviceColumn], live: jnp.ndarray,
             return _dict_grouped_aggregate(keys, live, inputs, n_slots) \
                 + (False,)
     if dense_mode == 0 and _dense_eligible(keys, inputs):
-        return _dense_int_aggregate(keys[0], live, inputs)
+        return _dense_int_aggregate(keys, live, inputs)
     return _sort_grouped_aggregate(keys, live, inputs) + (False,)
 
 
@@ -360,50 +376,19 @@ def _sort_grouped_aggregate(keys: Sequence[DeviceColumn],
     orig_starts = perm[starts]
     key_cols = [gather_column(k, orig_starts, group_live) for k in keys]
 
-    # -- per-input reductions ---------------------------------------------
-    # All via single-op segment scatters: ~60ms runtime at 1M rows but
-    # ~1s to COMPILE, vs ~200s for one emulated-f64 cumsum stage on this
-    # toolchain. Compile time is the scarcer resource here.
-    def seg_sum(x):
-        return jax.ops.segment_sum(x, gid, num_segments=capacity)
+    # -- per-input reductions (shared dispatch; segment scatters are
+    # single-op HLO: cheap to compile, ~free at runtime) ------------------
+    def seg(x, op="sum"):
+        f = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+             "max": jax.ops.segment_max}[op]
+        return f(x, gid, num_segments=capacity)
 
-    results = []
-    for v, val, op in inputs:
-        v_s = v[perm]
-        contrib = val[perm] & live_sorted
-        cnt = seg_sum(contrib.astype(jnp.int64))
-        if op == "count":
-            res = cnt
-        elif op == "sum":
-            masked = jnp.where(contrib, v_s, jnp.zeros((), v_s.dtype))
-            res = seg_sum(masked)
-        elif op in ("min", "max"):
-            floating = jnp.issubdtype(v_s.dtype, jnp.floating)
-            vv = _minmax_strip_nan(v_s, op) if floating else v_s
-            neutral = _max_value(vv.dtype) if op == "min" \
-                else _min_value(vv.dtype)
-            masked = jnp.where(contrib, vv, neutral)
-            seg = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-            res = seg(masked, gid, num_segments=capacity)
-            if floating:
-                nan_cnt = seg_sum((jnp.isnan(v_s) & contrib).astype(jnp.int64))
-                res = _minmax_reinstate_nan(res, nan_cnt, cnt, op)
-        elif op in ("first", "last"):
-            if op == "first":
-                pos = jax.ops.segment_min(
-                    jnp.where(contrib, iota, capacity), gid,
-                    num_segments=capacity)
-            else:
-                pos = jax.ops.segment_max(
-                    jnp.where(contrib, iota, -1), gid,
-                    num_segments=capacity)
-            res = v_s[jnp.clip(pos, 0, capacity - 1)]
-        else:
-            raise ValueError(op)
-        # Dead-group lanes must hold deterministic zeros.
-        res = jnp.where(group_live, res, jnp.zeros((), res.dtype))
-        cnt = jnp.where(group_live, cnt, 0)
-        results.append((res, cnt))
+    def post(x):
+        return jnp.where(group_live, x, jnp.zeros((), x.dtype))
+
+    results = _segment_reduce_inputs(
+        inputs, seg, iota, capacity, live_sorted,
+        pre=lambda x: x[perm], post=post)
     return key_cols, results, n_groups, group_live
 
 
@@ -468,34 +453,11 @@ def _dict_grouped_aggregate(keys: Sequence[DeviceColumn],
         dense = jnp.pad(full, (0, pad))[slot_of_group]
         return dense
 
-    results = []
-    for v, val, op in inputs:
-        contrib = val & live
-        cnt = seg(contrib.astype(jnp.int64))
-        if op == "count":
-            res = cnt
-        elif op == "sum":
-            res = seg(jnp.where(contrib, v, jnp.zeros((), v.dtype)))
-        elif op in ("min", "max"):
-            floating = jnp.issubdtype(v.dtype, jnp.floating)
-            vv = _minmax_strip_nan(v, op) if floating else v
-            neutral = _max_value(vv.dtype) if op == "min" \
-                else _min_value(vv.dtype)
-            res = seg(jnp.where(contrib, vv, neutral), op)
-            if floating:
-                nan_cnt = seg((jnp.isnan(v) & contrib).astype(jnp.int64))
-                res = _minmax_reinstate_nan(res, nan_cnt, cnt, op)
-        elif op in ("first", "last"):
-            if op == "first":
-                pos = seg(jnp.where(contrib, iota, capacity), "min")
-            else:
-                pos = seg(jnp.where(contrib, iota, -1), "max")
-            res = v[jnp.clip(pos, 0, capacity - 1)]
-        else:
-            raise ValueError(op)
-        res = jnp.where(group_live, res, jnp.zeros((), res.dtype))
-        cnt = jnp.where(group_live, cnt, 0)
-        results.append((res, cnt))
+    def post(x):
+        return jnp.where(group_live, x, jnp.zeros((), x.dtype))
+
+    results = _segment_reduce_inputs(
+        inputs, seg, iota, capacity, live, post=post)
     return key_cols, results, n_groups, group_live
 
 

@@ -7,27 +7,41 @@ Apache Spark for a matrix of expression/cast/aggregate shapes — the
 pattern of the reference's SparkQueryCompareTestSuite.scala:54, which
 always compares against stock Spark.
 
-Skipped (module-level) when pyspark is not installed — this image ships
-without it; the suite lights up wherever `pip install pyspark` is
-possible. Documented divergences (tested as such):
+Two execution modes (VERDICT round-4 item 7):
+
+* **live** — pyspark installed (``pip install -e .[dev]``): every case
+  runs against a real local SparkSession.
+* **replay** — ``tests/data/spark_oracle_recorded.json`` present
+  (written once by ``python tools/record_spark_oracle.py`` on a machine
+  with pyspark): the oracle's results compare against the recorded
+  real-Spark rows, no JVM needed.
+
+Only when NEITHER is available does the tier skip, printing the exact
+command to light it up. Documented divergences (tested as such):
 - float aggregation order (compared with tolerance),
 - Rand() sequences (distribution-compatible only; excluded).
 """
 
+import json
 import math
+import os
 
 import pytest
 
-pyspark = pytest.importorskip("pyspark")
+import numpy as np
+import pyarrow as pa
 
-import numpy as np  # noqa: E402
-import pyarrow as pa  # noqa: E402
+from spark_rapids_tpu.session import TpuSession
 
-from spark_rapids_tpu.session import TpuSession  # noqa: E402
+#: recorded real-Spark results (tools/record_spark_oracle.py writes it on
+#: any machine with the dev extra installed: pip install -e .[dev])
+RECORDED = os.path.join(os.path.dirname(__file__), "data",
+                        "spark_oracle_recorded.json")
 
 
 @pytest.fixture(scope="module")
 def spark():
+    pytest.importorskip("pyspark")
     from pyspark.sql import SparkSession
     s = (SparkSession.builder.master("local[1]")
          .appName("spark-oracle-crosscheck")
@@ -184,7 +198,7 @@ def _mk_cases():
            _sel(o["T"] and __import__(
                "spark_rapids_tpu.ops.strings",
                fromlist=["ConcatStrings"]).ConcatStrings(
-                   [col("s"), lit("_x")])))
+                   col("s"), lit("_x"))))
     yield ("if", "SELECT IF(i > 0, i, -i) FROM t",
            _sel(o["If"](P.GreaterThan(col("i"), lit(0)), col("i"),
                         sub(lit(0), col("i")))))
@@ -196,7 +210,7 @@ def _mk_cases():
                 (P.GreaterThan(col("i"), lit(0)), lit("mid"))],
                lit("lo"))))
     yield ("coalesce", "SELECT coalesce(j, i) FROM t",
-           _sel(o["Coalesce"]([col("j"), col("i")])))
+           _sel(o["Coalesce"](col("j"), col("i"))))
     yield ("cast_l2s", "SELECT CAST(i AS STRING) FROM t",
            _sel(o["Cast"](col("i"), T.STRING)))
     yield ("cast_l2d", "SELECT CAST(i AS DOUBLE) FROM t",
@@ -244,11 +258,84 @@ def _all_cases():
     yield from _agg_cases()
 
 
+# ---------------------------------------------------------------------------
+# recorded-oracle serialization (shared with tools/record_spark_oracle.py)
+# ---------------------------------------------------------------------------
+
+
+def case_matrix_hash():
+    """Hash of every case's SQL plus the test table bytes: a recorded
+    artifact from a different matrix must fail loudly, not replay
+    stale rows."""
+    import hashlib
+    h = hashlib.sha256()
+    for name, sql, _ in _all_cases():
+        h.update(name.encode())
+        h.update(sql.encode())
+    for c in _table().columns:
+        h.update(str(c).encode())
+    return h.hexdigest()
+
+
+def encode_rows(rows):
+    """JSON-safe encoding of result rows (dates/NaN tagged)."""
+    import datetime
+
+    def enc(v):
+        if isinstance(v, float) and math.isnan(v):
+            return {"__nan__": True}
+        if isinstance(v, datetime.date):
+            return {"__date__": v.isoformat()}
+        return v
+    return [[enc(v) for v in r] for r in rows]
+
+
+def decode_rows(rows):
+    import datetime
+
+    def dec(v):
+        if isinstance(v, dict):
+            if v.get("__nan__"):
+                return float("nan")
+            if "__date__" in v:
+                return datetime.date.fromisoformat(v["__date__"])
+        return v
+    return [tuple(dec(v) for v in r) for r in rows]
+
+
 @pytest.mark.parametrize("name,sql,q",
                          [pytest.param(n, s, q, id=n)
                           for n, s, q in _all_cases()])
-def test_oracle_matches_spark(spark, oracle, name, sql, q):
+def test_oracle_matches_spark(oracle, name, sql, q, request):
+    """Live when pyspark is importable; replay from the recorded
+    artifact otherwise; skip (with the exact lighting-up command) only
+    when neither is available."""
     table = _table()
-    want = _run_spark_sql(spark, table, sql)
     got = _run_oracle_sql(oracle, table, q)
+    try:
+        import pyspark  # noqa: F401
+        have_spark = True
+    except ImportError:
+        have_spark = False
+    if have_spark:
+        spark = request.getfixturevalue("spark")
+        want = _run_spark_sql(spark, table, sql)
+    elif os.path.exists(RECORDED):
+        with open(RECORDED) as f:
+            recorded = json.load(f)
+        if recorded.get("matrix_hash") != case_matrix_hash():
+            pytest.fail(
+                "recorded Spark-oracle artifact is STALE (case matrix or "
+                "test table changed since it was recorded); re-run "
+                "tools/record_spark_oracle.py on a machine with pyspark")
+        if name not in recorded["cases"]:
+            pytest.skip(f"case {name!r} missing from recorded artifact; "
+                        "re-run tools/record_spark_oracle.py")
+        want = decode_rows(recorded["cases"][name])
+    else:
+        pytest.skip(
+            "real-Spark oracle needs pyspark (pip install -e .[dev]) or "
+            "the recorded artifact (python tools/record_spark_oracle.py "
+            "on a machine with pyspark, then commit "
+            "tests/data/spark_oracle_recorded.json)")
     _match(got, want)

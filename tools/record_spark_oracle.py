@@ -1,0 +1,59 @@
+#!/usr/bin/env python
+"""Record real-Spark results for the oracle cross-check tier.
+
+Run ONCE on any machine with the dev extra installed
+(``pip install -e .[dev]`` pulls pyspark), then commit the artifact:
+
+    python tools/record_spark_oracle.py
+    git add tests/data/spark_oracle_recorded.json
+
+After that, ``tests/test_spark_oracle.py`` executes in REPLAY mode on
+machines without a JVM: the pyarrow host oracle's results are compared
+against these recorded real-Spark rows — the reference's "stock Spark
+is the oracle" stance (SparkQueryCompareTestSuite.scala:54) without
+requiring Spark at test time.
+
+The artifact records the Spark version and the case matrix hash so a
+drifted matrix fails loudly instead of replaying stale rows.
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    import pyspark
+    from pyspark.sql import SparkSession
+
+    import test_spark_oracle as M
+
+    spark = (SparkSession.builder.master("local[1]")
+             .appName("spark-oracle-record")
+             .config("spark.sql.session.timeZone", "UTC")
+             .config("spark.ui.enabled", "false")
+             .getOrCreate())
+    table = M._table()
+    cases = {}
+    for name, sql, _ in M._all_cases():
+        rows = M._run_spark_sql(spark, table, sql)
+        cases[name] = M.encode_rows(rows)
+        print(f"recorded {name}: {len(rows)} rows")
+    spark.stop()
+    out = {"spark_version": pyspark.__version__,
+           "n_cases": len(cases), "cases": cases,
+           "matrix_hash": M.case_matrix_hash()}
+    path = os.path.join(os.path.dirname(__file__), "..", "tests", "data",
+                        "spark_oracle_recorded.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f)
+    print(f"wrote {path} ({len(cases)} cases, "
+          f"spark {pyspark.__version__})")
+
+
+if __name__ == "__main__":
+    main()
