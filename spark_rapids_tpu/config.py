@@ -288,6 +288,14 @@ TOPK_THRESHOLD = conf_int(
     "streaming top-k exec (lax.top_k, O(n log k)) instead of a global "
     "sort. 0 disables limit-into-sort.")
 
+TPU_PALLAS_ENABLED = conf_bool(
+    "spark.rapids.tpu.pallas.enabled", False,
+    "Run the string row-hash (Spark murmur3 over UTF-8 bytes) as a "
+    "hand-written Pallas TPU kernel that walks the whole mix chain in "
+    "VMEM, instead of the default jnp emulation XLA schedules per step. "
+    "On non-TPU backends the kernel runs in Pallas interpreter mode "
+    "(slow; intended for tests).")
+
 TPU_UPLOAD_CACHE_BYTES = conf_int(
     "spark.rapids.tpu.uploadCache.maxBytes", 1 << 30,
     "Byte budget for the host->device upload memo: conversions are keyed "

@@ -304,6 +304,11 @@ def coerce_binary(left: Expression, right: Expression):
     lt, rt = left.data_type, right.data_type
     if lt.name == rt.name:
         return left, right
+    if lt is T.NULL or rt is T.NULL:
+        # Null literals adopt the other side's type at eval; compiled-UDF
+        # loop state (udf/loops.py) types itself lazily after binding —
+        # either way there is nothing sound to cast yet.
+        return left, right
     common = T.numeric_promote(lt, rt)
     if lt.name != common.name:
         left = Cast(left, common)

@@ -212,6 +212,18 @@ _expr(CPX.ArrayContains)
 _expr(CPX.CreateNamedStruct)
 _expr(CPX.GetStructField)
 
+# Compiled-UDF loop IR (udf-compiler CFG output; lax.while_loop on device).
+# PythonUDF — the uncompilable fallback — deliberately has NO rule, so
+# plans containing it keep their operator on the CPU with a reason.
+from ..udf.loops import (LoopExpr as _LoopExpr,  # noqa: E402
+                         LoopVar as _LoopVar, NullPropIf as _NullPropIf,
+                         TypedIf as _TypedIf)
+
+_expr(_LoopExpr)
+_expr(_LoopVar)
+_expr(_TypedIf)
+_expr(_NullPropIf)
+
 
 # ---------------------------------------------------------------------------
 # Meta tree (RapidsMeta analog)

@@ -56,9 +56,11 @@ class TpuSession:
         self.conf = TpuConf(conf)
         self.device_manager = DeviceManager.get_or_create(self.conf)
         self._overrides = TpuOverrides(self.conf)
-        from .config import TPU_UPLOAD_CACHE_BYTES
+        from .config import TPU_PALLAS_ENABLED, TPU_UPLOAD_CACHE_BYTES
         from .data import upload_cache
+        from .ops.kernels import pallas_kernels
         upload_cache.set_budget(self.conf.get(TPU_UPLOAD_CACHE_BYTES))
+        pallas_kernels.configure(self.conf.get(TPU_PALLAS_ENABLED))
 
     # -- conf ---------------------------------------------------------------
     def with_conf(self, **kv) -> "TpuSession":

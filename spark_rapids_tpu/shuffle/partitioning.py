@@ -179,9 +179,15 @@ def _hash_device_column(c: DeviceColumn, h: jnp.ndarray) -> jnp.ndarray:
             hh = jnp.where(live, nh, hh)
         return jnp.where(c.validity, hh, h)
     if c.is_string:
+        from ..ops.kernels import pallas_kernels as PK
         from ..ops.strings_util import lengths as str_lengths
         m = char_matrix(c)
-        nh = murmur3_bytes_rows(jnp, m, str_lengths(c), h)
+        if PK.enabled():
+            # Hand-written Pallas kernel: the whole W-step mix chain runs
+            # in VMEM (spark.rapids.tpu.pallas.enabled).
+            nh = PK.murmur3_bytes_rows(m, str_lengths(c), h)
+        else:
+            nh = murmur3_bytes_rows(jnp, m, str_lengths(c), h)
         return jnp.where(c.validity, nh, h)
     return hash_column(jnp, c.data, c.validity, c.dtype, h)
 

@@ -266,7 +266,15 @@ def type_by_name(name: str) -> DataType:
 
 
 def numeric_promote(a: DataType, b: DataType) -> DataType:
-    """Spark's binary-arithmetic result type for two numeric inputs."""
+    """Spark's binary-arithmetic result type for two numeric inputs.
+
+    NULL is the bottom of the lattice: a null literal (or compiled-UDF
+    loop state that hasn't typed itself yet, udf/loops.py) adopts the
+    other side's type, matching Spark's analyzer."""
+    if a is NULL:
+        return b
+    if b is NULL:
+        return a
     if not (a.is_numeric and b.is_numeric):
         raise TypeError(f"cannot promote {a} and {b}")
     return _NUMERIC_ORDER[max(_NUMERIC_ORDER.index(a), _NUMERIC_ORDER.index(b))]

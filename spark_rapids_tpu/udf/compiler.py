@@ -17,7 +17,22 @@ shaped for CPython 3.12 bytecode):
 * Conditional jumps FORK the interpretation: both arms run to their
   RETURN, and the fork joins as ``If(cond, then_expr, else_expr)`` — this
   covers ternaries, early returns, and chained and/or in one rule.
-  Backward jumps (loops) are rejected.
+* LOOPS compile for real (the reference's CFG handles full control flow;
+  XLA's ``lax.while_loop`` makes this *easier* here than in Catalyst,
+  which has no loop node). Any index that is the target of a backward
+  jump is a loop header; the loop region is symbolically executed ONCE
+  into a decision tree whose leaves are terminals — *continue* (a
+  backward jump to the header), *exit* (a jump past the region), or
+  *return* — and the tree folds into per-iteration update expressions
+  over :class:`~.loops.LoopVar` state, vectorized by
+  :class:`~.loops.LoopExpr` as a masked ``lax.while_loop``. ``return``
+  inside a loop body becomes carried ``$ret``/``$retval`` state;
+  ``for x in range(...)`` desugars to a carried counter whose pre-test
+  folds into the first iteration's decision tree; ``break``/``continue``
+  in ``while`` loops are just exit/continue terminals. CPython 3.12's
+  loop rotation (the duplicated guard before the body) needs no special
+  casing: the guard is an ordinary fork whose body arm reaches the
+  header.
 * Anything unsupported raises :class:`CompileError`; the ``udf()`` wrapper
   then falls back to running the original Python function row-wise on the
   CPU path, exactly like the reference's catch-and-keep-original
@@ -27,14 +42,21 @@ Semantics caveats (same class of caveats the reference documents): ``and``/
 ``or`` compile structurally (``If(a, b, a)``), which matches Python on
 non-null booleans; ``%`` maps to Pmod (Python's divisor-sign modulo);
 ``/`` maps to Divide (always double, like Python 3). ``//`` is rejected
-(Python floors, SQL truncates).
+(Python floors, SQL truncates). NULL inputs follow SQL branching (a null
+condition takes the else/exit arm) where Python would raise TypeError.
+Loops that exceed :data:`~.loops.DEFAULT_MAX_ITERS` iterations for a row
+yield NULL for that row. Loop-carried locals must stay numeric/boolean
+(per-row string state has no fixed-lane device layout); a local read
+before any possible store yields NULL where Python raises
+UnboundLocalError. ``break`` inside ``for`` is not yet compiled (the
+iterator cleanup path is not modeled) — such UDFs fall back to Python.
 """
 
 from __future__ import annotations
 
 import dis
 import math
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .. import types as T
 from ..ops import math as M
@@ -45,6 +67,7 @@ from ..ops.arithmetic import (Abs, Add, Divide, Multiply, Pmod, Subtract,
 from ..ops.math import Pow
 from ..ops.conditional import If
 from ..ops.expression import Expression, Literal, lit
+from .loops import LoopExpr, LoopTypeError, LoopVar, NullPropIf, TypedIf
 
 
 class CompileError(Exception):
@@ -92,6 +115,9 @@ class _Obj:
     def __init__(self, obj):
         self.obj = obj
 
+    def __repr__(self):
+        return f"_Obj({self.obj!r})"
+
 
 class _Method:
     """A pending method load: CALL will see [..., _Method, self_expr]."""
@@ -100,15 +126,87 @@ class _Method:
         self.name = name
 
 
+class _Range:
+    """A symbolic ``range(start, stop, step)`` awaiting FOR_ITER."""
+
+    def __init__(self, start, stop, step: int):
+        self.start = start
+        self.stop = stop
+        self.step = step
+
+
 def _as_expr(v) -> Expression:
     if isinstance(v, Expression):
         return v
-    if isinstance(v, (_Obj, _Method, _Null)):
+    if isinstance(v, (_Obj, _Method, _Null, _Range)):
         raise CompileError(f"cannot use {v!r} as a value")
     return lit(v)
 
 
-_MAX_FORKS = 64
+def _join_typed(cond: Expression, a: Expression, b: Expression) -> Expression:
+    """``If(cond, a, b)`` tolerant of arms that disagree on numeric type
+    (bytecode branches routinely mix int and float returns). TypedIf
+    promotes lazily — at UDF-compile time column references are unbound,
+    so arm types are not yet knowable."""
+    return TypedIf(cond, a, b)
+
+
+class _Terminal:
+    """A leaf of a loop region's decision tree."""
+
+    __slots__ = ("kind", "env", "value", "target")
+
+    def __init__(self, kind: str, env: Optional[Dict] = None,
+                 value: Optional[Expression] = None,
+                 target: Optional[int] = None):
+        self.kind = kind      # "continue" | "exit" | "return"
+        self.env = env
+        self.value = value
+        self.target = target
+
+
+class _Branch:
+    __slots__ = ("cond", "true", "false", "nullprop")
+
+    def __init__(self, cond: Expression, true, false, nullprop: bool = False):
+        self.cond = cond
+        self.true = true
+        self.false = false
+        #: join with NullPropIf: a NULL cond (capped loop row) must yield
+        #: NULL, not the false arm
+        self.nullprop = nullprop
+
+
+def _terminals(tree) -> List[_Terminal]:
+    if isinstance(tree, _Terminal):
+        return [tree]
+    return _terminals(tree.true) + _terminals(tree.false)
+
+
+def _fold(tree, f) -> Expression:
+    if isinstance(tree, _Terminal):
+        return f(tree)
+    join = NullPropIf if tree.nullprop else TypedIf
+    return join(tree.cond, _fold(tree.true, f), _fold(tree.false, f))
+
+
+class _Region:
+    """The loop currently being symbolically executed."""
+
+    __slots__ = ("header", "last", "rng", "ivar")
+
+    def __init__(self, header: int, last: int, rng: Optional[_Range],
+                 ivar: str):
+        self.header = header
+        self.last = last
+        self.rng = rng
+        self.ivar = ivar
+
+
+_MAX_FORKS = 128
+_IVAR = "$range_i"
+_RET = "$ret"
+_RETVAL = "$retval"
 
 
 class _Interp:
@@ -130,160 +228,202 @@ class _Interp:
         if fn.__closure__:
             for name, cell in zip(code.co_freevars, fn.__closure__):
                 self.cells[name] = cell.cell_contents
+        # Loop headers: target index -> LAST backward-jump index into it
+        # (a for-body with branches jumps back once per arm).
+        self.back_edges: Dict[int, int] = {}
+        for i, ins in enumerate(self.instrs):
+            if ins.opname in ("JUMP_BACKWARD", "JUMP_BACKWARD_NO_INTERRUPT",
+                              "POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                t = self.by_offset.get(ins.argval)
+                if t is not None and t <= i:
+                    self.back_edges[t] = max(self.back_edges.get(t, i), i)
+        # A `continue` in a rotated while targets the un-rotated top test,
+        # giving ONE loop two back-edge targets whose regions overlap
+        # without nesting. Merge those into one canonical region; a truly
+        # nested loop's region is CONTAINED and stays its own header.
+        self.canonical: Dict[int, int] = dict(self.back_edges)
+        self.interior: Dict[int, int] = {}    # secondary -> canonical
+        changed = True
+        while changed:
+            changed = False
+            hs = sorted(self.canonical)
+            for a in hs:
+                for c in hs:
+                    if a < c and c <= self.canonical[a] < self.canonical[c]:
+                        self.canonical[a] = self.canonical[c]
+                        del self.canonical[c]
+                        self.interior[c] = a
+                        changed = True
+                        break
+                if changed:
+                    break
+        # Resolve interior chains to their ultimate canonical header.
+        for c, a in list(self.interior.items()):
+            while a in self.interior:
+                a = self.interior[a]
+            self.interior[c] = a
 
     def compile(self) -> Expression:
         env = {self.names[i]: e for i, e in enumerate(self.arg_exprs)}
         return self.run(0, [], env)
 
-    # -- the symbolic machine ----------------------------------------------
+    # -- shared straight-line interpreter ----------------------------------
+    def _exec_simple(self, ins, stack: List, env: Dict[str, Any]) -> bool:
+        """Execute one non-control-flow instruction; True if handled (the
+        caller advances by one)."""
+        op = ins.opname
+        if op in ("RESUME", "NOP", "CACHE", "PRECALL",
+                  "MAKE_CELL", "COPY_FREE_VARS"):
+            return True
+        if op == "PUSH_NULL":
+            stack.append(_Null())
+            return True
+        if op in ("LOAD_FAST", "LOAD_FAST_CHECK"):
+            name = ins.argval
+            if name not in env:
+                raise CompileError(f"use of unbound local {name!r}")
+            stack.append(env[name])
+            return True
+        if op == "STORE_FAST":
+            env[ins.argval] = stack.pop()
+            return True
+        if op == "LOAD_CONST":
+            stack.append(ins.argval)
+            return True
+        if op == "LOAD_DEREF":
+            if ins.argval not in self.cells:
+                raise CompileError(f"free variable {ins.argval!r}")
+            stack.append(self.cells[ins.argval])
+            return True
+        if op == "LOAD_GLOBAL":
+            name = ins.argval
+            if ins.arg & 1:
+                stack.append(_Null())
+            obj = self.fn.__globals__.get(name, _MISSING)
+            if obj is _MISSING:
+                import builtins
+                obj = getattr(builtins, name, _MISSING)
+            if obj is _MISSING:
+                raise CompileError(f"unresolvable global {name!r}")
+            stack.append(_Obj(obj))
+            return True
+        if op == "LOAD_ATTR":
+            name = ins.argval
+            tos = stack.pop()
+            if isinstance(tos, _Obj):
+                try:
+                    stack.append(_Obj(getattr(tos.obj, name)))
+                except AttributeError:
+                    raise CompileError(
+                        f"no attribute {name!r} on {tos.obj!r}")
+            elif ins.arg & 1:
+                # Method load on a column value: [..., method, self].
+                stack.append(_Method(name))
+                stack.append(tos)
+            else:
+                raise CompileError(f"attribute {name!r} on a column")
+            return True
+        if op == "BINARY_OP":
+            r = _as_expr(stack.pop())
+            l = _as_expr(stack.pop())
+            sym = ins.argrepr.rstrip("=")
+            if ins.argrepr.endswith("="):  # augmented x += ...
+                sym = ins.argrepr[:-1]
+            cls = _BINARY.get(sym)
+            if cls is None:
+                raise CompileError(f"operator {ins.argrepr!r}")
+            stack.append(cls(l, r))
+            return True
+        if op == "COMPARE_OP":
+            sym = ins.argrepr.replace("bool(", "").replace(")", "")
+            cls = _COMPARE.get(sym)
+            if cls is None:
+                raise CompileError(f"comparison {ins.argrepr!r}")
+            r = _as_expr(stack.pop())
+            l = _as_expr(stack.pop())
+            stack.append(cls(l, r))
+            return True
+        if op == "CONTAINS_OP":
+            container = stack.pop()
+            needle = stack.pop()
+            if isinstance(container, Expression) and isinstance(needle, str):
+                e = S.Contains(container, needle)
+                stack.append(P.Not(e) if ins.arg else e)
+            else:
+                raise CompileError("'in' only supports str in column")
+            return True
+        if op == "UNARY_NEGATIVE":
+            stack.append(UnaryMinus(_as_expr(stack.pop())))
+            return True
+        if op == "UNARY_NOT":
+            stack.append(P.Not(_as_expr(stack.pop())))
+            return True
+        if op == "UNARY_INVERT":
+            from ..ops.bitwise import BitwiseNot
+            stack.append(BitwiseNot(_as_expr(stack.pop())))
+            return True
+        if op == "COPY":
+            stack.append(stack[-ins.arg])
+            return True
+        if op == "SWAP":
+            stack[-ins.arg], stack[-1] = stack[-1], stack[-ins.arg]
+            return True
+        if op == "POP_TOP":
+            stack.pop()
+            return True
+        if op == "GET_ITER":
+            if not isinstance(stack[-1], _Range):
+                raise CompileError("only range() iteration is compilable")
+            return True
+        if op == "CALL":
+            # Stack below the args differs by call form: a global call
+            # sits on [NULL, callable]; a method call on
+            # [method, self] (3.12 LOAD_ATTR method-bit layout).
+            argc = ins.arg
+            args = [stack.pop() for _ in range(argc)][::-1]
+            p1 = stack.pop()
+            p2 = stack.pop()
+            if isinstance(p2, _Null) and isinstance(p1, _Obj):
+                stack.append(self._call_fn(p1.obj, args))
+            elif isinstance(p2, _Method):
+                stack.append(self._call_method(p2.name, _as_expr(p1), args))
+            else:
+                raise CompileError(f"call form ({p2!r}, {p1!r})")
+            return True
+        return False
+
+    # -- the symbolic machine (straight-line + forks) -----------------------
     def run(self, idx: int, stack: List, env: Dict[str, Any]) -> Expression:
         instrs = self.instrs
         while True:
             if idx >= len(instrs):
                 raise CompileError("fell off the end of the bytecode")
+            if idx in self.canonical:
+                return self._loop_toplevel(idx, stack, env)
             ins = instrs[idx]
             op = ins.opname
-            if op in ("RESUME", "NOP", "CACHE", "PRECALL",
-                      "PUSH_NULL", "MAKE_CELL", "COPY_FREE_VARS"):
-                if op == "PUSH_NULL":
-                    stack.append(_Null())
+            if self._exec_simple(ins, stack, env):
                 idx += 1
                 continue
-            if op == "LOAD_FAST":
-                name = ins.argval
-                if name not in env:
-                    raise CompileError(f"use of unbound local {name!r}")
-                stack.append(env[name])
-                idx += 1
-            elif op == "STORE_FAST":
-                env[ins.argval] = stack.pop()
-                idx += 1
-            elif op == "LOAD_CONST":
-                stack.append(ins.argval)
-                idx += 1
-            elif op == "LOAD_DEREF":
-                if ins.argval not in self.cells:
-                    raise CompileError(f"free variable {ins.argval!r}")
-                stack.append(self.cells[ins.argval])
-                idx += 1
-            elif op == "LOAD_GLOBAL":
-                name = ins.argval
-                if ins.arg & 1:
-                    stack.append(_Null())
-                obj = self.fn.__globals__.get(name, _MISSING)
-                if obj is _MISSING:
-                    import builtins
-                    obj = getattr(builtins, name, _MISSING)
-                if obj is _MISSING:
-                    raise CompileError(f"unresolvable global {name!r}")
-                stack.append(_Obj(obj))
-                idx += 1
-            elif op == "LOAD_ATTR":
-                name = ins.argval
-                tos = stack.pop()
-                if isinstance(tos, _Obj):
-                    try:
-                        stack.append(_Obj(getattr(tos.obj, name)))
-                    except AttributeError:
-                        raise CompileError(
-                            f"no attribute {name!r} on {tos.obj!r}")
-                elif ins.arg & 1:
-                    # Method load on a column value: [..., method, self].
-                    stack.append(_Method(name))
-                    stack.append(tos)
-                else:
-                    raise CompileError(f"attribute {name!r} on a column")
-                idx += 1
-            elif op == "BINARY_OP":
-                r = _as_expr(stack.pop())
-                l = _as_expr(stack.pop())
-                sym = ins.argrepr.rstrip("=")
-                if ins.argrepr.endswith("="):  # augmented x += ...
-                    sym = ins.argrepr[:-1]
-                cls = _BINARY.get(sym)
-                if cls is None:
-                    raise CompileError(f"operator {ins.argrepr!r}")
-                stack.append(cls(l, r))
-                idx += 1
-            elif op == "COMPARE_OP":
-                sym = ins.argrepr.replace("bool(", "").replace(")", "")
-                cls = _COMPARE.get(sym)
-                if cls is None:
-                    raise CompileError(f"comparison {ins.argrepr!r}")
-                r = _as_expr(stack.pop())
-                l = _as_expr(stack.pop())
-                stack.append(cls(l, r))
-                idx += 1
-            elif op == "CONTAINS_OP":
-                container = stack.pop()
-                needle = stack.pop()
-                if isinstance(container, Expression) \
-                        and isinstance(needle, str):
-                    e = S.Contains(container, needle)
-                    stack.append(P.Not(e) if ins.arg else e)
-                else:
-                    raise CompileError("'in' only supports str in column")
-                idx += 1
-            elif op == "UNARY_NEGATIVE":
-                stack.append(UnaryMinus(_as_expr(stack.pop())))
-                idx += 1
-            elif op == "UNARY_NOT":
-                stack.append(P.Not(_as_expr(stack.pop())))
-                idx += 1
-            elif op == "UNARY_INVERT":
-                from ..ops.bitwise import BitwiseNot
-                stack.append(BitwiseNot(_as_expr(stack.pop())))
-                idx += 1
-            elif op == "COPY":
-                stack.append(stack[-ins.arg])
-                idx += 1
-            elif op == "SWAP":
-                stack[-ins.arg], stack[-1] = stack[-1], stack[-ins.arg]
-                idx += 1
-            elif op == "POP_TOP":
-                stack.pop()
-                idx += 1
-            elif op == "CALL":
-                # Stack below the args differs by call form: a global call
-                # sits on [NULL, callable]; a method call on
-                # [method, self] (3.12 LOAD_ATTR method-bit layout).
-                argc = ins.arg
-                args = [stack.pop() for _ in range(argc)][::-1]
-                p1 = stack.pop()
-                p2 = stack.pop()
-                if isinstance(p2, _Null) and isinstance(p1, _Obj):
-                    stack.append(self._call_fn(p1.obj, args))
-                elif isinstance(p2, _Method):
-                    stack.append(self._call_method(p2.name, _as_expr(p1),
-                                                   args))
-                else:
-                    raise CompileError(f"call form ({p2!r}, {p1!r})")
-                idx += 1
-            elif op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+            if op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
                 cond = _as_expr(stack.pop())
-                if op == "POP_JUMP_IF_TRUE":
-                    cond_taken, cond_fall = cond, P.Not(cond)
-                else:
-                    cond_taken, cond_fall = P.Not(cond), cond
                 self.forks += 1
                 if self.forks > _MAX_FORKS:
                     raise CompileError("too many branches")
                 target = self.by_offset.get(ins.argval)
                 if target is None or target <= idx:
-                    raise CompileError("backward jump (loop)")
+                    raise CompileError("backward jump outside a loop")
                 fall = self.run(idx + 1, list(stack), dict(env))
                 jump = self.run(target, list(stack), dict(env))
                 # cond true -> fallthrough for IF_FALSE, jump for IF_TRUE.
                 if op == "POP_JUMP_IF_FALSE":
-                    return If(cond, fall, jump)
-                return If(cond, jump, fall)
+                    return _join_typed(cond, fall, jump)
+                return _join_typed(cond, jump, fall)
             elif op == "JUMP_FORWARD":
                 t = self.by_offset.get(ins.argval)
                 if t is None or t <= idx:
                     raise CompileError("bad forward jump")
                 idx = t
-            elif op == "JUMP_BACKWARD":
-                raise CompileError("loops are not compilable")
             elif op == "RETURN_VALUE":
                 return _as_expr(stack.pop())
             elif op == "RETURN_CONST":
@@ -291,6 +431,245 @@ class _Interp:
             else:
                 raise CompileError(f"opcode {op}")
 
+    # -- loops --------------------------------------------------------------
+    def _loop_toplevel(self, h: int, stack: List,
+                       env: Dict[str, Any]) -> Expression:
+        exit_idx, env_after, ret_pair = self._compile_loop(h, stack, env)
+        if exit_idx is None:
+            if ret_pair is None:
+                raise CompileError("loop can neither exit nor return")
+            return ret_pair[1]
+        cont = self.run(exit_idx, [], env_after)
+        if ret_pair is not None:
+            # NullPropIf: a capped row's $ret flag is NULL; the result must
+            # be NULL, not the post-loop continuation's value.
+            return NullPropIf(ret_pair[0], ret_pair[1], cont)
+        return cont
+
+    def _compile_loop(self, h: int, stack: List, env: Dict[str, Any]):
+        """Compile the loop whose header is instruction ``h``. Returns
+        ``(exit_idx, env_after, ret_pair)``: where execution resumes (None
+        if the loop only ever returns), the post-loop environment whose
+        carried locals are sibling LoopExprs over the final state, and —
+        when the body contains ``return`` — ``($ret flag, $retval)``
+        sibling expressions."""
+        b = self.canonical[h]
+        rng: Optional[_Range] = None
+        if self.instrs[h].opname == "FOR_ITER":
+            it = stack.pop() if stack else None
+            if not isinstance(it, _Range):
+                raise CompileError("only range() iteration is compilable")
+            rng = it
+        if stack:
+            raise CompileError("loop in expression context")
+
+        carried: List[str] = []
+        for i in range(h, b + 1):
+            if self.instrs[i].opname == "STORE_FAST" \
+                    and self.instrs[i].argval not in carried:
+                carried.append(self.instrs[i].argval)
+        names = ([_IVAR] if rng else []) + carried + [_RET, _RETVAL]
+
+        vars: Dict[str, LoopVar] = {}
+        inits: Dict[str, Expression] = {}
+        for nm in names:
+            if nm == _IVAR:
+                init = _as_expr(rng.start)
+            elif nm == _RET:
+                init = lit(False)
+            elif nm == _RETVAL:
+                init = lit(None)
+            elif nm in env:
+                init = _as_expr(env[nm])
+            else:
+                # First-assigned inside the body; observable only on paths
+                # Python would call UnboundLocalError — NULL here.
+                init = lit(None)
+            inits[nm] = init
+            # Dtypes resolve lazily (LoopExpr.resolve_types) once column
+            # references have bound.
+            vars[nm] = LoopVar(nm, T.NULL)
+
+        env0 = dict(env)
+        for nm in names:
+            if nm not in (_RET, _RETVAL):
+                env0[nm] = vars[nm]
+        region = _Region(h, b, rng, _IVAR)
+        tree = self._run_region(h, [], env0, region)
+
+        terms = _terminals(tree)
+        returns_present = any(t.kind == "return" for t in terms)
+        exit_targets = sorted({t.target for t in terms if t.kind == "exit"})
+        if len(exit_targets) > 1:
+            raise CompileError("loop with multiple exit continuations")
+        if not any(t.kind == "continue" for t in terms):
+            raise CompileError("loop body never reaches the backward jump")
+        if not returns_present:
+            names = [nm for nm in names if nm not in (_RET, _RETVAL)]
+
+        def term_value(t: _Terminal, nm: str) -> Expression:
+            if t.kind == "return":
+                if nm == _RET:
+                    return lit(True)
+                if nm == _RETVAL:
+                    return t.value
+            if nm == _RET:
+                return vars[nm]     # unchanged (rows freeze once returned)
+            if nm == _RETVAL:
+                return vars[nm]
+            return _as_expr(t.env[nm])
+
+        updates = {nm: _fold(tree, lambda t, nm=nm: term_value(t, nm))
+                   for nm in names}
+        continue_expr = _fold(
+            tree, lambda t: lit(t.kind == "continue"))
+
+        group: Dict = {}
+        var_list = [vars[nm] for nm in names]
+        init_list = [inits[nm] for nm in names]
+        upd_list = [updates[nm] for nm in names]
+
+        def sibling(nm: str) -> LoopExpr:
+            return LoopExpr(var_list, init_list, upd_list, continue_expr,
+                            vars[nm], group=group)
+
+        env_after = dict(env)
+        for nm in carried:
+            env_after[nm] = sibling(nm)
+        if rng:
+            env_after.pop(_IVAR, None)
+        ret_pair = (sibling(_RET), sibling(_RETVAL)) \
+            if returns_present else None
+
+        # Best-effort early typing so clearly-untypeable loops (string
+        # state, int/string joins) fall back to Python at compile time;
+        # unbound column references defer resolution to bind time.
+        try:
+            sibling(names[0]).resolve_types()
+        except LoopTypeError as e:
+            raise CompileError(str(e))
+        except RuntimeError:
+            pass
+
+        exit_idx: Optional[int] = None
+        if exit_targets:
+            exit_idx = exit_targets[0]
+            if self.instrs[exit_idx].opname == "END_FOR":
+                # The symbolic stack never held the iterator; skip its pop.
+                exit_idx += 1
+        return exit_idx, env_after, ret_pair
+
+    def _is_interior_continue(self, t: Optional[int],
+                              region: _Region) -> bool:
+        """A jump to a merged secondary header (the un-rotated top test a
+        ``continue`` targets) is equivalent to continuing at the canonical
+        header iff the prefix between them is pure — re-running a
+        store-free test block with the same state takes the same branch."""
+        if t is None or self.interior.get(t) != region.header:
+            return False
+        return all(self.instrs[i].opname != "STORE_FAST"
+                   for i in range(region.header, t))
+
+    def _run_region(self, idx: int, stack: List, env: Dict[str, Any],
+                    region: _Region):
+        """Symbolically execute inside a loop region, returning a decision
+        tree of terminals (see :meth:`_compile_loop`)."""
+        instrs = self.instrs
+        while True:
+            if idx >= len(instrs):
+                raise CompileError("fell off the end of the loop body")
+            if idx != region.header and idx in self.canonical:
+                # A nested loop: compile it, then resume this region.
+                exit_idx, env2, ret_pair = self._compile_loop(idx, stack, env)
+                if exit_idx is None:
+                    if ret_pair is None:
+                        raise CompileError("loop can neither exit nor return")
+                    return _Terminal("return", env=dict(env2),
+                                     value=ret_pair[1])
+                sub = self._run_region(exit_idx, [], env2, region)
+                if ret_pair is not None:
+                    return _Branch(
+                        ret_pair[0],
+                        _Terminal("return", env=dict(env2),
+                                  value=ret_pair[1]),
+                        sub, nullprop=True)
+                return sub
+            ins = instrs[idx]
+            op = ins.opname
+            if idx == region.header and op == "FOR_ITER":
+                rng = region.rng
+                cur = _as_expr(env[region.ivar])
+                stop = _as_expr(rng.stop)
+                cond = P.LessThan(cur, stop) if rng.step > 0 \
+                    else P.GreaterThan(cur, stop)
+                exit_t = self.by_offset.get(ins.argval)
+                if exit_t is None:
+                    raise CompileError("bad FOR_ITER exit target")
+                self.forks += 1
+                if self.forks > _MAX_FORKS:
+                    raise CompileError("too many branches")
+                env_body = dict(env)
+                # The iterator advances as it yields: the body sees the
+                # pre-increment value; continue terminals carry the
+                # incremented counter.
+                env_body[region.ivar] = Add(cur, lit(rng.step))
+                body = self._run_region(idx + 1, list(stack) + [cur],
+                                        env_body, region)
+                return _Branch(cond, body,
+                               _Terminal("exit", env=dict(env),
+                                         target=exit_t))
+            if self._exec_simple(ins, stack, env):
+                idx += 1
+                continue
+            if op in ("POP_JUMP_IF_FALSE", "POP_JUMP_IF_TRUE"):
+                cond = _as_expr(stack.pop())
+                self.forks += 1
+                if self.forks > _MAX_FORKS:
+                    raise CompileError("too many branches")
+                t = self.by_offset.get(ins.argval)
+                if t is None:
+                    raise CompileError("bad jump target")
+
+                def arm(i: int):
+                    if i == region.header:
+                        return _Terminal("continue", env=dict(env))
+                    if i <= idx and self._is_interior_continue(i, region):
+                        return _Terminal("continue", env=dict(env))
+                    if i > region.last:
+                        return _Terminal("exit", env=dict(env), target=i)
+                    if i <= idx:
+                        raise CompileError("irreducible backward jump")
+                    return self._run_region(i, list(stack), dict(env),
+                                            region)
+
+                fall = arm(idx + 1)
+                jump = arm(t)
+                if op == "POP_JUMP_IF_FALSE":
+                    return _Branch(cond, fall, jump)
+                return _Branch(cond, jump, fall)
+            if op in ("JUMP_BACKWARD", "JUMP_BACKWARD_NO_INTERRUPT"):
+                t = self.by_offset.get(ins.argval)
+                if t != region.header \
+                        and not self._is_interior_continue(t, region):
+                    raise CompileError("irreducible backward jump")
+                return _Terminal("continue", env=dict(env))
+            if op == "JUMP_FORWARD":
+                t = self.by_offset.get(ins.argval)
+                if t is None or t <= idx:
+                    raise CompileError("bad forward jump")
+                if t > region.last:
+                    return _Terminal("exit", env=dict(env), target=t)
+                idx = t
+                continue
+            if op == "RETURN_VALUE":
+                return _Terminal("return", env=dict(env),
+                                 value=_as_expr(stack.pop()))
+            if op == "RETURN_CONST":
+                return _Terminal("return", env=dict(env),
+                                 value=_as_expr(ins.argval))
+            raise CompileError(f"opcode {op} in loop body")
+
+    # -- calls --------------------------------------------------------------
     def _call_method(self, name: str, obj: Expression, args) -> Expression:
         if name in _METHODS_0 and not args:
             return _METHODS_0[name](obj)
@@ -300,7 +679,21 @@ class _Interp:
             return cls(obj, args[0])
         raise CompileError(f"method .{name}() is not compilable")
 
-    def _call_fn(self, fn, args) -> Expression:
+    def _call_fn(self, fn, args):
+        if fn is range and 1 <= len(args) <= 3:
+            start: Any = 0
+            step: Any = 1
+            if len(args) == 1:
+                stop = args[0]
+            elif len(args) == 2:
+                start, stop = args
+            else:
+                start, stop, step = args
+            if isinstance(step, Expression) or not isinstance(step, int) \
+                    or step == 0:
+                raise CompileError("range() step must be a nonzero int "
+                                   "constant")
+            return _Range(start, stop, step)
         if fn in _CALLS_1 and len(args) == 1 and _CALLS_1[fn] is not None:
             return _CALLS_1[fn](_as_expr(args[0]))
         if fn in _CALLS_2 and len(args) == 2:
@@ -308,7 +701,7 @@ class _Interp:
         if fn in (min, max) and len(args) == 2:
             l, r = _as_expr(args[0]), _as_expr(args[1])
             c = P.LessThan(l, r) if fn is min else P.GreaterThan(l, r)
-            return If(c, l, r)
+            return _join_typed(c, l, r)
         if fn is float and len(args) == 1:
             from ..ops.cast import Cast
             return Cast(_as_expr(args[0]), T.DOUBLE)
@@ -328,4 +721,10 @@ def compile_udf(fn, arg_exprs: List[Expression]) -> Expression:
         fn.__code__
     except AttributeError:
         raise CompileError("not a plain Python function")
-    return _Interp(fn, list(arg_exprs)).compile()
+    try:
+        return _Interp(fn, list(arg_exprs)).compile()
+    except IndexError:
+        # Unmodeled control flow drained the symbolic stack (e.g. the
+        # iterator-cleanup path of break-inside-for); fall back to Python.
+        raise CompileError("symbolic stack underflow (unmodeled control "
+                           "flow shape)")

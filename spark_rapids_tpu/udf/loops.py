@@ -1,0 +1,406 @@
+"""Loop IR for the UDF compiler — bounded loops as ``lax.while_loop``.
+
+The reference compiles full bytecode control-flow graphs, loops included,
+by abstract interpretation over basic blocks (``udf-compiler/.../CFG.scala``,
+``Instruction.scala:85-549``) into Catalyst expressions. Catalyst has no
+loop node, so the reference must encode loops as recursion over rows; XLA
+*does* have one (``lax.while_loop``), which makes loops strictly easier
+here: the compiler (:mod:`.compiler`) symbolically executes the loop region
+into a per-iteration decision tree, and this module vectorizes that tree as
+a masked ``lax.while_loop`` over per-row scalar state.
+
+Vectorized semantics (one program for the whole column):
+
+* every loop-carried local becomes one state lane ``[capacity]`` (+ a
+  validity lane);
+* each iteration evaluates the body's update/continue expressions for ALL
+  rows and commits them where the row is still ``active``;
+* a row leaves ``active`` when its continue-condition goes false (a null
+  condition exits, matching SQL's null-is-false branching; ``return``
+  inside the body is lowered by the compiler to ordinary carried state);
+* the loop ends when no row is active, or after ``max_iters`` iterations —
+  rows still active at the cap yield NULL rather than a wrong value (the
+  row diverged or exceeded the bound; Python would still be looping).
+
+A loop with several carried locals compiles to SIBLING LoopExprs — one per
+local read after the loop — sharing one ``group`` dict: the first sibling
+evaluated computes the final state, the rest reuse it (memoized per thread
+on batch identity, and only when no enclosing loop frame is live, so the
+host's eager per-iteration re-evaluation of a *nested* loop can never see
+a stale outer iteration's state).
+
+Host evaluation mirrors the same masked iteration with pyarrow compute, so
+the device path has an independent oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, HostBatch
+from ..data.column import DeviceColumn
+from ..ops.expression import Expression, host_to_array, make_column
+
+#: Iteration cap: each iteration is one fused body evaluation over the
+#: whole batch, so 10k iterations of useful work is already generous for
+#: a scalar UDF; rows that hit the cap return NULL (see module doc).
+DEFAULT_MAX_ITERS = 10_000
+
+
+class LoopTypeError(Exception):
+    """Loop state cannot be typed (raised lazily, once references bind)."""
+
+
+def promote_types(a: T.DataType, b: T.DataType) -> T.DataType:
+    """Join two value types the way Python's numeric tower would."""
+    if a is b:
+        return a
+    if a is T.NULL:
+        return b
+    if b is T.NULL:
+        return a
+    def numeric_ish(t):
+        return t.is_numeric or t is T.BOOLEAN
+    if numeric_ish(a) and numeric_ish(b):
+        # Python treats bool as an int; a bool-or-int join widens to the
+        # numeric side.
+        a2 = T.INT if a is T.BOOLEAN else a
+        b2 = T.INT if b is T.BOOLEAN else b
+        return T.numeric_promote(a2, b2)
+    raise LoopTypeError(f"cannot join values of types {a} and {b}")
+
+_BINDINGS = threading.local()
+
+
+def _stack() -> List[Dict[int, object]]:
+    st = getattr(_BINDINGS, "stack", None)
+    if st is None:
+        st = []
+        _BINDINGS.stack = st
+    return st
+
+
+class LoopVar(Expression):
+    """A loop-carried local. Evaluates to whatever column the enclosing
+    :class:`LoopExpr` bound for the current iteration (thread-local, so
+    concurrent partition tasks evaluating the same plan don't race)."""
+
+    children = ()
+
+    def __init__(self, name: str, dtype: T.DataType):
+        self._name = name
+        self._dtype = dtype  # widened in place by the compiler's fixpoint
+
+    @property
+    def data_type(self) -> T.DataType:
+        return self._dtype
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def _lookup(self):
+        for frame in reversed(_stack()):
+            if id(self) in frame:
+                return frame[id(self)]
+        raise RuntimeError(f"loop variable {self._name!r} evaluated outside "
+                           "its LoopExpr")
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        return self._lookup()
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        return self._lookup()
+
+    def __str__(self) -> str:
+        return f"loopvar({self._name})"
+
+
+class TypedIf(Expression):
+    """``If`` whose arms may disagree on numeric type: the result takes the
+    promoted type and each arm is widened at evaluation. The compiler's
+    fork joins use this because bytecode branches routinely mix int and
+    float returns; type promotion must wait until column references have
+    bound (data_type is not known at UDF-compile time)."""
+
+    def __init__(self, predicate: Expression, true_value: Expression,
+                 false_value: Expression):
+        self.children = [predicate, true_value, false_value]
+
+    @property
+    def data_type(self) -> T.DataType:
+        return promote_types(self.children[1].data_type,
+                             self.children[2].data_type)
+
+    def with_children(self, children):
+        return TypedIf(*children)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        n = batch.num_rows
+        at = T.to_arrow_type(self.data_type)
+        p = host_to_array(self.children[0].eval_host(batch), n)
+        t = host_to_array(self.children[1].eval_host(batch), n).cast(at)
+        f = host_to_array(self.children[2].eval_host(batch), n).cast(at)
+        # SQL branching: a null predicate selects the false arm.
+        return pc.if_else(pc.fill_null(p, False), t, f)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        dt = self.data_type
+        if dt is T.STRING:
+            # Same-typed string arms: delegate to the engine's If.
+            from ..ops.conditional import If
+            return If(*self.children).eval_device(batch)
+        p = self.children[0].eval_device(batch)
+        t = self.children[1].eval_device(batch)
+        f = self.children[2].eval_device(batch)
+        take = p.data & p.validity
+        np_dt = dt.np_dtype
+        data = jnp.where(take, t.data.astype(np_dt), f.data.astype(np_dt))
+        validity = jnp.where(take, t.validity, f.validity)
+        return make_column(data, validity, dt)
+
+
+class NullPropIf(TypedIf):
+    """TypedIf whose NULL predicate yields NULL instead of the false arm.
+
+    Used for the ``$ret``-flag join around a loop: a row that hit the
+    iteration cap has a NULL flag, and routing it to the post-loop
+    continuation (SQL's null-takes-else) would return a concrete wrong
+    value where the documented contract is NULL."""
+
+    def with_children(self, children):
+        return NullPropIf(*children)
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        n = batch.num_rows
+        out = super().eval_host(batch)
+        p = host_to_array(self.children[0].eval_host(batch), n)
+        return pc.if_else(pc.is_null(p), pa.nulls(n, out.type), out)
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        out = super().eval_device(batch)
+        p = self.children[0].eval_device(batch)
+        validity = out.validity & p.validity
+        if out.dtype is T.STRING:
+            return dataclasses.replace(out, validity=validity)
+        return make_column(out.data, validity, out.dtype)
+
+
+class LoopExpr(Expression):
+    """``result_expr`` evaluated over the final state of a masked while-loop.
+
+    ``vars[i]`` starts at ``inits[i]``; each iteration rebinds the vars to
+    the current state, evaluates every ``updates[i]`` and ``continue_expr``,
+    and commits the updates to rows whose continue-condition held.
+    ``result_expr`` (usually a single :class:`LoopVar`) sees the final
+    state; rows still active at ``max_iters`` come back NULL."""
+
+    def __init__(self, vars: List[LoopVar], inits: List[Expression],
+                 updates: List[Expression], continue_expr: Expression,
+                 result_expr: Expression,
+                 max_iters: int = None,
+                 group: Dict = None):
+        assert len(vars) == len(inits) == len(updates)
+        self.vars = list(vars)
+        self.inits = list(inits)
+        self.updates = list(updates)
+        self.continue_expr = continue_expr
+        self.result_expr = result_expr
+        # Read the module knob at construction, not def time, so tests and
+        # sessions can adjust DEFAULT_MAX_ITERS.
+        self.max_iters = int(max_iters if max_iters is not None
+                             else DEFAULT_MAX_ITERS)
+        #: shared final-state memo across sibling LoopExprs of one loop
+        self.group = group if group is not None else {}
+        self.children = [*inits, *updates, continue_expr, result_expr]
+
+    @property
+    def data_type(self) -> T.DataType:
+        self.resolve_types()
+        return self.result_expr.data_type
+
+    @property
+    def nullable(self) -> bool:
+        return True
+
+    def with_children(self, children):
+        n = len(self.vars)
+        return LoopExpr(self.vars, children[:n], children[n:2 * n],
+                        children[2 * n], children[2 * n + 1],
+                        self.max_iters, self.group)
+
+    def __str__(self) -> str:
+        names = ",".join(v._name for v in self.vars)
+        return f"Loop[{names}]({self.result_expr})"
+
+    # -- lazy state typing ---------------------------------------------------
+    def resolve_types(self) -> None:
+        """Widen each LoopVar's dtype to fix(init ⊔ update). Runs once per
+        sibling group, deferred to first data_type/eval access so column
+        references have bound by then; idempotent (re-running after a
+        transform reaches the same fixpoint on the shared vars)."""
+        if self.group.get("types_resolved"):
+            return
+        for _ in range(8):
+            changed = False
+            pending = False
+            for v, init, upd in zip(self.vars, self.inits, self.updates):
+                nt = promote_types(v._dtype, init.data_type)
+                try:
+                    nt = promote_types(nt, upd.data_type)
+                except (TypeError, LoopTypeError):
+                    # The update reads vars this fixpoint hasn't typed yet
+                    # (NULL seeds); retry after the seeds widen.
+                    pending = True
+                if nt is not v._dtype:
+                    v._dtype = nt
+                    changed = True
+            if not changed:
+                if pending:
+                    raise LoopTypeError(
+                        "loop variable types do not stabilize")
+                break
+        else:
+            raise LoopTypeError("loop variable types do not stabilize")
+        for v in self.vars:
+            if not v._dtype.is_fixed_width:
+                raise LoopTypeError(
+                    f"loop-carried local {v._name!r} holds strings (no "
+                    "fixed-lane device state layout)")
+        self.group["types_resolved"] = True
+
+    # -- shared final-state memo -------------------------------------------
+    def _memo_get(self, mode: str, batch):
+        # Only trustworthy when no enclosing loop frame is live: an inner
+        # loop re-evaluated per outer host iteration sees the same batch
+        # object with different LoopVar bindings.
+        if _stack():
+            return None
+        ent = self.group.get((mode, threading.get_ident()))
+        if ent is not None and ent[0] is batch:
+            return ent[1]
+        return None
+
+    def _memo_put(self, mode: str, batch, final):
+        if not _stack():
+            self.group[(mode, threading.get_ident())] = (batch, final)
+
+    # -- device -------------------------------------------------------------
+    def _bind_device(self, frame, state):
+        for v, (d, vl) in zip(self.vars, state):
+            frame[id(v)] = DeviceColumn(data=d, validity=vl, dtype=v._dtype)
+
+    def _final_state_device(self, batch: ColumnarBatch, frame):
+        state = []
+        for v, init in zip(self.vars, self.inits):
+            c = init.eval_device(batch)
+            state.append((c.data.astype(v._dtype.np_dtype), c.validity))
+        live = jnp.asarray(batch.row_mask())
+
+        def cond_fn(carry):
+            it, active, _ = carry
+            return (it < self.max_iters) & jnp.any(active)
+
+        def body_fn(carry):
+            it, active, st = carry
+            self._bind_device(frame, st)
+            cont = self.continue_expr.eval_device(batch)
+            new_st = []
+            for (d, vl), upd in zip(st, self.updates):
+                u = upd.eval_device(batch)
+                new_st.append((jnp.where(active, u.data.astype(d.dtype), d),
+                               jnp.where(active, u.validity, vl)))
+            active = active & cont.data & cont.validity
+            return it + 1, active, tuple(new_st)
+
+        # Iteration 1's continue-condition decides entry per row (the
+        # compiler folds a pre-test loop's test into the first body
+        # evaluation's decision tree).
+        _, active, state = jax.lax.while_loop(
+            cond_fn, body_fn, (jnp.int32(0), live, tuple(state)))
+        return active, state
+
+    def eval_device(self, batch: ColumnarBatch) -> DeviceColumn:
+        self.resolve_types()
+        final = self._memo_get("device", batch)
+        frame: Dict[int, object] = {}
+        if final is None:
+            _stack().append(frame)
+            try:
+                final = self._final_state_device(batch, frame)
+            finally:
+                _stack().pop()
+            self._memo_put("device", batch, final)
+        active, state = final
+        _stack().append(frame)
+        try:
+            self._bind_device(frame, state)
+            out = self.result_expr.eval_device(batch)
+        finally:
+            _stack().pop()
+        # Rows still active at the cap never converged: NULL, not garbage.
+        validity = out.validity & ~active
+        if out.dtype is T.STRING:
+            return dataclasses.replace(out, validity=validity)
+        return make_column(out.data, validity, out.dtype)
+
+    # -- host ---------------------------------------------------------------
+    def _final_state_host(self, batch: HostBatch, frame):
+        n = batch.num_rows
+        state = []
+        for v, init in zip(self.vars, self.inits):
+            arr = host_to_array(init.eval_host(batch), n)
+            state.append(arr.cast(T.to_arrow_type(v._dtype)))
+        active = pa.array(np.ones(n, dtype=bool))
+        for v, arr in zip(self.vars, state):
+            frame[id(v)] = arr
+        it = 0
+        while it < self.max_iters:
+            if not pc.any(active).as_py():
+                break
+            cont = host_to_array(self.continue_expr.eval_host(batch), n)
+            new_state = []
+            for v, old, upd in zip(self.vars, state, self.updates):
+                u = host_to_array(upd.eval_host(batch), n)
+                u = u.cast(T.to_arrow_type(v._dtype))
+                new_state.append(pc.if_else(active, u, old))
+            state = new_state
+            for v, arr in zip(self.vars, state):
+                frame[id(v)] = arr
+            active = pc.and_(active, pc.fill_null(cont, False))
+            it += 1
+        return active, state
+
+    def eval_host(self, batch: HostBatch) -> pa.Array:
+        self.resolve_types()
+        final = self._memo_get("host", batch)
+        frame: Dict[int, object] = {}
+        if final is None:
+            _stack().append(frame)
+            try:
+                final = self._final_state_host(batch, frame)
+            finally:
+                _stack().pop()
+            self._memo_put("host", batch, final)
+        active, state = final
+        n = batch.num_rows
+        _stack().append(frame)
+        try:
+            for v, arr in zip(self.vars, state):
+                frame[id(v)] = arr
+            out = host_to_array(self.result_expr.eval_host(batch), n)
+        finally:
+            _stack().pop()
+        stuck = active.to_numpy(zero_copy_only=False)
+        if stuck.any():
+            out = pc.if_else(pa.array(stuck), pa.nulls(n, out.type), out)
+        return out
