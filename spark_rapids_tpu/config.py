@@ -215,6 +215,24 @@ PARQUET_DEVICE_DECODE = conf_bool(
     "Table.readParquet split, GpuParquetScan.scala:365-388). Row groups "
     "outside the decoder's scope fall back to the host reader per unit.")
 
+CSV_DEVICE_DECODE = conf_bool(
+    "spark.rapids.sql.csv.deviceDecode.enabled", True,
+    "Parse CSV ON DEVICE (the GpuBatchScanExec.scala:87 cudf-csv role): "
+    "the host finds line/field boundaries in one vectorized pass, the "
+    "raw bytes upload once, and a traced digit-DP kernel converts "
+    "int/double/bool columns while string columns gather their char "
+    "matrix from the same buffer. Files with quoted fields, custom null "
+    "tokens, or values beyond the DP's exact range fall back to the "
+    "host reader per file.")
+
+PARQUET_DEVICE_ENCODE = conf_bool(
+    "spark.rapids.sql.parquet.deviceEncode.enabled", True,
+    "Encode parquet ON DEVICE (the Table.writeParquetChunked split, "
+    "GpuParquetFileFormat.scala:243): a traced kernel compacts def-level "
+    "and value lanes in encoding order; the host RLE-frames pages and "
+    "writes the thrift footer. Columns outside the encoder's scope fall "
+    "back to the host Arrow writer per file.")
+
 ADAPTIVE_ENABLED = conf_bool(
     "spark.rapids.sql.adaptive.enabled", False,
     "Re-plan shuffle reads with OBSERVED map-output sizes: coalesce "

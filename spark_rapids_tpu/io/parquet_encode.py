@@ -1,0 +1,447 @@
+"""Device-side parquet encode — the ``Table.writeParquetChunked`` analog.
+
+The reference encodes parquet ON DEVICE and streams finished buffers to
+the filesystem (``GpuParquetFileFormat.scala:243`` ->
+``Table.writeParquetChunked``, ``ColumnarOutputWriter.scala:37``). This is
+the inverse of :mod:`.parquet_device`'s decode split, and the work divides
+the same way in reverse:
+
+* DEVICE (data-sized work): one traced kernel per batch compacts each
+  column — live rows in lane order, then non-null values scattered dense
+  by a cumsum index — so the page VALUES buffer and the def-level bits
+  leave the device already in encoding order. Dictionary string columns
+  ship their (small) dictionary plus compacted int32 codes; no string
+  bytes are rematerialized per row.
+* HOST (metadata-sized work): RLE/bit-pack the downloaded def-level and
+  dictionary-code lanes (vectorized numpy, run-table style), frame pages,
+  write thrift-compact PageHeaders and the FileMetaData footer.
+
+Scope (per-FILE fallback to the host Arrow writer otherwise, the same
+graceful-degradation contract as the decoders): flat schemas; INT32/INT64/
+FLOAT/DOUBLE/BOOLEAN/DATE/TIMESTAMP plain encoding, dictionary-encoded
+strings as PLAIN dictionary page + RLE_DICTIONARY data page; optional
+values via RLE def-levels; one row group per file; UNCOMPRESSED or SNAPPY
+data pages. Files are readable by pyarrow AND by this engine's own device
+decoder (round-trip differentials in tests/test_parquet_encode.py).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+
+from .. import types as T
+from ..data.batch import ColumnarBatch
+from ..data.column import DeviceColumn
+from ..utils.kernel_cache import cached_kernel
+
+
+class NotDeviceEncodable(Exception):
+    """Column/type outside the device encoder's scope; caller falls back."""
+
+
+# ---------------------------------------------------------------------------
+# Thrift compact protocol WRITER (inverse of parquet_device._Thrift)
+# ---------------------------------------------------------------------------
+
+_T_BOOL_TRUE = 1
+_T_BOOL_FALSE = 2
+_T_BYTE = 3
+_T_I16 = 4
+_T_I32 = 5
+_T_I64 = 6
+_T_DOUBLE = 7
+_T_BINARY = 8
+_T_LIST = 9
+_T_STRUCT = 12
+
+
+def _varint(v: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _zigzag(v: int) -> bytes:
+    return _varint((v << 1) ^ (v >> 63))
+
+
+class _ThriftWriter:
+    """Just enough of the thrift compact protocol for parquet metadata."""
+
+    def __init__(self):
+        self.buf = bytearray()
+        self._last_fid = [0]
+
+    def _field(self, fid: int, ftype: int):
+        delta = fid - self._last_fid[-1]
+        if 1 <= delta <= 15:
+            self.buf.append((delta << 4) | ftype)
+        else:
+            self.buf.append(ftype)
+            self.buf += _zigzag(fid)
+        self._last_fid[-1] = fid
+
+    def i32(self, fid: int, v: int):
+        self._field(fid, _T_I32)
+        self.buf += _zigzag(v)
+
+    def i64(self, fid: int, v: int):
+        self._field(fid, _T_I64)
+        self.buf += _zigzag(v)
+
+    def string(self, fid: int, s: str):
+        self._field(fid, _T_BINARY)
+        raw = s.encode("utf-8")
+        self.buf += _varint(len(raw))
+        self.buf += raw
+
+    def struct_begin(self, fid: int):
+        self._field(fid, _T_STRUCT)
+        self._last_fid.append(0)
+
+    def struct_end(self):
+        self.buf.append(0x00)
+        self._last_fid.pop()
+
+    def list_begin(self, fid: int, elem_type: int, size: int):
+        self._field(fid, _T_LIST)
+        if size < 15:
+            self.buf.append((size << 4) | elem_type)
+        else:
+            self.buf.append(0xF0 | elem_type)
+            self.buf += _varint(size)
+
+    def i32_elem(self, v: int):
+        self.buf += _zigzag(v)
+
+    def done(self) -> bytes:
+        self.buf.append(0x00)   # terminate the top-level struct
+        return bytes(self.buf)
+
+
+# ---------------------------------------------------------------------------
+# Physical-type mapping
+# ---------------------------------------------------------------------------
+
+_PQ_BOOLEAN, _PQ_INT32, _PQ_INT64, _PQ_FLOAT, _PQ_DOUBLE, _PQ_BYTE_ARRAY = \
+    0, 1, 2, 4, 5, 6
+_ENC_PLAIN, _ENC_RLE, _ENC_RLE_DICTIONARY, _ENC_PLAIN_DICTIONARY = 0, 3, 8, 2
+_CODEC_UNCOMPRESSED, _CODEC_SNAPPY = 0, 1
+
+#: engine type -> (parquet physical type, converted_type or None)
+_PHYS: Dict[str, Tuple[int, Optional[int]]] = {
+    "int": (_PQ_INT32, None),
+    "bigint": (_PQ_INT64, None),
+    "float": (_PQ_FLOAT, None),
+    "double": (_PQ_DOUBLE, None),
+    "boolean": (_PQ_BOOLEAN, None),
+    "date": (_PQ_INT32, 6),            # DATE converted type
+    "timestamp": (_PQ_INT64, 10),      # TIMESTAMP_MICROS
+    "smallint": (_PQ_INT32, 15),       # INT_16
+    "tinyint": (_PQ_INT32, 16),        # INT_8
+    "string": (_PQ_BYTE_ARRAY, 0),     # UTF8
+}
+
+
+# ---------------------------------------------------------------------------
+# Device compaction kernel
+# ---------------------------------------------------------------------------
+
+
+def _build_compact():
+    def run(batch: ColumnarBatch):
+        live = batch.row_mask()
+        cap = batch.capacity
+        live_pos = jnp.cumsum(live) - 1      # position of each live row
+        outs = []
+        for c in batch.columns:
+            valid = c.validity & live
+            # def-levels, compacted to live-row order
+            defl = jnp.zeros(cap, jnp.bool_).at[
+                jnp.where(live, live_pos, cap)].set(c.validity, mode="drop")
+            vals_src = c.codes if c.codes is not None else c.data
+            val_pos = jnp.cumsum(valid) - 1
+            vals = jnp.zeros(cap, vals_src.dtype).at[
+                jnp.where(valid, val_pos, cap)].set(vals_src, mode="drop")
+            outs.append((defl, vals, valid.sum()))
+        return tuple(outs), batch.n_rows
+    return run
+
+
+def _compact_columns(batch: ColumnarBatch):
+    """One traced program for the whole batch: per column, (validity in
+    live-row order, values dense in non-null order, dict codes dense).
+    Invalid/dead lanes scatter to a dropped out-of-bounds slot."""
+    key = (batch.capacity, batch.live is not None,
+           tuple(f.data_type.name for f in batch.schema),
+           tuple(c.codes is not None for c in batch.columns))
+    fn = cached_kernel("parquet_encode.compact", key, _build_compact)
+    return fn(batch)
+
+
+# ---------------------------------------------------------------------------
+# Host-side RLE / bit-pack framing
+# ---------------------------------------------------------------------------
+
+
+def _rle_runs(values: np.ndarray) -> List[Tuple[int, int]]:
+    """(run_length, value) pairs over an int array (vectorized breaks)."""
+    n = len(values)
+    if n == 0:
+        return []
+    breaks = np.nonzero(values[1:] != values[:-1])[0] + 1
+    starts = np.concatenate(([0], breaks))
+    ends = np.concatenate((breaks, [n]))
+    return [(int(e - s), int(values[s])) for s, e in zip(starts, ends)]
+
+
+def _rle_encode(values: np.ndarray, bit_width: int) -> bytes:
+    """Parquet RLE/bit-pack hybrid, RLE runs only (def levels and dict
+    codes compress well as runs; bit-packed fallback kicks in when runs
+    are short)."""
+    byte_w = (bit_width + 7) // 8
+    out = bytearray()
+    runs = _rle_runs(values)
+    # Heuristic: many tiny runs -> bit-pack groups of 8 instead.
+    if bit_width and runs and len(runs) > max(4, len(values) // 4):
+        return _bitpack_encode(values, bit_width)
+    for count, value in runs:
+        out += _varint(count << 1)
+        out += int(value).to_bytes(byte_w, "little") if byte_w else b""
+    return bytes(out)
+
+
+def _bitpack_encode(values: np.ndarray, bit_width: int) -> bytes:
+    n = len(values)
+    groups = (n + 7) // 8
+    padded = np.zeros(groups * 8, np.uint64)
+    padded[:n] = values.astype(np.uint64)
+    # Little-endian bit order within each group.
+    bits = ((padded[:, None] >> np.arange(bit_width, dtype=np.uint64))
+            & 1).astype(np.uint8)           # [8g, bw]
+    flat = bits.reshape(-1)                  # value-major LSB-first
+    packed = np.packbits(flat, bitorder="little")
+    out = bytearray(_varint((groups << 1) | 1))
+    out += packed.tobytes()
+    return bytes(out)
+
+
+def _length_prefixed(payload: bytes) -> bytes:
+    return struct.pack("<I", len(payload)) + payload
+
+
+def _compress(payload: bytes, codec: int) -> bytes:
+    if codec == _CODEC_UNCOMPRESSED:
+        return payload
+    return pa.Codec("snappy").compress(payload).to_pybytes()
+
+
+# ---------------------------------------------------------------------------
+# Page assembly
+# ---------------------------------------------------------------------------
+
+
+def _page_header(page_type: int, uncomp: int, comp: int, num_values: int,
+                 encoding: int) -> bytes:
+    w = _ThriftWriter()
+    w.i32(1, page_type)
+    w.i32(2, uncomp)
+    w.i32(3, comp)
+    if page_type == 0:        # data page v1
+        w.struct_begin(5)
+        w.i32(1, num_values)
+        w.i32(2, encoding)
+        w.i32(3, _ENC_RLE)    # definition levels
+        w.i32(4, _ENC_RLE)    # repetition levels (none written: flat)
+        w.struct_end()
+    else:                     # dictionary page
+        w.struct_begin(7)
+        w.i32(1, num_values)
+        w.i32(2, _ENC_PLAIN)
+        w.struct_end()
+    return w.done()
+
+
+def _plain_values(vals: np.ndarray, dtype: T.DataType, n_valid: int) -> bytes:
+    v = vals[:n_valid]
+    if dtype is T.BOOLEAN:
+        return np.packbits(v.astype(np.uint8), bitorder="little").tobytes()
+    return np.ascontiguousarray(v).tobytes()
+
+
+def _string_dict_plain(col: DeviceColumn) -> Tuple[bytes, int]:
+    """PLAIN-encode the dictionary entries (4-byte LE length + bytes)."""
+    offs = np.asarray(col.offsets)
+    payload = np.asarray(col.data, dtype=np.uint8).tobytes()
+    out = bytearray()
+    n = len(offs) - 1
+    for i in range(n):
+        s, e = int(offs[i]), int(offs[i + 1])
+        out += struct.pack("<I", e - s)
+        out += payload[s:e]
+    return bytes(out), n
+
+
+class _ColumnPlan:
+    __slots__ = ("name", "dtype", "phys", "conv", "nullable", "is_dict",
+                 "dict_bytes", "dict_n")
+
+    def __init__(self, field: T.StructField, col: DeviceColumn):
+        self.name = field.name
+        self.dtype = field.data_type
+        if self.dtype.name not in _PHYS:
+            raise NotDeviceEncodable(f"type {self.dtype} not encodable")
+        self.phys, self.conv = _PHYS[self.dtype.name]
+        self.nullable = field.nullable
+        self.is_dict = col.codes is not None
+        if self.dtype is T.STRING and not self.is_dict:
+            raise NotDeviceEncodable("flat (non-dictionary) string column")
+        self.dict_bytes = None
+        self.dict_n = 0
+
+
+def write_device_batch(batch: ColumnarBatch, path: str,
+                       compression: Optional[str] = "snappy") -> int:
+    """Encode one device batch as a single-row-group parquet file.
+
+    Returns bytes written. Raises :class:`NotDeviceEncodable` BEFORE
+    touching the filesystem when any column is out of scope, so the
+    caller's host fallback writes the whole file instead."""
+    schema = batch.schema
+    plans = [_ColumnPlan(f, c) for f, c in zip(schema, batch.columns)]
+    if compression in (None, "none", "uncompressed"):
+        codec = _CODEC_UNCOMPRESSED
+    elif compression == "snappy":
+        codec = _CODEC_SNAPPY
+    else:
+        raise NotDeviceEncodable(f"codec {compression!r} not encodable")
+
+    compacted, n_rows_dev = _compact_columns(batch)
+    n_rows = int(n_rows_dev)
+
+    chunks: List[bytes] = []
+    metas: List[Dict] = []
+    offset = 4  # after magic
+    for plan, col, (defl_dev, vals_dev, nv_dev) in zip(
+            plans, batch.columns, compacted):
+        defl = np.asarray(defl_dev)[:n_rows]
+        n_valid = int(nv_dev)
+        vals = np.asarray(vals_dev)
+        piece = bytearray()
+        dict_off = None
+        uncomp_total = 0
+        encodings = [_ENC_RLE]
+        if plan.is_dict:
+            dict_plain, dict_n = _string_dict_plain(col)
+            payload = _compress(dict_plain, codec)
+            dict_off = offset + len(piece)
+            hdr = _page_header(2, len(dict_plain), len(payload), dict_n,
+                               _ENC_PLAIN)
+            piece += hdr
+            piece += payload
+            uncomp_total += len(hdr) + len(dict_plain)
+            bw = max(int(dict_n - 1).bit_length(), 1)
+            body = bytes([bw]) + _rle_encode(vals[:n_valid], bw)
+            enc = _ENC_RLE_DICTIONARY
+            encodings += [_ENC_PLAIN, _ENC_RLE_DICTIONARY]
+        else:
+            body = _plain_values(vals, plan.dtype, n_valid)
+            enc = _ENC_PLAIN
+            encodings += [_ENC_PLAIN]
+        if plan.nullable:
+            levels = _length_prefixed(_rle_encode(defl.astype(np.int64), 1))
+        else:
+            levels = b""
+        data_plain = levels + body
+        payload = _compress(data_plain, codec)
+        data_off = offset + len(piece)
+        hdr = _page_header(0, len(data_plain), len(payload), n_rows, enc)
+        piece += hdr
+        piece += payload
+        uncomp_total += len(hdr) + len(data_plain)
+        metas.append(dict(plan=plan, dict_off=dict_off, data_off=data_off,
+                          encodings=encodings, n_values=n_rows,
+                          total=len(piece), uncomp=uncomp_total,
+                          start=offset))
+        chunks.append(bytes(piece))
+        offset += len(piece)
+
+    footer = _file_metadata(schema, plans, metas, n_rows, codec)
+    with open(path, "wb") as f:
+        f.write(b"PAR1")
+        for ch in chunks:
+            f.write(ch)
+        f.write(footer)
+        f.write(struct.pack("<I", len(footer)))
+        f.write(b"PAR1")
+    return 8 + sum(len(c) for c in chunks) + len(footer) + 4
+
+
+def _file_metadata(schema: T.Schema, plans: List[_ColumnPlan],
+                   metas: List[Dict], n_rows: int, codec: int) -> bytes:
+    w = _ThriftWriter()
+    w.i32(1, 1)                                   # version
+    w.list_begin(2, _T_STRUCT, len(plans) + 1)    # schema elements
+    # List elements carry no field headers; each struct body opens a fresh
+    # field-id frame (compact-protocol deltas are per-struct).
+    w._last_fid.append(0)                         # root element
+    w.string(4, "schema")
+    w.i32(5, len(plans))
+    w.buf.append(0x00)
+    w._last_fid.pop()
+    for p in plans:
+        w._last_fid.append(0)
+        w.i32(1, p.phys)
+        w.i32(3, 1 if p.nullable else 0)          # OPTIONAL / REQUIRED
+        w.string(4, p.name)
+        if p.conv is not None:
+            w.i32(6, p.conv)
+        w.buf.append(0x00)
+        w._last_fid.pop()
+    w.i64(3, n_rows)
+    w.list_begin(4, _T_STRUCT, 1)                 # one row group
+    w._last_fid.append(0)
+    w.list_begin(1, _T_STRUCT, len(metas))        # column chunks
+    total = 0
+    for m in metas:
+        p = m["plan"]
+        w._last_fid.append(0)
+        w.i64(2, m["start"])                      # file_offset
+        w.struct_begin(3)                         # ColumnMetaData
+        w.i32(1, p.phys)
+        w.list_begin(2, _T_I32, len(m["encodings"]))
+        for e in m["encodings"]:
+            w.i32_elem(e)
+        w.list_begin(3, _T_BINARY, 1)
+        raw = p.name.encode()
+        w.buf += _varint(len(raw))
+        w.buf += raw
+        w.i32(4, codec)
+        w.i64(5, m["n_values"])
+        w.i64(6, m["uncomp"])                     # total_uncompressed_size
+        w.i64(7, m["total"])                      # total_compressed_size
+        w.i64(9, m["data_off"])
+        if m["dict_off"] is not None:
+            w.i64(11, m["dict_off"])
+        w.struct_end()
+        w.buf.append(0x00)
+        w._last_fid.pop()
+        total += m["total"]
+    w.i64(2, total)
+    w.i64(3, n_rows)
+    w.buf.append(0x00)
+    w._last_fid.pop()
+    w.string(6, "spark-rapids-tpu device encoder")
+    return w.done()

@@ -257,6 +257,7 @@ class TpuWriteFilesExec(_WriteFilesBase):
     children_coalesce_goals = ["target"]
 
     def execute(self, ctx: ExecContext):
+        from ..config import PARQUET_DEVICE_ENCODE
         from ..ops.kernels import rowops as KR
         stats = WriteStats()
         if not prepare_target(self.path, self.mode):
@@ -265,6 +266,8 @@ class TpuWriteFilesExec(_WriteFilesBase):
         part_ordinals = [child_schema.index_of(c) for c in self.partition_by]
         data_arrow = self._data_arrow()
         seen_dirs: set = set()
+        device_encode = (self.fmt == "parquet" and not part_ordinals
+                         and ctx.conf.get(PARQUET_DEVICE_ENCODE))
         task_id = 0
         for part in self.children[0].execute(ctx):
             for db in part:
@@ -275,6 +278,9 @@ class TpuWriteFilesExec(_WriteFilesBase):
                         db = KR.sort_batch(db, part_ordinals,
                                            [True] * len(part_ordinals),
                                            [True] * len(part_ordinals))
+                if device_encode and self._emit_device(db, task_id, stats):
+                    task_id += 1
+                    continue
                 rb = db.to_arrow()
                 if not part_ordinals:
                     self._emit(rb, self.path, task_id, 0, stats, rb.num_rows)
@@ -283,6 +289,23 @@ class TpuWriteFilesExec(_WriteFilesBase):
                                             data_arrow)
                 task_id += 1
         return self._finish(stats, seen_dirs)
+
+    def _emit_device(self, db, task_id: int, stats: WriteStats) -> bool:
+        """Device-encode one batch as one parquet file; False when out of
+        the encoder's scope (caller falls back to the host Arrow path)."""
+        from .parquet_encode import NotDeviceEncodable, write_device_batch
+        target = os.path.join(self.path, self._file_name(task_id, 0))
+        with trace_range("write.parquet_device_encode"):
+            try:
+                n = write_device_batch(
+                    db, target,
+                    compression=self.options.get("compression", "snappy"))
+            except NotDeviceEncodable:
+                return False
+        stats.bytes += n
+        stats.files += 1
+        stats.rows += int(db.n_rows)
+        return True
 
     def _write_sorted_runs(self, rb: pa.RecordBatch, task_id: int,
                            stats: WriteStats, seen_dirs: set,

@@ -663,7 +663,8 @@ class TpuOverrides:
 def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
     """Swap an uploadable parquet/ORC host scan for the device decoder
     (io/parquet_device.py, io/orc_device.py) when every unit qualifies."""
-    from ..config import ORC_DEVICE_DECODE, PARQUET_DEVICE_DECODE
+    from ..config import (CSV_DEVICE_DECODE, ORC_DEVICE_DECODE,
+                          PARQUET_DEVICE_DECODE)
     from ..io.files import CpuFileScanExec
     if conf is None or not isinstance(node, CpuFileScanExec):
         return None
@@ -671,6 +672,16 @@ def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
         # input_file_name() queries synthesize metadata columns host-side;
         # the host scan + upload path handles them.
         return None
+    if node.fmt == "csv" and conf.get(CSV_DEVICE_DECODE):
+        from ..io import csv_device as CD
+        try:
+            CD_ok = CD.device_decodable(node.schema, node.options)
+        except Exception:
+            return None
+        files = CD.scan_files(node.paths) if CD_ok else []
+        if not files:
+            return None
+        return CD.TpuCsvScanExec(files, node.schema, node.options)
     if node.fmt == "orc" and conf.get(ORC_DEVICE_DECODE):
         from ..io import orc_device as OD
         files = OD.scan_files(node.paths)

@@ -147,8 +147,17 @@ def _join_typed(cond: Expression, a: Expression, b: Expression) -> Expression:
     """``If(cond, a, b)`` tolerant of arms that disagree on numeric type
     (bytecode branches routinely mix int and float returns). TypedIf
     promotes lazily — at UDF-compile time column references are unbound,
-    so arm types are not yet knowable."""
-    return TypedIf(cond, a, b)
+    so arm types are not yet knowable. Joins that are ALREADY provably
+    un-joinable (string vs int literals) must fail here, as CompileError,
+    so the udf() wrapper falls back to row-wise Python."""
+    e = TypedIf(cond, a, b)
+    try:
+        e.data_type
+    except LoopTypeError as ex:
+        raise CompileError(str(ex))
+    except RuntimeError:
+        pass        # unbound column refs; types resolve at bind time
+    return e
 
 
 class _Terminal:
@@ -536,8 +545,6 @@ class _Interp:
         env_after = dict(env)
         for nm in carried:
             env_after[nm] = sibling(nm)
-        if rng:
-            env_after.pop(_IVAR, None)
         ret_pair = (sibling(_RET), sibling(_RETVAL)) \
             if returns_present else None
 
