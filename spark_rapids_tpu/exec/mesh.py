@@ -62,9 +62,9 @@ from ..utils.kernel_cache import cached_kernel, kernel_key, \
 from .coalesce import TpuCoalesceBatchesExec
 from .execs import (DeviceSourceExec, DeviceToHostExec, TpuFilterExec,
                     TpuHashAggregateExec, TpuProjectExec,
-                    TpuShuffledHashJoinExec, _aggregate_batch, _bind_all,
-                    _coalesce_device, _swap_schema, finalize_agg_kernel,
-                    hash_join_kernel, join_post_filter,
+                    TpuShuffledHashJoinExec, TpuSortExec, _aggregate_batch,
+                    _bind_all, _coalesce_device, _swap_schema,
+                    finalize_agg_kernel, hash_join_kernel, join_post_filter,
                     unmatched_build_kernel)
 
 
@@ -92,6 +92,13 @@ def _exchange_by_key(batch: ColumnarBatch, key_exprs: List[Expression],
     keys = [e.eval_device(batch) for e in key_exprs]
     h = spark_hash_columns_device(keys)
     pid = pmod_partition(h, n_parts)
+    return _exchange_by_pid(batch, pid, n_parts, bucket_cap, flags)
+
+
+def _exchange_by_pid(batch: ColumnarBatch, pid, n_parts: int,
+                     bucket_cap: int, flags: List) -> ColumnarBatch:
+    """Exchange rows to the chip named by per-row ``pid`` (hash exchange
+    for aggs/joins, RANGE exchange for the distributed sort)."""
     live = batch.row_mask()
     payload = {}
     for i, c in enumerate(batch.columns):
@@ -323,7 +330,110 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
             return out
         return join
 
+    if isinstance(node, TpuSortExec):
+        return _compile_sort(node, sources, n_parts, bucket_growth, conf)
+
     raise NotMeshCapable(type(node).__name__)
+
+
+#: samples per shard for the range-partition bounds; P*64 candidates give
+#: boundary error O(1/64) of a shard, well inside the 2x bucket slack.
+_SORT_SAMPLES = 64
+
+
+def _compile_sort(node, sources: List, n_parts: int, bucket_growth: float,
+                  conf):
+    """Distributed ORDER BY — range-exchange + per-chip sort, never a
+    collect-then-sort: each shard samples its first sort key, the samples
+    all_gather into global range bounds, rows exchange to the chip owning
+    their key range (ties share one chip because bounds are VALUES), and
+    the ordinary local sort kernel finishes each shard. Shard s then holds
+    global range s, so the collect's in-order concatenation IS the total
+    order — the reference's GpuRangePartitioner + per-partition
+    GpuSortExec stage shape, as one SPMD program."""
+    child = _compile(node.children[0], sources, n_parts, bucket_growth,
+                     conf)
+    schema = node.schema
+    orders = node.orders
+    from ..ops.expression import Alias, AttributeReference, BoundReference
+    for o in orders:
+        if o.child.data_type is T.STRING:
+            inner = o.child.children[0] if isinstance(o.child, Alias) \
+                else o.child
+            _require(isinstance(inner, (AttributeReference, BoundReference)),
+                     "computed string sort key over the mesh")
+    key_exprs = _bind_all([o.child for o in orders], schema)
+    asc = [o.ascending for o in orders]
+    nfirst = [o.effective_nulls_first for o in orders]
+
+    def rank_lane(col):
+        """Orderable per-row lane in ASCENDING rank space for the first
+        key: dict codes for (sorted-dict) strings, raw data otherwise.
+        Descending flips with bitwise NOT for integers (order-reversing
+        with no overflow at INT_MIN, where negation wraps) and negation
+        for floats."""
+        lane = col.codes if col.is_dict else col.data
+        if col.is_dict:
+            # Engine invariant: mesh strings are upload-dictionary-encoded,
+            # whose dictionaries are unique+sorted (codes order == string
+            # order). Exchanges preserve the flag.
+            assert col.dict_sorted, "unsorted dict reached the mesh sort"
+        if not asc[0]:
+            lane = jnp.negative(lane) \
+                if jnp.issubdtype(lane.dtype, jnp.floating) else ~lane
+        return lane
+
+    def sortfn(env, flags):
+        b = child(env, flags)
+        b = KR.physical(b)              # sampling reads the [0, n) prefix
+        keys = [e.eval_device(b) for e in key_exprs]
+        k0 = keys[0]
+        lane = rank_lane(k0)
+        n = b.n_rows
+        # -- sampled global bounds ---------------------------------------
+        pos = (jnp.arange(_SORT_SAMPLES, dtype=jnp.int32) * n) \
+            // _SORT_SAMPLES
+        samp = lane[jnp.clip(pos, 0, lane.shape[0] - 1)]
+        sflag = (jnp.arange(_SORT_SAMPLES, dtype=jnp.int32) < n) \
+            & k0.validity[jnp.clip(pos, 0, lane.shape[0] - 1)]
+        if jnp.issubdtype(lane.dtype, jnp.floating):
+            # NaN keys route explicitly (below), never into the bounds.
+            sflag = sflag & ~jnp.isnan(samp)
+        all_s = jax.lax.all_gather(samp, PART_AXIS).reshape(-1)
+        all_f = jax.lax.all_gather(sflag, PART_AXIS).reshape(-1)
+        if all_s.dtype == jnp.bool_:
+            all_s = all_s.astype(jnp.int32)
+            lane = lane.astype(jnp.int32)
+        hi = jnp.asarray(jnp.finfo(all_s.dtype).max
+                         if jnp.issubdtype(all_s.dtype, jnp.floating)
+                         else jnp.iinfo(all_s.dtype).max, all_s.dtype)
+        ordered = jnp.sort(jnp.where(all_f, all_s, hi))
+        total = all_f.sum()
+        b_idx = (jnp.arange(1, n_parts) * total) // n_parts
+        bounds = jnp.where(
+            total > 0,
+            ordered[jnp.clip(b_idx, 0, ordered.shape[0] - 1)], hi)
+        # -- per-row destination -----------------------------------------
+        pid = jnp.zeros(lane.shape[0], jnp.int32)
+        for j in range(n_parts - 1):
+            pid = pid + (lane > bounds[j]).astype(jnp.int32)
+        if jnp.issubdtype(lane.dtype, jnp.floating):
+            # Spark: NaN is the LARGEST value — last shard ascending,
+            # shard 0 descending (rank space already folds direction for
+            # finite values, but every NaN comparison is False).
+            nan_dest = n_parts - 1 if asc[0] else 0
+            pid = jnp.where(jnp.isnan(lane), nan_dest, pid)
+        # nulls-first (w.r.t. the ORDER BY direction) puts nulls on shard
+        # 0; the asc/desc direction is already folded into rank space.
+        null_dest = 0 if nfirst[0] else n_parts - 1
+        pid = jnp.where(k0.validity, pid, null_dest)
+        # -- range exchange + local sort ---------------------------------
+        bucket = bucket_capacity(
+            max(int(2 * b.capacity * bucket_growth) // n_parts, 128))
+        shuffled = _exchange_by_pid(b, pid, n_parts, bucket, flags)
+        keys2 = [e.eval_device(shuffled) for e in key_exprs]
+        return KR.sort_batch_by_columns(shuffled, keys2, asc, nfirst)
+    return sortfn
 
 
 def _compile_global_agg(node, child, child_schema):
@@ -430,15 +540,17 @@ def _encoding_fingerprint(node) -> tuple:
 
 
 def _split_tail(plan):
-    """Split trailing single-chip finishers (sort / limit / project /
-    coalesce above the last wide op) off the mesh core: the core's result
-    is tiny (post-aggregate), so the tail runs on the collected output
-    through the ordinary streaming path — the reference likewise finishes
-    ORDER BY/LIMIT driver-side after its accelerated stages."""
-    from .execs import TpuLimitExec, TpuLocalLimitExec, TpuSortExec
-    peelable = (TpuSortExec, TpuLimitExec, TpuLocalLimitExec,
+    """Split trailing single-chip finishers (limit / top-k / project /
+    coalesce above the last wide op) off the mesh core: a LIMIT's result
+    is tiny by contract, so it finishes on the collected output through
+    the ordinary streaming path — the reference likewise finishes LIMIT
+    driver-side after its accelerated stages. ORDER BY is NOT peeled:
+    TpuSortExec compiles in-mesh as a range-exchange + per-chip sort
+    (_compile_sort), so sort tails stay distributed."""
+    from .execs import TpuLimitExec, TpuLocalLimitExec, TpuTopKExec
+    peelable = (TpuTopKExec, TpuLimitExec, TpuLocalLimitExec,
                 TpuProjectExec, TpuCoalesceBatchesExec)
-    ordered = (TpuSortExec, TpuLimitExec, TpuLocalLimitExec)
+    ordered = (TpuTopKExec, TpuLimitExec, TpuLocalLimitExec)
 
     def prefix_has_ordered(n):
         while isinstance(n, peelable):

@@ -93,14 +93,17 @@ def _boundaries(buf: np.ndarray, delim: int, n_cols: int,
     nl = np.nonzero(buf == 10)[0]
     line_starts = np.concatenate(([0], nl + 1))
     line_ends = np.concatenate((nl, [n_bytes]))
+    # CRLF: trim the \r BEFORE the empty-line filter, so a blank "\r\n"
+    # line is recognized as empty (pyarrow skips it; a post-trim check
+    # would let it through as a spurious null row).
+    crlf = (line_ends > line_starts) \
+        & (buf[np.maximum(line_ends - 1, 0)] == 13)
+    line_ends = line_ends - crlf.astype(np.int64)
     # Drop the phantom line after a trailing newline (and any empty lines
     # — Spark/pyarrow skip fully empty lines).
     live = line_starts < line_ends
     line_starts = line_starts[live]
     line_ends = line_ends[live]
-    # CRLF: trim the \r
-    crlf = buf[np.maximum(line_ends - 1, 0)] == 13
-    line_ends = line_ends - crlf.astype(np.int64)
     if header:
         line_starts, line_ends = line_starts[1:], line_ends[1:]
     n = len(line_starts)
@@ -249,9 +252,12 @@ def decode_file(path: str, schema: T.Schema, options: dict,
     """Yield ColumnarBatches parsed on device; NotCsvDecodable when the
     file's DATA is out of scope (quotes, overlong numbers, ragged rows)."""
     buf = np.fromfile(path, dtype=np.uint8)
-    quote = ord(str(options.get("quote", '"')))
-    if len(buf) and (buf == quote).any():
-        raise NotCsvDecodable("quoted fields")
+    q_opt = options.get("quote", '"')
+    if q_opt not in (False, None, ""):
+        # Quoting disabled (quote=False, pyarrow-style) needs no check.
+        quote = ord(str(q_opt))
+        if len(buf) and (buf == quote).any():
+            raise NotCsvDecodable("quoted fields")
     delim = ord(str(options.get("delimiter", ",")))
     header = bool(options.get("header", True))
     starts, ends = _boundaries(buf, delim, len(schema), header)

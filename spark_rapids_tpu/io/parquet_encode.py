@@ -282,16 +282,27 @@ def _plain_values(vals: np.ndarray, dtype: T.DataType, n_valid: int) -> bytes:
 
 
 def _string_dict_plain(col: DeviceColumn) -> Tuple[bytes, int]:
-    """PLAIN-encode the dictionary entries (4-byte LE length + bytes)."""
-    offs = np.asarray(col.offsets)
-    payload = np.asarray(col.data, dtype=np.uint8).tobytes()
-    out = bytearray()
+    """PLAIN-encode the dictionary entries (4-byte LE length + bytes) —
+    fully vectorized; uploads dict-encode every string column, so a
+    near-unique column makes the dictionary row-count-sized."""
+    offs = np.asarray(col.offsets).astype(np.int64)
     n = len(offs) - 1
-    for i in range(n):
-        s, e = int(offs[i]), int(offs[i + 1])
-        out += struct.pack("<I", e - s)
-        out += payload[s:e]
-    return bytes(out), n
+    payload_end = int(offs[-1])
+    payload = np.asarray(col.data, dtype=np.uint8)[:payload_end]
+    lens = np.diff(offs).astype("<u4")
+    out = np.zeros(4 * n + payload_end, np.uint8)
+    # Each entry's 4-byte length lands at 4*i + (payload bytes before it).
+    len_pos = 4 * np.arange(n, dtype=np.int64) + (offs[:-1])
+    len_bytes = lens.view(np.uint8).reshape(n, 4)
+    for b in range(4):
+        out[len_pos + b] = len_bytes[:, b]
+    # Payload byte j belongs to entry e(j); it shifts right by 4*(e(j)+1).
+    if payload_end:
+        byte_entry = np.repeat(np.arange(n, dtype=np.int64),
+                               np.diff(offs))
+        out[np.arange(payload_end, dtype=np.int64)
+            + 4 * (byte_entry + 1)] = payload
+    return out.tobytes(), n
 
 
 class _ColumnPlan:

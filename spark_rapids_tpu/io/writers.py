@@ -297,9 +297,11 @@ class TpuWriteFilesExec(_WriteFilesBase):
         target = os.path.join(self.path, self._file_name(task_id, 0))
         with trace_range("write.parquet_device_encode"):
             try:
+                # `or "snappy"`: an explicit compression=None means snappy
+                # on the host path too (_write_one) — keep one codec per job.
                 n = write_device_batch(
                     db, target,
-                    compression=self.options.get("compression", "snappy"))
+                    compression=self.options.get("compression") or "snappy")
             except NotDeviceEncodable:
                 return False
         stats.bytes += n

@@ -16,6 +16,7 @@ buckets."""
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import Callable, Dict, List, Optional, Type
 
 from .. import types as T
@@ -680,6 +681,13 @@ def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
             return None
         files = CD.scan_files(node.paths) if CD_ok else []
         if not files:
+            return None
+        # Hive-partitioned layouts synthesize the key=value directory
+        # columns at read time; the per-file device parse (and its
+        # per-file host fallback) sees only the file's own fields, so
+        # partitioned directories keep the host dataset reader.
+        if any("=" in part for f in files
+               for part in os.path.dirname(f).split(os.sep)):
             return None
         return CD.TpuCsvScanExec(files, node.schema, node.options)
     if node.fmt == "orc" and conf.get(ORC_DEVICE_DECODE):

@@ -140,6 +140,42 @@ class TestFallbacks:
             T.Schema([T.StructField("x", T.LONG, True)]),
             {"nullValue": "NA"})
 
+    def test_hive_partitioned_dir_stays_host(self, tmp_path):
+        """Read-back of a partitionBy CSV write must restore the partition
+        columns — the per-file device parse can't see them, so the plan
+        keeps the host dataset reader."""
+        cpu, tpu = cpu_session(), tpu_session()
+        path = str(tmp_path / "hive")
+        cpu.create_dataframe({"k": [0, 1, 0, 1], "v": [1, 2, 3, 4]}) \
+            .write.partition_by("k").csv(path)
+        df = tpu.read.csv(path).where(P.IsNotNull(col("v")))
+        assert not _plan_has_device_scan(tpu, df)
+        key = [("v", "ascending")]
+        got = df.collect().sort_by(key)
+        want = cpu.read.csv(path).where(
+            P.IsNotNull(col("v"))).collect().sort_by(key)
+        assert got.to_pydict() == want.to_pydict()
+
+    def test_blank_crlf_line_skipped(self, tmp_path):
+        path = str(tmp_path / "blank.csv")
+        with open(path, "wb") as f:
+            f.write(b"x\r\n1\r\n\r\n2\r\n")
+        schema = T.Schema([T.StructField("x", T.LONG, True)])
+        out = self._decode_all(path, schema, {"header": True})
+        import numpy as np
+        n = int(out[0].n_rows)
+        assert n == 2
+        assert list(np.asarray(out[0].columns[0].data)[:n]) == [1, 2]
+
+    def test_quote_false_option(self, tmp_path):
+        path = str(tmp_path / "nq.csv")
+        with open(path, "w") as f:
+            f.write("x\n1\n2\n")
+        schema = T.Schema([T.StructField("x", T.LONG, True)])
+        out = self._decode_all(path, schema, {"header": True,
+                                              "quote": False})
+        assert int(out[0].n_rows) == 2
+
     def test_ragged_rows_fall_back(self, tmp_path):
         path = str(tmp_path / "r.csv")
         with open(path, "w") as f:
