@@ -80,6 +80,56 @@ def _geo(xs):
     return math.exp(sum(math.log(x) for x in xs) / len(xs))
 
 
+def tunnel_diagnostics() -> dict:
+    """Measured link characteristics, reported so the artifact is
+    interpretable: on the axon tunnel every collect pays one dispatch+
+    download round trip, and bandwidth has been observed anywhere from
+    2 to 20 MB/s — numbers a colocated deployment would not pay."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    jax.device_get(jnp.arange(8).sum())      # settle/compile
+    t0 = time.perf_counter()
+    for _ in range(3):
+        jax.device_get(jnp.arange(8).sum())
+    rt = (time.perf_counter() - t0) / 3
+    buf = jnp.zeros((1 << 21,), jnp.int64)   # 16 MB
+    jax.device_get(buf)
+    t0 = time.perf_counter()
+    jax.device_get(buf)
+    dl = time.perf_counter() - t0
+    return {"backend": jax.default_backend(),
+            "tunnel_rt_ms": round(rt * 1e3, 1),
+            "tunnel_download_mbps": round(16 / max(dl - rt, 1e-3), 1)}
+
+
+def run_large_scale(n_rows: int = 1 << 22):
+    """Cached-only supplement at 4M lineitem rows: the reference's claim
+    is accelerator wins AT SCALE — at 1M rows the per-query round-trip
+    floor (~100-200ms on the tunnel) dwarfs compute, at 4M the CPU
+    oracle's compute grows 4x while the device pays the same floor.
+    Returns the geomean CPU/TPU ratio over q1/q6/q19."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.workloads import tpch
+    tables = tpch.gen_tables(n_rows, seed=42)
+    cpu = TpuSession({"spark.rapids.sql.enabled": False})
+    tpu = TpuSession({"spark.rapids.sql.enabled": True,
+                      "spark.rapids.sql.variableFloatAgg.enabled": True})
+    cpu_t = tpch.load(cpu, tables)
+    tpu_t = tpch.load(tpu, tables)
+    ratios = []
+    for name in ("q1", "q6", "q19"):
+        q = tpch.QUERIES[name]
+        q(tpu_t).collect()                   # warmup + compile
+        cpu_time = timed(lambda: q(cpu_t).collect())
+        tpu_time = timed(lambda: q(tpu_t).collect())
+        ratios.append(cpu_time / tpu_time)
+        print(f"[bench] 4M {name}: cpu={cpu_time*1e3:.0f}ms "
+              f"tpu={tpu_time*1e3:.0f}ms ratio={cpu_time/tpu_time:.2f}",
+              file=sys.stderr)
+    return _geo(ratios)
+
+
 def run_suite():
     # NOTE: do not enable jax_compilation_cache_dir here — it deadlocks the
     # axon remote-compile helper (observed: queries hang indefinitely), and
@@ -89,6 +139,10 @@ def run_suite():
     from spark_rapids_tpu.utils import kernel_cache as KC
     from spark_rapids_tpu.workloads import tpch
     from spark_rapids_tpu.workloads.compare import tables_match
+    suite_t0 = time.perf_counter()
+    diag = tunnel_diagnostics()
+    print(f"[bench] backend={diag['backend']} rt={diag['tunnel_rt_ms']}ms "
+          f"download={diag['tunnel_download_mbps']}MB/s", file=sys.stderr)
 
     n_li = 1 << 20
     tables = tpch.gen_tables(n_li, seed=42)
@@ -179,14 +233,23 @@ def run_suite():
           f"re-collects over the same host tables with the upload memo "
           f"warm, cold clears the memo so prep+transfer are fully timed)",
           file=sys.stderr)
-    return {
+    out = {
         "metric": f"tpch_tpcxbb_{len(tpu_times)}q_1Mrow_geomean_device_time",
         "value": round(geo_t * 1000, 2),
         "unit": "ms",
         "vs_baseline": round(geo_r, 3),
         "uncached_vs_baseline": round(_geo(uncached_ratios), 3),
         "cold_vs_baseline": round(_geo(cold_ratios), 3),
+        **diag,
     }
+    # Large-scale supplement (skipped if the main suite already consumed
+    # the budget — compile time on a cold remote helper can be minutes).
+    if time.perf_counter() - suite_t0 < 1800:
+        try:
+            out["vs_baseline_4m_cached"] = round(run_large_scale(), 3)
+        except Exception as e:  # noqa: BLE001 — supplement must not kill it
+            print(f"[bench] 4M supplement failed: {e}", file=sys.stderr)
+    return out
 
 
 def main():

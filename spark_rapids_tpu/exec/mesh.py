@@ -539,29 +539,49 @@ def _encoding_fingerprint(node) -> tuple:
     return tuple(out)
 
 
+def _sort_mesh_ok(node) -> bool:
+    """Static twin of _compile_sort's gates: the in-mesh range sort needs
+    every STRING sort key to be a direct column reference (computed string
+    keys could yield flat per-shard payloads)."""
+    from ..ops.expression import Alias, AttributeReference, BoundReference
+    for o in node.orders:
+        if o.child.data_type is T.STRING:
+            inner = o.child.children[0] if isinstance(o.child, Alias) \
+                else o.child
+            if not isinstance(inner, (AttributeReference, BoundReference)):
+                return False
+    return True
+
+
 def _split_tail(plan):
     """Split trailing single-chip finishers (limit / top-k / project /
     coalesce above the last wide op) off the mesh core: a LIMIT's result
     is tiny by contract, so it finishes on the collected output through
     the ordinary streaming path — the reference likewise finishes LIMIT
-    driver-side after its accelerated stages. ORDER BY is NOT peeled:
-    TpuSortExec compiles in-mesh as a range-exchange + per-chip sort
-    (_compile_sort), so sort tails stay distributed."""
+    driver-side after its accelerated stages. ORDER BY is NOT peeled when
+    _compile_sort can take it: TpuSortExec compiles in-mesh as a
+    range-exchange + per-chip sort, so sort tails stay distributed; a
+    sort OUTSIDE that scope (computed string key) peels like a limit
+    rather than disqualifying the whole plan from the mesh."""
     from .execs import TpuLimitExec, TpuLocalLimitExec, TpuTopKExec
-    peelable = (TpuTopKExec, TpuLimitExec, TpuLocalLimitExec,
-                TpuProjectExec, TpuCoalesceBatchesExec)
-    ordered = (TpuTopKExec, TpuLimitExec, TpuLocalLimitExec)
+    always_peel = (TpuTopKExec, TpuLimitExec, TpuLocalLimitExec)
+    narrow = (TpuProjectExec, TpuCoalesceBatchesExec)
+
+    def peelable(n):
+        if isinstance(n, always_peel) or isinstance(n, narrow):
+            return True
+        return isinstance(n, TpuSortExec) and not _sort_mesh_ok(n)
 
     def prefix_has_ordered(n):
-        while isinstance(n, peelable):
-            if isinstance(n, ordered):
+        while peelable(n):
+            if isinstance(n, always_peel) or isinstance(n, TpuSortExec):
                 return True
             n = n.children[0]
         return False
 
     tail = []
     node = plan
-    while isinstance(node, peelable) and prefix_has_ordered(node):
+    while peelable(node) and prefix_has_ordered(node):
         tail.append(node)
         node = node.children[0]
     return tail, node

@@ -685,9 +685,19 @@ def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
         # Hive-partitioned layouts synthesize the key=value directory
         # columns at read time; the per-file device parse (and its
         # per-file host fallback) sees only the file's own fields, so
-        # partitioned directories keep the host dataset reader.
+        # partitioned directories keep the host dataset reader. Only
+        # components BELOW the scanned roots count — an '=' in the user's
+        # base path is not a partition.
+        roots = [os.path.abspath(p) for p in node.paths]
+
+        def below_root(f):
+            af = os.path.abspath(f)
+            for r in roots:
+                if af.startswith(r + os.sep):
+                    return os.path.relpath(os.path.dirname(af), r)
+            return ""
         if any("=" in part for f in files
-               for part in os.path.dirname(f).split(os.sep)):
+               for part in below_root(f).split(os.sep)):
             return None
         return CD.TpuCsvScanExec(files, node.schema, node.options)
     if node.fmt == "orc" and conf.get(ORC_DEVICE_DECODE):

@@ -309,6 +309,15 @@ class CpuHashAggregateExec(PhysicalPlan):
                         cols.append(host_to_array(fn.child.eval_host(hb),
                                                   hb.num_rows))
                     names.append(f"_a{i}")
+                for i, a in enumerate(self.aggregates):
+                    # Spark float min/max semantics need a NaN-presence
+                    # indicator per group (NaN orders GREATEST: max is NaN
+                    # when any contribution is, min only when all are) —
+                    # pyarrow's min_max silently skips NaN.
+                    if self._nan_minmax(a):
+                        gi = len(self.groupings) + i
+                        cols.append(pc.is_nan(cols[gi]))
+                        names.append(f"_n{i}")
                 if hb.num_rows:
                     rows.append(pa.RecordBatch.from_arrays(cols, names=names))
 
@@ -334,6 +343,10 @@ class CpuHashAggregateExec(PhysicalPlan):
             if isinstance(a.func, AGG.Count) and a.func.child is None:
                 pa_agg = "sum"  # count(*) over the synthesized ones column
             aggs.append((f"_a{i}", pa_agg))
+        n_base = len(aggs)
+        for i, a in enumerate(self.aggregates):
+            if self._nan_minmax(a):
+                aggs.append((f"_n{i}", "max"))
         if not aggs:
             aggs = [(keys[0], "count")] if keys else []
         grouped = table.group_by(keys, use_threads=False).aggregate(aggs)
@@ -342,14 +355,32 @@ class CpuHashAggregateExec(PhysicalPlan):
             arrays.append(grouped.column(f"_g{i}").combine_chunks()
                           .cast(T.to_arrow_type(g.data_type)))
         for i, a in enumerate(self.aggregates):
-            pa_agg = aggs[i][1] if i < len(aggs) else a.func.pa_agg
+            pa_agg = aggs[i][1] if i < n_base else a.func.pa_agg
             cname = f"_a{i}_{pa_agg}"
             arr = grouped.column(cname).combine_chunks()
             if isinstance(a.func, AGG.Count) and a.func.child is None:
                 arr = pc.fill_null(arr, 0)
+            if self._nan_minmax(a):
+                has_nan = pc.fill_null(
+                    grouped.column(f"_n{i}_max").combine_chunks(), False)
+                nan = pa.scalar(float("nan"), arr.type)
+                if isinstance(a.func, AGG.Max):
+                    # Any NaN contribution: the max IS NaN.
+                    arr = pc.if_else(has_nan, nan, arr)
+                else:
+                    # All-NaN group: pyarrow skipped every value -> null;
+                    # Spark's answer is NaN.
+                    arr = pc.if_else(pc.and_(pc.is_null(arr), has_nan),
+                                     nan, arr)
             arrays.append(arr.cast(T.to_arrow_type(a.func.data_type)))
         rb_out = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
         return [iter([HostBatch(rb_out)])]
+
+    @staticmethod
+    def _nan_minmax(a) -> bool:
+        fn = a.func
+        return isinstance(fn, (AGG.Min, AGG.Max)) and fn.child is not None \
+            and fn.data_type.is_floating
 
 
 class CpuJoinExec(PhysicalPlan):

@@ -83,6 +83,20 @@ _NATIONS = np.array(["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT",
                      "VIETNAM", "RUSSIA", "UNITED KINGDOM", "UNITED STATES"])
 
 
+def _rb(d: dict, schema: pa.Schema) -> pa.RecordBatch:
+    """RecordBatch.from_pydict that tolerates pyarrow returning a
+    ChunkedArray for large numpy-unicode columns (seen at 4M+ rows)."""
+    cols = []
+    for name, typ in zip(schema.names, schema.types):
+        a = pa.array(d[name], type=typ)
+        if isinstance(a, pa.ChunkedArray):
+            a = a.combine_chunks()
+            if isinstance(a, pa.ChunkedArray):
+                a = a.chunk(0)
+        cols.append(a)
+    return pa.RecordBatch.from_arrays(cols, schema=schema)
+
+
 def gen_tables(lineitem_rows: int = 1 << 20, seed: int = 42) -> dict:
     """TPC-H-shaped tables as pyarrow RecordBatches, scaled off the
     lineitem row count (other tables keep roughly TPC-H's relative sizes)."""
@@ -104,7 +118,7 @@ def gen_tables(lineitem_rows: int = 1 << 20, seed: int = 42) -> dict:
 
     orderkeys = rng.integers(0, n_ord, n_li).astype(np.int64)
     shipdate = date(8400, 10700, n_li)
-    lineitem = pa.RecordBatch.from_pydict({
+    lineitem = _rb({
         "l_orderkey": orderkeys,
         "l_partkey": rng.integers(0, n_part, n_li).astype(np.int64),
         "l_suppkey": rng.integers(0, n_supp, n_li).astype(np.int64),
@@ -131,7 +145,7 @@ def gen_tables(lineitem_rows: int = 1 << 20, seed: int = 42) -> dict:
     # ≡ 2 mod 3 here) — what keeps Q13's zero bucket and Q22's NOT EXISTS
     # leg populated.
     ock = rng.integers(0, max(n_cust * 2 // 3, 1), n_ord)
-    orders = pa.RecordBatch.from_pydict({
+    orders = _rb({
         "o_orderkey": np.arange(n_ord, dtype=np.int64),
         "o_custkey": (ock + ock // 2).astype(np.int64),
         "o_orderdate": date(8300, 10600, n_ord),

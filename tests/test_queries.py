@@ -93,6 +93,18 @@ class TestAggregate:
             lambda s: s.create_dataframe(small_table())
             .group_by().agg(*self._aggs()))
 
+    def test_nan_min_max_spark_semantics(self):
+        """Spark: NaN orders GREATEST — max is NaN when any contribution
+        is, min only when all are. Round-5 regression: the pyarrow host
+        oracle silently skipped NaN and disagreed with the device."""
+        data = {"k": [1, 1, 2, 3, 3, 4],
+                "d": [3.45, float("nan"), 7.0, float("nan"), float("nan"),
+                      None]}
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(data).group_by(col("k")).agg(
+                AGG.AggregateExpression(AGG.Max(col("d")), "mx"),
+                AGG.AggregateExpression(AGG.Min(col("d")), "mn")))
+
     def test_global_agg_empty_input(self):
         assert_tpu_and_cpu_are_equal(
             lambda s: s.create_dataframe(small_table())
