@@ -215,6 +215,15 @@ PARQUET_DEVICE_DECODE = conf_bool(
     "Table.readParquet split, GpuParquetScan.scala:365-388). Row groups "
     "outside the decoder's scope fall back to the host reader per unit.")
 
+PARQUET_REBASE_READ = conf_str(
+    "spark.sql.legacy.parquet.datetimeRebaseModeInRead", "EXCEPTION",
+    "Spark's own rebase-mode key, honored by the device parquet reader "
+    "(the RebaseHelper.scala:60 guard): EXCEPTION raises on "
+    "legacy-calendar files whose date/timestamp statistics reach below "
+    "the 1582-10-15 / 1900-01-01 switchover (this reader never "
+    "rebases), CORRECTED reads raw proleptic values, LEGACY is "
+    "unsupported.")
+
 CSV_DEVICE_DECODE = conf_bool(
     "spark.rapids.sql.csv.deviceDecode.enabled", True,
     "Parse CSV ON DEVICE (the GpuBatchScanExec.scala:87 cudf-csv role): "

@@ -1062,11 +1062,9 @@ def hash_join_kernel(jt: str, lkeys: List[Expression],
             lo, exp_counts, build_at_rank, out_cap)
         real = matched[p_idx]
         out_live = jnp.arange(out_cap, dtype=jnp.int32) < n_out
-        pcols = [KR.gather_column(c, p_idx, out_live)
-                 for c in probe.columns]
-        bcols = [KR.gather_column(c, b_idx, out_live & real)
-                 for c in build.columns]
-        out = ColumnarBatch(tuple(pcols + bcols), n_out, out_schema)
+        pcols = KR.gather_columns(probe.columns, p_idx, out_live)
+        bcols = KR.gather_columns(build.columns, b_idx, out_live & real)
+        out = ColumnarBatch(tuple(pcols) + tuple(bcols), n_out, out_schema)
         return (out, hits), total
 
     return cached_kernel(

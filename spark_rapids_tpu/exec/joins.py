@@ -158,9 +158,9 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             p_idx = jnp.repeat(jnp.arange(pcap, dtype=jnp.int32), bcap)
             b_idx = jnp.tile(jnp.arange(bcap, dtype=jnp.int32), pcap)
             live = probe.row_mask()[p_idx] & build.row_mask()[b_idx]
-            pcols = [KR.gather_column(c, p_idx, live) for c in probe.columns]
-            bcols = [KR.gather_column(c, b_idx, live) for c in build.columns]
-            pairs = ColumnarBatch(tuple(pcols + bcols),
+            pcols = KR.gather_columns(probe.columns, p_idx, live)
+            bcols = KR.gather_columns(build.columns, b_idx, live)
+            pairs = ColumnarBatch(tuple(pcols) + tuple(bcols),
                                   jnp.asarray(n_pairs, jnp.int32), pair_schema)
             if cond is not None:
                 m = cond.eval_device(pairs)
@@ -184,10 +184,8 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             out_live = jnp.arange(out_cap, dtype=jnp.int32) < n_match
             sp_idx = p_idx[sel]
             sb_idx = b_idx[sel]
-            ocols = [KR.gather_column(c, sp_idx, out_live)
-                     for c in probe.columns]
-            ocols += [KR.gather_column(c, sb_idx, out_live)
-                      for c in build.columns]
+            ocols = KR.gather_columns(probe.columns, sp_idx, out_live) \
+                + KR.gather_columns(build.columns, sb_idx, out_live)
             out = ColumnarBatch(tuple(ocols),
                                 jnp.minimum(n_match, out_cap).astype(jnp.int32),
                                 out_schema)

@@ -31,6 +31,7 @@ encodings, flat schemas, dictionary bit widths <= 24.
 from __future__ import annotations
 
 import dataclasses
+import datetime as _dt
 import struct as _struct
 from typing import Dict, List, Optional, Tuple
 
@@ -552,6 +553,70 @@ def decode_row_group(path: str, row_group: int, schema: T.Schema,
                          schema)
 
 
+class SparkUpgradeError(RuntimeError):
+    """Ambiguous legacy-calendar datetimes (the SparkUpgradeException the
+    reference raises via RebaseHelper.newRebaseExceptionInRead)."""
+
+
+#: Proleptic/Julian switchover bounds (RebaseDateTime.lastSwitchJulianDay/
+#: Ts): dates before 1582-10-15 and timestamps before 1900-01-01 differ
+#: between the hybrid and proleptic Gregorian calendars.
+_JULIAN_SWITCH_DATE = _dt.date(1582, 10, 15)
+_JULIAN_SWITCH_TS = _dt.datetime(1900, 1, 1)
+_LEGACY_MARKER = b"org.apache.spark.legacyDateTime"
+
+
+def rebase_guard(meta, schema: T.Schema, mode: str, path: str) -> None:
+    """The RebaseHelper.isDateTimeRebaseNeededRead analog
+    (reference RebaseHelper.scala:60,82): files written by Spark 2.x /
+    legacy Hive carry the legacyDateTime marker and a hybrid-calendar
+    encoding for ancient datetimes. This reader never rebases, so under
+    the default EXCEPTION mode a marked file whose date/timestamp
+    statistics reach (or may reach — stats absent) below the 1582-10-15 /
+    1900-01-01 switchover raises instead of silently mis-reading;
+    CORRECTED reads raw values as proleptic, LEGACY is unsupported."""
+    mode = (mode or "EXCEPTION").upper()
+    if mode == "CORRECTED":
+        return
+    kv = meta.metadata or {}
+    if _LEGACY_MARKER not in kv:
+        return      # proleptic writer: nothing ambiguous
+    if mode == "LEGACY":
+        raise SparkUpgradeError(
+            f"{path}: LEGACY datetime rebase is not supported on the TPU "
+            "parquet reader (reference raises the same; "
+            "RebaseHelper.scala:66). Set "
+            "spark.sql.legacy.parquet.datetimeRebaseModeInRead=CORRECTED "
+            "to read raw proleptic values.")
+    dt_names = {f.name for f in schema
+                if f.data_type in (T.DATE, T.TIMESTAMP)}
+    if not dt_names:
+        return
+    for rg in range(meta.num_row_groups):
+        md = meta.row_group(rg)
+        for ci in range(md.num_columns):
+            c = md.column(ci)
+            if c.path_in_schema not in dt_names:
+                continue
+            st = c.statistics
+            ancient = True      # stats absent: conservative
+            if st is not None and st.has_min_max:
+                mn = st.min
+                if isinstance(mn, _dt.datetime):
+                    ancient = mn.replace(tzinfo=None) < _JULIAN_SWITCH_TS
+                elif isinstance(mn, _dt.date):
+                    ancient = mn < _JULIAN_SWITCH_DATE
+            if ancient:
+                raise SparkUpgradeError(
+                    f"{path}: reading dates before 1582-10-15 or "
+                    "timestamps before 1900-01-01T00:00:00Z from parquet "
+                    "files written with the legacy hybrid calendar is "
+                    "ambiguous (SPARK-31404); this reader does not rebase. "
+                    "Set spark.sql.legacy.parquet."
+                    "datetimeRebaseModeInRead=CORRECTED to read the raw "
+                    "values as-is.")
+
+
 class TpuParquetScanExec:
     """Device parquet scan: one partition per (file, row group); each batch
     decodes ON DEVICE from uploaded page bytes (the GpuParquetScan +
@@ -592,6 +657,8 @@ class TpuParquetScanExec:
 
     def execute(self, ctx):
         import pyarrow.parquet as pq
+        from ..config import PARQUET_REBASE_READ
+        rebase_mode = ctx.conf.get(PARQUET_REBASE_READ)
         units = []
         for path in self.files:
             cached = self._pf_cache.get(path)
@@ -599,6 +666,9 @@ class TpuParquetScanExec:
                 with pq.ParquetFile(path) as pf:
                     cached = (pf.metadata, pf.schema)
             meta, pq_schema = cached
+            # Raised HERE, outside the per-row-group fallback, so the
+            # ambiguity error cannot be swallowed by the host-read path.
+            rebase_guard(meta, self._schema, rebase_mode, path)
             units.extend((path, meta, pq_schema, rg)
                          for rg in range(meta.num_row_groups))
 

@@ -302,8 +302,8 @@ def dense_join_swapped(probe, build, pk: DeviceColumn, bk: DeviceColumn,
     bslot = jnp.where(in_range_b, kb, 0).astype(jnp.int32)
     matched = in_range_b & (cnt_tbl[bslot] > 0)
     probe_row = jnp.clip(row_tbl[bslot], 0, cap_p - 1)
-    pcols = tuple(gather_column(c, probe_row, matched)
-                  for c in probe.columns)
+    from .rowops import gather_columns
+    pcols = gather_columns(probe.columns, probe_row, matched)
     return ColumnarBatch(pcols + tuple(build.columns),
                          jnp.sum(matched.astype(jnp.int32)), out_schema,
                          live=matched), fail
@@ -367,9 +367,8 @@ def dense_join(jt: str, probe, build, pk: DeviceColumn, bk: DeviceColumn,
                              live=keep), fail
     build_row = jnp.clip(row_tbl[pslot], 0, cap_b - 1)
     bvalid = matched
-    from .rowops import gather_column
-    bcols = tuple(gather_column(c, build_row, bvalid)
-                  for c in build.columns)
+    from .rowops import gather_columns
+    bcols = gather_columns(build.columns, build_row, bvalid)
     keep = matched if jt == "inner" else live_p
     return ColumnarBatch(tuple(probe.columns) + bcols,
                          jnp.sum(keep.astype(jnp.int32)), out_schema,

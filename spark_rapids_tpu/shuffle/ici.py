@@ -55,14 +55,42 @@ def build_send_buffers(values, validity, part_id: jnp.ndarray,
                        pid * bucket_cap + rank,
                        n_parts * bucket_cap)
 
-    def scatter(v):
-        flat = jnp.zeros((n_parts * bucket_cap,), dtype=v.dtype)
-        flat = flat.at[target].set(v, mode="drop")
-        return flat.reshape(n_parts, bucket_cap)
+    # Scatter lanes DTYPE-BATCHED: one 2D scatter per dtype instead of one
+    # kernel launch per column (~7ms each on TPU at 1M rows).
+    leaves, treedef = jax.tree_util.tree_flatten(values)
+    leaves = leaves + [validity & live]
 
-    send_values = jax.tree_util.tree_map(scatter, values)
-    send_valid = scatter(validity & live)
+    def scatter_many(st):       # [cap, B] -> [n_parts, bucket_cap, B]
+        flat = jnp.zeros((n_parts * bucket_cap, st.shape[1]), st.dtype)
+        flat = flat.at[target].set(st, mode="drop")
+        return flat.reshape(n_parts, bucket_cap, st.shape[1])
+
+    out = _dtype_batched(
+        leaves,
+        one=lambda v: jnp.zeros((n_parts * bucket_cap,), v.dtype)
+        .at[target].set(v, mode="drop").reshape(n_parts, bucket_cap),
+        many=scatter_many)
+    send_valid = out.pop()
+    send_values = jax.tree_util.tree_unflatten(treedef, out)
     return send_values, send_valid, overflow
+
+
+def _dtype_batched(leaves, one, many):
+    """Run ``many`` on dtype-grouped stacks of 1D lanes (falling back to
+    ``one`` for singleton groups); returns per-leaf results in order."""
+    out = [None] * len(leaves)
+    groups = {}
+    for i, leaf in enumerate(leaves):
+        groups.setdefault(leaf.dtype.name, []).append(i)
+    for idxs in groups.values():
+        if len(idxs) == 1:
+            out[idxs[0]] = one(leaves[idxs[0]])
+            continue
+        st = jnp.stack([leaves[i] for i in idxs], axis=1)
+        m = many(st)
+        for j, i in enumerate(idxs):
+            out[i] = m[..., j]
+    return out
 
 
 def exchange(send_values, send_valid, axis_name: str = PART_AXIS):
@@ -89,6 +117,9 @@ def flatten_received(recv_values, recv_valid):
     _, perm = jax.lax.sort((drop, iota), num_keys=1, is_stable=True)
     n_live = jnp.sum(valid.astype(jnp.int32))
 
-    def gather(x):
-        return x[perm]
-    return jax.tree_util.tree_map(gather, values), valid[perm], n_live
+    leaves, treedef = jax.tree_util.tree_flatten(values)
+    leaves = leaves + [valid]
+    out = _dtype_batched(leaves, one=lambda x: x[perm],
+                         many=lambda st: st[perm])
+    valid_out = out.pop()
+    return jax.tree_util.tree_unflatten(treedef, out), valid_out, n_live

@@ -66,6 +66,7 @@ class TestExternalSort:
         assert _norm(wd["f"]) == _norm(gd["f"])
         assert wd["k"] == gd["k"]
 
+    @pytest.mark.slow
     def test_multi_run_merge_differential(self):
         # repartition(6) forces SIX input partitions -> six sorted runs, so
         # sorted_chunks must drive the binary merge tree (_merge_two) —

@@ -145,8 +145,13 @@ class TestMeshJoin:
         })
         return probe, build
 
-    @pytest.mark.parametrize("how", ["inner", "left", "right", "full",
-                                     "left_semi", "left_anti"])
+    @pytest.mark.parametrize(
+        "how",
+        ["inner", "left_semi",
+         pytest.param("left", marks=pytest.mark.slow),
+         pytest.param("right", marks=pytest.mark.slow),
+         pytest.param("full", marks=pytest.mark.slow),
+         pytest.param("left_anti", marks=pytest.mark.slow)])
     def test_shuffled_join_types(self, how):
         probe, build = self._tables()
 
@@ -214,6 +219,7 @@ class TestMeshStrings:
                  AGG.AggregateExpression(AGG.Count(), "c"),
                  AGG.AggregateExpression(AGG.Min(col("v")), "mn"))))
 
+    @pytest.mark.slow
     def test_string_join_key_and_payload(self):
         rng = np.random.default_rng(12)
         n, m = 8_000, 23
@@ -463,8 +469,13 @@ class TestMeshTpch:
         return (tpch.load(cpu, tables), tpch.load(mesh, tables),
                 mesh)
 
-    @pytest.mark.parametrize("name", ["q1", "q3", "q5", "q6", "q10",
-                                      "q16"])
+    @pytest.mark.parametrize(
+        "name",
+        ["q1", "q6",        # grouped agg + in-mesh sort; global agg psum
+         pytest.param("q3", marks=pytest.mark.slow),
+         pytest.param("q5", marks=pytest.mark.slow),
+         pytest.param("q10", marks=pytest.mark.slow),
+         pytest.param("q16", marks=pytest.mark.slow)])
     def test_tpch_mesh_differential(self, tpch_envs, name):
         from spark_rapids_tpu.workloads import tpch
         from spark_rapids_tpu.workloads.compare import tables_match

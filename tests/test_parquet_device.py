@@ -173,3 +173,79 @@ def test_plain_fallback_pages(tmp_path):
     pq.write_table(tbl, path, use_dictionary=False)
     assert_tpu_and_cpu_are_equal(
         lambda s: s.read.parquet(path).select(col("i"), col("f"), col("s")))
+
+
+class TestRebaseGuard:
+    """RebaseHelper.scala:60 analog: legacy-calendar files with ancient
+    datetimes must raise under EXCEPTION mode, read raw under CORRECTED,
+    and reject LEGACY — never silently mis-read."""
+
+    def _legacy_file(self, tmp_path, dates):
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        t = pa.table({"d": pa.array(dates, pa.date32()),
+                      "v": pa.array(list(range(len(dates))), pa.int64())})
+        t = t.replace_schema_metadata(
+            {b"org.apache.spark.legacyDateTime": b""})
+        path = str(tmp_path / "legacy.parquet")
+        pq.write_table(t, path)
+        return path
+
+    def _scan(self, session, path):
+        from spark_rapids_tpu.ops import predicates as P
+        from spark_rapids_tpu.ops.expression import col
+        return session.read.parquet(path).where(P.IsNotNull(col("v")))
+
+    def test_ancient_dates_raise_by_default(self, tmp_path):
+        import datetime
+        from harness import tpu_session
+        from spark_rapids_tpu.io.parquet_device import SparkUpgradeError
+        path = self._legacy_file(
+            tmp_path, [datetime.date(1500, 1, 1), datetime.date(2020, 1, 1)])
+        s = tpu_session()
+        with pytest.raises(SparkUpgradeError, match="1582"):
+            self._scan(s, path).collect()
+
+    def test_corrected_mode_reads_raw(self, tmp_path):
+        import datetime
+        from harness import cpu_session, tpu_session
+        path = self._legacy_file(
+            tmp_path, [datetime.date(1500, 1, 1), datetime.date(2020, 1, 1)])
+        s = tpu_session(**{
+            "spark.sql.legacy.parquet.datetimeRebaseModeInRead": "CORRECTED"})
+        got = self._scan(s, path).collect().sort_by([("v", "ascending")])
+        want = self._scan(cpu_session(), path).collect().sort_by(
+            [("v", "ascending")])
+        assert got.to_pydict() == want.to_pydict()
+
+    def test_modern_legacy_file_passes(self, tmp_path):
+        import datetime
+        from harness import tpu_session
+        path = self._legacy_file(
+            tmp_path, [datetime.date(1990, 5, 4), datetime.date(2020, 1, 1)])
+        s = tpu_session()
+        out = self._scan(s, path).collect()
+        assert out.num_rows == 2
+
+    def test_unmarked_file_never_raises(self, tmp_path):
+        import datetime
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from harness import tpu_session
+        t = pa.table({"d": pa.array([datetime.date(1500, 1, 1)],
+                                    pa.date32()),
+                      "v": pa.array([1], pa.int64())})
+        path = str(tmp_path / "modern.parquet")
+        pq.write_table(t, path)
+        out = self._scan(tpu_session(), path).collect()
+        assert out.num_rows == 1
+
+    def test_legacy_mode_rejected(self, tmp_path):
+        import datetime
+        from harness import tpu_session
+        from spark_rapids_tpu.io.parquet_device import SparkUpgradeError
+        path = self._legacy_file(tmp_path, [datetime.date(2020, 1, 1)])
+        s = tpu_session(**{
+            "spark.sql.legacy.parquet.datetimeRebaseModeInRead": "LEGACY"})
+        with pytest.raises(SparkUpgradeError, match="LEGACY"):
+            self._scan(s, path).collect()

@@ -27,8 +27,7 @@ def envs():
 #: windows, intersect/except semi-anti chains, inventory, null-fk counts,
 #: full-outer overlap, bucket cross-joins). The long tail runs under
 #: ``-m "slow or not slow"``.
-FAST = {"q1", "q3", "q6", "q18", "q22", "q36", "q44", "q49", "q51",
-        "q76", "q88", "q98"}
+FAST = {"q1", "q3", "q6", "q36", "q44", "q51", "q88", "q98"}
 
 
 @pytest.mark.parametrize(

@@ -19,7 +19,16 @@ def envs():
     return tpcxbb.load(cpu, tables), tpcxbb.load(tpu, tables)
 
 
-@pytest.mark.parametrize("name", sorted(tpcxbb.QUERIES))
+#: Default-tier subset: the bench's three shapes (category agg q01,
+#: ML feature build q05, sessionization q30); the other 27 run under
+#: ``-m "slow or not slow"``.
+FAST = {"q01", "q05", "q30"}
+
+
+@pytest.mark.parametrize(
+    "name",
+    [n if n in FAST else pytest.param(n, marks=pytest.mark.slow)
+     for n in sorted(tpcxbb.QUERIES)])
 def test_query_differential(envs, name):
     cpu_t, tpu_t = envs
     q = tpcxbb.QUERIES[name]

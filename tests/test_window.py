@@ -227,6 +227,7 @@ class TestChunkedWindow:
     the shared partition keys through the spill catalog and evaluate
     complete key groups chunk by chunk (round-4 VERDICT item 10)."""
 
+    @pytest.mark.slow
     def test_chunked_matches_oracle_and_spills(self, tmp_path):
         import numpy as np
         import pyarrow as pa
