@@ -1,0 +1,10 @@
+"""Static plan analysis — post-planning verification of physical plans.
+
+The reference proves convertibility statically (GpuOverrides tagging)
+*before* execution; :mod:`.plan_lint` is the complementary pass that
+re-verifies the invariants of the plan that planning and the TPU rewrite
+actually produced. See docs/plan-lint.md.
+"""
+
+from .plan_lint import (PlanLintError, PlanLintViolation,  # noqa: F401
+                        lint_plan, verify_plan)

@@ -1,0 +1,520 @@
+"""Plan-lint — a static schema/partitioning verifier for physical plans.
+
+The reference's tagging pass (``GpuOverrides``) statically proves every
+operator convertible *before* execution; nothing in this engine re-verified
+the plan the rewrite actually produced, so a bug in a rule (or an encoder
+declaring one physical width and serializing another) shipped as a
+successful query. This module is the missing static layer: a post-planning
+walk that checks, per node,
+
+* **schema consistency** — declared output schema vs child schemas (dtype,
+  nullability direction, field order) for every node that *stores* a schema
+  rather than deriving it (unions, joins, windows, expand, generate), plus
+  reference resolution: every ``AttributeReference`` must name a column of
+  the node's input and every ``BoundReference`` ordinal/dtype must agree
+  with the input field it points at;
+* **cast-lattice legality** — every ``Cast`` in the plan must be a pair the
+  engine's cast matrix (:mod:`..ops.cast`) actually implements, so illegal
+  casts fail at plan time instead of as a mid-query ``NotImplementedError``;
+* **host/device transition correctness** — a node consumes device batches
+  iff its children produce them; ``HostToDeviceExec``/``DeviceToHostExec``
+  are the only legal flips, and the plan root must be host-side;
+* **partitioning contracts** — when both inputs of a shuffled hash join are
+  hash-partitioned exchanges they must agree on partition count and be
+  partitioned on the join keys (both warn: this single-process engine
+  materializes whole sides, so misaligned partitioning degrades, not
+  corrupts; CI promotes via ``planLint.failOnWarn``);
+* **writer physical-type consistency** — the parquet physical type width
+  each column *declares* must equal the width the device encoder actually
+  serializes, and ConvertedType annotations must match the parquet spec.
+  The spec constants here are declared independently of
+  :mod:`..io.parquet_encode` on purpose: the verifier re-derives, it does
+  not trust (this exact class of bug silently corrupted smallint/tinyint
+  writes before this pass existed).
+
+Violations carry the offending node path. Error severity raises
+:class:`PlanLintError`; warn severity is returned to the caller
+(``TpuSession.plan`` logs and falls back to the CPU plan). Config:
+``spark.rapids.tpu.planLint.enabled`` / ``...planLint.failOnWarn``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from .. import types as T
+from ..ops.cast import Cast
+from ..ops.expression import AttributeReference, BoundReference, Expression
+
+ERROR = "error"
+WARN = "warn"
+
+
+@dataclasses.dataclass(frozen=True)
+class PlanLintViolation:
+    check: str  # schema | cast | transition | partitioning | writer-width
+                # | internal (a lint pass itself could not run)
+    severity: str   # error | warn
+    node_path: str  # e.g. "DeviceToHostExec/TpuProjectExec[0]"
+    message: str
+
+    def __str__(self) -> str:
+        return f"[{self.severity}] {self.check} at {self.node_path}: " \
+               f"{self.message}"
+
+
+class PlanLintError(Exception):
+    """One or more error-severity plan-lint violations."""
+
+    def __init__(self, violations: List[PlanLintViolation]):
+        self.violations = list(violations)
+        super().__init__(
+            "plan verification failed:\n  "
+            + "\n  ".join(str(v) for v in violations))
+
+
+# ---------------------------------------------------------------------------
+# Cast lattice (mirrors what ops/cast.py implements on BOTH paths)
+# ---------------------------------------------------------------------------
+
+_NUMERIC_ISH = frozenset(
+    ["boolean", "tinyint", "smallint", "int", "bigint", "float", "double"])
+_STRING_PARSE_TARGETS = _NUMERIC_ISH | {"date", "timestamp"}
+_STRING_FORMAT_SOURCES = _NUMERIC_ISH | {"date", "timestamp"}
+
+
+def legal_cast(src: T.DataType, to: T.DataType) -> bool:
+    """True when the engine's cast matrix implements src -> to."""
+    if isinstance(src, (T.ArrayType, T.StructType)) \
+            or isinstance(to, (T.ArrayType, T.StructType)):
+        return src.name == to.name
+    if src.name == to.name or src is T.NULL:
+        return True
+    if src.name in _NUMERIC_ISH and to.name in _NUMERIC_ISH:
+        return True
+    if src is T.STRING and to.name in _STRING_PARSE_TARGETS:
+        return True
+    if to is T.STRING and src.name in _STRING_FORMAT_SOURCES:
+        return True
+    if (src is T.DATE and to is T.TIMESTAMP) \
+            or (src is T.TIMESTAMP and to is T.DATE):
+        return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Parquet spec constants — independent copies (see module doc)
+# ---------------------------------------------------------------------------
+
+#: physical type code -> PLAIN value width in bytes (None: bit-/length-coded)
+_SPEC_PHYS_WIDTH = {0: None, 1: 4, 2: 8, 4: 4, 5: 8, 6: None}
+#: engine type name -> ConvertedType the parquet spec assigns it
+_SPEC_CONVERTED = {"int": None, "bigint": None, "float": None, "double": None,
+                   "boolean": None, "date": 6, "timestamp": 10,
+                   "smallint": 16, "tinyint": 15, "string": 0}
+
+
+# ---------------------------------------------------------------------------
+# Plan walking helpers
+# ---------------------------------------------------------------------------
+
+
+def _node_path(path: List[str]) -> str:
+    return "/".join(path) if path else "<root>"
+
+
+def _expr_lists(node) -> List[Tuple[Expression, Optional[T.Schema]]]:
+    """(expression, input schema it resolves against) pairs for one node.
+
+    Attribute names are shared between the Cpu and Tpu exec variants, so a
+    generic attribute sweep covers both sides of every rewrite rule."""
+    out: List[Tuple[Expression, Optional[T.Schema]]] = []
+    child = node.children[0].schema if node.children else None
+    combined = None
+    if len(node.children) == 2:
+        combined = T.Schema(list(node.children[0].schema)
+                            + list(node.children[1].schema))
+    for e in getattr(node, "exprs", []) or []:
+        out.append((e, child))
+    cond = getattr(node, "condition", None)
+    if isinstance(cond, Expression):
+        out.append((cond, combined if combined is not None else child))
+    for g in getattr(node, "groupings", []) or []:
+        out.append((g, child))
+    for a in getattr(node, "aggregates", []) or []:
+        fn = getattr(a, "func", None)
+        if isinstance(fn, Expression):
+            out.append((fn, child))
+    if len(node.children) == 2:
+        left = node.children[0].schema
+        right = node.children[1].schema
+        for k in getattr(node, "left_keys", []) or []:
+            out.append((k, left))
+        for k in getattr(node, "right_keys", []) or []:
+            out.append((k, right))
+    for o in getattr(node, "orders", []) or []:
+        out.append((o.child, child))
+    for _, we in getattr(node, "window_exprs", []) or []:
+        for c in we.func.children:
+            out.append((c, child))
+        for e in we.spec.partition_by:
+            out.append((e, child))
+        for o in we.spec.order_by:
+            out.append((o.child, child))
+    for proj in getattr(node, "projections", []) or []:
+        for e in proj:
+            out.append((e, child))
+    gen = getattr(node, "generator", None)
+    if isinstance(gen, Expression):
+        out.append((gen, child))
+    factory = getattr(node, "partitioner_factory", None)
+    if factory is not None:
+        for k in getattr(factory, "keys", None) or []:
+            out.append((k, child))
+        for o in getattr(factory, "orders", None) or []:
+            out.append((o.child, child))
+    return out
+
+
+def _walk_expr(e: Expression):
+    yield e
+    for c in e.children:
+        yield from _walk_expr(c)
+
+
+def _nullable_ok(child_field: T.StructField, out_field: T.StructField) -> bool:
+    """Nullability may widen (False -> True) across a node, never narrow:
+    a nullable input feeding a non-nullable declared output can produce
+    nulls where the schema promises none."""
+    return out_field.nullable or not child_field.nullable
+
+
+# ---------------------------------------------------------------------------
+# Per-check passes
+# ---------------------------------------------------------------------------
+
+
+def _check_expressions(node, path, out: List[PlanLintViolation]):
+    for expr, schema in _expr_lists(node):
+        for e in _walk_expr(expr):
+            if isinstance(e, Cast):
+                try:
+                    src = e.child.data_type
+                except Exception:
+                    continue  # unresolved subtree; legality unknowable here
+                if src is not None and not legal_cast(src, e.to):
+                    out.append(PlanLintViolation(
+                        "cast", ERROR, _node_path(path),
+                        f"illegal cast {src} -> {e.to} in {expr}"))
+            elif isinstance(e, AttributeReference) and schema is not None:
+                if schema.field_maybe(e._name) is None:
+                    out.append(PlanLintViolation(
+                        "schema", ERROR, _node_path(path),
+                        f"column {e._name!r} referenced by {expr} is not "
+                        f"in the input schema {schema}"))
+            elif isinstance(e, BoundReference) and schema is not None:
+                if not 0 <= e.ordinal < len(schema):
+                    out.append(PlanLintViolation(
+                        "schema", ERROR, _node_path(path),
+                        f"bound ordinal {e.ordinal} out of range for input "
+                        f"schema of {len(schema)} columns"))
+                elif schema[e.ordinal].data_type.name != e.data_type.name \
+                        and e.data_type is not T.NULL:
+                    out.append(PlanLintViolation(
+                        "schema", ERROR, _node_path(path),
+                        f"bound ordinal {e.ordinal} declares "
+                        f"{e.data_type} but the input column "
+                        f"{schema[e.ordinal].name!r} is "
+                        f"{schema[e.ordinal].data_type}"))
+
+
+def _check_schema(node, path, out: List[PlanLintViolation]):
+    name = type(node).__name__
+    try:
+        schema = node.schema
+    except Exception as e:  # schema must always be derivable statically
+        out.append(PlanLintViolation(
+            "schema", ERROR, _node_path(path),
+            f"output schema is not derivable: {e!r}"))
+        return
+    if "UnionExec" in name:
+        for i, c in enumerate(node.children):
+            cs = c.schema
+            if len(cs) != len(schema):
+                out.append(PlanLintViolation(
+                    "schema", ERROR, _node_path(path),
+                    f"union child {i} has {len(cs)} columns, output "
+                    f"declares {len(schema)}"))
+                continue
+            for cf, of in zip(cs, schema):
+                if not legal_cast(cf.data_type, of.data_type):
+                    out.append(PlanLintViolation(
+                        "schema", ERROR, _node_path(path),
+                        f"union child {i} column {cf.name!r}: "
+                        f"{cf.data_type} cannot cast to declared "
+                        f"{of.data_type}"))
+        return
+    if _is_equi_join(node) or "NestedLoopJoin" in name \
+            or "CartesianProduct" in name:
+        jt = getattr(node, "join_type", "inner")
+        left, right = node.children[0].schema, node.children[1].schema
+        expect = list(left) if jt in ("left_semi", "left_anti") \
+            else list(left) + list(right)
+        if len(schema) != len(expect):
+            out.append(PlanLintViolation(
+                "schema", ERROR, _node_path(path),
+                f"{jt} join declares {len(schema)} output columns, "
+                f"children supply {len(expect)}"))
+            return
+        for i, (cf, of) in enumerate(zip(expect, schema)):
+            if cf.data_type.name != of.data_type.name:
+                out.append(PlanLintViolation(
+                    "schema", ERROR, _node_path(path),
+                    f"join output column {i} ({of.name!r}) declares "
+                    f"{of.data_type} but the child supplies "
+                    f"{cf.data_type}"))
+            elif not _nullable_ok(cf, of):
+                out.append(PlanLintViolation(
+                    "schema", ERROR, _node_path(path),
+                    f"join output column {i} ({of.name!r}) declares "
+                    f"non-nullable but the child column is nullable"))
+        return
+    if "WindowExec" in name:
+        child = node.children[0].schema
+        if len(schema) < len(child):
+            out.append(PlanLintViolation(
+                "schema", ERROR, _node_path(path),
+                f"window output drops child columns ({len(schema)} < "
+                f"{len(child)})"))
+            return
+        for i, (cf, of) in enumerate(zip(child, schema)):
+            if cf.data_type.name != of.data_type.name \
+                    or not _nullable_ok(cf, of):
+                out.append(PlanLintViolation(
+                    "schema", ERROR, _node_path(path),
+                    f"window pass-through column {i} ({of.name!r}) "
+                    f"declares {of.data_type} but the child supplies "
+                    f"{cf.data_type}"))
+        return
+    if "ExpandExec" in name:
+        for pi, proj in enumerate(getattr(node, "projections", []) or []):
+            if len(proj) != len(schema):
+                out.append(PlanLintViolation(
+                    "schema", ERROR, _node_path(path),
+                    f"expand projection {pi} has {len(proj)} expressions, "
+                    f"output declares {len(schema)} columns"))
+                continue
+            for e, of in zip(proj, schema):
+                try:
+                    dt = e.data_type
+                except Exception:
+                    continue
+                if not legal_cast(dt, of.data_type):
+                    out.append(PlanLintViolation(
+                        "schema", ERROR, _node_path(path),
+                        f"expand projection {pi} column {of.name!r}: "
+                        f"{dt} cannot cast to declared {of.data_type}"))
+        return
+    if "GenerateExec" in name:
+        child = node.children[0].schema
+        for i, (cf, of) in enumerate(zip(child, schema)):
+            if cf.data_type.name != of.data_type.name:
+                out.append(PlanLintViolation(
+                    "schema", ERROR, _node_path(path),
+                    f"generate pass-through column {i} ({of.name!r}) "
+                    f"declares {of.data_type} but the child supplies "
+                    f"{cf.data_type}"))
+        return
+
+
+def _check_transitions(node, path, parent_wants: Optional[bool],
+                       out: List[PlanLintViolation]):
+    name = type(node).__name__
+    columnar = bool(getattr(node, "columnar", False))
+    if parent_wants is not None and columnar != parent_wants:
+        want = "device (columnar)" if parent_wants else "host"
+        have = "device" if columnar else "host"
+        out.append(PlanLintViolation(
+            "transition", ERROR, _node_path(path),
+            f"parent consumes {want} batches but this node produces "
+            f"{have} batches — missing "
+            f"{'HostToDeviceExec' if parent_wants else 'DeviceToHostExec'}"))
+    if name == "HostToDeviceExec":
+        wants = False
+    elif name == "DeviceToHostExec":
+        wants = True
+    else:
+        wants = bool(getattr(node, "children_columnar", columnar))
+    for i, c in enumerate(node.children):
+        _check_transitions(c, path + [f"{type(c).__name__}[{i}]"], wants, out)
+
+
+def _is_equi_join(node) -> bool:
+    return type(node).__name__ in (
+        "CpuJoinExec", "CpuBroadcastHashJoinExec",
+        "TpuShuffledHashJoinExec", "TpuBroadcastHashJoinExec")
+
+
+def _is_shuffled_join(node) -> bool:
+    return type(node).__name__ in ("CpuJoinExec", "TpuShuffledHashJoinExec")
+
+
+#: nodes that pass their single child's partitioning through unchanged
+_PARTITION_PRESERVING = (
+    "CpuFilterExec", "TpuFilterExec", "CpuLocalLimitExec",
+    "TpuLocalLimitExec", "TpuCoalesceBatchesExec", "HostToDeviceExec",
+    "DeviceToHostExec",
+)
+
+
+def _expr_name(e) -> str:
+    return getattr(e, "_name", None) or getattr(e, "name", None) or str(e)
+
+
+def _partitioning(node):
+    """Output partitioning property, bottom-up (outputPartitioning analog).
+    Returns ("hash", key-name tuple, n_parts) | ("single",) | None."""
+    name = type(node).__name__
+    if "ShuffleExchangeExec" in name:
+        factory = node.partitioner_factory
+        mode = getattr(factory, "mode", None)
+        if mode == "hash":
+            keys = tuple(_expr_name(k)
+                         for k in (getattr(factory, "keys", None) or []))
+            return ("hash", keys, node.n_parts)
+        if mode == "single":
+            return ("single",)
+        return None
+    if name in _PARTITION_PRESERVING and node.children:
+        return _partitioning(node.children[0])
+    if name in ("CpuProjectExec", "TpuProjectExec"):
+        child = _partitioning(node.children[0])
+        if child is not None and child[0] == "hash":
+            names = {_expr_name(e) for e in node.exprs}
+            if all(k in names for k in child[1]):
+                return child
+        return None
+    return None
+
+
+def _check_partitioning(node, path, out: List[PlanLintViolation]):
+    if not _is_shuffled_join(node) or not getattr(node, "left_keys", None):
+        return
+    lp = _partitioning(node.children[0])
+    rp = _partitioning(node.children[1])
+    if lp is None or rp is None or lp[0] != "hash" or rp[0] != "hash":
+        return
+    # Both partitioning violations are WARN: this single-process engine
+    # materializes whole join sides, so a broken co-partitioning contract
+    # degrades (extra shuffle work) rather than corrupts — and
+    # left.repartition(4).join(right.repartition(8)) is a legal API shape
+    # that must keep answering. CI promotes via planLint.failOnWarn.
+    if lp[2] != rp[2]:
+        out.append(PlanLintViolation(
+            "partitioning", WARN, _node_path(path),
+            f"shuffled join inputs are hash-partitioned into {lp[2]} vs "
+            f"{rp[2]} partitions — co-partitioning contract broken"))
+    lkeys = tuple(_expr_name(k) for k in node.left_keys)
+    rkeys = tuple(_expr_name(k) for k in node.right_keys)
+    if lp[1] != lkeys or rp[1] != rkeys:
+        out.append(PlanLintViolation(
+            "partitioning", WARN, _node_path(path),
+            f"shuffled join inputs are hash-partitioned on {lp[1]}/{rp[1]} "
+            f"but joined on {lkeys}/{rkeys}; rows with equal join keys may "
+            f"land in different partitions"))
+
+
+def _check_writer(node, path, out: List[PlanLintViolation]):
+    if type(node).__name__ != "TpuWriteFilesExec" \
+            or getattr(node, "fmt", None) != "parquet":
+        return
+    from ..io import parquet_encode as PE
+    part_cols = set(getattr(node, "partition_by", []) or [])
+    for f in node.children[0].schema:
+        if f.name in part_cols or f.data_type.name not in PE._PHYS:
+            continue
+        phys, conv = PE._PHYS[f.data_type.name]
+        spec_width = _SPEC_PHYS_WIDTH.get(phys)
+        emitted = PE.encoded_value_dtype(f.data_type)
+        if spec_width is not None and (emitted is None
+                                       or emitted.itemsize != spec_width):
+            have = "nothing" if emitted is None \
+                else f"{emitted.itemsize}-byte {emitted} values"
+            out.append(PlanLintViolation(
+                "writer-width", ERROR, _node_path(path),
+                f"column {f.name!r} ({f.data_type}) declares a "
+                f"{spec_width}-byte parquet physical type but the device "
+                f"encoder serializes {have} — readers would see a "
+                f"truncated stream"))
+        spec_conv = _SPEC_CONVERTED.get(f.data_type.name)
+        if conv != spec_conv:
+            out.append(PlanLintViolation(
+                "writer-width", ERROR, _node_path(path),
+                f"column {f.name!r} ({f.data_type}) annotates "
+                f"ConvertedType {conv} but the parquet spec assigns "
+                f"{spec_conv}"))
+
+
+# ---------------------------------------------------------------------------
+# Entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_plan(plan, stage: str = "post-overrides"
+              ) -> List[PlanLintViolation]:
+    """Run every check over the plan; returns all violations (pure)."""
+    out: List[PlanLintViolation] = []
+
+    def guarded(check, node, path):
+        # The verifier must never crash uncontrolled out of session.plan:
+        # a check tripping over an underivable child schema (the child's
+        # own visit reports the root cause) degrades to a structured
+        # violation, not a raw exception.
+        try:
+            check(node, path, out)
+        except Exception as e:
+            out.append(PlanLintViolation(
+                "internal", ERROR, _node_path(path),
+                f"{check.__name__} could not run: {e!r}"))
+
+    def walk(node, path):
+        guarded(_check_schema, node, path)
+        guarded(_check_expressions, node, path)
+        guarded(_check_partitioning, node, path)
+        guarded(_check_writer, node, path)
+        for i, c in enumerate(node.children):
+            walk(c, path + [f"{type(c).__name__}[{i}]"])
+
+    root_path = [type(plan).__name__]
+    walk(plan, root_path)
+    # Transition correctness is a POST-rewrite invariant: the planner's CPU
+    # tree legitimately contains device-resident leaves (DeviceSourceExec
+    # over cached HBM partitions) under host parents — insert_transitions
+    # adds the flips during the overrides pass, so only the rewritten plan
+    # is required to be transition-complete.
+    if stage == "post-overrides":
+        _check_transitions(plan, root_path, None, out)
+        if getattr(plan, "columnar", False):
+            out.append(PlanLintViolation(
+                "transition", ERROR, _node_path(root_path),
+                "plan root produces device batches; the root must be "
+                "host-side (missing DeviceToHostExec)"))
+    return out
+
+
+def verify_plan(plan, conf=None, stage: str = "post-overrides"
+                ) -> List[PlanLintViolation]:
+    """Gated entry: raises :class:`PlanLintError` on error-severity
+    violations (or any violation under planLint.failOnWarn) and returns
+    the surviving warn-severity list for the caller's fallback decision."""
+    from ..config import PLAN_LINT_ENABLED, PLAN_LINT_FAIL_ON_WARN
+    if conf is not None and not conf.get(PLAN_LINT_ENABLED):
+        return []
+    violations = lint_plan(plan, stage)
+    fail_on_warn = conf is not None and conf.get(PLAN_LINT_FAIL_ON_WARN)
+    errors = [v for v in violations
+              if v.severity == ERROR or fail_on_warn]
+    if errors:
+        raise PlanLintError(errors)
+    return [v for v in violations if v.severity == WARN]

@@ -373,6 +373,22 @@ TPU_MESH_ENABLED = conf_bool(
     "engine-integrated form of the reference's GPU-resident shuffle "
     "manager.")
 
+PLAN_LINT_ENABLED = conf_bool(
+    "spark.rapids.tpu.planLint.enabled", True,
+    "Statically verify every physical plan after planning and again after "
+    "the TPU rewrite (analysis/plan_lint.py): per-node schema consistency "
+    "against child schemas, cast-lattice legality, host<->device "
+    "transition correctness, shuffle partitioning contracts at joins, and "
+    "parquet writer physical-type widths. Error-severity violations raise "
+    "PlanLintError with the offending node path; warn-severity violations "
+    "log and fall the query back to the CPU plan. See docs/plan-lint.md.")
+
+PLAN_LINT_FAIL_ON_WARN = conf_bool(
+    "spark.rapids.tpu.planLint.failOnWarn", False,
+    "Promote warn-severity plan-lint violations (which normally log and "
+    "fall back to the CPU plan) to hard PlanLintError failures. Intended "
+    "for CI and tests. See docs/plan-lint.md.")
+
 DEVICE_BACKEND = conf_str(
     "spark.rapids.tpu.backend", None,
     "Force a jax backend for device execution (tpu/cpu). Default: jax default.",

@@ -149,10 +149,31 @@ _PHYS: Dict[str, Tuple[int, Optional[int]]] = {
     "boolean": (_PQ_BOOLEAN, None),
     "date": (_PQ_INT32, 6),            # DATE converted type
     "timestamp": (_PQ_INT64, 10),      # TIMESTAMP_MICROS
-    "smallint": (_PQ_INT32, 15),       # INT_16
-    "tinyint": (_PQ_INT32, 16),        # INT_8
+    "smallint": (_PQ_INT32, 16),       # INT_16
+    "tinyint": (_PQ_INT32, 15),        # INT_8
     "string": (_PQ_BYTE_ARRAY, 0),     # UTF8
 }
+
+#: PLAIN-encoding value dtype per parquet physical type (BOOLEAN and
+#: BYTE_ARRAY are bit-/length-encoded, not fixed-width).
+_PHYS_NP: Dict[int, np.dtype] = {
+    _PQ_INT32: np.dtype(np.int32),
+    _PQ_INT64: np.dtype(np.int64),
+    _PQ_FLOAT: np.dtype(np.float32),
+    _PQ_DOUBLE: np.dtype(np.float64),
+}
+
+
+def encoded_value_dtype(dtype: T.DataType) -> Optional[np.dtype]:
+    """The numpy dtype the PLAIN value stream serializes for one engine
+    type — the declared physical width, not the device lane width
+    (smallint/tinyint lanes are int16/int8 but declare INT32). The plan
+    verifier (analysis/plan_lint.py) cross-checks this against its own
+    copy of the parquet spec widths."""
+    if dtype.name not in _PHYS:
+        return None
+    phys, _ = _PHYS[dtype.name]
+    return _PHYS_NP.get(phys)
 
 
 # ---------------------------------------------------------------------------
@@ -278,6 +299,12 @@ def _plain_values(vals: np.ndarray, dtype: T.DataType, n_valid: int) -> bytes:
     v = vals[:n_valid]
     if dtype is T.BOOLEAN:
         return np.packbits(v.astype(np.uint8), bitorder="little").tobytes()
+    phys_np = encoded_value_dtype(dtype)
+    if phys_np is not None and v.dtype != phys_np:
+        # The device lane is narrower than the declared physical type
+        # (smallint/tinyint are int16/int8 on device, INT32 in the file):
+        # widen to the declared width or readers see a truncated stream.
+        v = v.astype(phys_np)
     return np.ascontiguousarray(v).tobytes()
 
 

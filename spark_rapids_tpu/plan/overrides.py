@@ -625,11 +625,7 @@ class TpuOverrides:
             self.last_explain = meta.explain(all_nodes=(explain == "ALL"))
             if self.last_explain:
                 print(self.last_explain, end="")
-        converted = meta.convert(self.conf)
-        converted = insert_transitions(converted, self.conf.batch_size_rows,
-                                       self.conf)
-        from ..exec.coalesce import insert_coalesce
-        converted = insert_coalesce(converted, self.conf.batch_size_rows)
+        converted = finalize_plan(meta.convert(self.conf), self.conf)
         if self.conf.test_enabled:
             self._assert_on_tpu(converted)
         return converted
@@ -736,6 +732,16 @@ def _device_scan_or_none(node: P.PhysicalPlan, conf: Optional[TpuConf]):
         if not ok:
             return None
     return PD.TpuParquetScanExec(files, node.schema, pf_cache)
+
+
+def finalize_plan(plan: P.PhysicalPlan, conf: TpuConf) -> P.PhysicalPlan:
+    """Make a converted tree executable: insert host/device transitions and
+    batch coalescing. The tail of ``TpuOverrides.apply`` — also used by the
+    session's plan-lint warn-fallback, which must prepare its CPU plan the
+    same way as every other plan the session emits."""
+    from ..exec.coalesce import insert_coalesce
+    plan = insert_transitions(plan, conf.batch_size_rows, conf)
+    return insert_coalesce(plan, conf.batch_size_rows)
 
 
 def insert_transitions(plan: P.PhysicalPlan,

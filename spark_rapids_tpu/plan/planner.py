@@ -80,6 +80,24 @@ def _plan_join(plan: L.Join, conf: TpuConf) -> P.PhysicalPlan:
                plan.schema, plan.condition)
 
 
+def plan_and_verify(plan: L.LogicalPlan,
+                    conf: TpuConf = DEFAULT_CONF) -> P.PhysicalPlan:
+    """Plan to the CPU physical tree and statically verify the result —
+    the planner-side plan-lint hook (the session re-verifies after the
+    TPU rewrite; see analysis/plan_lint.py and docs/plan-lint.md)."""
+    physical = plan_physical(plan, conf)
+    from ..analysis.plan_lint import verify_plan
+    warns = verify_plan(physical, conf, stage="planned")
+    if warns:
+        # No rewritten plan exists yet to fall back from; surface the
+        # warns so direct callers of this hook don't lose them (the
+        # session's post-overrides pass owns the fallback decision).
+        import warnings
+        for w in warns:
+            warnings.warn(f"plan-lint: {w}", stacklevel=2)
+    return physical
+
+
 def plan_physical(plan: L.LogicalPlan,
                   conf: TpuConf = DEFAULT_CONF) -> P.PhysicalPlan:
     if isinstance(plan, L.LocalRelation):

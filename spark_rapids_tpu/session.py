@@ -21,7 +21,6 @@ from .memory.device_manager import DeviceManager
 from .plan import logical as L
 from .plan import physical as P
 from .plan.overrides import TpuOverrides
-from .plan.planner import plan_physical
 
 
 class DataFrameReader:
@@ -101,11 +100,35 @@ class TpuSession:
 
     # -- execution ----------------------------------------------------------
     def plan(self, logical: L.LogicalPlan) -> P.PhysicalPlan:
+        from .analysis.plan_lint import verify_plan
         from .plan.input_file import rewrite_input_file_exprs
         from .plan.optimizer import prune_columns
+        from .plan.planner import plan_and_verify
         logical = rewrite_input_file_exprs(logical)
-        cpu_plan = plan_physical(prune_columns(logical), self.conf)
-        return self._overrides.apply(cpu_plan)
+        cpu_plan = plan_and_verify(prune_columns(logical), self.conf)
+        converted = self._overrides.apply(cpu_plan)
+        # Post-rewrite static verification (docs/plan-lint.md): error
+        # severity raised inside verify_plan; warn severity falls the
+        # query back to the un-rewritten CPU plan.
+        warns = verify_plan(converted, self.conf, stage="post-overrides")
+        if warns:
+            import warnings
+
+            from .analysis.plan_lint import PlanLintError
+            from .plan.overrides import finalize_plan
+            if self.conf.test_enabled:
+                # Test mode promises "no silent CPU fallback"; a silent
+                # warn-fallback here would run the differential harness
+                # CPU-vs-CPU and mask the regression it exists to catch.
+                raise PlanLintError(warns)
+            for w in warns:
+                warnings.warn(f"plan-lint: {w}; falling back to the CPU "
+                              "plan", stacklevel=2)
+            # The CPU tree may still hold device-resident leaves
+            # (DeviceSourceExec); finalize so it is runnable like every
+            # other plan the session emits.
+            return finalize_plan(cpu_plan, self.conf)
+        return converted
 
     #: plan signature -> ({join site ordinal: exact output capacity},
     #: {join site ordinal: dense-mode escalation}). Learned from observed

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
+import weakref
 from typing import Dict, List, Tuple
 
 import jax
@@ -250,7 +251,16 @@ class LoopExpr(Expression):
         transform reaches the same fixpoint on the shared vars)."""
         if self.group.get("types_resolved"):
             return
-        for _ in range(8):
+        # Bound the fixpoint by WORK, not a constant: each round
+        # propagates types at least one hop along the var dependency
+        # chain, and promote() joins directly to the least upper bound
+        # (no one-step-at-a-time climbing), so a var stabilizes within a
+        # round of its support stabilizing — n rounds reach the fixpoint
+        # on any chain, and 3*n+1 leaves margin for pending/NULL
+        # re-visits. A constant cap mistypes long dependency chains
+        # (e.g. v_i seeded NULL and typed only through v_{i+1}) as
+        # unstable.
+        for _ in range(3 * len(self.vars) + 1):
             changed = False
             pending = False
             for v, init, upd in zip(self.vars, self.inits, self.updates):
@@ -286,13 +296,25 @@ class LoopExpr(Expression):
         if _stack():
             return None
         ent = self.group.get((mode, threading.get_ident()))
-        if ent is not None and ent[0] is batch:
+        if ent is not None and ent[0]() is batch:
             return ent[1]
         return None
 
     def _memo_put(self, mode: str, batch, final):
-        if not _stack():
-            self.group[(mode, threading.get_ident())] = (batch, final)
+        # The batch is held via weakref with a drop callback: once the
+        # batch is otherwise dead its memoized final state is useless
+        # (lookups key on batch identity), so the entry must not pin the
+        # state buffers for the plan's lifetime.
+        if _stack():
+            return
+        key = (mode, threading.get_ident())
+        group = self.group
+
+        def _drop(wr):
+            ent = group.get(key)
+            if ent is not None and ent[0] is wr:
+                group.pop(key, None)
+        group[key] = (weakref.ref(batch, _drop), final)
 
     # -- device -------------------------------------------------------------
     def _bind_device(self, frame, state):

@@ -72,6 +72,33 @@ class TestDirectRoundTrip:
             [pa.array([7], pa.int64())], names=["x"])
         _roundtrip(rb1, tmp_path)
 
+    def test_smallint_tinyint_roundtrip(self, tmp_path):
+        # Regression: device int16/int8 lanes declare physical INT32 and
+        # must widen before serializing — the raw-lane bytes produced an
+        # unreadable file pyarrow rejected ("Unexpected end of stream").
+        rb = pa.RecordBatch.from_arrays(
+            [pa.array([1, -300, None, 32767, -32768], pa.int16()),
+             pa.array([1, -128, 127, None, 5], pa.int8())],
+            names=["s16", "s8"])
+        _roundtrip(rb, tmp_path)
+
+    def test_smallint_tinyint_converted_types(self, tmp_path):
+        # Regression: the ConvertedType annotations were swapped (the
+        # parquet spec defines INT_8=15, INT_16=16), so readers would have
+        # materialized smallint as int8 and tinyint as int16.
+        rb = pa.RecordBatch.from_arrays(
+            [pa.array([300, None], pa.int16()),
+             pa.array([-7, 7], pa.int8())], names=["s16", "s8"])
+        path = str(tmp_path / "conv.parquet")
+        write_device_batch(ColumnarBatch.from_arrow(rb), path)
+        pf = pq.ParquetFile(path)
+        assert pf.schema.column(0).converted_type == "INT_16"
+        assert pf.schema.column(1).converted_type == "INT_8"
+        got = pq.read_table(path)
+        assert got.schema.field("s16").type == pa.int16()
+        assert got.schema.field("s8").type == pa.int8()
+        assert got.to_pydict() == pa.Table.from_batches([rb]).to_pydict()
+
     def test_date_timestamp(self, tmp_path):
         rb = pa.RecordBatch.from_arrays(
             [pa.array([0, 19000, None], pa.date32()),

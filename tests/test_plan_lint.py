@@ -1,0 +1,302 @@
+"""Plan-lint tests: the static verifier must reject a deliberately
+corrupted plan in EACH checked dimension (schema, cast, transition,
+partitioning, writer physical width) with node-path diagnostics, and pass
+clean on the plans the real workloads build (the CI smoke run over the
+TPC-H q1/q6/q19 plans). See docs/plan-lint.md."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from harness import cpu_session, tpu_session
+
+from spark_rapids_tpu import types as T
+from spark_rapids_tpu.analysis.plan_lint import (PlanLintError, lint_plan,
+                                                 verify_plan)
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.ops.expression import AttributeReference, col, lit
+from spark_rapids_tpu.plan import physical as P
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.workloads import tpch
+
+
+def _scan(schema_dict, n=4):
+    """A tiny CpuLocalScanExec with the given {name: dtype} schema."""
+    arrays, fields = [], []
+    for name, dt in schema_dict.items():
+        at = T.to_arrow_type(dt)
+        if dt is T.STRING:
+            arrays.append(pa.array([f"v{i}" for i in range(n)], at))
+        elif dt is T.BOOLEAN:
+            arrays.append(pa.array([i % 2 == 0 for i in range(n)], at))
+        else:
+            arrays.append(pa.array(list(range(n)), pa.int64()).cast(at))
+        fields.append(T.StructField(name, dt, True))
+    schema = T.Schema(fields)
+    rb = pa.RecordBatch.from_arrays(arrays, schema=T.schema_to_arrow(schema))
+    return P.CpuLocalScanExec([rb], schema)
+
+
+# ---------------------------------------------------------------------------
+# CI smoke run: the real TPC-H plans verify clean on both paths
+# ---------------------------------------------------------------------------
+
+
+class TestCleanPlans:
+    @pytest.fixture(scope="class")
+    def tables(self):
+        return tpch.gen_tables(1 << 10, seed=7)
+
+    @pytest.mark.parametrize("query", ["q1", "q6", "q19"])
+    def test_tpch_plan_verifies_clean(self, tables, query):
+        for s in (cpu_session(),
+                  tpu_session(**{
+                      "spark.rapids.sql.variableFloatAgg.enabled": True})):
+            df = tpch.QUERIES[query](tpch.load(s, tables, cache=False))
+            plan = s.plan(df._plan)  # session.plan itself verifies
+            assert lint_plan(plan) == []
+
+    def test_session_plan_runs_the_verifier(self, tables):
+        # planLint.enabled=false must skip verification entirely.
+        s = cpu_session().with_conf(**{
+            "spark.rapids.tpu.planLint.enabled": False})
+        df = tpch.QUERIES["q1"](tpch.load(s, tables, cache=False))
+        s.plan(df._plan)
+
+
+# ---------------------------------------------------------------------------
+# Dimension 1: schema consistency
+# ---------------------------------------------------------------------------
+
+
+class TestSchemaViolations:
+    def test_missing_column_reference(self):
+        plan = P.CpuFilterExec(_scan({"a": T.LONG}),
+                               AttributeReference("nope", T.LONG).is_null())
+        vs = lint_plan(plan, stage="planned")
+        assert any(v.check == "schema" and "nope" in v.message for v in vs)
+        assert any("CpuFilterExec" in v.node_path for v in vs)
+
+    def test_join_output_dtype_mismatch(self):
+        left = _scan({"a": T.LONG})
+        right = _scan({"b": T.LONG})
+        corrupt = T.Schema([T.StructField("a", T.LONG, True),
+                            T.StructField("b", T.STRING, True)])  # lies
+        plan = P.CpuJoinExec(left, right, "inner",
+                             [col("a")], [col("b")], corrupt)
+        vs = lint_plan(plan, stage="planned")
+        assert any(v.check == "schema" and "join output column 1"
+                   in v.message for v in vs)
+
+    def test_union_arity_mismatch(self):
+        one = _scan({"a": T.LONG})
+        two = _scan({"a": T.LONG, "b": T.LONG})
+        plan = P.CpuUnionExec([one, two], one.schema)
+        vs = lint_plan(plan, stage="planned")
+        assert any(v.check == "schema" and "union child 1" in v.message
+                   for v in vs)
+
+    def test_bound_ordinal_out_of_range(self):
+        from spark_rapids_tpu.ops.expression import BoundReference
+        plan = P.CpuFilterExec(
+            _scan({"a": T.LONG}),
+            BoundReference(3, T.LONG).is_null())
+        vs = lint_plan(plan, stage="planned")
+        assert any("ordinal 3 out of range" in v.message for v in vs)
+
+
+# ---------------------------------------------------------------------------
+# Dimension 2: cast-lattice legality
+# ---------------------------------------------------------------------------
+
+
+class TestCastViolations:
+    def test_illegal_cast_rejected_at_plan_time(self):
+        s = cpu_session()
+        df = s.create_dataframe({"b": [True, False]})
+        bad = df.select(col("b").cast(T.DATE).alias("d"))
+        with pytest.raises(PlanLintError, match="illegal cast"):
+            s.plan(bad._plan)
+
+    def test_legal_casts_pass(self):
+        s = cpu_session()
+        df = s.create_dataframe({"i": [1, 2], "s": ["1", "2"]})
+        ok = df.select(col("i").cast(T.DOUBLE).alias("d"),
+                       col("s").cast(T.INT).alias("n"),
+                       col("i").cast(T.STRING).alias("t"))
+        assert lint_plan(s.plan(ok._plan)) == []
+
+
+# ---------------------------------------------------------------------------
+# Dimension 3: host/device transition correctness
+# ---------------------------------------------------------------------------
+
+
+class TestTransitionViolations:
+    def test_device_exec_over_host_child(self):
+        from spark_rapids_tpu.exec.execs import TpuProjectExec
+        scan = _scan({"a": T.LONG})
+        a = AttributeReference("a", T.LONG)
+        plan = P.CpuProjectExec(  # host root over an illegal device child
+            TpuProjectExec(scan, [a]), [a])
+        vs = lint_plan(plan)
+        trans = [v for v in vs if v.check == "transition"]
+        # Both flips are missing: Tpu node consumes the host scan, and the
+        # host root consumes the device node.
+        assert any("HostToDeviceExec" in v.message for v in trans)
+        assert any("DeviceToHostExec" in v.message for v in trans)
+        assert all("ProjectExec" in v.node_path for v in trans)
+
+    def test_columnar_root_rejected(self):
+        from spark_rapids_tpu.exec.execs import (HostToDeviceExec,
+                                                 TpuProjectExec)
+        plan = TpuProjectExec(HostToDeviceExec(_scan({"a": T.LONG})),
+                              [AttributeReference("a", T.LONG)])
+        vs = lint_plan(plan, stage="post-overrides")
+        assert any(v.check == "transition" and "root" in v.message
+                   for v in vs)
+        # The same tree is legal as a device subtree (pre-root stage).
+        assert lint_plan(plan, stage="planned") == []
+
+
+# ---------------------------------------------------------------------------
+# Dimension 4: partitioning contracts
+# ---------------------------------------------------------------------------
+
+
+def _hash_exchange(child, keys, n_parts):
+    from spark_rapids_tpu.shuffle.exchange import CpuShuffleExchangeExec
+    from spark_rapids_tpu.shuffle.partitioners import partitioner_factory
+    return CpuShuffleExchangeExec(
+        child, partitioner_factory("hash", n_parts, keys=keys), n_parts)
+
+
+class TestPartitioningViolations:
+    def test_copartition_count_mismatch_is_warn(self):
+        # WARN, not error: this single-process engine materializes whole
+        # join sides, so left.repartition(4).join(right.repartition(8))
+        # answers correctly and must keep doing so. CI rejects it via
+        # planLint.failOnWarn.
+        left = _hash_exchange(_scan({"a": T.LONG}), [col("a")], 4)
+        right = _hash_exchange(_scan({"b": T.LONG}), [col("b")], 8)
+        out = T.Schema([T.StructField("a", T.LONG, True),
+                        T.StructField("b", T.LONG, True)])
+        plan = P.CpuJoinExec(left, right, "inner", [col("a")], [col("b")],
+                             out)
+        vs = lint_plan(plan, stage="planned")
+        bad = [v for v in vs if v.check == "partitioning"
+               and v.severity == "warn"]
+        assert bad and "4 vs 8" in bad[0].message
+        assert "CpuJoinExec" in bad[0].node_path
+        with pytest.raises(PlanLintError, match="4 vs 8"):
+            verify_plan(plan, TpuConf({
+                "spark.rapids.tpu.planLint.failOnWarn": True}),
+                stage="planned")
+
+    def test_key_mismatch_is_warn_and_fallback_severity(self):
+        left = _hash_exchange(_scan({"a": T.LONG, "k": T.LONG}),
+                              [col("k")], 4)
+        right = _hash_exchange(_scan({"b": T.LONG}), [col("b")], 4)
+        out = T.Schema([T.StructField("a", T.LONG, True),
+                        T.StructField("k", T.LONG, True),
+                        T.StructField("b", T.LONG, True)])
+        plan = P.CpuJoinExec(left, right, "inner", [col("a")], [col("b")],
+                             out)
+        warns = verify_plan(plan, TpuConf(), stage="planned")
+        assert [v.severity for v in warns] == ["warn"]
+        assert "joined on" in warns[0].message
+        with pytest.raises(PlanLintError):
+            verify_plan(plan, TpuConf({
+                "spark.rapids.tpu.planLint.failOnWarn": True}),
+                stage="planned")
+
+    def test_matching_copartition_passes(self):
+        left = _hash_exchange(_scan({"a": T.LONG}), [col("a")], 4)
+        right = _hash_exchange(_scan({"b": T.LONG}), [col("b")], 4)
+        out = T.Schema([T.StructField("a", T.LONG, True),
+                        T.StructField("b", T.LONG, True)])
+        plan = P.CpuJoinExec(left, right, "inner", [col("a")], [col("b")],
+                             out)
+        assert lint_plan(plan, stage="planned") == []
+
+
+# ---------------------------------------------------------------------------
+# Session-level warn handling (fallback vs test-mode promotion)
+# ---------------------------------------------------------------------------
+
+
+class TestSessionWarnFallback:
+    def _mismatched_join(self, s):
+        # Hash-repartitioned on k/m but joined on a/b: warn severity.
+        left = s.create_dataframe({"a": [1, 2, 3], "k": [1, 1, 2]})
+        right = s.create_dataframe({"b": [1, 2, 3], "m": [1, 2, 2]})
+        return (left.repartition(4, col("k"))
+                .join(right.repartition(4, col("m")),
+                      on=col("a").eq(col("b"))))
+
+    def test_warn_falls_back_to_cpu_plan_and_still_answers(self):
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.autoBroadcastJoinRows": -1})
+        df = self._mismatched_join(s)
+        with pytest.warns(UserWarning, match="plan-lint"):
+            plan = s.plan(df._plan)
+
+        def names(n):
+            yield type(n).__name__
+            for c in n.children:
+                yield from names(c)
+        assert not any(nm.startswith("Tpu") for nm in names(plan))
+        with pytest.warns(UserWarning, match="plan-lint"):
+            out = df.collect()
+        assert sorted(out.to_pydict()["a"]) == [1, 2, 3]
+
+    def test_warn_promotes_to_error_in_test_mode(self):
+        # test.enabled promises "no silent CPU fallback": a quiet
+        # warn-fallback would run the differential harness CPU-vs-CPU.
+        s = TpuSession({"spark.rapids.sql.enabled": True,
+                        "spark.rapids.sql.test.enabled": True,
+                        "spark.rapids.sql.autoBroadcastJoinRows": -1})
+        with pytest.raises(PlanLintError, match="joined on"):
+            s.plan(self._mismatched_join(s)._plan)
+
+
+# ---------------------------------------------------------------------------
+# Dimension 5: parquet writer physical-type consistency
+# ---------------------------------------------------------------------------
+
+
+def _writer_plan(tmp_path):
+    from spark_rapids_tpu.exec.execs import HostToDeviceExec
+    from spark_rapids_tpu.io.writers import TpuWriteFilesExec
+    scan = _scan({"s16": T.SHORT, "s8": T.BYTE, "i": T.INT})
+    return TpuWriteFilesExec(HostToDeviceExec(scan), "parquet",
+                             str(tmp_path / "out"), {}, [], "overwrite")
+
+
+class TestWriterViolations:
+    def test_clean_after_the_width_fix(self, tmp_path):
+        assert lint_plan(_writer_plan(tmp_path)) == []
+
+    def test_narrow_serialization_is_rejected(self, tmp_path, monkeypatch):
+        # Re-seed the exact ADVICE.md corruption: the encoder serializing
+        # the device lane width (int16/int8) while declaring INT32.
+        from spark_rapids_tpu.io import parquet_encode as PE
+        monkeypatch.setattr(PE, "encoded_value_dtype",
+                            lambda dt: np.dtype(dt.np_dtype))
+        vs = lint_plan(_writer_plan(tmp_path))
+        bad = [v for v in vs if v.check == "writer-width"]
+        assert len(bad) == 2  # s16 and s8; the int column is 4-byte anyway
+        assert all("truncated stream" in v.message for v in bad)
+        assert all("TpuWriteFilesExec" in v.node_path for v in bad)
+
+    def test_swapped_converted_types_are_rejected(self, tmp_path,
+                                                  monkeypatch):
+        from spark_rapids_tpu.io import parquet_encode as PE
+        phys = dict(PE._PHYS)
+        phys["smallint"] = (phys["smallint"][0], 15)   # INT_8: the old bug
+        phys["tinyint"] = (phys["tinyint"][0], 16)     # INT_16
+        monkeypatch.setattr(PE, "_PHYS", phys)
+        vs = lint_plan(_writer_plan(tmp_path))
+        bad = [v for v in vs if v.check == "writer-width"]
+        assert len(bad) == 2
+        assert all("ConvertedType" in v.message for v in bad)

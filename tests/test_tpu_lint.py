@@ -1,0 +1,187 @@
+"""tpu_lint tests: the repo must lint clean against its checked-in
+baseline (the CI gate), seeded anti-patterns must each be caught, and the
+baseline must ratchet (counts may not grow, shrinking prints a tighten
+reminder). See docs/plan-lint.md."""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import tools.tpu_lint as TL
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(root, relpath, source):
+    full = os.path.join(root, relpath)
+    os.makedirs(os.path.dirname(full), exist_ok=True)
+    with open(full, "w") as f:
+        f.write(textwrap.dedent(source))
+
+
+@pytest.fixture
+def fake_pkg(tmp_path):
+    """A tmp tree shaped like spark_rapids_tpu/ for seeding violations."""
+    return str(tmp_path / "pkg")
+
+
+class TestRepoIsClean:
+    def test_lint_clean_against_baseline(self):
+        assert TL.main([]) == 0
+
+    def test_module_invocation(self):
+        # The exact CI incantation.
+        r = subprocess.run([sys.executable, "-m", "tools.tpu_lint"],
+                           cwd=REPO, capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_baseline_counts_match_reality_exactly(self):
+        # A stale (too-loose) baseline would let new debt in silently.
+        violations = TL.lint_tree(os.path.join(REPO, "spark_rapids_tpu"))
+        baseline = TL.load_baseline(
+            os.path.join(REPO, "tools", "tpu_lint_baseline.json"))
+        assert TL.counts_of(violations) == baseline
+
+
+class TestSeededAntiPatterns:
+    def test_host_sync_in_kernel_module(self, fake_pkg):
+        _write(fake_pkg, "ops/kernels/bad.py", """
+            import numpy as np
+            import jax
+
+            def kernel(x):
+                a = np.asarray(x)          # transfer
+                b = jax.device_get(x)      # sync
+                x.block_until_ready()      # stall
+                c = x.item()               # hidden sync
+                d = int(x)                 # concretize
+                return a, b, c, d
+            """)
+        rules = [v.rule for v in TL.lint_tree(fake_pkg)]
+        assert rules.count("host-sync") == 5
+
+    def test_host_sync_outside_kernel_scope_not_flagged(self, fake_pkg):
+        _write(fake_pkg, "exec/fine.py", """
+            import numpy as np
+
+            def download(x):
+                return np.asarray(x)       # legal at an exec boundary
+            """)
+        assert TL.lint_tree(fake_pkg) == []
+
+    def test_whitelisted_sync_point(self, fake_pkg):
+        _write(fake_pkg, "ops/kernels/ok.py", """
+            import numpy as np
+
+            def kernel(x):
+                return np.asarray(x)  # tpu-lint: ignore - download point
+            """)
+        assert TL.lint_tree(fake_pkg) == []
+
+    def test_data_dependent_branch_in_jit(self, fake_pkg):
+        _write(fake_pkg, "ops/anywhere.py", """
+            import jax
+
+            @jax.jit
+            def f(x, n):
+                if n > 0:                  # traced branch
+                    return x
+                while x < n:               # traced loop
+                    x = x + 1
+                return x
+
+            def host_side(x, n):
+                if n > 0:                  # not jitted: fine
+                    return x
+                return n
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg) if v.rule == "jit-branch"]
+        assert len(vs) == 2
+
+    def test_nested_jit_flagged(self, fake_pkg):
+        _write(fake_pkg, "exec/compilers.py", """
+            import jax
+
+            TOP = jax.jit(lambda x: x)     # module scope: compiles once
+
+            def per_call(fn):
+                return jax.jit(fn)         # fresh program per call
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg) if v.rule == "jit-nested"]
+        assert len(vs) == 1
+
+    def test_bare_jit_call_flagged(self, fake_pkg):
+        # `from jax import jit` must not dodge the rule: detection cannot
+        # depend on import style.
+        _write(fake_pkg, "exec/barejit.py", """
+            from jax import jit
+
+            TOP = jit(lambda x: x)     # module scope: compiles once
+
+            def per_call(fn):
+                return jit(fn)         # fresh program per call
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg) if v.rule == "jit-nested"]
+        assert len(vs) == 1
+
+    def test_nondeterminism_in_plan_code(self, fake_pkg):
+        _write(fake_pkg, "plan/clock.py", """
+            import random
+            import time
+            import uuid
+
+            def signature():
+                return (time.time(), random.random(), uuid.uuid4().hex)
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg) if v.rule == "plan-nondet"]
+        assert len(vs) == 3
+
+    def test_nondeterminism_outside_plan_scope_not_flagged(self, fake_pkg):
+        _write(fake_pkg, "utils/timers.py", """
+            import time
+
+            def stamp():
+                return time.time()
+            """)
+        assert TL.lint_tree(fake_pkg) == []
+
+
+class TestRatchet:
+    def _seed(self, fake_pkg, n):
+        body = "\n".join(f"    a{i} = np.asarray(x)" for i in range(n))
+        _write(fake_pkg, "ops/kernels/debt.py",
+               f"import numpy as np\n\ndef k(x):\n{body}\n    return x\n")
+
+    def test_baselined_debt_passes(self, fake_pkg):
+        self._seed(fake_pkg, 2)
+        vs = TL.lint_tree(fake_pkg)
+        baseline = TL.counts_of(vs)
+        new, improved = TL.compare_to_baseline(vs, baseline)
+        assert new == [] and improved == []
+
+    def test_new_debt_fails(self, fake_pkg):
+        self._seed(fake_pkg, 2)
+        baseline = TL.counts_of(TL.lint_tree(fake_pkg))
+        self._seed(fake_pkg, 3)
+        new, _ = TL.compare_to_baseline(TL.lint_tree(fake_pkg), baseline)
+        assert len(new) == 1
+        assert new[0].rule == "host-sync"
+
+    def test_paying_down_debt_reports_improvement(self, fake_pkg):
+        self._seed(fake_pkg, 3)
+        baseline = TL.counts_of(TL.lint_tree(fake_pkg))
+        self._seed(fake_pkg, 1)
+        new, improved = TL.compare_to_baseline(TL.lint_tree(fake_pkg),
+                                               baseline)
+        assert new == []
+        assert improved == ["ops/kernels/debt.py::host-sync"]
+
+    def test_update_baseline_roundtrip(self, fake_pkg, tmp_path):
+        self._seed(fake_pkg, 2)
+        vs = TL.lint_tree(fake_pkg)
+        path = str(tmp_path / "baseline.json")
+        TL.write_baseline(path, vs)
+        assert TL.load_baseline(path) == TL.counts_of(vs)

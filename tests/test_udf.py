@@ -376,3 +376,67 @@ class TestFallback:
         data = {"a": list(range(20))}
         f = lambda x: max(x * 3 - 2, 0) if x % 2 == 0 else x
         assert _run_udf(f, data, "a") == _expected(f, data, "a")
+
+
+class TestLoopIR:
+    """Direct loop-IR regressions (udf/loops.py), independent of the
+    bytecode compiler front end."""
+
+    def test_long_widening_chain_resolves(self):
+        # Regression: the type-widening fixpoint was capped at a constant
+        # 8 rounds; a chain of NULL-seeded vars each typed only through
+        # the next one needs ~n rounds, so 10 vars raised LoopTypeError
+        # at bind time. The bound is now by work (3n+1 rounds).
+        from spark_rapids_tpu.ops.expression import Literal
+        from spark_rapids_tpu.udf.loops import LoopExpr, LoopVar
+        n = 10
+        vs = [LoopVar(f"v{i}", T.NULL) for i in range(n)]
+        inits = [Literal(None, T.NULL)] * (n - 1) + [Literal(1, T.INT)]
+        updates = [vs[i + 1] for i in range(n - 1)] + [vs[-1]]
+        loop = LoopExpr(vs, inits, updates, Literal(False, T.BOOLEAN),
+                        vs[0])
+        assert loop.data_type is T.INT
+
+    def test_truly_unstable_types_still_raise(self):
+        from spark_rapids_tpu.ops.expression import Literal, col
+        from spark_rapids_tpu.udf.loops import (LoopExpr, LoopTypeError,
+                                                LoopVar)
+        v = LoopVar("x", T.NULL)
+        # int state joined with a string update can never stabilize.
+        loop = LoopExpr([v], [Literal(1, T.INT)], [Literal("s", T.STRING)],
+                        Literal(False, T.BOOLEAN), v)
+        with pytest.raises(LoopTypeError):
+            loop.resolve_types()
+
+    def test_sibling_memo_releases_dead_batches(self):
+        # Regression: the sibling-group memo stored (batch, final_state)
+        # keyed by (mode, thread id) and never evicted, pinning the last
+        # batch and its loop state for the plan's lifetime. The batch is
+        # now held via weakref with a drop callback.
+        import gc
+
+        from spark_rapids_tpu.data.batch import HostBatch
+        from spark_rapids_tpu.ops.expression import Literal
+        from spark_rapids_tpu.udf.loops import LoopExpr, LoopVar
+        v = LoopVar("x", T.NULL)
+        loop = LoopExpr([v], [Literal(0, T.INT)], [v],
+                        Literal(False, T.BOOLEAN), v)
+        hb = HostBatch.from_pydict({"a": [1, 2, 3]})
+        assert loop.eval_host(hb).to_pylist() == [0, 0, 0]
+        assert any(isinstance(k, tuple) for k in loop.group)
+        del hb
+        gc.collect()
+        assert not any(isinstance(k, tuple) for k in loop.group)
+
+    def test_memo_still_hits_for_live_batches(self):
+        from spark_rapids_tpu.data.batch import HostBatch
+        from spark_rapids_tpu.ops.expression import Literal
+        from spark_rapids_tpu.udf.loops import LoopExpr, LoopVar
+        group = {}
+        v = LoopVar("x", T.NULL)
+        a = LoopExpr([v], [Literal(2, T.INT)], [v],
+                     Literal(False, T.BOOLEAN), v, group=group)
+        hb = HostBatch.from_pydict({"a": [5]})
+        assert a.eval_host(hb).to_pylist() == [2]
+        memo = a._memo_get("host", hb)
+        assert memo is not None  # second sibling would reuse, not re-run

@@ -1,0 +1,340 @@
+"""tpu_lint — stdlib-ast linter for JAX/TPU anti-patterns in the engine.
+
+The plan verifier (analysis/plan_lint.py) checks the plans the engine
+builds; this linter checks the engine's own source for the patterns that
+corrupt TPU performance or correctness silently:
+
+* ``host-sync`` (kernel modules, ``ops/kernels/``): ``np.asarray``,
+  ``jax.device_get``, ``.block_until_ready()``, ``.item()``, and
+  ``int(...)``/``float(...)`` on non-constants — each one a device->host
+  round trip; inside a traced kernel they serialize the pipeline.
+* ``jit-branch`` (everywhere): ``if``/``while`` on a parameter of a
+  ``@jax.jit`` function — data-dependent Python branching either fails to
+  trace or silently burns one recompile per distinct value.
+* ``jit-nested`` (everywhere): a ``jax.jit(...)`` call inside a function
+  body — a fresh jitted callable per invocation, so the compile cache
+  never hits (the engine's sanctioned pattern is
+  ``utils.kernel_cache.cached_kernel``).
+* ``plan-nondet`` (plan modules, ``plan/``): wall-clock/random/uuid calls
+  in planning code — plan signatures and kernel-cache keys must be
+  deterministic or caches silently miss (the ``Date.now`` class of bug).
+
+Existing debt is RATCHETED, not flooded: the checked-in baseline
+(``tools/tpu_lint_baseline.json``) records per-(file, rule) counts; the
+lint fails only when a count exceeds its baseline. Lowering counts below
+baseline prints a reminder to tighten with ``--update-baseline``.
+
+Suppress a finding by putting ``# tpu-lint: ignore`` on the offending
+line (counts as a whitelisted sync point for ``host-sync``).
+
+CLI::
+
+    python -m tools.tpu_lint            # check against the baseline
+    python -m tools.tpu_lint --list     # print every finding
+    python -m tools.tpu_lint --update-baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: relpath prefixes that scope the path-restricted rules
+KERNEL_SCOPE = ("ops/kernels/",)
+PLAN_SCOPE = ("plan/",)
+
+IGNORE_MARKER = "tpu-lint: ignore"
+
+_NONDET_MODULE_CALLS = {
+    "time": {"time", "time_ns", "monotonic", "perf_counter"},
+    "random": None,   # any attribute
+    "uuid": {"uuid1", "uuid3", "uuid4", "uuid5"},
+    "os": {"urandom"},
+    "secrets": None,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    path: str    # relpath under the scan root, '/' separators
+    rule: str
+    lineno: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        return f"{self.path}::{self.rule}"
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.lineno}: [{self.rule}] {self.message}"
+
+
+def _is_jit_decorator(d: ast.expr) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) / jax.jit(...) decorators."""
+    if isinstance(d, ast.Attribute) and d.attr == "jit":
+        return True
+    if isinstance(d, ast.Name) and d.id == "jit":
+        return True
+    if isinstance(d, ast.Call):
+        if _is_jit_decorator(d.func):
+            return True
+        return any(_is_jit_decorator(a) for a in d.args)
+    return False
+
+
+def _call_root(func: ast.expr) -> Optional[str]:
+    """Leftmost Name of a dotted call target (``jax`` in jax.x.y(...))."""
+    while isinstance(func, ast.Attribute):
+        func = func.value
+    return func.id if isinstance(func, ast.Name) else None
+
+
+class _FileLinter(ast.NodeVisitor):
+    def __init__(self, relpath: str, lines: List[str]):
+        self.relpath = relpath
+        self.lines = lines
+        self.in_kernel = relpath.startswith(KERNEL_SCOPE)
+        self.in_plan = relpath.startswith(PLAN_SCOPE)
+        self.violations: List[Violation] = []
+        #: stack of (is_jit, frozenset(param names)) for enclosing functions
+        self._funcs: List[Tuple[bool, frozenset]] = []
+
+    # -- helpers ------------------------------------------------------------
+    def _suppressed(self, node: ast.AST) -> bool:
+        line = self.lines[node.lineno - 1] if node.lineno <= len(self.lines) \
+            else ""
+        return IGNORE_MARKER in line
+
+    def _flag(self, node: ast.AST, rule: str, message: str):
+        if not self._suppressed(node):
+            self.violations.append(
+                Violation(self.relpath, rule, node.lineno, message))
+
+    def _jit_params(self) -> Optional[frozenset]:
+        for is_jit, params in reversed(self._funcs):
+            if is_jit:
+                return params
+        return None
+
+    # -- function tracking ---------------------------------------------------
+    def _visit_func(self, node):
+        is_jit = any(_is_jit_decorator(d) for d in node.decorator_list)
+        args = node.args
+        params = frozenset(
+            a.arg for a in [*args.posonlyargs, *args.args, *args.kwonlyargs]
+            + ([args.vararg] if args.vararg else [])
+            + ([args.kwarg] if args.kwarg else []))
+        self._funcs.append((is_jit, params))
+        self.generic_visit(node)
+        self._funcs.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- rules ---------------------------------------------------------------
+    def visit_Call(self, node: ast.Call):
+        func = node.func
+        root = _call_root(func)
+        if self.in_kernel:
+            self._check_host_sync(node, func, root)
+        if self.in_plan:
+            self._check_nondet(node, func, root)
+        if self._funcs and (
+                (root == "jax" and isinstance(func, ast.Attribute)
+                 and func.attr == "jit")
+                or (isinstance(func, ast.Name) and func.id == "jit")):
+            self._flag(node, "jit-nested",
+                       "jax.jit called inside a function body compiles a "
+                       "fresh program per call; route through "
+                       "utils.kernel_cache.cached_kernel")
+        self.generic_visit(node)
+
+    def _check_host_sync(self, node: ast.Call, func, root):
+        if isinstance(func, ast.Attribute):
+            if func.attr == "asarray" and root in ("np", "numpy"):
+                self._flag(node, "host-sync",
+                           "np.asarray on a device value forces a "
+                           "device->host transfer inside a kernel module")
+            elif func.attr == "device_get":
+                self._flag(node, "host-sync",
+                           "jax.device_get is a blocking device->host sync")
+            elif func.attr == "block_until_ready":
+                self._flag(node, "host-sync",
+                           ".block_until_ready() stalls the dispatch "
+                           "pipeline")
+            elif func.attr == "item" and not node.args:
+                self._flag(node, "host-sync",
+                           ".item() on a traced/device value is a hidden "
+                           "device->host sync")
+        elif isinstance(func, ast.Name) and func.id in ("int", "float") \
+                and len(node.args) == 1 \
+                and not isinstance(node.args[0], ast.Constant):
+            self._flag(node, "host-sync",
+                       f"{func.id}(...) on a non-constant concretizes a "
+                       "traced value (host sync inside a kernel module)")
+
+    def _check_nondet(self, node: ast.Call, func, root):
+        if not isinstance(func, ast.Attribute):
+            return
+        allowed = _NONDET_MODULE_CALLS.get(root or "")
+        if root in _NONDET_MODULE_CALLS \
+                and (allowed is None or func.attr in allowed):
+            self._flag(node, "plan-nondet",
+                       f"{root}.{func.attr}() is nondeterministic; plan "
+                       "construction must be reproducible (plan signatures "
+                       "and kernel-cache keys depend on it)")
+        elif func.attr in ("now", "utcnow", "today") \
+                and isinstance(func.value, (ast.Name, ast.Attribute)):
+            tail = func.value.attr if isinstance(func.value, ast.Attribute) \
+                else func.value.id
+            if tail in ("datetime", "date"):
+                self._flag(node, "plan-nondet",
+                           f"{tail}.{func.attr}() reads the wall clock in "
+                           "plan code")
+
+    def _check_branch(self, node):
+        params = self._jit_params()
+        if params is not None:
+            names = {n.id for n in ast.walk(node.test)
+                     if isinstance(n, ast.Name)}
+            hit = sorted(names & params)
+            if hit:
+                kind = "if" if isinstance(node, ast.If) else "while"
+                self._flag(node, "jit-branch",
+                           f"Python `{kind}` on traced parameter(s) "
+                           f"{', '.join(hit)} inside a @jax.jit function; "
+                           "use lax.cond/lax.while_loop or hoist to a "
+                           "static argument")
+        self.generic_visit(node)
+
+    visit_If = _check_branch
+    visit_While = _check_branch
+
+
+# ---------------------------------------------------------------------------
+# Driver
+# ---------------------------------------------------------------------------
+
+
+def lint_tree(root: str) -> List[Violation]:
+    """Lint every .py file under ``root`` (the package directory)."""
+    out: List[Violation] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d not in ("__pycache__", "_build"))
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            full = os.path.join(dirpath, fn)
+            rel = os.path.relpath(full, root).replace(os.sep, "/")
+            with open(full, encoding="utf-8") as f:
+                src = f.read()
+            try:
+                tree = ast.parse(src, filename=full)
+            except SyntaxError as e:
+                out.append(Violation(rel, "parse-error", e.lineno or 0,
+                                     str(e)))
+                continue
+            linter = _FileLinter(rel, src.splitlines())
+            linter.visit(tree)
+            out.extend(linter.violations)
+    return out
+
+
+def counts_of(violations: List[Violation]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for v in violations:
+        counts[v.key] = counts.get(v.key, 0) + 1
+    return counts
+
+
+def compare_to_baseline(violations: List[Violation],
+                        baseline: Dict[str, int]
+                        ) -> Tuple[List[Violation], List[str]]:
+    """(new violations above the ratchet, keys now below baseline)."""
+    counts = counts_of(violations)
+    new: List[Violation] = []
+    by_key: Dict[str, List[Violation]] = {}
+    for v in violations:
+        by_key.setdefault(v.key, []).append(v)
+    for key, vs in sorted(by_key.items()):
+        allowed = baseline.get(key, 0)
+        if len(vs) > allowed:
+            # Report the trailing occurrences as the new ones (stable for
+            # appends; any fix inside the file re-anchors the ratchet).
+            new.extend(vs[allowed:])
+    improved = sorted(k for k, n in baseline.items()
+                      if counts.get(k, 0) < n)
+    return new, improved
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return dict(data.get("counts", {}))
+
+
+def write_baseline(path: str, violations: List[Violation]):
+    data = {
+        "comment": "Ratcheted tpu_lint debt: per (file, rule) finding "
+                   "counts. Regenerate with "
+                   "`python -m tools.tpu_lint --update-baseline`; counts "
+                   "may only go DOWN in review.",
+        "counts": dict(sorted(counts_of(violations).items())),
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=2, sort_keys=False)
+        f.write("\n")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap = argparse.ArgumentParser(
+        prog="tools.tpu_lint",
+        description="AST linter for JAX/TPU anti-patterns (ratcheted)")
+    ap.add_argument("--root",
+                    default=os.path.join(repo_root, "spark_rapids_tpu"),
+                    help="package directory to lint")
+    ap.add_argument("--baseline",
+                    default=os.path.join(repo_root, "tools",
+                                         "tpu_lint_baseline.json"))
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from the current findings")
+    ap.add_argument("--list", action="store_true",
+                    help="print every finding, baselined or not")
+    args = ap.parse_args(argv)
+
+    violations = lint_tree(args.root)
+    if args.update_baseline:
+        write_baseline(args.baseline, violations)
+        print(f"baseline updated: {len(violations)} finding(s) across "
+              f"{len(counts_of(violations))} (file, rule) key(s)")
+        return 0
+    if args.list:
+        for v in violations:
+            print(v)
+    baseline = load_baseline(args.baseline)
+    new, improved = compare_to_baseline(violations, baseline)
+    for k in improved:
+        print(f"note: {k} is below its baseline count — tighten the "
+              "ratchet with --update-baseline")
+    if new:
+        print(f"{len(new)} NEW violation(s) above the baseline:",
+              file=sys.stderr)
+        for v in new:
+            print(f"  {v}", file=sys.stderr)
+        return 1
+    print(f"tpu_lint clean: {len(violations)} baselined finding(s), "
+          "0 new")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
