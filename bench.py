@@ -131,10 +131,12 @@ def run_large_scale(n_rows: int = 1 << 22):
 
 
 def run_suite():
-    # NOTE: do not enable jax_compilation_cache_dir here — it deadlocks the
-    # axon remote-compile helper (observed: queries hang indefinitely), and
-    # its XLA-level executable replay can SIGILL on cross-machine AOT
-    # artifacts (see spark_rapids_tpu/__init__.py).
+    # NOTE: do not enable the persistent executable cache here
+    # (spark.rapids.tpu.compileCache.enabled / jax_compilation_cache_dir) —
+    # it deadlocks the axon remote-compile helper (observed: queries hang
+    # indefinitely), and its XLA-level executable replay can SIGILL on
+    # cross-machine AOT artifacts (see spark_rapids_tpu/__init__.py and
+    # docs/compile-cache.md).
     from spark_rapids_tpu.session import TpuSession
     from spark_rapids_tpu.utils import kernel_cache as KC
     from spark_rapids_tpu.workloads import tpch
@@ -223,6 +225,17 @@ def run_suite():
               f"fused_programs={len(fusion._FUSED_CACHE)} "
               f"(warmup+compile {time.perf_counter()-t0:.0f}s)",
               file=sys.stderr)
+
+    # Compile-once layer counters (docs/compile-cache.md): how many fused
+    # programs exist, how many AOT executables warm-up built, and how the
+    # steady-state dispatches split between the AOT table and jit.
+    from spark_rapids_tpu.compile import executables as _executables
+    from spark_rapids_tpu.compile import warmup as _compile_warmup
+    _aot = _executables.stats()
+    print(f"[bench] compile-once: programs={_aot['programs']} "
+          f"aot_executables={_aot['aot_executables']} "
+          f"aot_hits={_aot['aot_hits']} jit_calls={_aot['jit_calls']} "
+          f"warmup={_compile_warmup.stats()}", file=sys.stderr)
 
     geo_t = _geo(tpu_times)
     geo_r = _geo(ratios)

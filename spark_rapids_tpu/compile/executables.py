@@ -1,0 +1,122 @@
+"""In-process compiled-program cache with AOT-visible dispatch.
+
+``jax.jit``'s tracing cache is populated only by CALLING the wrapped
+function with concrete arguments; programs built through the AOT path
+(``fn.lower(...).compile()``) never enter it. A warm-up pass that relied
+on ``lower().compile()`` alone would therefore leave the hot dispatch
+path re-tracing and re-compiling the very shapes it just warmed — the
+work would land in the persistent on-disk cache but the first real query
+would still pay tracing plus a cache probe.
+
+:class:`FusedProgram` closes that gap by holding both sides in one
+object: the jitted callable AND a table of AOT-compiled executables
+keyed by the input aval signature. Dispatch prefers the AOT table (a
+dict probe on static shapes), so anything :mod:`.warmup` compiled in the
+background — or replayed from a previous process via the compile
+manifest — is hit directly, with the jit path as the always-correct
+fallback for shapes nobody warmed.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, Tuple
+
+import jax
+
+#: Every live FusedProgram, for aggregate diagnostics (bench.py,
+#: TpuSession.compile_status). Weak: programs die with their cache entry.
+_REGISTRY: "weakref.WeakSet[FusedProgram]" = weakref.WeakSet()
+
+
+def aval_signature(tree) -> tuple:
+    """Hashable (treedef, leaf shapes/dtypes) signature of an argument
+    pytree — exactly the specialization key ``jax.jit`` uses, minus weak
+    types. Works on concrete arrays and ``ShapeDtypeStruct`` templates
+    alike, so a warmed abstract shape and the concrete batch that later
+    arrives at it produce the SAME key."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return (treedef,
+            tuple((tuple(int(d) for d in leaf.shape), str(leaf.dtype))
+                  for leaf in leaves))
+
+
+def abstract_like(tree):
+    """``ShapeDtypeStruct`` template of a concrete pytree. Safe to hold on
+    the warm-up queue: no device buffers stay pinned through it."""
+    return jax.tree_util.tree_map(
+        lambda leaf: jax.ShapeDtypeStruct(tuple(leaf.shape), leaf.dtype),
+        tree)
+
+
+class FusedProgram:
+    """One compiled query program: a jitted callable plus its AOT table.
+
+    Stored in ``exec.fusion._FUSED_CACHE`` per structural plan signature;
+    callers invoke it exactly like the bare jitted function.
+    """
+
+    def __init__(self, fn, label: str = ""):
+        self.fn = fn
+        self.label = label
+        self._aot: Dict[tuple, object] = {}
+        self._lock = threading.Lock()
+        self._stats = {"aot_hits": 0, "aot_call_errors": 0, "jit_calls": 0,
+                       "aot_compiles": 0}
+        _REGISTRY.add(self)
+
+    def __call__(self, *args):
+        key = aval_signature(args)
+        with self._lock:
+            exe = self._aot.get(key)
+        if exe is not None:
+            try:
+                out = exe(*args)
+                self._stats["aot_hits"] += 1
+                return out
+            except (TypeError, ValueError):
+                # Aval subtleties the signature cannot see (weak types,
+                # commitments): the jit path below is always correct.
+                self._stats["aot_call_errors"] += 1
+        self._stats["jit_calls"] += 1
+        return self.fn(*args)
+
+    def compile_abstract(self, args: Tuple) -> str:
+        """AOT-compile for the given (possibly abstract) argument tuple.
+        Returns ``"compiled"``, or ``"cached"`` when the shape is already
+        warm. With the persistent cache on, the XLA compile inside
+        ``lower().compile()`` deserializes from disk when a previous
+        process built the same HLO."""
+        key = aval_signature(args)
+        with self._lock:
+            if key in self._aot:
+                return "cached"
+        exe = self.fn.lower(*args).compile()
+        with self._lock:
+            if key in self._aot:
+                return "cached"
+            self._aot[key] = exe
+            self._stats["aot_compiles"] += 1
+        return "compiled"
+
+    @property
+    def n_aot(self) -> int:
+        with self._lock:
+            return len(self._aot)
+
+    def stats(self) -> dict:
+        return dict(self._stats, aot_executables=self.n_aot)
+
+
+def stats() -> dict:
+    """Aggregate dispatch/warm-up counters over every live program."""
+    total = {"programs": 0, "aot_executables": 0, "aot_hits": 0,
+             "aot_call_errors": 0, "jit_calls": 0, "aot_compiles": 0}
+    for prog in list(_REGISTRY):
+        s = prog.stats()
+        total["programs"] += 1
+        for k in ("aot_executables", "aot_hits", "aot_call_errors",
+                  "jit_calls", "aot_compiles"):
+            total[k] += s[k]
+    return total

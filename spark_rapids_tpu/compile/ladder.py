@@ -1,0 +1,157 @@
+"""Bucket-ladder control — ONE shared capacity ladder for the whole engine.
+
+Every compiled XLA program in this engine is keyed (through the batch pytree
+treedef and leaf avals) on static capacities: row capacities, string byte
+capacities, dictionary sizes. The seed hard-wired "round up to a power of
+two" at ~40 call sites through ``data.column.bucket_capacity``; this module
+makes that policy an object:
+
+* ``growth`` controls the rung spacing. 2.0 reproduces the power-of-two
+  ladder; 4.0 quarters the program population at the price of up to 4x
+  padding (attractive when compiles are served by a slow remote helper);
+  1.5 halves the padding waste at ~1.7x the program count.
+* ``min_capacity`` floors the ladder (the conf key
+  ``spark.rapids.tpu.minCapacity``, previously registered but never read).
+  A serving deployment that never sees small batches can start the ladder
+  at its typical size and avoid compiling the tiny rungs entirely.
+* ``max_capacity`` caps the ladder: requests above it get an exact
+  lane-aligned fit instead of the next geometric rung, bounding padded HBM
+  waste for huge batches (the programs up there are rare and data-bound,
+  so program-count control matters less than memory).
+* ``enabled=False`` degrades to bare lane alignment — one program per
+  distinct 128-row count. Only sensible for debugging compile-cache
+  behavior; the conf key existed since the seed and now actually works.
+
+Rungs are always multiples of the 8x128 VPU lane layout. The ladder is
+process-global (``get_ladder``/``set_ladder``) because capacities bake into
+compiled programs: two sessions with different ladders would silently
+double the program population, which is exactly what this layer exists to
+prevent. ``TpuSession`` configures it from the conf at construction.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import List
+
+#: Lane width of the VPU — the minimum sensible capacity granularity.
+LANE = 128
+
+
+def _align_up(n: int, step: int = LANE) -> int:
+    return -(-int(n) // step) * step
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketLadder:
+    """Immutable capacity-ladder policy. ``bucket`` is the hot call."""
+
+    min_capacity: int = LANE
+    growth: float = 2.0
+    max_capacity: int = 0  # 0 = unbounded ladder
+    enabled: bool = True
+
+    def __post_init__(self):
+        if self.growth < 1.125:
+            raise ValueError(f"ladder growth {self.growth} must be >= 1.125 "
+                             "(below that rungs collapse to lane steps)")
+        if self.min_capacity < 1:
+            raise ValueError("min_capacity must be positive")
+
+    @property
+    def base(self) -> int:
+        return _align_up(max(self.min_capacity, LANE))
+
+    def bucket(self, n: int, min_capacity: int = LANE) -> int:
+        """Smallest rung >= n (and >= max(min_capacity, ladder base)).
+
+        Matches the seed's ``bucket_capacity`` exactly at the default
+        ``growth=2.0, min_capacity=128``: powers of two starting at 128.
+        """
+        n = max(int(n), 1)
+        cap = max(self.base, _align_up(max(int(min_capacity), 1)))
+        if not self.enabled:
+            return max(cap, _align_up(n))
+        top = _align_up(self.max_capacity) if self.max_capacity > 0 else 0
+        while cap < n:
+            if top and cap >= top:
+                # Above the ladder top: exact lane-aligned fit, no rung.
+                return _align_up(n)
+            cap = self._next(cap)
+        return cap
+
+    def bucket_bytes(self, n: int, min_capacity: int = LANE) -> int:
+        """Byte/dictionary-capacity variant: same geometric climb, but the
+        conf row-capacity floor/cap (``min_capacity``/``max_capacity``) do
+        NOT apply — raising ``spark.rapids.tpu.minCapacity`` to skip tiny
+        row rungs must not inflate string payload, dictionary, or decode
+        scratch buffers (which call in with their own small floors)."""
+        n = max(int(n), 1)
+        cap = max(_align_up(max(int(min_capacity), 1)), LANE)
+        if not self.enabled:
+            return max(cap, _align_up(n))
+        while cap < n:
+            cap = self._next(cap)
+        return cap
+
+    def _next(self, cap: int) -> int:
+        """The rung above ``cap`` (strictly greater, lane aligned)."""
+        return max(_align_up(cap * self.growth), cap + LANE)
+
+    def next_up(self, cap: int, steps: int = 1) -> int:
+        """``steps`` rungs above the rung containing ``cap``."""
+        cap = self.bucket(cap)
+        for _ in range(max(steps, 0)):
+            cap = self._next(cap)
+        return cap
+
+    def next_down(self, cap: int, steps: int = 1) -> int:
+        """``steps`` rungs below the rung containing ``cap`` (floored at
+        the ladder base)."""
+        target = self.bucket(cap)
+        for _ in range(max(steps, 0)):
+            if target <= self.base:
+                return self.base
+            target = self._prev(target)
+        return target
+
+    def _prev(self, cap: int) -> int:
+        lo, step = self.base, self.base
+        while (nxt := self._next(step)) < cap:
+            lo, step = step, nxt
+        return lo if step >= cap else step
+
+    def rungs(self, lo: int, hi: int) -> List[int]:
+        """Every rung covering ``[lo, hi]`` (inclusive), ascending."""
+        out = [cap := self.bucket(lo)]
+        while cap < hi:
+            cap = self._next(cap)
+            out.append(cap)
+        return out
+
+
+_LOCK = threading.Lock()
+_LADDER = BucketLadder()
+
+
+def get_ladder() -> BucketLadder:
+    return _LADDER
+
+
+def set_ladder(ladder: BucketLadder) -> None:
+    global _LADDER
+    with _LOCK:
+        _LADDER = ladder
+
+
+def bucket_capacity(n: int, min_capacity: int = LANE) -> int:
+    """Round ``n`` up onto the process bucket ladder (the drop-in body of
+    the seed's ``data.column.bucket_capacity``, which now delegates here)."""
+    return _LADDER.bucket(n, min_capacity)
+
+
+def bucket_byte_capacity(n: int, min_capacity: int = LANE) -> int:
+    """Round a byte/dictionary capacity up the process ladder WITHOUT the
+    conf row floor/cap (see :meth:`BucketLadder.bucket_bytes`)."""
+    return _LADDER.bucket_bytes(n, min_capacity)

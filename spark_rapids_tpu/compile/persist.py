@@ -1,0 +1,240 @@
+"""Persistent XLA executable cache + compile manifest.
+
+Two cooperating pieces of cross-process memory:
+
+1. **JAX persistent compilation cache** — XLA executables keyed by HLO
+   hash, written under ``spark.rapids.tpu.compileCache.dir``. With it, a
+   restarted process pays deserialization (milliseconds) instead of
+   compilation (seconds per program on remote-compile backends) for every
+   program any previous process built.
+
+2. **Compile manifest** (``tpu_compile_manifest.json`` in the same dir) —
+   the engine-level index the JAX cache lacks: which (plan signature,
+   capacity vector) pairs were actually executed. The JAX cache can only
+   answer "have I compiled this exact HLO"; the manifest lets a NEW
+   process *ask the right questions* — warm-up replays the recorded rungs
+   through AOT lowering (:mod:`.warmup`), each of which then hits the
+   on-disk executable, so cold start collapses to tracing time.
+
+Safety: the environment kill-switch ``JAX_ENABLE_COMPILATION_CACHE=false``
+always wins (the CPU test tier sets it because replaying cross-machine AOT
+artifacts can SIGILL; some remote-compile helpers deadlock on the cache —
+see bench.py and tests/conftest.py). Configuration failures degrade to
+disabled, never to an error: a broken cache must not break queries.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_LOCK = threading.Lock()
+_STATUS: Dict[str, object] = {"enabled": False, "reason": "not configured"}
+_MANIFEST: Optional["CompileManifest"] = None
+#: True while this process's jax config points at our cache dir — so a
+#: later disable actually reverts it instead of only updating _STATUS.
+_APPLIED = False
+
+#: Bounds on the manifest so it stays a small index, not a log.
+_MAX_PLANS = 256
+_MAX_VECTORS_PER_PLAN = 8
+
+MANIFEST_NAME = "tpu_compile_manifest.json"
+
+
+def _env_killed() -> bool:
+    return os.environ.get("JAX_ENABLE_COMPILATION_CACHE", "").strip().lower() \
+        in ("false", "0", "no")
+
+
+def default_cache_dir() -> str:
+    return os.path.join(os.path.expanduser("~"), ".cache",
+                        "spark_rapids_tpu", "xla")
+
+
+def configure(conf) -> Dict[str, object]:
+    """Apply the conf's compile-cache keys to the process. Idempotent;
+    returns the resulting status dict (also available via :func:`status`)."""
+    global _MANIFEST, _APPLIED
+    from ..config import (COMPILE_CACHE_DIR, COMPILE_CACHE_ENABLED,
+                          COMPILE_CACHE_MIN_COMPILE_SECS)
+    with _LOCK:
+        if not conf.get(COMPILE_CACHE_ENABLED):
+            _deactivate_locked("disabled by conf")
+            return dict(_STATUS)
+        if _env_killed():
+            _deactivate_locked(
+                "JAX_ENABLE_COMPILATION_CACHE=false in environment")
+            return dict(_STATUS)
+        cache_dir = conf.get(COMPILE_CACHE_DIR) or default_cache_dir()
+        try:
+            os.makedirs(cache_dir, exist_ok=True)
+            _apply_jax_config(cache_dir,
+                              conf.get(COMPILE_CACHE_MIN_COMPILE_SECS))
+            _APPLIED = True
+        except Exception as e:  # noqa: BLE001 - cache must never break queries
+            _deactivate_locked(f"jax cache config failed: {e}")
+            return dict(_STATUS)
+        if _MANIFEST is None or _MANIFEST.path != \
+                os.path.join(cache_dir, MANIFEST_NAME):
+            _MANIFEST = CompileManifest(os.path.join(cache_dir,
+                                                     MANIFEST_NAME))
+        _STATUS.update(enabled=True, reason="", dir=cache_dir)
+        return dict(_STATUS)
+
+
+def _deactivate_locked(reason: str) -> None:
+    """Turn the cache OFF for real: revert any jax config this module
+    applied earlier, not just the reported status (a session disabling the
+    key — or the env kill-switch appearing — must stop XLA persisting and
+    replaying executables)."""
+    global _MANIFEST, _APPLIED
+    if _APPLIED:
+        # The compile layer is process-global and follows the most
+        # recently constructed session's conf: flipping OFF a cache an
+        # earlier session enabled is allowed, but never silent.
+        import warnings
+        warnings.warn(
+            f"persistent compile cache deactivated ({reason}); it was "
+            "enabled by an earlier session's conf — the compile layer is "
+            "process-global (docs/compile-cache.md)", stacklevel=4)
+        try:
+            _revert_jax_config()
+        except Exception:  # noqa: BLE001 - cache must never break queries
+            pass
+        _APPLIED = False
+    _STATUS.clear()
+    _STATUS.update(enabled=False, reason=reason)
+    _MANIFEST = None
+
+
+def _revert_jax_config() -> None:
+    import jax
+    jax.config.update("jax_enable_compilation_cache", False)
+    try:
+        jax.config.update("jax_compilation_cache_dir", None)
+    except Exception:  # noqa: BLE001 - enable=False is the load-bearing one
+        pass
+
+
+def _apply_jax_config(cache_dir: str, min_secs: float) -> None:
+    import jax
+    updates = {
+        "jax_enable_compilation_cache": True,
+        "jax_compilation_cache_dir": cache_dir,
+        "jax_persistent_cache_min_compile_time_secs": float(min_secs),
+        # Entry size floor of 0: tiny shrink/transition kernels recompile
+        # per rung too, and on remote-compile links they are not cheap.
+        "jax_persistent_cache_min_entry_size_bytes": 0,
+    }
+    for key, value in updates.items():
+        try:
+            jax.config.update(key, value)
+        except AttributeError:
+            # Older jax without this knob: the dir + enable flags are the
+            # load-bearing ones and exist back to 0.4.x.
+            if key in ("jax_enable_compilation_cache",
+                       "jax_compilation_cache_dir"):
+                raise
+
+
+def status() -> Dict[str, object]:
+    with _LOCK:
+        return dict(_STATUS)
+
+
+def manifest() -> Optional["CompileManifest"]:
+    """The configured manifest, or None when the cache is off."""
+    with _LOCK:
+        return _MANIFEST
+
+
+def plan_hash(plan_sig: tuple) -> str:
+    """Stable short hash of a structural plan signature
+    (utils.kernel_cache.plan_signature output: type names + primitives,
+    deterministic across processes)."""
+    return hashlib.sha256(repr(plan_sig).encode()).hexdigest()[:16]
+
+
+def _to_jsonable(v):
+    if isinstance(v, (list, tuple)):
+        return [_to_jsonable(x) for x in v]
+    return int(v)
+
+
+def _to_hashable(v):
+    if isinstance(v, list):
+        return tuple(_to_hashable(x) for x in v)
+    return int(v)
+
+
+class CompileManifest:
+    """Tiny crash-safe index: plan hash -> capacity vectors executed.
+
+    A capacity vector mirrors the nesting of a fused program's boundary
+    inputs (boundary -> partition -> batch) with each batch replaced by
+    its integer row capacity — exactly what :mod:`.warmup` needs to
+    rebuild abstract inputs for another rung. Writes are atomic
+    (tmp + rename); a corrupt or missing file loads as empty.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._plans: Dict[str, List[tuple]] = {}
+        self._load()
+
+    def _load(self) -> None:
+        try:
+            with open(self.path, encoding="utf-8") as f:
+                data = json.load(f)
+            for h, vecs in data.get("plans", {}).items():
+                self._plans[str(h)] = [_to_hashable(v) for v in vecs]
+        except (OSError, ValueError):
+            self._plans = {}
+
+    def record(self, plan_hash_: str, cap_vector: tuple) -> bool:
+        """Remember that ``plan_hash_`` ran with ``cap_vector``. Returns
+        True (and flushes) when the pair is new."""
+        with self._lock:
+            vecs = self._plans.setdefault(plan_hash_, [])
+            if cap_vector in vecs:
+                return False
+            vecs.append(cap_vector)
+            del vecs[:-_MAX_VECTORS_PER_PLAN]
+            while len(self._plans) > _MAX_PLANS:
+                self._plans.pop(next(iter(self._plans)))
+            self._flush_locked()
+            return True
+
+    def vectors_for(self, plan_hash_: str) -> List[tuple]:
+        with self._lock:
+            return list(self._plans.get(plan_hash_, []))
+
+    def _flush_locked(self) -> None:
+        data = {
+            "comment": "Compile manifest: capacity vectors each plan "
+                       "signature has executed with; warm-up replays "
+                       "them after restart (docs/compile-cache.md).",
+            "plans": {h: [_to_jsonable(v) for v in vecs]
+                      for h, vecs in self._plans.items()},
+        }
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(data, f, indent=1)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # manifest is an optimization; never fail the query
+
+
+def reset_for_tests() -> None:
+    global _MANIFEST, _APPLIED
+    with _LOCK:
+        _MANIFEST = None
+        _APPLIED = False
+        _STATUS.clear()
+        _STATUS.update(enabled=False, reason="not configured")

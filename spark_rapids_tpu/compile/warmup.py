@@ -1,0 +1,259 @@
+"""AOT warm-up — compile neighbor ladder rungs before the data gets there.
+
+The bucket ladder (:mod:`.ladder`) bounds how many programs a query shape
+can ever need; this module makes sure the NEXT one is already compiled
+when a growing dataset crosses a rung boundary, and that a restarted
+process re-builds everything the previous one served (the compile
+manifest, :mod:`.persist`) before the second query.
+
+Mechanics: after a fused query dispatches, :func:`note_run` records the
+run's **capacity vector** — the nesting of the fused program's boundary
+inputs (boundary -> partition -> batch) with each batch replaced by its
+integer row capacity — in the manifest, then (when
+``spark.rapids.tpu.warmup.auto`` is on) enqueues AOT compiles for:
+
+* the same vector scaled to neighboring ladder rungs
+  (``warmup.rungsAhead`` / ``warmup.rungsBehind``), and
+* every vector the manifest recorded for this plan in ANY process.
+
+A single daemon worker drains the queue through
+:meth:`..compile.executables.FusedProgram.compile_abstract`, so warmed
+shapes are visible to the dispatch path (plain ``lower().compile()``
+would not be — see executables.py). The queue holds only
+``ShapeDtypeStruct`` templates: no device buffers are pinned by pending
+warm-ups, and a warm-up failure only increments a counter — it can never
+fail a query.
+
+Best-effort by design: rebucketing rescales array dimensions that match
+the batch's row capacity, so a warmed rung is exact for fixed-width and
+dict-encoded-string batches (the engine default) and approximate when an
+unrelated static dimension (flat-string byte capacity) happens to grow in
+step; a miss there costs one ordinary jit compile, nothing more.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+import jax
+
+from . import persist
+from .executables import FusedProgram, abstract_like
+from .ladder import get_ladder
+
+_CV = threading.Condition()
+_QUEUE: deque = deque()
+_WORKER: Optional[threading.Thread] = None
+_INFLIGHT = 0
+_AUTO = False
+_AHEAD = 1
+_BEHIND = 0
+_STATS = {"scheduled": 0, "compiled": 0, "already_cached": 0, "errors": 0}
+
+#: Worker exits after this long with nothing to do; it restarts on demand.
+_IDLE_EXIT_SECS = 60.0
+
+#: At interpreter exit, wait at most this long for an in-flight compile.
+_SHUTDOWN_JOIN_SECS = 120.0
+_SHUTDOWN = False
+_ATEXIT_REGISTERED = False
+
+
+def configure(conf) -> None:
+    """Apply the conf's warm-up keys to the process (idempotent)."""
+    global _AUTO, _AHEAD, _BEHIND
+    from ..config import (WARMUP_AUTO, WARMUP_RUNGS_AHEAD,
+                          WARMUP_RUNGS_BEHIND)
+    with _CV:
+        _AUTO = bool(conf.get(WARMUP_AUTO))
+        _AHEAD = max(int(conf.get(WARMUP_RUNGS_AHEAD)), 0)
+        _BEHIND = max(int(conf.get(WARMUP_RUNGS_BEHIND)), 0)
+
+
+def capacity_vector(inputs) -> tuple:
+    """Nested row-capacity vector of a fused program's boundary inputs:
+    tuples mirror the nesting, each ColumnarBatch becomes its capacity."""
+    if isinstance(inputs, tuple):
+        return tuple(capacity_vector(x) for x in inputs)
+    return int(inputs.capacity)
+
+
+def _map_vec(vec, f):
+    if isinstance(vec, tuple):
+        return tuple(_map_vec(v, f) for v in vec)
+    return int(f(int(vec)))
+
+
+def _neighbor_vectors(vec) -> List[tuple]:
+    ladder = get_ladder()
+    out = []
+    for step in range(1, _AHEAD + 1):
+        out.append(_map_vec(vec, lambda c: ladder.next_up(c, step)))
+    for step in range(1, _BEHIND + 1):
+        out.append(_map_vec(vec, lambda c: ladder.next_down(c, step)))
+    if ladder.max_capacity > 0:
+        # Above the ladder top, dispatch uses exact lane-aligned fits —
+        # a geometric rung up there can never be dispatched, so compiling
+        # it would be pure waste.
+        top = ladder.bucket(ladder.max_capacity)
+        out = [v for v in out if _max_cap(v) <= top]
+    return out
+
+
+def _max_cap(vec) -> int:
+    if isinstance(vec, tuple):
+        return max((_max_cap(v) for v in vec), default=0)
+    return int(vec)
+
+
+def _rebucket(template, vec):
+    """Abstract boundary inputs with every batch re-capacitied to ``vec``
+    (same nesting as :func:`capacity_vector`)."""
+    if isinstance(template, tuple):
+        return tuple(_rebucket(t, v) for t, v in zip(template, vec))
+    return _rebucket_batch(template, int(vec))
+
+
+def _rebucket_batch(batch, new_cap: int):
+    old = batch.capacity
+    if new_cap == old:
+        return batch
+
+    def leaf(x):
+        shape = list(x.shape)
+        if shape and shape[0] == old:
+            shape[0] = new_cap          # data/validity/codes/lengths/live
+        elif shape and shape[0] == old + 1:
+            shape[0] = new_cap + 1      # string offsets
+        return jax.ShapeDtypeStruct(tuple(shape), x.dtype)
+    return jax.tree_util.tree_map(leaf, batch)
+
+
+def note_run(program: FusedProgram, plan_sig: tuple, inputs) -> None:
+    """Post-dispatch hook from the fused execution path: record the run's
+    capacity vector in the compile manifest and schedule background AOT
+    warm-ups. Called between program dispatch and the result download so
+    scheduling overlaps the transfer; near-free when both the persistent
+    cache and auto warm-up are off (``plan_sig`` is hashed only past the
+    early exit)."""
+    m = persist.manifest()
+    with _CV:
+        auto = _AUTO
+    if m is None and not auto:
+        return
+    plan_hash_ = persist.plan_hash(plan_sig)
+    vec = capacity_vector(inputs)
+    recorded: List[tuple] = []
+    if m is not None:
+        recorded = m.vectors_for(plan_hash_)
+        m.record(plan_hash_, vec)
+    if not auto or _SHUTDOWN:
+        return
+    seen = {vec}
+    targets = []
+    for v in _neighbor_vectors(vec) + recorded:
+        if v not in seen:
+            seen.add(v)
+            targets.append(v)
+    if not targets:
+        return
+    template = abstract_like(inputs)
+    with _CV:
+        for v in targets:
+            _QUEUE.append((program, template, v))
+            _STATS["scheduled"] += 1
+        _ensure_worker_locked()
+        _CV.notify_all()
+
+
+def _ensure_worker_locked() -> None:
+    global _WORKER, _ATEXIT_REGISTERED
+    if _SHUTDOWN:
+        return
+    if _WORKER is None or not _WORKER.is_alive():
+        _WORKER = threading.Thread(target=_work, name="tpu-compile-warmup",
+                                   daemon=True)
+        _WORKER.start()
+        if not _ATEXIT_REGISTERED:
+            # A daemon thread frozen mid-XLA-compile while C++ static
+            # destructors run aborts the process (std::terminate at exit,
+            # observed on the CPU backend). Stop scheduling and join the
+            # in-flight compile before the interpreter finalizes.
+            atexit.register(_stop_at_exit)
+            _ATEXIT_REGISTERED = True
+
+
+def _stop_at_exit() -> None:
+    global _SHUTDOWN
+    with _CV:
+        _SHUTDOWN = True
+        _QUEUE.clear()
+        _CV.notify_all()
+    worker = _WORKER
+    if worker is not None and worker.is_alive():
+        worker.join(timeout=_SHUTDOWN_JOIN_SECS)
+
+
+def _work() -> None:
+    global _INFLIGHT, _WORKER
+    while True:
+        with _CV:
+            if not _QUEUE and not _CV.wait(timeout=_IDLE_EXIT_SECS) \
+                    and not _QUEUE:
+                # Idle exit. Clear _WORKER under the lock so a concurrent
+                # note_run cannot observe a still-alive-but-exiting thread
+                # and strand its freshly queued warm-ups.
+                _WORKER = None
+                return
+            if _SHUTDOWN:
+                return
+            if not _QUEUE:
+                continue
+            program, template, vec = _QUEUE.popleft()
+            _INFLIGHT += 1
+        try:
+            abstract = _rebucket(template, vec)
+            result = program.compile_abstract((abstract,))
+            with _CV:
+                _STATS["compiled" if result == "compiled"
+                       else "already_cached"] += 1
+        except Exception:  # noqa: BLE001 - warm-up must never fail a query
+            with _CV:
+                _STATS["errors"] += 1
+        finally:
+            with _CV:
+                _INFLIGHT -= 1
+                _CV.notify_all()
+
+
+def drain(timeout: float = 60.0) -> bool:
+    """Block until the warm-up queue is empty and no compile is in flight
+    (tests/diagnostics). True when drained, False on timeout."""
+    deadline = time.monotonic() + timeout
+    with _CV:
+        while _QUEUE or _INFLIGHT:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return False
+            _CV.wait(left)
+    return True
+
+
+def stats() -> dict:
+    with _CV:
+        return dict(_STATS, queued=len(_QUEUE), in_flight=_INFLIGHT,
+                    auto=_AUTO, rungs_ahead=_AHEAD, rungs_behind=_BEHIND)
+
+
+def reset_for_tests() -> None:
+    global _AUTO, _AHEAD, _BEHIND
+    with _CV:
+        _QUEUE.clear()
+        for k in _STATS:
+            _STATS[k] = 0
+        _AUTO, _AHEAD, _BEHIND = False, 1, 0
+        _CV.notify_all()

@@ -332,12 +332,70 @@ TPU_UPLOAD_CACHE_BYTES = conf_int(
 
 TPU_CAPACITY_BUCKETING = conf_bool(
     "spark.rapids.tpu.capacityBucketing.enabled", True,
-    "Pad device batches to power-of-two capacities so XLA compiles one program "
-    "per bucket instead of one per row count.")
+    "Pad device batches to bucket-ladder capacities so XLA compiles one "
+    "program per rung instead of one per row count (compile/ladder.py). "
+    "Disabling degrades to bare 128-lane alignment — debugging only.")
 
 TPU_MIN_CAPACITY = conf_int(
     "spark.rapids.tpu.minCapacity", 128,
-    "Smallest device batch capacity; aligns with the 8x128 VPU lane layout.")
+    "Smallest device batch capacity (the bucket ladder's bottom rung); "
+    "aligns with the 8x128 VPU lane layout. Deployments that never see "
+    "small batches can raise this to skip compiling the tiny rungs.")
+
+TPU_LADDER_GROWTH = conf_float(
+    "spark.rapids.tpu.bucketLadder.growth", 2.0,
+    "Geometric spacing between capacity-ladder rungs. 2.0 is the classic "
+    "power-of-two ladder; 4.0 quarters the number of programs XLA ever "
+    "compiles at the price of up to 4x padding (attractive on slow "
+    "remote-compile backends); values toward 1.5 trade more programs for "
+    "less padded HBM. Rungs stay 128-lane aligned. See "
+    "docs/compile-cache.md.")
+
+TPU_LADDER_MAX_CAPACITY = conf_int(
+    "spark.rapids.tpu.bucketLadder.maxCapacity", 0,
+    "Ladder top: batches above this capacity get an exact lane-aligned "
+    "fit instead of the next geometric rung, bounding padded HBM waste "
+    "for huge batches. 0 = unbounded.")
+
+COMPILE_CACHE_ENABLED = conf_bool(
+    "spark.rapids.tpu.compileCache.enabled", False,
+    "Persist XLA executables to disk (JAX persistent compilation cache) "
+    "plus a manifest of (plan, capacity-rung) shapes, so a restarted "
+    "process skips recompiling everything it served before. Off by "
+    "default: some remote-compile helpers deadlock on the cache and "
+    "cross-machine AOT artifacts can SIGILL on replay (see "
+    "docs/compile-cache.md before enabling). The "
+    "JAX_ENABLE_COMPILATION_CACHE=false environment kill-switch always "
+    "wins.")
+
+COMPILE_CACHE_DIR = conf_str(
+    "spark.rapids.tpu.compileCache.dir", None,
+    "Directory for the persistent executable cache + compile manifest. "
+    "Default: ~/.cache/spark_rapids_tpu/xla.")
+
+COMPILE_CACHE_MIN_COMPILE_SECS = conf_float(
+    "spark.rapids.tpu.compileCache.minCompileSecs", 0.0,
+    "Only persist executables whose compile took at least this long "
+    "(jax_persistent_cache_min_compile_time_secs). 0 persists "
+    "everything.")
+
+WARMUP_AUTO = conf_bool(
+    "spark.rapids.tpu.warmup.auto", False,
+    "After each fused query runs at some capacity rung, AOT-compile the "
+    "same program at neighboring ladder rungs (and any rung recorded in "
+    "the compile manifest) in a background thread, so growing data never "
+    "stalls at a rung boundary. Off by default: it multiplies compile "
+    "work, which only pays off for long-lived serving sessions.")
+
+WARMUP_RUNGS_AHEAD = conf_int(
+    "spark.rapids.tpu.warmup.rungsAhead", 1,
+    "How many ladder rungs ABOVE the observed capacity the auto warm-up "
+    "pre-compiles (growing datasets climb the ladder upward).")
+
+WARMUP_RUNGS_BEHIND = conf_int(
+    "spark.rapids.tpu.warmup.rungsBehind", 0,
+    "How many ladder rungs BELOW the observed capacity the auto warm-up "
+    "pre-compiles.")
 
 TPU_JOIN_OUTPUT_GROWTH = conf_float(
     "spark.rapids.tpu.join.outputGrowthFactor", 1.0,

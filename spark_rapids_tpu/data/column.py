@@ -38,16 +38,11 @@ import pyarrow as pa
 from .. import types as T
 
 #: Lane width of the VPU — the minimum sensible capacity granularity.
-LANE = 128
-
-
-def bucket_capacity(n: int, min_capacity: int = LANE) -> int:
-    """Round up to a power of two (>= min_capacity) to bound jit cache size."""
-    cap = max(int(min_capacity), LANE)
-    n = max(int(n), 1)
-    while cap < n:
-        cap <<= 1
-    return cap
+#: Canonical definition (and the bucket policy itself) live in
+#: compile/ladder.py; re-exported here because every exec imports them
+#: from this module since the seed.
+from ..compile.ladder import (LANE, bucket_byte_capacity,  # noqa: E402,F401
+                              bucket_capacity)
 
 
 @jax.tree_util.register_pytree_node_class
@@ -222,7 +217,7 @@ class DeviceColumn:
         n = len(offsets) - 1
         assert n <= capacity
         nbytes = int(offsets[-1])
-        byte_capacity = byte_capacity or bucket_capacity(max(nbytes, 1))
+        byte_capacity = byte_capacity or bucket_byte_capacity(max(nbytes, 1))
         off = np.full(capacity + 1, nbytes, dtype=np.int32)
         off[: n + 1] = offsets.astype(np.int32, copy=False)
         payload = np.zeros(byte_capacity, dtype=np.uint8)
@@ -233,7 +228,7 @@ class DeviceColumn:
         else:
             mask[:n] = validity
         item_lens = np.diff(offsets)
-        max_bytes = bucket_capacity(int(item_lens.max()) if n else 1, 8)
+        max_bytes = bucket_byte_capacity(int(item_lens.max()) if n else 1, 8)
         return DeviceColumn(jnp.asarray(payload), jnp.asarray(mask), T.STRING,
                             offsets=jnp.asarray(off), max_bytes=max_bytes)
 
@@ -346,7 +341,7 @@ class DeviceColumn:
         offsets = np.concatenate([[0], np.cumsum(lens)]).astype(np.int32)
         payload = np.frombuffer(b"".join(raw), dtype=np.uint8) \
             if offsets[-1] else np.zeros(0, np.uint8)
-        byte_cap = bucket_capacity(max(int(offsets[-1]), 1))
+        byte_cap = bucket_byte_capacity(max(int(offsets[-1]), 1))
         buf = np.zeros(byte_cap, np.uint8)
         buf[: offsets[-1]] = payload
         code_buf = np.zeros(capacity, np.int32)
@@ -357,7 +352,7 @@ class DeviceColumn:
         else:
             mask[: len(arr)] = validity
             code_buf[: len(codes)] = np.where(validity, codes, 0)
-        max_bytes = bucket_capacity(int(lens.max()) if n_dict else 1, 8)
+        max_bytes = bucket_byte_capacity(int(lens.max()) if n_dict else 1, 8)
         return DeviceColumn(
             data=jnp.asarray(buf), validity=jnp.asarray(mask),
             dtype=T.STRING, offsets=jnp.asarray(offsets),
@@ -576,7 +571,7 @@ def scalar_column(value, dtype: T.DataType, capacity: int,
         # O(1) payload instead of a capacity-wide tiled buffer.
         raw = np.frombuffer(str(value).encode("utf-8"), dtype=np.uint8)
         ln = len(raw)
-        byte_cap = bucket_capacity(max(ln, 1), 8)
+        byte_cap = bucket_byte_capacity(max(ln, 1), 8)
         payload = np.zeros(byte_cap, dtype=np.uint8)
         payload[:ln] = raw
         valid = live
@@ -585,7 +580,7 @@ def scalar_column(value, dtype: T.DataType, capacity: int,
             validity=valid,
             dtype=T.STRING,
             offsets=jnp.asarray(np.asarray([0, ln], np.int32)),
-            max_bytes=bucket_capacity(max(ln, 1), 8),
+            max_bytes=bucket_byte_capacity(max(ln, 1), 8),
             codes=jnp.zeros(capacity, dtype=jnp.int32),
             dict_sorted=True)
     valid = live

@@ -40,6 +40,8 @@ import numpy as np
 import pyarrow as pa
 
 from .. import types as T
+from ..compile import warmup as _warmup
+from ..compile.executables import FusedProgram
 from ..data.batch import ColumnarBatch, _shrink_batch
 from ..data.column import bucket_capacity
 from ..plan.physical import ExecContext
@@ -204,14 +206,23 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
            tuple(sorted(ctx.dense_modes.items())))
     fn = _FUSED_CACHE.get(sig)
     if fn is None:
-        fn = _build_fused(fused_plan, ctx.conf, ctx.join_growth, guess_rows,
-                          ctx.join_caps, ctx.dense_modes)
+        # FusedProgram: the jitted callable plus its AOT executable table,
+        # so background warm-ups (compile/warmup.py) are visible to this
+        # dispatch instead of rotting in jit's invisible lower() path.
+        fn = FusedProgram(_build_fused(fused_plan, ctx.conf,
+                                       ctx.join_growth, guess_rows,
+                                       ctx.join_caps, ctx.dense_modes),
+                          label=type(device_plan).__name__)
         _FUSED_CACHE[sig] = fn
     # Boundary subtrees run eagerly (uploads, windows, shuffles, ...); their
     # materialized batches are the fused program's positional arguments.
     inputs = tuple(tuple(tuple(p) for p in b.execute(ctx))
                    for b in boundaries)
     head, full = fn(inputs)
+    # Between dispatch and download: record this run's capacity rungs in
+    # the compile manifest and schedule neighbor-rung AOT warm-ups, so the
+    # scheduling work overlaps the device->host transfer below.
+    _warmup.note_run(fn, sig, inputs)
     n_rows_np, flags_np, totals_np, dfails_np, shrunk_np = \
         jax.device_get(head)  # ONE round trip
     # Surface inlined joins' observed totals and dense-fail flags for the

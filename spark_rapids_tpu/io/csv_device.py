@@ -40,7 +40,8 @@ import numpy as np
 
 from .. import types as T
 from ..data.batch import ColumnarBatch
-from ..data.column import DeviceColumn, bucket_capacity
+from ..data.column import (DeviceColumn, bucket_byte_capacity,
+                           bucket_capacity)
 from ..utils.kernel_cache import cached_kernel
 from ..utils.tracing import trace_range
 
@@ -276,7 +277,7 @@ def _decode_slice(dev_buf, starts: np.ndarray, ends: np.ndarray,
     n = len(starts)
     cap = bucket_capacity(n)
     widths = tuple(
-        int(bucket_capacity(int((ends[:, j] - starts[:, j]).max())
+        int(bucket_byte_capacity(int((ends[:, j] - starts[:, j]).max())
                             if n else 1, 8))
         for j in range(len(schema)))
     dtypes = tuple(f.data_type.name for f in schema)

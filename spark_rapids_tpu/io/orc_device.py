@@ -39,7 +39,8 @@ import pyarrow as pa
 
 from .. import types as T
 from ..data.batch import ColumnarBatch
-from ..data.column import DeviceColumn, bucket_capacity
+from ..data.column import (DeviceColumn, bucket_byte_capacity,
+                           bucket_capacity)
 from ..utils.kernel_cache import cached_kernel
 from ..utils.tracing import trace_range
 
@@ -396,7 +397,7 @@ def _runs_arrays(runs: _Runs, pad: int):
         a[: len(xs)] = xs
         return jnp.asarray(a)
     vals = np.asarray(runs.values or [0], np.int64)
-    vcap = bucket_capacity(max(len(vals), 1), 8)
+    vcap = bucket_byte_capacity(max(len(vals), 1), 8)
     vbuf = np.zeros(vcap, np.int64)
     vbuf[: len(vals)] = vals
     return (arr(runs.kinds, 0, np.int32), arr(runs.counts, 0, np.int32),
@@ -426,7 +427,7 @@ def _expand_present(packed: jnp.ndarray, capacity: int) -> jnp.ndarray:
 
 
 def _pad_bits(bits: Optional[np.ndarray], capacity: int) -> jnp.ndarray:
-    cap = bucket_capacity(max(capacity // 8 + 1, 8), 8)
+    cap = bucket_byte_capacity(max(capacity // 8 + 1, 8), 8)
     buf = np.full(cap, 0xFF, np.uint8)
     if bits is not None:
         buf[: len(bits)] = bits
@@ -443,7 +444,7 @@ _INT_KINDS = {_K_SHORT: T.SHORT, _K_INT: T.INT, _K_LONG: T.LONG,
 
 def _decode_int_column(runs: _Runs, bits, n_rows: int, capacity: int,
                        dtype: T.DataType) -> DeviceColumn:
-    pad = bucket_capacity(max(len(runs.kinds), 1), 8)
+    pad = bucket_byte_capacity(max(len(runs.kinds), 1), 8)
     table = _runs_arrays(runs, pad)
     packed = _pad_bits(bits, capacity)
 
@@ -514,9 +515,9 @@ def _dict_from_blob(blob: bytes, lengths: np.ndarray
 
 def _string_column_from_codes(codes_dev, validity, payload: np.ndarray,
                               offsets: np.ndarray) -> DeviceColumn:
-    max_bytes = bucket_capacity(
+    max_bytes = bucket_byte_capacity(
         max(int(np.diff(offsets).max()) if len(offsets) > 1 else 1, 1), 8)
-    byte_cap = bucket_capacity(max(int(offsets[-1]), 1))
+    byte_cap = bucket_byte_capacity(max(int(offsets[-1]), 1))
     buf = np.zeros(byte_cap, np.uint8)
     buf[: len(payload)] = payload
     return DeviceColumn(data=jnp.asarray(buf), validity=validity,
@@ -599,7 +600,7 @@ def decode_stripe(path: str, tail: OrcTail, si: StripeInfo,
                 codes = _decode_int_column(cruns, bits, n_rows, capacity,
                                            T.INT)
                 remap_pad = np.zeros(
-                    bucket_capacity(max(len(remap), 1), 8), np.int32)
+                    bucket_byte_capacity(max(len(remap), 1), 8), np.int32)
                 remap_pad[: len(remap)] = remap
                 rdev = jnp.asarray(remap_pad)
                 code_vals = rdev[jnp.clip(codes.data.astype(jnp.int32), 0,

@@ -41,7 +41,8 @@ import numpy as np
 
 from .. import types as T
 from ..data.batch import ColumnarBatch
-from ..data.column import DeviceColumn, bucket_capacity
+from ..data.column import (DeviceColumn, bucket_byte_capacity,
+                           bucket_capacity)
 from ..utils.kernel_cache import cached_kernel
 
 # -- minimal thrift compact protocol reader ---------------------------------
@@ -450,7 +451,7 @@ def _decode_chunk_device(def_table, idx_table, packed, plain, dict_table,
 
 def _pad_packed(packed: bytes) -> jnp.ndarray:
     raw = np.frombuffer(packed or b"\0\0\0\0", dtype=np.uint8)
-    cap = bucket_capacity(max(len(raw), 4), 8)
+    cap = bucket_byte_capacity(max(len(raw), 4), 8)
     buf = np.zeros(cap, np.uint8)
     buf[: len(raw)] = raw
     return jnp.asarray(buf)
@@ -468,7 +469,7 @@ def _runs_arrays(runs: _HybridRuns, pad_to: int):
 
 def decode_chunk(plan: ColumnChunkPlan, capacity: int) -> DeviceColumn:
     """Upload one chunk's page bytes + run tables and decode on device."""
-    pad = bucket_capacity(max(len(plan.def_runs.kinds),
+    pad = bucket_byte_capacity(max(len(plan.def_runs.kinds),
                               len(plan.idx_runs.kinds)
                               if plan.idx_runs else 1, 1), 8)
     def_table = _runs_arrays(plan.def_runs, pad)
@@ -478,7 +479,7 @@ def decode_chunk(plan: ColumnChunkPlan, capacity: int) -> DeviceColumn:
         """Pad to a power-of-two length: unbucketed shapes would retrace
         the jitted kernel per row group (kernel_cache discipline). Also
         keeps (masked-out) gathers in range for empty dictionaries."""
-        cap = bucket_capacity(max(len(arr), 1), 8)
+        cap = bucket_byte_capacity(max(len(arr), 1), 8)
         buf = np.zeros(cap, dtype)
         buf[: len(arr)] = arr
         return jnp.asarray(buf)
@@ -513,9 +514,9 @@ def decode_chunk(plan: ColumnChunkPlan, capacity: int) -> DeviceColumn:
     if dict_string:
         max_bytes = 8
         if plan.dict_offsets is not None and len(plan.dict_offsets) > 1:
-            max_bytes = bucket_capacity(
+            max_bytes = bucket_byte_capacity(
                 int(np.diff(plan.dict_offsets).max() or 1), 8)
-        byte_cap = bucket_capacity(max(int(plan.dict_offsets[-1]), 1))
+        byte_cap = bucket_byte_capacity(max(int(plan.dict_offsets[-1]), 1))
         payload = np.zeros(byte_cap, np.uint8)
         payload[: len(plan.dict_payload)] = plan.dict_payload
         return DeviceColumn(

@@ -24,7 +24,8 @@ import numpy as np
 
 from ... import types as T
 from ...data.batch import ColumnarBatch
-from ...data.column import DeviceColumn, bucket_capacity
+from ...data.column import (DeviceColumn, bucket_byte_capacity,
+                            bucket_capacity)
 from ..strings_util import PAD, char_matrix
 
 
@@ -173,7 +174,7 @@ def strings_from_matrix(m: jnp.ndarray, validity: jnp.ndarray,
     offsets = jnp.concatenate([jnp.zeros(1, jnp.int32),
                                jnp.cumsum(lens).astype(jnp.int32)])
     total_bytes = offsets[-1]
-    byte_cap = bucket_capacity(out_cap * w)
+    byte_cap = bucket_byte_capacity(out_cap * w)
     drop = (flat == PAD).astype(jnp.int8)
     _, sorted_chars = jax.lax.sort((drop, flat), num_keys=1, is_stable=True)
     kept = jnp.pad(sorted_chars, (0, byte_cap - sorted_chars.shape[0]))

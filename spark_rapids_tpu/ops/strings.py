@@ -26,7 +26,7 @@ import pyarrow.compute as pc
 
 from .. import types as T
 from ..data.batch import ColumnarBatch, HostBatch
-from ..data.column import DeviceColumn, bucket_capacity
+from ..data.column import DeviceColumn, bucket_byte_capacity
 from .expression import (Expression, UnaryExpression, host_to_array,
                          make_column)
 from .kernels.rowops import strings_from_matrix
@@ -152,7 +152,7 @@ class Substring(Expression):
         gathered = jnp.take_along_axis(m, jnp.clip(cols_idx, 0, w - 1), axis=1)
         out_m = jnp.where(in_range, gathered, PAD)
         return strings_from_matrix(out_m, c.validity,
-                                   bucket_capacity(out_w, 8))
+                                   bucket_byte_capacity(out_w, 8))
 
 
 class _FixMatch(Expression):
@@ -400,7 +400,7 @@ class ConcatStrings(Expression):
             validity = validity & c.validity
         out = jnp.where(validity[:, None], out, PAD)
         return strings_from_matrix(out, validity,
-                                   bucket_capacity(sum(c.max_bytes
+                                   bucket_byte_capacity(sum(c.max_bytes
                                                        for c in cols), 8))
 
 

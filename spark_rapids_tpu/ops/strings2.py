@@ -21,7 +21,7 @@ import pyarrow.compute as pc
 
 from .. import types as T
 from ..data.batch import ColumnarBatch, HostBatch
-from ..data.column import DeviceColumn, bucket_capacity
+from ..data.column import DeviceColumn, bucket_byte_capacity
 from .expression import Expression, host_to_array, make_column
 from .kernels.rowops import strings_from_matrix
 from .strings_util import PAD, char_matrix, lengths, map_string_column
@@ -125,7 +125,7 @@ class StringReplace(Expression):
             out = jnp.where(live, out, PAD)
             return strings_from_matrix(
                 jnp.where(col.validity[:, None], out, PAD), col.validity,
-                bucket_capacity(w_out, 8))
+                bucket_byte_capacity(w_out, 8))
         return map_string_column(c, xform)
 
 
@@ -213,7 +213,7 @@ class _Pad(Expression):
                 out = jnp.where(oidx < out_len[:, None], s_char, PAD)
                 return strings_from_matrix(
                     jnp.where(col.validity[:, None], out, PAD),
-                    col.validity, bucket_capacity(max(target, 1), 8))
+                    col.validity, bucket_byte_capacity(max(target, 1), 8))
             pad_n = jnp.maximum(target - ln, 0)
             if self.left:
                 src = oidx - pad_n[:, None]
@@ -230,7 +230,7 @@ class _Pad(Expression):
             out = jnp.where(oidx < target, val, PAD)
             return strings_from_matrix(
                 jnp.where(col.validity[:, None], out, PAD), col.validity,
-                bucket_capacity(max(target, 1), 8))
+                bucket_byte_capacity(max(target, 1), 8))
         return map_string_column(c, xform)
 
 
@@ -474,7 +474,7 @@ class StringRepeat(Expression):
             live = idx < (ln * reps)[:, None]
             return strings_from_matrix(jnp.where(live, out, PAD),
                                        col.validity,
-                                       bucket_capacity(w_out, 8))
+                                       bucket_byte_capacity(w_out, 8))
         return map_string_column(c, xform)
 
 

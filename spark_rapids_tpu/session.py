@@ -60,6 +60,10 @@ class TpuSession:
         from .ops.kernels import pallas_kernels
         upload_cache.set_budget(self.conf.get(TPU_UPLOAD_CACHE_BYTES))
         pallas_kernels.configure(self.conf.get(TPU_PALLAS_ENABLED))
+        # Compile-once layer: bucket ladder, persistent XLA executable
+        # cache, AOT warm-up worker (compile/, docs/compile-cache.md).
+        from . import compile as compile_layer
+        compile_layer.configure(self.conf)
 
     # -- conf ---------------------------------------------------------------
     def with_conf(self, **kv) -> "TpuSession":
@@ -67,7 +71,27 @@ class TpuSession:
         s.conf = self.conf.with_overrides(**kv)
         s.device_manager = self.device_manager
         s._overrides = TpuOverrides(s.conf)
+        from . import compile as compile_layer
+        compile_layer.configure(s.conf)
         return s
+
+    def compile_status(self) -> dict:
+        """Diagnostic snapshot of the compile-once layer: the process
+        bucket ladder, persistent-cache state, warm-up counters, fused
+        program dispatch stats, and the operator kernel cache. See
+        docs/compile-cache.md."""
+        import dataclasses
+        from .compile import executables, ladder, persist, warmup
+        from .exec import fusion
+        from .utils import kernel_cache
+        return {
+            "ladder": dataclasses.asdict(ladder.get_ladder()),
+            "persistent_cache": persist.status(),
+            "warmup": warmup.stats(),
+            "fused_programs": executables.stats(),
+            "fused_cache_entries": len(fusion._FUSED_CACHE),
+            "kernel_cache": kernel_cache.cache_stats(),
+        }
 
     # -- data sources -------------------------------------------------------
     @property

@@ -1,0 +1,126 @@
+"""Bucket-ladder control (compile/ladder.py): the shared capacity ladder
+must reproduce the seed's power-of-two policy at defaults, honor the new
+growth/min/max knobs, and wire through the session conf."""
+
+import pytest
+
+from spark_rapids_tpu.compile.ladder import (LANE, BucketLadder,
+                                             bucket_capacity, get_ladder,
+                                             set_ladder)
+
+
+@pytest.fixture(autouse=True)
+def _restore_ladder():
+    prev = get_ladder()
+    yield
+    set_ladder(prev)
+
+
+def _seed_bucket(n, min_capacity=LANE):
+    """The seed's hard-wired policy (data/column.py before this layer)."""
+    cap = max(int(min_capacity), LANE)
+    n = max(int(n), 1)
+    while cap < n:
+        cap <<= 1
+    return cap
+
+
+class TestDefaultLadder:
+    def test_matches_seed_pow2(self):
+        ladder = BucketLadder()
+        for n in (0, 1, 8, 127, 128, 129, 255, 256, 1000, 4096, 5000,
+                  1 << 20, (1 << 20) + 1):
+            for mc in (1, 8, 128, 512):
+                assert ladder.bucket(n, mc) == _seed_bucket(n, mc), (n, mc)
+
+    def test_module_function_delegates_to_process_ladder(self):
+        assert bucket_capacity(1000) == 1024
+        set_ladder(BucketLadder(growth=4.0))
+        assert bucket_capacity(1000) == get_ladder().bucket(1000)
+
+
+class TestKnobs:
+    def test_growth_4_produces_fewer_rungs(self):
+        wide = BucketLadder(growth=4.0)
+        narrow = BucketLadder(growth=2.0)
+        lo, hi = 128, 1 << 20
+        assert len(wide.rungs(lo, hi)) < len(narrow.rungs(lo, hi))
+        for cap in wide.rungs(lo, hi):
+            assert cap % LANE == 0
+
+    def test_growth_1_5_lane_aligned_and_monotone(self):
+        ladder = BucketLadder(growth=1.5)
+        rungs = ladder.rungs(128, 100_000)
+        assert rungs == sorted(set(rungs))
+        for prev, nxt in zip(rungs, rungs[1:]):
+            assert nxt % LANE == 0
+            assert nxt > prev
+        for n in (129, 5000, 99_999):
+            assert ladder.bucket(n) >= n
+
+    def test_min_capacity_floors_the_ladder(self):
+        ladder = BucketLadder(min_capacity=4096)
+        assert ladder.bucket(1) == 4096
+        assert ladder.bucket(4097) == 8192
+
+    def test_max_capacity_exact_fit_above_top(self):
+        ladder = BucketLadder(max_capacity=1024)
+        assert ladder.bucket(900) == 1024          # still on the ladder
+        assert ladder.bucket(1025) == 1152         # exact lane-aligned fit
+        assert ladder.bucket(1_000_000) == 1_000_064
+
+    def test_disabled_degrades_to_lane_alignment(self):
+        ladder = BucketLadder(enabled=False)
+        assert ladder.bucket(1) == 128
+        assert ladder.bucket(129) == 256
+        assert ladder.bucket(1000) == 1024
+        assert ladder.bucket(1025) == 1152
+
+    def test_bucket_bytes_ignores_conf_row_floor_and_cap(self):
+        # Raising spark.rapids.tpu.minCapacity must not inflate string
+        # payload / dictionary / decode-scratch buffers (code-review
+        # finding: tuning docs advise 4096+ row floors).
+        ladder = BucketLadder(min_capacity=4096, max_capacity=8192)
+        assert ladder.bucket(10) == 4096
+        assert ladder.bucket_bytes(10, 8) == 128      # seed behavior
+        assert ladder.bucket_bytes(1000) == 1024
+        assert ladder.bucket_bytes(100_000) == 131072  # no top cut-off
+
+    def test_invalid_growth_rejected(self):
+        with pytest.raises(ValueError):
+            BucketLadder(growth=1.0)
+
+    def test_next_up_down(self):
+        ladder = BucketLadder()
+        assert ladder.next_up(128) == 256
+        assert ladder.next_up(100, steps=2) == 512
+        assert ladder.next_down(512) == 256
+        assert ladder.next_down(128, steps=3) == 128  # floored at base
+        # Inverse on interior rungs.
+        for cap in (256, 1024, 1 << 15):
+            assert ladder.next_down(ladder.next_up(cap)) == cap
+
+
+class TestConfWiring:
+    def test_session_conf_configures_process_ladder(self):
+        from spark_rapids_tpu import compile as compile_layer
+        from spark_rapids_tpu.config import TpuConf
+        status = compile_layer.configure(TpuConf({
+            "spark.rapids.tpu.bucketLadder.growth": 4.0,
+            "spark.rapids.tpu.minCapacity": 256,
+            "spark.rapids.tpu.bucketLadder.maxCapacity": 1 << 16,
+        }))
+        ladder = get_ladder()
+        assert ladder.growth == 4.0
+        assert ladder.min_capacity == 256
+        assert ladder.max_capacity == 1 << 16
+        assert status["ladder"] is ladder
+        assert bucket_capacity(1) == 256
+
+    def test_default_conf_restores_seed_policy(self):
+        from spark_rapids_tpu import compile as compile_layer
+        from spark_rapids_tpu.config import TpuConf
+        compile_layer.configure(TpuConf({
+            "spark.rapids.tpu.bucketLadder.growth": 4.0}))
+        compile_layer.configure(TpuConf())
+        assert bucket_capacity(1000) == _seed_bucket(1000)

@@ -1,0 +1,285 @@
+"""Compile-once layer (compile/persist.py, executables.py, warmup.py):
+persistent-cache configuration must honor the conf and the environment
+kill-switch, the compile manifest must survive process restarts, and the
+AOT warm-up must make neighbor-rung dispatches hit pre-compiled
+executables — the whole point of the layer."""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.compile import executables, persist, warmup
+from spark_rapids_tpu.config import TpuConf
+
+
+@pytest.fixture(autouse=True)
+def _reset_compile_layer():
+    yield
+    persist.reset_for_tests()
+    warmup.reset_for_tests()
+
+
+def _conf(tmp_path, **extra):
+    return TpuConf({
+        "spark.rapids.tpu.compileCache.enabled": True,
+        "spark.rapids.tpu.compileCache.dir": str(tmp_path / "xla"),
+        **extra,
+    })
+
+
+class TestPersistConfigure:
+    def test_disabled_by_default(self):
+        status = persist.configure(TpuConf())
+        assert status["enabled"] is False
+        assert persist.manifest() is None
+
+    def test_env_kill_switch_wins(self, tmp_path, monkeypatch):
+        # conftest sets JAX_ENABLE_COMPILATION_CACHE=false for the CPU
+        # tier; the conf must NOT override it.
+        monkeypatch.setenv("JAX_ENABLE_COMPILATION_CACHE", "false")
+        status = persist.configure(_conf(tmp_path))
+        assert status["enabled"] is False
+        assert "environment" in status["reason"]
+        assert persist.manifest() is None
+
+    def test_enabled_path_creates_dir_and_manifest(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.delenv("JAX_ENABLE_COMPILATION_CACHE", raising=False)
+        applied = {}
+        monkeypatch.setattr(persist, "_apply_jax_config",
+                            lambda d, secs: applied.update(dir=d, secs=secs))
+        status = persist.configure(_conf(tmp_path))
+        assert status["enabled"] is True
+        assert os.path.isdir(status["dir"])
+        assert applied["dir"] == status["dir"]
+        assert persist.manifest() is not None
+
+    def test_disable_after_enable_reverts_jax_config(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.delenv("JAX_ENABLE_COMPILATION_CACHE", raising=False)
+        events = []
+        monkeypatch.setattr(persist, "_apply_jax_config",
+                            lambda d, secs: events.append("apply"))
+        monkeypatch.setattr(persist, "_revert_jax_config",
+                            lambda: events.append("revert"))
+        assert persist.configure(_conf(tmp_path))["enabled"] is True
+        status = persist.configure(TpuConf())     # cache off again
+        assert status["enabled"] is False
+        assert "dir" not in status                # no stale dir reported
+        assert events == ["apply", "revert"]
+        # Disabling twice must not revert twice.
+        persist.configure(TpuConf())
+        assert events == ["apply", "revert"]
+
+    def test_jax_config_failure_degrades_to_disabled(self, tmp_path,
+                                                     monkeypatch):
+        monkeypatch.delenv("JAX_ENABLE_COMPILATION_CACHE", raising=False)
+
+        def boom(d, secs):
+            raise RuntimeError("no cache for you")
+        monkeypatch.setattr(persist, "_apply_jax_config", boom)
+        status = persist.configure(_conf(tmp_path))
+        assert status["enabled"] is False
+        assert "no cache for you" in status["reason"]
+
+
+class TestCompileManifest:
+    def test_record_and_reload(self, tmp_path):
+        path = str(tmp_path / persist.MANIFEST_NAME)
+        m = persist.CompileManifest(path)
+        vec = ((((256,),),),)
+        assert m.record("abcd", vec) is True
+        assert m.record("abcd", vec) is False       # dedup
+        assert m.record("abcd", ((((512,),),),)) is True
+        # A NEW process loads the same vectors back as hashable tuples.
+        m2 = persist.CompileManifest(path)
+        assert m2.vectors_for("abcd") == [vec, ((((512,),),),)]
+        assert m2.vectors_for("unknown") == []
+
+    def test_corrupt_file_loads_empty(self, tmp_path):
+        path = str(tmp_path / persist.MANIFEST_NAME)
+        with open(path, "w") as f:
+            f.write("{not json")
+        m = persist.CompileManifest(path)
+        assert m.vectors_for("x") == []
+        assert m.record("x", (128,)) is True        # and still writes
+
+    def test_vectors_per_plan_bounded(self, tmp_path):
+        m = persist.CompileManifest(str(tmp_path / persist.MANIFEST_NAME))
+        for i in range(20):
+            m.record("p", (128 * (i + 1),))
+        assert len(m.vectors_for("p")) <= 8
+
+    def test_flush_is_valid_json(self, tmp_path):
+        path = str(tmp_path / persist.MANIFEST_NAME)
+        persist.CompileManifest(path).record("p", ((128, 256), (512,)))
+        with open(path) as f:
+            data = json.load(f)
+        assert data["plans"]["p"] == [[[128, 256], [512]]]
+
+    def test_plan_hash_deterministic(self):
+        sig = (("TpuProjectExec", (), ()), 1.0, 1024, (), ())
+        assert persist.plan_hash(sig) == persist.plan_hash(sig)
+        assert persist.plan_hash(sig) != persist.plan_hash(sig + (1,))
+
+
+def _double(x):
+    return jax.tree_util.tree_map(lambda v: v * 2, x)
+
+
+_DOUBLE_JIT = jax.jit(_double)
+
+
+class TestFusedProgram:
+    def test_aot_dispatch_and_fallback(self):
+        prog = executables.FusedProgram(_DOUBLE_JIT)
+        x = jnp.arange(128, dtype=jnp.int64)
+        # Cold shape: jit path.
+        np.testing.assert_array_equal(np.asarray(prog(x)),
+                                      np.arange(128) * 2)
+        assert prog.stats()["jit_calls"] == 1
+        # Warm a DIFFERENT shape abstractly, then dispatch it: AOT hit.
+        big = jax.ShapeDtypeStruct((256,), jnp.int64)
+        assert prog.compile_abstract((big,)) == "compiled"
+        assert prog.compile_abstract((big,)) == "cached"
+        y = jnp.arange(256, dtype=jnp.int64)
+        np.testing.assert_array_equal(np.asarray(prog(y)),
+                                      np.arange(256) * 2)
+        s = prog.stats()
+        assert s["aot_hits"] == 1 and s["jit_calls"] == 1
+        assert s["aot_executables"] == 1
+
+    def test_aval_signature_shared_between_concrete_and_abstract(self):
+        x = jnp.zeros((128,), jnp.int64)
+        assert executables.aval_signature((x,)) == executables.aval_signature(
+            (jax.ShapeDtypeStruct((128,), jnp.int64),))
+        assert executables.aval_signature((x,)) != executables.aval_signature(
+            (jax.ShapeDtypeStruct((256,), jnp.int64),))
+
+
+def _query(session, n):
+    from spark_rapids_tpu.ops import aggregates as AGG
+    from spark_rapids_tpu.ops import predicates as P
+    from spark_rapids_tpu.ops.expression import col, lit
+    rb = pa.RecordBatch.from_pydict({
+        "k": np.arange(n, dtype=np.int64) % 5,
+        "v": np.arange(n, dtype=np.int64),
+    })
+    return (session.create_dataframe(rb)
+            .where(P.GreaterThan(col("v"), lit(3)))
+            .group_by(col("k"))
+            .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s")))
+
+
+class TestWarmupEndToEnd:
+    def test_capacity_vector_and_rebucket(self):
+        from spark_rapids_tpu.data.batch import ColumnarBatch
+        rb = pa.RecordBatch.from_pydict(
+            {"a": np.arange(100, dtype=np.int64)})
+        batch = ColumnarBatch.from_arrow(rb)
+        inputs = (((batch,),),)
+        assert warmup.capacity_vector(inputs) == (((128,),),)
+        template = executables.abstract_like(inputs)
+        grown = warmup._rebucket(template, (((256,),),))
+        gbatch = grown[0][0][0]
+        assert gbatch.capacity == 256
+        assert all(isinstance(leaf, jax.ShapeDtypeStruct)
+                   for leaf in jax.tree_util.tree_leaves(gbatch))
+
+    def test_auto_warmup_makes_next_rung_an_aot_hit(self):
+        from spark_rapids_tpu.exec import fusion
+        from spark_rapids_tpu.session import TpuSession
+        fusion.clear_fused_cache()
+        s = TpuSession({"spark.rapids.tpu.warmup.auto": True})
+        _query(s, 100).collect()             # cap 128; warms rung 256
+        assert warmup.drain(120), "warm-up queue did not drain"
+        st = warmup.stats()
+        assert st["scheduled"] >= 1 and st["errors"] == 0
+        programs = [p for p in fusion._FUSED_CACHE.values()
+                    if isinstance(p, executables.FusedProgram)]
+        assert programs and any(p.n_aot >= 1 for p in programs)
+        before = executables.stats()
+        result = _query(s, 200).collect()    # cap 256: the warmed rung
+        after = executables.stats()
+        assert after["aot_hits"] == before["aot_hits"] + 1, \
+            "grown dataset did not dispatch into the warmed executable"
+        assert after["jit_calls"] == before["jit_calls"]
+        assert result.num_rows == 5
+
+    def test_neighbor_rungs_respect_ladder_top(self):
+        from spark_rapids_tpu.compile.ladder import (BucketLadder,
+                                                     get_ladder, set_ladder)
+        warmup.configure(TpuConf({"spark.rapids.tpu.warmup.auto": True,
+                                  "spark.rapids.tpu.warmup.rungsAhead": 1}))
+        prev = get_ladder()
+        try:
+            set_ladder(BucketLadder(max_capacity=1024))
+            # At the top rung there is nothing above worth compiling:
+            # dispatch uses exact lane-aligned fits past the top.
+            assert warmup._neighbor_vectors((1024,)) == []
+            # Below the top the next rung is still warmed.
+            assert warmup._neighbor_vectors((512,)) == [(1024,)]
+        finally:
+            set_ladder(prev)
+
+    def test_warmup_off_by_default_schedules_nothing(self):
+        from spark_rapids_tpu.exec import fusion
+        from spark_rapids_tpu.session import TpuSession
+        fusion.clear_fused_cache()
+        warmup.reset_for_tests()
+        s = TpuSession({})
+        _query(s, 100).collect()
+        assert warmup.stats()["scheduled"] == 0
+
+    def test_manifest_replay_after_restart(self, tmp_path, monkeypatch):
+        """A restarted process must re-warm every rung the previous one
+        executed: run big, 'restart', run small — the big rung comes back
+        through the manifest replay and the next big query is an AOT
+        hit."""
+        from spark_rapids_tpu.exec import fusion
+        from spark_rapids_tpu.session import TpuSession
+        monkeypatch.delenv("JAX_ENABLE_COMPILATION_CACHE", raising=False)
+        # Keep the process-global jax cache config untouched on the CPU
+        # tier (conftest scrubbed it for SIGILL safety); the manifest and
+        # warm-up replay are what this test exercises.
+        monkeypatch.setattr(persist, "_apply_jax_config",
+                            lambda d, secs: None)
+        conf = {
+            "spark.rapids.tpu.compileCache.enabled": True,
+            "spark.rapids.tpu.compileCache.dir": str(tmp_path / "xla"),
+            "spark.rapids.tpu.warmup.auto": True,
+            "spark.rapids.tpu.warmup.rungsAhead": 0,
+        }
+        fusion.clear_fused_cache()
+        s = TpuSession(conf)
+        _query(s, 200).collect()             # cap 256 recorded
+        assert warmup.drain(120)
+        mpath = os.path.join(str(tmp_path / "xla"), persist.MANIFEST_NAME)
+        assert os.path.exists(mpath)
+        # "Restart": drop every in-process cache, keep the on-disk state.
+        fusion.clear_fused_cache()
+        persist.reset_for_tests()
+        warmup.reset_for_tests()
+        s = TpuSession(conf)
+        _query(s, 100).collect()             # cap 128; replays rung 256
+        assert warmup.drain(120)
+        before = executables.stats()
+        _query(s, 200).collect()             # yesterday's rung: AOT hit
+        after = executables.stats()
+        assert after["aot_hits"] == before["aot_hits"] + 1
+
+
+class TestSessionStatus:
+    def test_compile_status_shape(self):
+        from spark_rapids_tpu.session import TpuSession
+        status = TpuSession({}).compile_status()
+        assert set(status) >= {"ladder", "persistent_cache", "warmup",
+                               "fused_programs", "fused_cache_entries",
+                               "kernel_cache"}
+        assert status["ladder"]["growth"] == 2.0
+        assert status["persistent_cache"]["enabled"] is False
