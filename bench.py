@@ -153,8 +153,11 @@ def run_suite():
     # variableFloatAgg: same stance as the reference's benchmarks — float
     # aggregation order differs from CPU (documented incompat,
     # docs/compatibility.md); the correctness gate compares with tolerance.
+    # ESSENTIAL metrics so every timed query leaves a QueryProfile
+    # (emitted next to the BENCH_*.json artifacts; docs/monitoring.md).
     tpu = TpuSession({"spark.rapids.sql.enabled": True,
-                      "spark.rapids.sql.variableFloatAgg.enabled": True})
+                      "spark.rapids.sql.variableFloatAgg.enabled": True,
+                      "spark.rapids.tpu.metrics.level": "ESSENTIAL"})
     cpu_t = tpch.load(cpu, tables)
     tpu_t = tpch.load(tpu, tables)
     # UNCACHED variants re-upload per run, so scan+transfer is inside the
@@ -188,6 +191,7 @@ def run_suite():
     runs += [(name, q, bb_cpu, bb_tpu, bb_cpu_u, bb_tpu_u)
              for name, q in xbb_specs]
     from spark_rapids_tpu.exec import fusion
+    profiles = {}
     for name, q, cpu_t, tpu_t, cpu_u, tpu_u in runs:
         t0 = time.perf_counter()
         stats0 = KC.cache_stats()
@@ -198,6 +202,10 @@ def run_suite():
         stats1 = KC.cache_stats()
         cpu_time = timed(lambda: q(cpu_t).collect())
         tpu_time = timed(lambda: q(tpu_t).collect())
+        # Per-query QueryProfile of the last timed device run, emitted
+        # next to BENCH_*.json (tools/profile_bench.py --compare diffs
+        # two of these bundles for >20% per-operator regressions).
+        profiles[name] = tpu.last_query_profile()
         # uncached: re-collect over the same (immutable) host tables —
         # the upload memo legally skips re-encoding/re-uploading bytes
         # the device has already seen (VERDICT r4 item 1c)
@@ -225,6 +233,18 @@ def run_suite():
               f"fused_programs={len(fusion._FUSED_CACHE)} "
               f"(warmup+compile {time.perf_counter()-t0:.0f}s)",
               file=sys.stderr)
+
+    # Per-query QueryProfile bundle next to the BENCH_*.json artifacts
+    # (best-effort: profiles must never fail the bench contract).
+    try:
+        from spark_rapids_tpu.metrics.profile import dump_profiles
+        prof_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_profiles.json")
+        dump_profiles(prof_path, profiles)
+        print(f"[bench] wrote {len([p for p in profiles.values() if p])} "
+              f"query profiles to {prof_path}", file=sys.stderr)
+    except Exception as e:  # noqa: BLE001 - observability is best-effort
+        print(f"[bench] profile dump failed: {e}", file=sys.stderr)
 
     # Compile-once layer counters (docs/compile-cache.md): how many fused
     # programs exist, how many AOT executables warm-up built, and how the

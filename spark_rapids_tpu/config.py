@@ -431,6 +431,32 @@ TPU_MESH_ENABLED = conf_bool(
     "engine-integrated form of the reference's GPU-resident shuffle "
     "manager.")
 
+METRICS_LEVEL = conf_str(
+    "spark.rapids.tpu.metrics.level", "MODERATE",
+    "Operator metrics level: NONE disables the whole query-profile layer "
+    "(no metric recording, no QueryProfile, no timing fences — asserted "
+    "bit-identical to metrics-free execution by tests), ESSENTIAL records "
+    "the core taxonomy (rows/batches/bytes/opTime/spill), MODERATE adds "
+    "build/semaphore/compile timings, DEBUG adds serialization and concat "
+    "internals. The GpuMetric-level analog "
+    "(spark.rapids.sql.metrics.level). See docs/monitoring.md.")
+
+METRICS_DEVICE_TIMING = conf_bool(
+    "spark.rapids.tpu.metrics.deviceTiming", False,
+    "Attribute DEVICE time per query: insert a block-until-ready fence "
+    "after the fused dispatch and record dispatch-to-ready nanoseconds as "
+    "the deviceTime metric. Off by default because the fence serializes "
+    "the dispatch pipeline — the default path runs with zero fences (the "
+    "tests assert none are inserted). See docs/monitoring.md.")
+
+METRICS_EVENT_LOG_DIR = conf_str(
+    "spark.rapids.tpu.metrics.eventLog.dir", None,
+    "Directory for the structured query event log: every executed query "
+    "appends its QueryProfile as one JSON line to query_profiles.jsonl "
+    "(crash-safe append; torn lines are skipped on read — same stance as "
+    "the compile manifest). Unset disables the log. See "
+    "docs/monitoring.md for the record schema.")
+
 PLAN_LINT_ENABLED = conf_bool(
     "spark.rapids.tpu.planLint.enabled", True,
     "Statically verify every physical plan after planning and again after "
@@ -518,6 +544,18 @@ class TpuConf:
     @property
     def mesh_enabled(self) -> bool:
         return self.get(TPU_MESH_ENABLED)
+
+    @property
+    def metrics_level(self) -> str:
+        return str(self.get(METRICS_LEVEL)).upper()
+
+    @property
+    def metrics_device_timing(self) -> bool:
+        return self.get(METRICS_DEVICE_TIMING)
+
+    @property
+    def metrics_event_log_dir(self) -> Optional[str]:
+        return self.get(METRICS_EVENT_LOG_DIR)
 
     def is_operator_enabled(self, conf_key: str, incompat: bool, disabled_by_default: bool) -> bool:
         """Three-state per-operator gating (reference RapidsMeta.tagForGpu:195-210)."""

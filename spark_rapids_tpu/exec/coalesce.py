@@ -17,7 +17,6 @@ from .. import types as T
 from ..data.batch import ColumnarBatch
 from ..memory import spill as SP
 from ..plan.physical import PhysicalPlan
-from ..utils.tracing import trace_range
 from .execs import TpuExec, _coalesce_device
 
 
@@ -64,6 +63,7 @@ class TpuCoalesceBatchesExec(TpuExec):
         catalog: Optional[SP.BufferCatalog] = getattr(ctx, "catalog", None)
         single = isinstance(self.goal, RequireSingleBatch)
         target = None if single else self.goal.rows
+        name = self.node_name()
 
         def run(part):
             # Accumulation is accounted by CAPACITY, not live rows: capacity
@@ -88,8 +88,11 @@ class TpuCoalesceBatchesExec(TpuExec):
                     batches = list(direct)
                 if not batches:
                     return None
-                with trace_range("coalesce.concat"):
+                with ctx.registry.timer(name, "concatTime",
+                                        trace="coalesce.concat"):
                     out = _coalesce_device(batches)
+                ctx.metric(name, "numInputBatches", len(batches))
+                ctx.metric(name, "numOutputBatches", 1)
                 for b in pending:
                     catalog.free(b)
                 pending.clear()

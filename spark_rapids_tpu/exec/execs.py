@@ -47,16 +47,26 @@ def _bind_all(exprs: List[Expression], schema: T.Schema) -> List[Expression]:
     return [e.bind(schema) for e in exprs]
 
 
-def _tick(ctx, name: str, t0: float) -> float:
+def _tick(ctx, name: str, t0: int) -> int:
     """Record one output batch + host-side dispatch time for an exec
     (GpuExec.scala:25-52's NUM_OUTPUT_BATCHES / OP_TIME analog — dispatch
     wall time only: device execution is async and row counts would cost a
-    tunnel round trip)."""
+    tunnel round trip). Times are nanoseconds (the taxonomy's NANO_TIMING
+    opTime; metrics/registry.py)."""
     import time as _time
-    now = _time.perf_counter()
+    now = _time.perf_counter_ns()
     ctx.metric(name, "numOutputBatches", 1)
-    ctx.metric(name, "opTimeMs", (now - t0) * 1000.0)
+    ctx.metric(name, "opTime", now - t0)
     return now
+
+
+def _counted_stream(ctx, name: str, batches):
+    """Pass-through generator recording numOutputBatches per batch — the
+    minimum ESSENTIAL instrumentation for execs whose per-batch work is too
+    cheap to time (union, limits, replays)."""
+    for db in batches:
+        ctx.metric(name, "numOutputBatches", 1)
+        yield db
 
 
 class TpuExec(PhysicalPlan):
@@ -101,22 +111,34 @@ class HostToDeviceExec(TpuExec):
                 pending.append(rb.cast(arrow))
                 pending_rows += rb.num_rows
                 if pending_rows >= self.goal_rows:
-                    yield self._upload(pending)
+                    yield self._upload(pending, ctx)
                     pending, pending_rows = [], 0
             if pending:
-                yield self._upload(pending)
+                yield self._upload(pending, ctx)
         from ..utils.prefetch import prefetch_iter
         return [prefetch_iter(run(p))
                 for p in self.children[0].execute(ctx)]
 
-    def _upload(self, rbs: List[pa.RecordBatch]) -> ColumnarBatch:
+    def _upload(self, rbs: List[pa.RecordBatch],
+                ctx=None) -> ColumnarBatch:
+        import time as _time
+        t0 = _time.perf_counter_ns()
         with trace_range("HostToDevice.upload"):
             if len(rbs) == 1:
                 combined = rbs[0]
             else:
                 combined = pa.Table.from_batches(rbs).combine_chunks() \
                     .to_batches()[0]
-            return ColumnarBatch.from_arrow(combined)
+            batch = ColumnarBatch.from_arrow(combined)
+        if ctx is not None:
+            # uploadBytes = the Arrow buffer footprint crossing the link
+            # (the transfer itself is async; opTime is host dispatch wall).
+            name = self.node_name()
+            ctx.metric(name, "uploadBytes", combined.nbytes)
+            ctx.metric(name, "numInputRows", combined.num_rows)
+            ctx.metric(name, "numOutputBatches", 1)
+            ctx.metric(name, "opTime", _time.perf_counter_ns() - t0)
+        return batch
 
 
 class DeviceToHostExec(PhysicalPlan):
@@ -135,13 +157,17 @@ class DeviceToHostExec(PhysicalPlan):
         name = self.node_name()
 
         def run(part):
+            import time as _time
             for db in part:
+                t0 = _time.perf_counter_ns()
                 with trace_range("DeviceToHost.download"):
                     hb = HostBatch.from_device(db)
                 # The download already synced the row count — the one place
                 # row metrics are free (GpuExec.NUM_OUTPUT_ROWS analog).
                 ctx.metric(name, "numOutputRows", hb.num_rows)
                 ctx.metric(name, "numOutputBatches", 1)
+                ctx.metric(name, "downloadBytes", hb.rb.nbytes)
+                ctx.metric(name, "opTime", _time.perf_counter_ns() - t0)
                 yield hb
         return [run(p) for p in self.children[0].execute(ctx)]
 
@@ -227,12 +253,14 @@ class TpuProjectExec(TpuExec):
         project = cached_kernel("project", kernel_key(bound, out_schema),
                                 build)
 
+        name = self.node_name()
+
         def run(part):
             import time as _time
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter_ns()
             for db in part:
                 out = project(db)
-                t0 = _tick(ctx, "TpuProject", t0)
+                t0 = _tick(ctx, name, t0)
                 yield out
         return [run(p) for p in self.children[0].execute(ctx)]
 
@@ -260,12 +288,14 @@ class TpuFilterExec(TpuExec):
             return filt
         filt = cached_kernel("filter", kernel_key(bound), build)
 
+        name = self.node_name()
+
         def run(part):
             import time as _time
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter_ns()
             for db in part:
                 out = filt(db)
-                t0 = _tick(ctx, "TpuFilter", t0)
+                t0 = _tick(ctx, name, t0)
                 yield out
         return [run(p) for p in self.children[0].execute(ctx)]
 
@@ -281,6 +311,8 @@ class TpuRangeExec(TpuExec):
         return T.Schema([T.StructField("id", T.LONG, False)])
 
     def execute(self, ctx):
+        name = self.node_name()
+
         def gen():
             n_total = max(0, -(-(self.end - self.start) // self.step))
             done = 0
@@ -292,6 +324,8 @@ class TpuRangeExec(TpuExec):
                 valid = jnp.arange(cap, dtype=jnp.int32) < n
                 col = DeviceColumn(data=jnp.where(valid, data, 0),
                                    validity=valid, dtype=T.LONG)
+                ctx.metric(name, "numOutputRows", n)
+                ctx.metric(name, "numOutputBatches", 1)
                 yield ColumnarBatch((col,), jnp.asarray(n, jnp.int32),
                                     self.schema)
                 done += n
@@ -308,10 +342,12 @@ class TpuUnionExec(TpuExec):
         return self._schema
 
     def execute(self, ctx):
+        name = self.node_name()
         parts = []
         for c in self.children:
             def relabel(p):
                 for db in p:
+                    ctx.metric(name, "numOutputBatches", 1)
                     yield ColumnarBatch(db.columns, db.n_rows,
                                         self._schema, live=db.live)
             parts.extend(relabel(p) for p in c.execute(ctx))
@@ -361,7 +397,9 @@ class TpuLocalLimitExec(TpuExec):
         return self.children[0].schema
 
     def execute(self, ctx):
-        return [_limit_stream(p, self.n, ctx.in_fusion)
+        name = self.node_name()
+        return [_counted_stream(ctx, name,
+                                _limit_stream(p, self.n, ctx.in_fusion))
                 for p in self.children[0].execute(ctx)]
 
 
@@ -381,7 +419,9 @@ class TpuLimitExec(TpuExec):
         def flat():
             for part in self.children[0].execute(ctx):
                 yield from part
-        return [_limit_stream(flat(), self.n, ctx.in_fusion)]
+        return [_counted_stream(ctx, self.node_name(),
+                                _limit_stream(flat(), self.n,
+                                              ctx.in_fusion))]
 
 
 @jax.jit
@@ -431,11 +471,16 @@ class TpuExpandExec(TpuExec):
         fns = [cached_kernel("expand", kernel_key(p, out_schema),
                              lambda p=p: make_projection(p))
                for p in bound]
+        name = self.node_name()
 
         def run(part):
+            import time as _time
+            t0 = _time.perf_counter_ns()
             for db in part:
                 for fn in fns:
-                    yield fn(db)
+                    out = fn(db)
+                    t0 = _tick(ctx, name, t0)
+                    yield out
         return [run(p) for p in self.children[0].execute(ctx)]
 
 
@@ -525,7 +570,7 @@ class TpuGenerateExec(TpuExec):
         def run(part):
             import time as _time
             from ..data.column import bucket_capacity
-            t0 = _time.perf_counter()
+            t0 = _time.perf_counter_ns()
             for db in part:
                 # Explode liveness is positional (flat_r < n_rows).
                 db = KR.physical(db) if ctx.in_fusion \
@@ -546,7 +591,7 @@ class TpuGenerateExec(TpuExec):
                     max(int(jax.device_get(db.n_rows)), 1)
                 for off in range(0, live_rows, tile_rows):
                     out = fn(db, arr, jnp.asarray(off, jnp.int32))
-                    t0 = _tick(ctx, "TpuGenerate", t0)
+                    t0 = _tick(ctx, self.node_name(), t0)
                     yield out
         return [run(p) for p in self.children[0].execute(ctx)]
 
@@ -595,7 +640,9 @@ class TpuSortExec(TpuExec):
                 if merged is None:
                     return
                 ctx.metric(self.node_name(), "numOutputBatches", 1)
-                yield do_sort(merged)
+                with ctx.registry.timer(self.node_name(), "sortTime"):
+                    out = do_sort(merged)
+                yield out
                 return
             from ..memory import spill as SP_MOD
             threshold = ctx.conf.get(SORT_EXTERNAL_THRESHOLD) or \
@@ -615,7 +662,9 @@ class TpuSortExec(TpuExec):
                     merged = _coalesce_device(
                         [catalog.acquire_batch(b) for b in ids])
                     ctx.metric(self.node_name(), "numOutputBatches", 1)
-                    yield do_sort(merged)
+                    with ctx.registry.timer(self.node_name(), "sortTime"):
+                        out = do_sort(merged)
+                    yield out
                     return
                 from .external_sort import ExternalSorter
                 sorter = ExternalSorter(self.orders, schema, catalog,
@@ -894,10 +943,10 @@ class TpuHashAggregateExec(TpuExec):
                 # rows), so no row-count sync is ever needed here.
                 if self.groupings:
                     return
-                ctx.metric("TpuHashAggregate", "numOutputBatches", 1)
+                ctx.metric(self.node_name(), "numOutputBatches", 1)
                 yield self._empty_result()
                 return
-            ctx.metric("TpuHashAggregate", "numOutputBatches", 1)
+            ctx.metric(self.node_name(), "numOutputBatches", 1)
             yield self._finalize(state, buf_schema)
         return [gen()]
 
@@ -1211,9 +1260,14 @@ class TpuShuffledHashJoinExec(TpuExec):
                 out = post_filter(out)
             return out, hits
 
+        name = self.node_name()
+
         def gen():
-            build = _accumulate_spillable(right, ctx, "join.build")
+            import time as _time
+            with ctx.registry.timer(name, "buildTime"):
+                build = _accumulate_spillable(right, ctx, "join.build")
             hit_acc = None
+            t0 = _time.perf_counter_ns()
             for part in left.execute(ctx):
                 for probe in part:
                     if build is None:
@@ -1225,12 +1279,14 @@ class TpuShuffledHashJoinExec(TpuExec):
                                                 out_schema, live=probe.live)
                         continue
                     out, hits = join_batch(probe, build)
+                    t0 = _tick(ctx, name, t0)
                     if hit_acc is None:
                         hit_acc = hits
                     elif hits is not None:
                         hit_acc = hit_acc | hits
                     yield out
             if jt == "full" and build is not None:
+                ctx.metric(name, "numOutputBatches", 1)
                 yield self._unmatched_build(build, hit_acc)
         return [gen()]
 

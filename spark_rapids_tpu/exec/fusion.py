@@ -218,13 +218,28 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     # materialized batches are the fused program's positional arguments.
     inputs = tuple(tuple(tuple(p) for p in b.execute(ctx))
                    for b in boundaries)
+    reg = ctx.registry
+    import time as _time
+    t_dispatch = _time.perf_counter_ns()
     head, full = fn(inputs)
     # Between dispatch and download: record this run's capacity rungs in
     # the compile manifest and schedule neighbor-rung AOT warm-ups, so the
     # scheduling work overlaps the device->host transfer below.
     _warmup.note_run(fn, sig, inputs)
-    n_rows_np, flags_np, totals_np, dfails_np, shrunk_np = \
-        jax.device_get(head)  # ONE round trip
+    if reg.device_timing:
+        # Device-time attribution (spark.rapids.tpu.metrics.deviceTiming):
+        # fence the fused dispatch so dispatch->ready is measurable. The
+        # ONLY place a fence is ever inserted — off by default, and tests
+        # assert the default path stays fence-free.
+        jax.block_until_ready(head)
+        reg.add("WholeStageFusion", "deviceTime",
+                _time.perf_counter_ns() - t_dispatch)
+    head_np = jax.device_get(head)  # ONE round trip
+    n_rows_np, flags_np, totals_np, dfails_np, shrunk_np = head_np
+    if reg.enabled:
+        reg.add("WholeStageFusion", "opTime",
+                _time.perf_counter_ns() - t_dispatch)
+        reg.add(root.node_name(), "downloadBytes", _host_nbytes(head_np))
     # Surface inlined joins' observed totals and dense-fail flags for the
     # session's learning (capacity ratchet + no_dense re-planning).
     for site, t in totals_np.items():
@@ -235,8 +250,13 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
         return None, True
     arrow_schema = T.schema_to_arrow(root.schema)
     if n_rows_np is None:
+        if reg.enabled:
+            reg.add(root.node_name(), "numOutputRows", 0)
         return pa.Table.from_batches([], schema=arrow_schema), False
     n = int(n_rows_np)
+    if reg.enabled:
+        reg.add(root.node_name(), "numOutputRows", n)
+        reg.add(root.node_name(), "numOutputBatches", 1)
     if n <= shrunk_np.capacity:
         arrays = [c.arrow_from_host(c.device_buffers(), n)
                   for c in shrunk_np.columns]
@@ -246,10 +266,18 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
         cap = bucket_capacity(n)
         fb = _shrink_batch(full, cap) if cap < full.capacity else full
         host = jax.device_get([c.device_buffers() for c in fb.columns])
+        if reg.enabled:
+            reg.add(root.node_name(), "downloadBytes", _host_nbytes(host))
         arrays = [c.arrow_from_host(bufs, n)
                   for c, bufs in zip(fb.columns, host)]
     rb = pa.RecordBatch.from_arrays(arrays, schema=arrow_schema)
     return pa.Table.from_batches([rb]).cast(arrow_schema), False
+
+
+def _host_nbytes(tree) -> int:
+    """Byte footprint of a downloaded host pytree (downloadBytes metric)."""
+    return sum(getattr(leaf, "nbytes", 0)
+               for leaf in jax.tree_util.tree_leaves(tree))
 
 
 def any_overflow(ctx: ExecContext) -> bool:

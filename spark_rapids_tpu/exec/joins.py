@@ -100,6 +100,8 @@ class TpuBroadcastExchangeExec(TpuExec):
 
     def execute(self, ctx):
         b = self.broadcast_batch(ctx)
+        if b is not None:
+            ctx.metric(self.node_name(), "numOutputBatches", 1)
         return [iter([b] if b is not None else [])]
 
 
@@ -200,11 +202,19 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             kernel_key(jt, cond, pair_schema, out_schema),
             lambda: kernel_impl, static_argnums=(2,))
 
+        name = self.node_name()
+
+        def counted(db):
+            ctx.metric(name, "numOutputBatches", 1)
+            return db
+
         def gen():
-            build_batches = []
-            for part in right.execute(ctx):
-                build_batches.extend(part)
-            build = _coalesce_device(build_batches) if build_batches else None
+            with ctx.registry.timer(name, "buildTime"):
+                build_batches = []
+                for part in right.execute(ctx):
+                    build_batches.extend(part)
+                build = _coalesce_device(build_batches) if build_batches \
+                    else None
             n_right = len(right.schema)
 
             for part in left.execute(ctx):
@@ -212,17 +222,18 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                     if build is None:
                         if jt in ("left", "left_anti"):
                             if jt == "left":
-                                yield _null_extend_right(probe, out_schema,
-                                                         n_right)
+                                yield counted(_null_extend_right(
+                                    probe, out_schema, n_right))
                             else:
-                                yield ColumnarBatch(probe.columns,
-                                                    probe.n_rows, out_schema,
-                                                    live=probe.live)
+                                yield counted(ColumnarBatch(
+                                    probe.columns, probe.n_rows, out_schema,
+                                    live=probe.live))
                         continue
                     if jt in ("left_semi", "left_anti"):
                         out, _ = kernel(probe, build, 0)
-                        yield ColumnarBatch(out.columns, out.n_rows,
-                                            out_schema, live=out.live)
+                        yield counted(ColumnarBatch(out.columns, out.n_rows,
+                                                    out_schema,
+                                                    live=out.live))
                         continue
                     # Optimistic sizing + deferred overflow flag — same
                     # no-sync discipline as TpuShuffledHashJoinExec; the
@@ -240,9 +251,10 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                     else:
                         ctx.overflow_flags.append(n_match > out_cap)
                         ctx.join_totals.append((site, n_match))
-                    yield out
+                    yield counted(out)
                     if extra is not None:
-                        yield _null_extend_right(extra, out_schema, n_right)
+                        yield counted(_null_extend_right(extra, out_schema,
+                                                         n_right))
         return [gen()]
 
 

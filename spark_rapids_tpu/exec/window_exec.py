@@ -87,6 +87,8 @@ class TpuWindowExec(TpuExec):
         common_parts = bound[0][2] if bound and len(part_sigs) == 1 \
             and bound[0][2] else None
 
+        name = self.node_name()
+
         def run(parts):
             from ..config import WINDOW_EXTERNAL_THRESHOLD
             from ..memory import spill as SP
@@ -101,12 +103,16 @@ class TpuWindowExec(TpuExec):
                     catalog.device_budget // 4
             total = sum(b.device_size_bytes for b in batches)
             if threshold is None or total <= threshold:
-                yield window_all(_coalesce_device(batches))
+                ctx.metric(name, "numOutputBatches", 1)
+                with ctx.registry.timer(name, "opTime"):
+                    out = window_all(_coalesce_device(batches))
+                yield out
                 return
             for piece in _chunked_pieces(batches, common_parts,
                                          child_schema, catalog, ctx,
                                          threshold):
-                ctx.metric("TpuWindow", "chunkedWindow", 1)
+                ctx.metric(name, "chunkedWindow", 1)
+                ctx.metric(name, "numOutputBatches", 1)
                 yield window_all(piece)
         return [run(self.children[0].execute(ctx))]
 

@@ -338,16 +338,20 @@ class TpuCsvScanExec:
         return self
 
     def execute(self, ctx):
+        name = self.node_name()
+
         def gen():
             for path in self.files:
                 try:
-                    batches = list(decode_file(path, self._schema,
-                                               self.options))
+                    with ctx.registry.timer(name, "opTime",
+                                            trace="csv.decode_file"):
+                        batches = list(decode_file(path, self._schema,
+                                                   self.options))
                 except NotCsvDecodable:
-                    ctx.metric(self.node_name(), "fileHostFallback", 1)
+                    ctx.metric(name, "fileHostFallback", 1)
                     batches = self._host_file(path)
                 for b in batches:
-                    ctx.metric(self.node_name(), "numOutputBatches", 1)
+                    ctx.metric(name, "numOutputBatches", 1)
                     yield b
         from ..utils.prefetch import prefetch_iter
         return [prefetch_iter(gen())]

@@ -723,21 +723,25 @@ class TpuOrcScanExec:
             tail = self._tails.get(path) or read_tail(path)
             units.extend((path, tail, si) for si in tail.stripes)
 
+        name = self.node_name()
+
         def read(path, tail, si):
             try:
-                with trace_range("orc.device_decode_stripe"):
+                with ctx.registry.timer(name, "opTime",
+                                        trace="orc.device_decode_stripe"):
                     return decode_stripe(path, tail, si, self._schema)
             except NotOrcDecodable:
                 # parsers translate malformed-input errors to
                 # NotOrcDecodable at their boundary (_parse_boundary);
                 # decoder-logic bugs elsewhere still fail loudly
-                ctx.metric(self.node_name(), "stripeHostFallback", 1)
+                ctx.metric(name, "stripeHostFallback", 1)
                 return self._host_stripe(path, tail, si)
 
         def gen():
             for u in units:
                 b = read(*u)
-                ctx.metric(self.node_name(), "numOutputBatches", 1)
+                ctx.metric(name, "numOutputBatches", 1)
+                ctx.metric(name, "numOutputRows", u[2].n_rows)
                 yield b
         from ..utils.prefetch import prefetch_iter
         return [prefetch_iter(gen())]

@@ -673,13 +673,19 @@ class TpuParquetScanExec:
             units.extend((path, meta, pq_schema, rg)
                          for rg in range(meta.num_row_groups))
 
+        name = self.node_name()
+
         def read(path, meta, pq_schema, rg):
             from ..utils.tracing import trace_range
+            n_rows = meta.row_group(rg).num_rows
             try:
-                with trace_range("parquet.device_decode"):
+                with ctx.registry.timer(name, "opTime",
+                                        trace="parquet.device_decode"):
                     yield decode_row_group(path, rg, self._schema,
                                            meta=meta, pq_schema=pq_schema)
-                ctx.metric("TpuParquetScan", "deviceDecodedRowGroups", 1)
+                ctx.metric(name, "deviceDecodedRowGroups", 1)
+                ctx.metric(name, "numOutputRows", n_rows)
+                ctx.metric(name, "numOutputBatches", 1)
             # ANY decode failure (unsupported shape, decompression codec
             # mismatch, corrupt/truncated page metadata) degrades to the
             # host reader for just this row group — the host result is the
@@ -698,7 +704,9 @@ class TpuParquetScanExec:
                             schema=T.schema_to_arrow(self._schema))
                     yield ColumnarBatch.from_arrow(
                         rb.cast(T.schema_to_arrow(self._schema)))
-                ctx.metric("TpuParquetScan", "hostFallbackRowGroups", 1)
+                ctx.metric(name, "hostFallbackRowGroups", 1)
+                ctx.metric(name, "numOutputRows", n_rows)
+                ctx.metric(name, "numOutputBatches", 1)
         return [read(p, m, ps, rg) for p, m, ps, rg in units]
 
 

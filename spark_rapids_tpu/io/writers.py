@@ -257,8 +257,11 @@ class TpuWriteFilesExec(_WriteFilesBase):
     children_coalesce_goals = ["target"]
 
     def execute(self, ctx: ExecContext):
+        import time as _time
         from ..config import PARQUET_DEVICE_ENCODE
         from ..ops.kernels import rowops as KR
+        name = self.node_name()
+        t_start = _time.perf_counter_ns()
         stats = WriteStats()
         if not prepare_target(self.path, self.mode):
             return [iter([stats.to_batch()])]
@@ -288,6 +291,12 @@ class TpuWriteFilesExec(_WriteFilesBase):
                     self._write_sorted_runs(rb, task_id, stats, seen_dirs,
                                             data_arrow)
                 task_id += 1
+        # Writer metrics mirror WriteStats (BasicColumnarWriteStatsTracker):
+        # the stats row is the query result, the metrics feed the profile.
+        ctx.metric(name, "numOutputRows", stats.rows)
+        ctx.metric(name, "bytesWritten", stats.bytes)
+        ctx.metric(name, "numFiles", stats.files)
+        ctx.metric(name, "writeTime", _time.perf_counter_ns() - t_start)
         return self._finish(stats, seen_dirs)
 
     def _emit_device(self, db, task_id: int, stats: WriteStats) -> bool:

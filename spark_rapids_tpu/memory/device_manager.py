@@ -43,6 +43,7 @@ class DeviceManager:
         self.semaphore = TpuSemaphore(conf.get(CONCURRENT_TPU_TASKS))
         self._devices = None
         self._hbm_budget = None
+        self._peak_in_use = 0
         self._init_lock = threading.Lock()
         # Spill catalog: the GpuShuffleEnv.initStorage chain
         # (device -> host -> disk, GpuShuffleEnv.scala:52-69). The device
@@ -108,6 +109,19 @@ class DeviceManager:
     def memory_in_use(self) -> int:
         try:
             stats = self.device.memory_stats() or {}
-            return stats.get("bytes_in_use", 0)
+            used = stats.get("bytes_in_use", 0)
         except Exception:
-            return 0
+            used = 0
+        if used > self._peak_in_use:
+            self._peak_in_use = used
+        return used
+
+    def hbm_watermarks(self) -> dict:
+        """HBM usage snapshot for the query profile. NEVER initializes the
+        backend: a CPU-oracle session (sql.enabled=false) querying its
+        profile must not touch the accelerator — watermarks report 0 until
+        some device work has forced init (the lazy-init contract above)."""
+        if self._devices is None:
+            return {"hbmBytesInUse": 0, "hbmPeakBytesInUse": 0}
+        return {"hbmBytesInUse": self.memory_in_use(),
+                "hbmPeakBytesInUse": self._peak_in_use}

@@ -10,6 +10,7 @@ operators don't deadlock.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict
 
 
@@ -19,6 +20,10 @@ class TpuSemaphore:
         self._sem = threading.Semaphore(max_concurrent)
         self._held: Dict[int, int] = {}
         self._lock = threading.Lock()
+        #: Lifetime nanoseconds threads spent blocked on acquire — the
+        #: semaphoreWaitNs metric source; the query profile takes deltas
+        #: (metrics/profile.py, GpuSemaphore's SEMAPHORE_WAIT analog).
+        self.wait_ns = 0
 
     def acquire_if_necessary(self):
         """Reentrant acquire (GpuSemaphore.acquireIfNecessary:74)."""
@@ -27,8 +32,11 @@ class TpuSemaphore:
             if self._held.get(tid, 0) > 0:
                 self._held[tid] += 1
                 return
+        t0 = time.perf_counter_ns()
         self._sem.acquire()
+        waited = time.perf_counter_ns() - t0
         with self._lock:
+            self.wait_ns += waited
             self._held[tid] = self._held.get(tid, 0) + 1
 
     def release_if_necessary(self):

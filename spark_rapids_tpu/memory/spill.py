@@ -151,7 +151,10 @@ class BufferCatalog:
         self._spill_file: Optional[SpillFile] = None  # lazy: first disk spill
         self._pinned: set = set()
         self.metrics = {"spilled_to_host": 0, "spilled_to_disk": 0,
-                        "reloaded_from_host": 0, "reloaded_from_disk": 0}
+                        "reloaded_from_host": 0, "reloaded_from_disk": 0,
+                        # byte counters feed the query profile's spillBytes
+                        # (metrics/profile.py takes per-query deltas)
+                        "spill_bytes_to_host": 0, "spill_bytes_to_disk": 0}
 
     @property
     def device_budget(self) -> int:
@@ -334,6 +337,7 @@ class BufferCatalog:
         self.host_bytes += entry.meta.size_bytes
         heapq.heappush(self._host_heap, (entry.priority, entry.buffer_id))
         self.metrics["spilled_to_host"] += 1
+        self.metrics["spill_bytes_to_host"] += entry.meta.size_bytes
         while self.host_bytes > self.host_budget:
             victim = self._pop_spillable(self._host_heap, StorageTier.HOST)
             if victim is None:
@@ -348,6 +352,7 @@ class BufferCatalog:
         entry.tier = StorageTier.DISK
         self.host_bytes -= entry.meta.size_bytes
         self.metrics["spilled_to_disk"] += 1
+        self.metrics["spill_bytes_to_disk"] += len(payload)
 
     def _remove_host(self, entry: _Entry):
         entry.host_batch = None

@@ -1,0 +1,94 @@
+"""Structured query-event log — one JSON line per executed query.
+
+The Spark analog is the SQL event log the history server replays; here a
+:class:`QueryProfile` (operator tree + metrics + engine counters) appends as
+one line to ``query_profiles.jsonl`` under
+``spark.rapids.tpu.metrics.eventLog.dir``. Append is crash-safe in the same
+spirit as the compile manifest (compile/persist.py): each record is a single
+``write()`` of one full line, failures never fail the query, and the reader
+skips torn/corrupt lines (a crash mid-append loses at most the last line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import List, Optional
+
+FILENAME = "query_profiles.jsonl"
+
+
+class EventLog:
+    """Append-only JSON-lines writer for query profiles."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        self.path = os.path.join(directory, FILENAME)
+        self._lock = threading.Lock()
+
+    def append(self, profile) -> bool:
+        """Append one profile (QueryProfile or plain dict); returns False
+        (and logs nothing) on any IO failure — the event log is an
+        observability aid, never a correctness dependency."""
+        record = profile if isinstance(profile, dict) else profile.to_dict()
+        try:
+            line = json.dumps(record, separators=(",", ":"),
+                              default=_jsonable) + "\n"
+        except (TypeError, ValueError):
+            return False
+        with self._lock:
+            try:
+                os.makedirs(self.dir, exist_ok=True)
+                # A previous writer may have crashed mid-append, leaving a
+                # torn line with no trailing newline; start this record on
+                # a fresh line so the torn one stays isolated (and skipped
+                # by read()) instead of corrupting ours too.
+                needs_nl = False
+                try:
+                    if os.path.getsize(self.path) > 0:
+                        with open(self.path, "rb") as r:
+                            r.seek(-1, os.SEEK_END)
+                            needs_nl = r.read(1) != b"\n"
+                except OSError:
+                    pass
+                with open(self.path, "ab") as f:
+                    f.write((b"\n" if needs_nl else b"")
+                            + line.encode("utf-8"))  # one write per record
+                    f.flush()
+            except OSError:
+                return False
+        return True
+
+
+def _jsonable(v):
+    """numpy scalars and other numerics that reach a profile dict."""
+    if hasattr(v, "item"):
+        return v.item()
+    return str(v)
+
+
+def read(path: str) -> List[dict]:
+    """Load every intact profile line; torn or corrupt lines are skipped
+    (crash-safety contract: a partial trailing line must not poison the
+    log)."""
+    out: List[dict] = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        return []
+    return out
+
+
+def log_path(directory: Optional[str]) -> Optional[str]:
+    return os.path.join(directory, FILENAME) if directory else None

@@ -1,0 +1,300 @@
+"""Per-query profiles: the operator tree annotated with its metrics.
+
+A :class:`QueryProfile` snapshots, at query end:
+
+* the physical operator tree (node names + describe strings) with each
+  node's metrics from the query's :class:`~.registry.MetricsRegistry`;
+* "extra" metric nodes that are not plan operators (WholeStageFusion,
+  TpuSemaphore) — work the plan tree cannot attribute;
+* engine-level counters folded in from the other subsystems: spill-catalog
+  byte deltas (memory/spill.py), semaphore wait, HBM watermarks
+  (memory/device_manager.py), and the compile-once layer's counters
+  (utils/kernel_cache.py, compile/executables.py, compile/warmup.py) — the
+  PR-2 counters now reporting through the same profile instead of their own
+  side channels.
+
+Profiles serialize to one JSON line in the event log
+(:mod:`.eventlog`), render as a metric-annotated EXPLAIN tree
+(``df.explain(metrics=True)`` / ``TpuSession.last_query_profile()``), and
+diff against an earlier run (:func:`compare_profiles`) — the regression
+ratchet ``tools/profile_bench.py --compare`` runs on.
+
+Metrics are keyed by node_name(), so two instances of the same exec type in
+one plan share accumulators; the render marks repeated names with ``*``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from typing import Dict, List, Optional
+
+from .registry import NONE, MetricsRegistry, level_name, parse_level
+
+#: Profile schema version (bump on incompatible event-log layout changes).
+VERSION = 1
+
+
+def plan_profile_hash(plan_sig: tuple) -> str:
+    """Short stable hash of a structural plan signature
+    (utils.kernel_cache.plan_signature output) — lets explain(metrics=True)
+    check that the last profile belongs to THIS query shape."""
+    return hashlib.sha256(repr(plan_sig).encode()).hexdigest()[:16]
+
+
+@dataclasses.dataclass
+class QueryProfile:
+    """One executed query's observability record."""
+
+    query_id: int
+    plan_hash: str
+    wall_ns: int
+    level: str
+    #: nested {"name", "describe", "metrics": {..}, "children": [..]}
+    tree: dict
+    #: metric nodes with no plan operator: {node: {name: value}}
+    extras: Dict[str, dict]
+    #: engine counters: spill/semaphore/hbm/compile sections
+    engine: dict
+    timestamp: str = ""
+    version: int = VERSION
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "QueryProfile":
+        known = {f.name for f in dataclasses.fields(cls)}
+        return cls(**{k: v for k, v in d.items() if k in known})
+
+    # -- rendering ----------------------------------------------------------
+    def render(self) -> str:
+        """The metric-annotated EXPLAIN tree."""
+        counts: Dict[str, int] = {}
+        _count_names(self.tree, counts)
+        lines = [f"== Query Profile #{self.query_id} "
+                 f"(level={self.level}, wall={_fmt_ns(self.wall_ns)}) =="]
+        _render_node(self.tree, 0, counts, lines)
+        shared = sorted(n for n, c in counts.items() if c > 1)
+        if shared:
+            lines.append("(* metrics are keyed by node name and shared by "
+                         f"repeated operators: {', '.join(shared)})")
+        for node in sorted(self.extras):
+            lines.append(f"+ {node}  {_fmt_metrics(self.extras[node])}")
+        eng = {k: v for k, v in self.engine.items() if not isinstance(v, dict)}
+        if eng:
+            lines.append(f"+ engine  {_fmt_metrics(eng)}")
+        comp = self.engine.get("compile")
+        if comp:
+            lines.append(f"+ compile  {_fmt_metrics(comp)}")
+        return "\n".join(lines) + "\n"
+
+
+def _count_names(node: dict, counts: Dict[str, int]) -> None:
+    counts[node["name"]] = counts.get(node["name"], 0) + 1
+    for c in node["children"]:
+        _count_names(c, counts)
+
+
+def _render_node(node: dict, indent: int, counts, lines: List[str]) -> None:
+    star = "*" if counts.get(node["name"], 0) > 1 and node["metrics"] else ""
+    tail = f"  {_fmt_metrics(node['metrics'])}{star}" if node["metrics"] \
+        else ""
+    lines.append("  " * indent + node["describe"] + tail)
+    for c in node["children"]:
+        _render_node(c, indent + 1, counts, lines)
+
+
+def _fmt_ns(v) -> str:
+    return f"{v / 1e6:.1f}ms"
+
+
+def _fmt_metrics(metrics: dict) -> str:
+    parts = []
+    for name in sorted(metrics):
+        v = metrics[name]
+        if isinstance(v, dict):
+            continue
+        if (name.endswith("Ns") or name.endswith("Time")) \
+                and isinstance(v, (int, float)):
+            parts.append(f"{name}={_fmt_ns(v)}")
+        elif isinstance(v, float):
+            parts.append(f"{name}={v:.2f}")
+        else:
+            parts.append(f"{name}={v}")
+    return "[" + ", ".join(parts) + "]"
+
+
+# ---------------------------------------------------------------------------
+# Collection
+# ---------------------------------------------------------------------------
+
+
+class QueryProfiler:
+    """Brackets one query execution: captures engine-counter baselines at
+    start, snapshots the registry + deltas at finish. Created only when the
+    metrics level is above NONE — at NONE nothing is measured at all."""
+
+    def __init__(self, session):
+        self._session = session
+        self._t0 = time.perf_counter_ns()
+        from ..compile import executables as _exe
+        from ..compile import warmup as _warmup
+        from ..utils import kernel_cache as _kc
+        self._kc0 = _kc.cache_stats()
+        self._exe0 = _exe.stats()
+        self._warm0 = _warmup.stats()
+        dm = session.device_manager
+        self._spill0 = dict(dm.catalog.metrics)
+        self._sem0 = dm.semaphore.wait_ns
+
+    @classmethod
+    def maybe(cls, session) -> Optional["QueryProfiler"]:
+        if parse_level(session.conf.metrics_level) == NONE:
+            return None
+        return cls(session)
+
+    def finish(self, physical, ctx, plan_sig: tuple,
+               query_id: int) -> QueryProfile:
+        import datetime
+
+        from ..compile import executables as _exe
+        from ..compile import warmup as _warmup
+        from ..utils import kernel_cache as _kc
+        wall_ns = time.perf_counter_ns() - self._t0
+        registry: MetricsRegistry = ctx.registry
+        tree = _tree_of(physical, registry)
+        tree_names: set = set()
+        _collect_names(tree, tree_names)
+        extras = {node: registry.node_metrics(node)
+                  for node in registry.node_names()
+                  if node not in tree_names}
+
+        dm = self._session.device_manager
+        spill = dm.catalog.metrics
+        kc = _kc.cache_stats()
+        exe = _exe.stats()
+        engine = {
+            "semaphoreWaitNs": dm.semaphore.wait_ns - self._sem0,
+            "spillBytes":
+                _delta(spill, self._spill0, "spill_bytes_to_host")
+                + _delta(spill, self._spill0, "spill_bytes_to_disk"),
+            "spillBytesToHost":
+                _delta(spill, self._spill0, "spill_bytes_to_host"),
+            "spillBytesToDisk":
+                _delta(spill, self._spill0, "spill_bytes_to_disk"),
+            "deviceStoreBytes": dm.catalog.device_bytes,
+            **dm.hbm_watermarks(),
+            "compile": {
+                "compileNs": _delta(kc, self._kc0, "build_ns"),
+                "kernelCompiles": _delta(kc, self._kc0, "misses"),
+                "kernelHits": _delta(kc, self._kc0, "hits"),
+                "fusedPrograms": exe.get("programs", 0),
+                "aotExecutables": exe.get("aot_executables", 0),
+                "aotHits": _delta(exe, self._exe0, "aot_hits"),
+                "jitCalls": _delta(exe, self._exe0, "jit_calls"),
+                "warmupCompiled": _delta(_warmup.stats(), self._warm0,
+                                         "compiled"),
+            },
+        }
+        return QueryProfile(
+            query_id=query_id,
+            plan_hash=plan_profile_hash(plan_sig),
+            wall_ns=wall_ns,
+            level=level_name(registry.level),
+            tree=tree,
+            extras=extras,
+            engine=engine,
+            timestamp=datetime.datetime.now(datetime.timezone.utc)
+            .isoformat(timespec="seconds"),
+        )
+
+
+def _delta(now: dict, base: dict, key: str) -> int:
+    return int(now.get(key, 0)) - int(base.get(key, 0))
+
+
+def _tree_of(plan, registry: MetricsRegistry) -> dict:
+    return {
+        "name": plan.node_name(),
+        "describe": plan.describe(),
+        "metrics": registry.node_metrics(plan.node_name()),
+        "children": [_tree_of(c, registry) for c in plan.children],
+    }
+
+
+def _collect_names(node: dict, out: set) -> None:
+    out.add(node["name"])
+    for c in node["children"]:
+        _collect_names(c, out)
+
+
+# ---------------------------------------------------------------------------
+# Comparison (tools/profile_bench.py --compare)
+# ---------------------------------------------------------------------------
+
+
+def _flatten(node: dict, _path: str, out: Dict[str, dict]) -> None:
+    # Keyed by node NAME, not tree position: metrics are shared by
+    # node_name() across repeated operators (registry.py), so positional
+    # keys would report the same shared accumulator once per duplicate and
+    # inflate the regression count.
+    out[node["name"]] = node["metrics"]
+    for c in node["children"]:
+        _flatten(c, _path, out)
+
+
+def compare_profiles(old: dict, new: dict, threshold: float = 0.20,
+                     min_ns: int = 1_000_000) -> List[dict]:
+    """Per-operator regression diff of two profile dicts.
+
+    Flags timing metrics (``*Time``/``*Ns``) that grew by more than
+    ``threshold`` (default 20%) AND by more than ``min_ns`` (noise floor,
+    default 1ms). Returns [{path, metric, old, new, ratio}] sorted by
+    severity."""
+    o_ops: Dict[str, dict] = {}
+    n_ops: Dict[str, dict] = {}
+    _flatten(old["tree"], "", o_ops)
+    _flatten(new["tree"], "", n_ops)
+    o_ops["<extras>"] = {k: v for m in old.get("extras", {}).values()
+                         for k, v in m.items()}
+    n_ops["<extras>"] = {k: v for m in new.get("extras", {}).values()
+                         for k, v in m.items()}
+    out: List[dict] = []
+    for path, n_metrics in n_ops.items():
+        o_metrics = o_ops.get(path)
+        if o_metrics is None:
+            continue
+        for name, nv in n_metrics.items():
+            if not (name.endswith("Time") or name.endswith("Ns")):
+                continue
+            ov = o_metrics.get(name)
+            if not isinstance(ov, (int, float)) \
+                    or not isinstance(nv, (int, float)) or ov <= 0:
+                continue
+            if nv - ov > min_ns and nv > ov * (1.0 + threshold):
+                out.append({"path": path, "metric": name,
+                            "old": ov, "new": nv,
+                            "ratio": round(nv / ov, 3)})
+    return sorted(out, key=lambda r: -r["ratio"])
+
+
+def dump_profiles(path: str, profiles: Dict[str, QueryProfile]) -> None:
+    """Write a {query name: profile dict} bundle (bench.py /
+    tools/profile_bench.py emit these next to BENCH_*.json)."""
+    import json
+    data = {name: (p.to_dict() if isinstance(p, QueryProfile) else p)
+            for name, p in profiles.items() if p is not None}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, default=str)
+        f.write("\n")
+
+
+def load_profiles(path: str) -> Dict[str, dict]:
+    import json
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict) and "tree" in data:
+        return {"query": data}  # a single bare profile
+    return data

@@ -1,0 +1,428 @@
+"""Typed, leveled operator metrics — the ``GpuMetric`` analog.
+
+The reference attaches leveled SQL metrics to every operator
+(``GpuMetric.scala``: ESSENTIAL/MODERATE/DEBUG levels gated by
+``spark.rapids.sql.metrics.level``; NANO_TIMING/SUM/PEAK/AVERAGE kinds) and
+couples timing metrics with profiler ranges (``NvtxWithMetrics.scala`` —
+SURVEY.md §5). This module is the TPU port: a per-query
+:class:`MetricsRegistry` holding :class:`TpuMetric` accumulators keyed by
+(node name, metric name), with a standard taxonomy (:data:`TAXONOMY`) shared
+by every layer of the engine — exec, shuffle, io, memory, compile — so one
+``QueryProfile`` (:mod:`.profile`) can read them all coherently.
+
+Level gating happens at record time: a metric above the configured level
+(``spark.rapids.tpu.metrics.level``) is dropped without allocation, and at
+level NONE the registry is inert — ``ExecContext.metric`` becomes a no-op
+and no timing fences are ever inserted (asserted by tests/test_metrics.py).
+
+Timing metrics are NANO_TIMING kind, implemented on
+:class:`..utils.tracing.NanoTimer` so every timed span doubles as an
+XProf/TraceAnnotation range (the NvtxWithMetrics coupling).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+# ---------------------------------------------------------------------------
+# Levels (GpuMetric.scala: ESSENTIAL/MODERATE/DEBUG) and kinds.
+# ---------------------------------------------------------------------------
+
+NONE = 0
+ESSENTIAL = 1
+MODERATE = 2
+DEBUG = 3
+
+_LEVEL_NAMES = {"NONE": NONE, "ESSENTIAL": ESSENTIAL,
+                "MODERATE": MODERATE, "DEBUG": DEBUG}
+_LEVEL_STRS = {v: k for k, v in _LEVEL_NAMES.items()}
+
+
+def parse_level(s: Optional[str]) -> int:
+    """Parse a metrics level name; unknown values default to MODERATE (the
+    reference's default for spark.rapids.sql.metrics.level)."""
+    return _LEVEL_NAMES.get(str(s or "").strip().upper(), MODERATE)
+
+
+def level_name(level: int) -> str:
+    return _LEVEL_STRS.get(level, "MODERATE")
+
+
+class MetricKind:
+    SUM = "SUM"
+    NANO_TIMING = "NANO_TIMING"
+    PEAK = "PEAK"
+    AVERAGE = "AVERAGE"
+
+
+class MetricSpec:
+    """Static description of one metric name: kind + level + doc. Frozen
+    (shared across registries); accumulation state lives in TpuMetric."""
+
+    __slots__ = ("name", "kind", "level", "doc")
+
+    def __init__(self, name: str, kind: str, level: int, doc: str):
+        self.name = name
+        self.kind = kind
+        self.level = level
+        self.doc = doc
+
+
+def _spec(name, kind, level, doc):
+    return MetricSpec(name, kind, level, doc)
+
+
+#: The standard metric taxonomy — the names every instrumented layer uses,
+#: so profiles are comparable across operators and across runs. The table in
+#: docs/monitoring.md is generated from this dict (taxonomy_markdown()).
+TAXONOMY: Dict[str, MetricSpec] = {s.name: s for s in [
+    _spec("opTime", MetricKind.NANO_TIMING, ESSENTIAL,
+          "Host-side wall time spent in the operator's dispatch path "
+          "(device execution is async; see deviceTime for fenced time)."),
+    _spec("deviceTime", MetricKind.NANO_TIMING, ESSENTIAL,
+          "Dispatch-to-ready device time, measured with an explicit "
+          "block-until-ready fence. Only recorded under "
+          "spark.rapids.tpu.metrics.deviceTiming=true — the fence "
+          "serializes the pipeline, so it never runs on the default path."),
+    _spec("uploadBytes", MetricKind.SUM, ESSENTIAL,
+          "Host->device bytes transferred (Arrow buffer footprint at the "
+          "HostToDevice boundary)."),
+    _spec("downloadBytes", MetricKind.SUM, ESSENTIAL,
+          "Device->host bytes transferred (result downloads, including the "
+          "fused head transfer)."),
+    _spec("numOutputRows", MetricKind.SUM, ESSENTIAL,
+          "Rows produced, recorded only where the count is host-known "
+          "(downloads, scans) — never via an extra device sync."),
+    _spec("numOutputBatches", MetricKind.SUM, ESSENTIAL,
+          "Batches produced by the operator."),
+    _spec("numInputRows", MetricKind.SUM, MODERATE,
+          "Rows consumed, where host-known."),
+    _spec("numInputBatches", MetricKind.SUM, MODERATE,
+          "Batches consumed."),
+    _spec("spillBytes", MetricKind.SUM, ESSENTIAL,
+          "Bytes pushed out of the device tier by the spill framework "
+          "during the query (host + disk)."),
+    _spec("semaphoreWaitNs", MetricKind.NANO_TIMING, MODERATE,
+          "Time blocked acquiring the task-admission semaphore "
+          "(spark.rapids.sql.concurrentTpuTasks)."),
+    _spec("compileNs", MetricKind.NANO_TIMING, MODERATE,
+          "Host time spent building/tracing kernels this query "
+          "(kernel-cache misses; XLA backend compile time is async and "
+          "shows up in deviceTime on first dispatch)."),
+    _spec("shuffleBytesWritten", MetricKind.SUM, ESSENTIAL,
+          "Serialized shuffle bytes written to the block catalog."),
+    _spec("shuffleBytesRead", MetricKind.SUM, ESSENTIAL,
+          "Serialized shuffle bytes read back on the reduce side."),
+    _spec("buildTime", MetricKind.NANO_TIMING, MODERATE,
+          "Join build-side accumulation wall time."),
+    _spec("sortTime", MetricKind.NANO_TIMING, MODERATE,
+          "Sort/top-k dispatch wall time."),
+    _spec("concatTime", MetricKind.NANO_TIMING, DEBUG,
+          "Batch-coalesce concat dispatch wall time."),
+    _spec("serializationTime", MetricKind.NANO_TIMING, DEBUG,
+          "Shuffle block serialization wall time."),
+    _spec("deserializationTime", MetricKind.NANO_TIMING, DEBUG,
+          "Shuffle block deserialization wall time."),
+    _spec("writeTime", MetricKind.NANO_TIMING, MODERATE,
+          "File-writer wall time (encode + filesystem)."),
+    _spec("bytesWritten", MetricKind.SUM, ESSENTIAL,
+          "Bytes written by the file writer."),
+    _spec("numFiles", MetricKind.SUM, MODERATE,
+          "Files produced by the file writer."),
+    _spec("peakDeviceBytes", MetricKind.PEAK, MODERATE,
+          "Peak device bytes observed (HBM watermark where the backend "
+          "reports it)."),
+    _spec("avgBatchRows", MetricKind.AVERAGE, DEBUG,
+          "Average host-known rows per batch."),
+]}
+
+#: Metrics recorded under names outside the taxonomy (operator-specific
+#: counters like aqeOutputPartitions, stripeHostFallback) default to
+#: SUM/MODERATE.
+_AD_HOC_LEVEL = MODERATE
+
+
+def taxonomy_markdown() -> str:
+    """The docs/monitoring.md taxonomy table (kept in sync by
+    tests/test_metrics.py)."""
+    lines = ["Name | Kind | Level | Description",
+             "-----|------|-------|------------"]
+    for name in sorted(TAXONOMY):
+        s = TAXONOMY[name]
+        lines.append(f"`{name}`|{s.kind}|{level_name(s.level)}|{s.doc}")
+    return "\n".join(lines) + "\n"
+
+
+class TpuMetric:
+    """One accumulator (the GpuMetric analog). Kind decides the merge:
+    SUM/NANO_TIMING add, PEAK keeps the max, AVERAGE tracks (sum, count).
+    Mutation is guarded by the owning registry's lock."""
+
+    __slots__ = ("spec", "_sum", "_count", "_peak")
+
+    def __init__(self, spec: MetricSpec):
+        self.spec = spec
+        self._sum = 0
+        self._count = 0
+        self._peak = 0
+
+    def update(self, value) -> None:
+        value = int(value) if not isinstance(value, float) else value
+        if self.spec.kind == MetricKind.PEAK:
+            self._peak = max(self._peak, value)
+        elif self.spec.kind == MetricKind.AVERAGE:
+            self._sum += value
+            self._count += 1
+        else:
+            self._sum += value
+
+    def set(self, value) -> None:
+        """Overwrite (legacy direct-dict-assignment semantics)."""
+        if self.spec.kind == MetricKind.PEAK:
+            self._peak = value
+        else:
+            self._sum = value
+            self._count = 1
+
+    @property
+    def value(self):
+        if self.spec.kind == MetricKind.PEAK:
+            return self._peak
+        if self.spec.kind == MetricKind.AVERAGE:
+            return self._sum / self._count if self._count else 0
+        return self._sum
+
+
+class _NoopTimer:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_TIMER = _NoopTimer()
+
+
+class MetricsRegistry:
+    """Per-query metric store: (node name, metric name) -> TpuMetric.
+
+    Thread-safe — warm-up workers and shuffle transport threads report
+    concurrently (tests/test_metrics.py hammer test). Node keying follows
+    the engine's existing convention: metrics are keyed by the exec's
+    node_name(), so two instances of the same exec type in one plan share
+    accumulators (noted in docs/monitoring.md)."""
+
+    def __init__(self, level: int = MODERATE, device_timing: bool = False):
+        self.level = level
+        self.device_timing = device_timing and level > NONE
+        self._lock = threading.Lock()
+        self._nodes: Dict[str, Dict[str, TpuMetric]] = {}
+
+    @classmethod
+    def for_conf(cls, conf) -> "MetricsRegistry":
+        """Build from a TpuConf (duck-typed: anything with the metrics
+        properties; bare test contexts without them get the defaults)."""
+        level = parse_level(getattr(conf, "metrics_level", None))
+        return cls(level, bool(getattr(conf, "metrics_device_timing", False)))
+
+    @property
+    def enabled(self) -> bool:
+        return self.level > NONE
+
+    def _spec_for(self, name: str) -> MetricSpec:
+        spec = TAXONOMY.get(name)
+        if spec is None:
+            spec = MetricSpec(name, MetricKind.SUM, _AD_HOC_LEVEL,
+                              "operator-specific counter")
+        return spec
+
+    def records(self, name: str) -> bool:
+        """Would a metric of this name be recorded at the current level?"""
+        return self.level >= self._spec_for(name).level
+
+    def _metric_locked(self, node: str, name: str) -> Optional[TpuMetric]:
+        """The accumulator for (node, name), or None when gated. Caller
+        holds the lock (one critical section per observation — this is the
+        per-batch hot path)."""
+        spec = self._spec_for(name)
+        if self.level < spec.level:
+            return None
+        metrics = self._nodes.setdefault(node, {})
+        m = metrics.get(name)
+        if m is None:
+            m = metrics[name] = TpuMetric(spec)
+        return m
+
+    def add(self, node: str, name: str, value) -> None:
+        with self._lock:
+            m = self._metric_locked(node, name)
+            if m is not None:
+                m.update(value)
+
+    def set_value(self, node: str, name: str, value) -> None:
+        with self._lock:
+            m = self._metric_locked(node, name)
+            if m is not None:
+                m.set(value)
+
+    def timer(self, node: str, name: str, trace: Optional[str] = None):
+        """Exception-safe NANO_TIMING context manager, coupled with an
+        XProf trace range (the NvtxWithMetrics analog). The trace span is
+        emitted regardless of the metrics level — profiler visibility must
+        not depend on metric gating — but the clock reads and accumulation
+        are skipped when the metric is gated."""
+        if not self.records(name):
+            if trace is None:
+                return _NOOP_TIMER
+            from ..utils.tracing import trace_range
+            return trace_range(trace)
+        from ..utils.tracing import NanoTimer
+        return NanoTimer(trace or f"{node}.{name}",
+                         _NodeSink(self, node), name)()
+
+    # -- read side ----------------------------------------------------------
+    def node_metrics(self, node: str) -> Dict[str, object]:
+        with self._lock:
+            return {n: m.value for n, m in self._nodes.get(node, {}).items()}
+
+    def node_names(self):
+        with self._lock:
+            return list(self._nodes)
+
+    def snapshot(self) -> Dict[str, Dict[str, object]]:
+        with self._lock:
+            return {node: {n: m.value for n, m in metrics.items()}
+                    for node, metrics in self._nodes.items()}
+
+    def legacy_view(self) -> "_LegacyMetricsView":
+        return _LegacyMetricsView(self)
+
+
+class _NodeSink:
+    """Dict-shaped adapter binding NanoTimer (and other legacy dict
+    writers) to one node of a registry."""
+
+    __slots__ = ("_registry", "_node")
+
+    def __init__(self, registry: MetricsRegistry, node: str):
+        self._registry = registry
+        self._node = node
+
+    def get(self, key, default=0):
+        return self._registry.node_metrics(self._node).get(key, default)
+
+    def __setitem__(self, key, value):
+        self._registry.set_value(self._node, key, value)
+
+    def add(self, key, value):
+        self._registry.add(self._node, key, value)
+
+
+def _deprecated(what: str) -> None:
+    import warnings
+    warnings.warn(
+        f"direct mutation of ExecContext.metrics ({what}) is deprecated; "
+        "use ExecContext.metric(node, name, value) or "
+        "ExecContext.registry — the dict shim is kept for one release",
+        DeprecationWarning, stacklevel=3)
+
+
+class _LegacyNodeView:
+    """Read/write shim for one node's metrics: reads return plain numbers
+    (what the old ad-hoc dict held); writes warn and route into the
+    registry."""
+
+    def __init__(self, registry: MetricsRegistry, node: str):
+        self._registry = registry
+        self._node = node
+
+    def _values(self):
+        return self._registry.node_metrics(self._node)
+
+    def __getitem__(self, name):
+        return self._values()[name]
+
+    def get(self, name, default=None):
+        return self._values().get(name, default)
+
+    def __contains__(self, name):
+        return name in self._values()
+
+    def __iter__(self):
+        return iter(self._values())
+
+    def __len__(self):
+        return len(self._values())
+
+    def items(self):
+        return self._values().items()
+
+    def keys(self):
+        return self._values().keys()
+
+    def values(self):
+        return self._values().values()
+
+    def __setitem__(self, name, value):
+        _deprecated(f"metrics[{self._node!r}][{name!r}] = ...")
+        self._registry.set_value(self._node, name, value)
+
+    def setdefault(self, name, default=0):
+        cur = self._values().get(name)
+        if cur is not None:
+            return cur
+        _deprecated(f"metrics[{self._node!r}].setdefault({name!r})")
+        self._registry.set_value(self._node, name, default)
+        return default
+
+    def __repr__(self):
+        return repr(self._values())
+
+    def __eq__(self, other):
+        return self._values() == other
+
+
+class _LegacyMetricsView:
+    """The ``ExecContext.metrics`` dict shim: node -> name -> value, backed
+    by the registry. Reads are silent (tests and diagnostics iterate it);
+    mutation warns with DeprecationWarning and keeps working for one
+    release."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self._registry = registry
+
+    def __getitem__(self, node):
+        return _LegacyNodeView(self._registry, node)
+
+    def get(self, node, default=None):
+        if node not in self._registry.node_names():
+            return default
+        return _LegacyNodeView(self._registry, node)
+
+    def setdefault(self, node, default=None):
+        return _LegacyNodeView(self._registry, node)
+
+    def __contains__(self, node):
+        return node in self._registry.node_names()
+
+    def __iter__(self):
+        return iter(self._registry.node_names())
+
+    def __len__(self):
+        return len(self._registry.node_names())
+
+    def items(self):
+        return [(n, _LegacyNodeView(self._registry, n))
+                for n in self._registry.node_names()]
+
+    def keys(self):
+        return self._registry.node_names()
+
+    def values(self):
+        return [_LegacyNodeView(self._registry, n)
+                for n in self._registry.node_names()]
+
+    def __repr__(self):
+        return repr(self._registry.snapshot())

@@ -877,7 +877,14 @@ class DataFrame:
     def count_rows(self) -> int:
         return self.collect().num_rows
 
-    def explain(self, extended: bool = False) -> str:
-        text = self._session.explain(self._plan)
+    def explain(self, extended: bool = False, metrics: bool = False) -> str:
+        """Print/return the physical plan tree. ``metrics=True`` annotates
+        every operator with the metrics of this session's last execution of
+        the same plan shape (docs/monitoring.md) — run ``.collect()``
+        first."""
+        if metrics:
+            text = self._session.explain_metrics(self._plan)
+        else:
+            text = self._session.explain(self._plan)
         print(text)
         return text

@@ -31,7 +31,11 @@ from .logical import SortOrder
 @dataclasses.dataclass
 class ExecContext:
     conf: TpuConf
-    metrics: dict = dataclasses.field(default_factory=dict)
+    #: Typed metrics registry (metrics/registry.py): per-query, leveled
+    #: (spark.rapids.tpu.metrics.level), thread-safe. Built from conf by
+    #: __post_init__ unless injected. The old free-form ``metrics`` dict
+    #: is now a deprecation shim over it (see the ``metrics`` property).
+    registry: object = None
     #: spill BufferCatalog (memory/spill.py); None in bare unit tests
     catalog: object = None
     #: end-of-query callbacks (shuffle unregister etc.); run by close()
@@ -74,6 +78,11 @@ class ExecContext:
     dense_fails: list = dataclasses.field(default_factory=list)
     _join_site: int = 0
 
+    def __post_init__(self):
+        if self.registry is None:
+            from ..metrics.registry import MetricsRegistry
+            self.registry = MetricsRegistry.for_conf(self.conf)
+
     def next_join_site(self) -> int:
         """Deterministic per-execution ordinal for a join probe batch
         (execution order is deterministic, so ordinals are stable across
@@ -83,8 +92,19 @@ class ExecContext:
         return s
 
     def metric(self, node: str, name: str, value):
-        self.metrics.setdefault(node, {})
-        self.metrics[node][name] = self.metrics[node].get(name, 0) + value
+        """Accumulate one metric observation. Thread-safe (warm-up and
+        shuffle transport threads report concurrently); kind/level come
+        from the taxonomy (metrics/registry.py). A no-op at metrics level
+        NONE."""
+        self.registry.add(node, name, value)
+
+    @property
+    def metrics(self):
+        """Deprecated dict view of the registry (node -> name -> value).
+        Reads keep working unchanged; direct mutation warns with
+        DeprecationWarning and is removed next release — use
+        :meth:`metric` or :attr:`registry`."""
+        return self.registry.legacy_view()
 
     def add_cleanup(self, fn: Callable[[], None]):
         self.cleanups.append(fn)

@@ -64,6 +64,10 @@ class TpuSession:
         # cache, AOT warm-up worker (compile/, docs/compile-cache.md).
         from . import compile as compile_layer
         compile_layer.configure(self.conf)
+        # Query-profile layer (metrics/, docs/monitoring.md).
+        self._last_profile = None
+        self._query_seq = 0
+        self._event_log = None
 
     # -- conf ---------------------------------------------------------------
     def with_conf(self, **kv) -> "TpuSession":
@@ -73,6 +77,9 @@ class TpuSession:
         s._overrides = TpuOverrides(s.conf)
         from . import compile as compile_layer
         compile_layer.configure(s.conf)
+        s._last_profile = None
+        s._query_seq = 0
+        s._event_log = None
         return s
 
     def compile_status(self) -> dict:
@@ -201,7 +208,9 @@ class TpuSession:
                 try:
                     # Task admission: bound concurrent queries holding the
                     # device (GpuSemaphore.acquireIfNecessary analog; conf
-                    # spark.rapids.sql.concurrentTpuTasks).
+                    # spark.rapids.sql.concurrentTpuTasks). Wait time is
+                    # accumulated by the semaphore itself (wait_ns); the
+                    # query profile reports the per-query delta.
                     with self.device_manager.semaphore:
                         result, overflowed = fn(
                             ctx, "eager" if eager else "deferred")
@@ -277,9 +286,13 @@ class TpuSession:
         as ONE compiled program (exec/fusion.py); mesh-capable plans as one
         SPMD program (exec/mesh.py)."""
         from .exec import fusion
+        from .metrics.profile import QueryProfiler
         physical = self.plan(logical)
+        profiler = QueryProfiler.maybe(self)
+        final = {}
 
         def run(ctx, mode):
+            final["ctx"] = ctx   # the profiled attempt = the last one run
             if mode == "deferred" and self.conf.sql_enabled \
                     and self.conf.mesh_enabled \
                     and _mesh().mesh_capable(physical, self.conf):
@@ -297,9 +310,13 @@ class TpuSession:
         # truncated files first, so they always use the eager exact-resize
         # join path (writes are IO-bound anyway).
         from .utils.kernel_cache import plan_signature
-        return self._run_with_retries(run,
-                                      eager_only=_contains_write(physical),
-                                      plan_sig=plan_signature(physical))
+        sig = plan_signature(physical)
+        result = self._run_with_retries(run,
+                                        eager_only=_contains_write(physical),
+                                        plan_sig=sig)
+        if profiler is not None and final.get("ctx") is not None:
+            self._note_profile(profiler, physical, final["ctx"], sig)
+        return result
 
     def materialize(self, logical: L.LogicalPlan) -> "L.CachedRelation":
         """Execute now and pin the result (eager df.cache()). Under a
@@ -357,6 +374,48 @@ class TpuSession:
     def explain(self, logical: L.LogicalPlan) -> str:
         physical = self.plan(logical)
         return physical.tree_string()
+
+    # -- query-profile layer (metrics/, docs/monitoring.md) -----------------
+    def _note_profile(self, profiler, physical, ctx, plan_sig) -> None:
+        """Snapshot the finished query into the session's last profile and
+        the structured event log (best-effort: observability must never
+        fail a query)."""
+        try:
+            self._query_seq += 1
+            prof = profiler.finish(physical, ctx, plan_sig, self._query_seq)
+        except Exception:  # noqa: BLE001 - profile is an aid, not a gate
+            return
+        self._last_profile = prof
+        log_dir = self.conf.metrics_event_log_dir
+        if log_dir:
+            if self._event_log is None or self._event_log.dir != log_dir:
+                from .metrics.eventlog import EventLog
+                self._event_log = EventLog(log_dir)
+            self._event_log.append(prof)
+
+    def last_query_profile(self):
+        """The :class:`~spark_rapids_tpu.metrics.profile.QueryProfile` of
+        the most recent query this session executed, or None (metrics level
+        NONE, or nothing run yet). Render with ``.render()``; serialize
+        with ``.to_dict()``."""
+        return self._last_profile
+
+    def explain_metrics(self, logical: L.LogicalPlan) -> str:
+        """The metric-annotated EXPLAIN tree (df.explain(metrics=True)):
+        the physical plan annotated with the metrics of this session's last
+        execution of the SAME plan shape. Falls back to the plain tree with
+        a note when no matching profile exists."""
+        from .metrics.profile import plan_profile_hash
+        from .utils.kernel_cache import plan_signature
+        physical = self.plan(logical)
+        prof = self._last_profile
+        if prof is not None and \
+                prof.plan_hash == plan_profile_hash(plan_signature(physical)):
+            return prof.render()
+        return (physical.tree_string()
+                + "(no QueryProfile recorded for this plan shape yet — run "
+                ".collect() first, with spark.rapids.tpu.metrics.level "
+                "above NONE)\n")
 
 
 def _mesh():

@@ -38,7 +38,6 @@ from ..config import SHUFFLE_COMPRESSION_CODEC
 from ..data.batch import ColumnarBatch, HostBatch
 from ..plan.physical import ExecContext, PhysicalPlan, _arrow_schema
 from ..utils.kernel_cache import cached_kernel, kernel_key
-from ..utils.tracing import trace_range
 from .codec import get_codec
 from .serializer import deserialize_batch, serialize_batch
 
@@ -265,12 +264,14 @@ class TpuShuffleExchangeExec(PhysicalPlan):
             build)
 
         # WRITE side (RapidsCachingWriter analog, host-serialized payloads).
+        name = self.node_name()
         map_id = 0
         for part in self.children[0].execute(ctx):
             for db in part:
                 if int(db.n_rows) == 0:
                     continue
-                with trace_range("shuffle.partition_split"):
+                with ctx.registry.timer(name, "opTime",
+                                        trace="shuffle.partition_split"):
                     sorted_batch, sorted_ids = partition_sort(db)
                     rb = sorted_batch.to_arrow()
                     ids_np = np.asarray(sorted_ids)[: rb.num_rows]
@@ -282,8 +283,10 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 for p in range(n_parts):
                     if ends[p] > starts[p]:
                         piece = rb.slice(starts[p], ends[p] - starts[p])
-                        with trace_range("shuffle.serialize"):
+                        with ctx.registry.timer(name, "serializationTime",
+                                                trace="shuffle.serialize"):
                             payload = serialize_batch(piece, codec)
+                        ctx.metric(name, "shuffleBytesWritten", len(payload))
                         catalog.add_block(shuffle_id, map_id, p, payload)
                 map_id += 1
 
@@ -318,7 +321,7 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 # accumulate the whole build side regardless, so dropping
                 # co-partitioning is safe in this single-process engine.
                 specs = aqe.plan_mapper_specs(map_id)
-                ctx.metric("TpuShuffleExchange", "aqeBroadcastConverted", 1)
+                ctx.metric(name, "aqeBroadcastConverted", 1)
             else:
                 specs = aqe.plan_specs(
                     sizes, n_parts, map_id,
@@ -327,8 +330,7 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                     ctx.conf.get(ADAPTIVE_SKEW_THRESHOLD),
                     allow_skew_split=getattr(self.partitioner_factory,
                                              "mode", None) == "round_robin")
-            ctx.metric("TpuShuffleExchange", "aqeOutputPartitions",
-                       len(specs))
+            ctx.metric(name, "aqeOutputPartitions", len(specs))
         else:
             specs = [aqe.CoalescedSpec(p, p + 1) for p in range(n_parts)]
         drained = {"n": 0}
@@ -348,8 +350,12 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 for p, map_range in pieces:
                     for payload in catalog.blocks_for_reduce(
                             shuffle_id, p, map_range):
-                        with trace_range("shuffle.deserialize"):
+                        ctx.metric(name, "shuffleBytesRead", len(payload))
+                        with ctx.registry.timer(
+                                name, "deserializationTime",
+                                trace="shuffle.deserialize"):
                             _, rb = deserialize_batch(payload)
+                        ctx.metric(name, "numOutputBatches", 1)
                         yield ColumnarBatch.from_arrow(rb)
             finally:
                 drained["n"] += 1

@@ -23,7 +23,10 @@ import jax
 
 _CACHE: Dict[tuple, Callable] = {}
 _LOCK = threading.Lock()
-_STATS = {"hits": 0, "misses": 0}
+#: build_ns: host time spent constructing kernels on cache misses — the
+#: compileNs source for query profiles (XLA backend compilation itself is
+#: async and lands in first-dispatch deviceTime).
+_STATS = {"hits": 0, "misses": 0, "build_ns": 0}
 
 
 def kernel_key(*parts) -> tuple:
@@ -85,19 +88,23 @@ def cached_kernel(kind: str, key: tuple, builder: Callable[[], Callable],
                   ) -> Callable:
     """Return the process-wide jitted kernel for (kind, key), building and
     wrapping ``builder()`` in ``jax.jit`` on first use."""
+    import time
     k = (kind, key)
     with _LOCK:
         fn = _CACHE.get(k)
         if fn is not None:
             _STATS["hits"] += 1
             return fn
+    t0 = time.perf_counter_ns()
     raw = builder()
     jitted = jax.jit(raw) if static_argnums is None else \
         jax.jit(raw, static_argnums=static_argnums)
+    build_ns = time.perf_counter_ns() - t0
     with _LOCK:
         fn = _CACHE.setdefault(k, jitted)
         if fn is jitted:
             _STATS["misses"] += 1
+            _STATS["build_ns"] += build_ns
         else:
             _STATS["hits"] += 1
     return fn
@@ -111,4 +118,4 @@ def cache_stats() -> dict:
 def clear_cache() -> None:
     with _LOCK:
         _CACHE.clear()
-        _STATS["hits"] = _STATS["misses"] = 0
+        _STATS["hits"] = _STATS["misses"] = _STATS["build_ns"] = 0

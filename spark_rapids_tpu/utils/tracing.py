@@ -4,6 +4,12 @@ The reference wraps every operator phase in NvtxRange so Nsight shows named
 spans (~40 files; NvtxWithMetrics.scala couples a range with a Spark SQL
 metric — SURVEY.md §5). On TPU the equivalent is jax.profiler's TraceAnnotation
 (XLA TraceMe): spans show up in the TensorBoard/XProf trace viewer.
+
+:class:`NanoTimer` is the NvtxWithMetrics analog AND the NANO_TIMING
+implementation of the typed metrics registry
+(:meth:`spark_rapids_tpu.metrics.registry.MetricsRegistry.timer` builds on
+it): one context manager that opens a trace range and accumulates the
+elapsed nanoseconds into a metric sink.
 """
 
 from __future__ import annotations
@@ -21,9 +27,16 @@ def trace_range(name: str):
 
 class NanoTimer:
     """Couples a trace range with an accumulated nanosecond metric
-    (NvtxWithMetrics analog)."""
+    (NvtxWithMetrics analog).
 
-    def __init__(self, name: str, metrics: dict, key: str):
+    ``metrics`` is either a plain dict (legacy callers) or any sink with an
+    ``add(key, nanos)`` method (the registry's node adapter). Accumulation
+    happens in a ``finally`` so an exception inside the ``with`` body still
+    records the time spent before the raise, and a non-numeric existing
+    value is treated as 0 rather than raising mid-metric (both were bugs in
+    the original dict-only implementation)."""
+
+    def __init__(self, name: str, metrics, key: str):
         self.name = name
         self.metrics = metrics
         self.key = key
@@ -31,7 +44,18 @@ class NanoTimer:
     @contextlib.contextmanager
     def __call__(self):
         start = time.perf_counter_ns()
-        with trace_range(self.name):
-            yield
-        self.metrics[self.key] = self.metrics.get(self.key, 0) + (
-            time.perf_counter_ns() - start)
+        try:
+            with trace_range(self.name):
+                yield
+        finally:
+            elapsed = time.perf_counter_ns() - start
+            sink = self.metrics
+            add = getattr(sink, "add", None)
+            if callable(add):
+                add(self.key, elapsed)
+            else:
+                prev = sink.get(self.key, 0)
+                if not isinstance(prev, (int, float)) \
+                        or isinstance(prev, bool):
+                    prev = 0
+                sink[self.key] = prev + elapsed

@@ -147,7 +147,7 @@ class TestBroadcastReplan:
         try:
             from spark_rapids_tpu.plan.physical import collect_partitions
             got = collect_partitions(physical, ctx)
-            metrics = ctx.metrics.get("TpuShuffleExchange", {})
+            metrics = ctx.metrics.get("TpuShuffleExchangeExec", {})
         finally:
             ctx.close()
         assert metrics.get("aqeBroadcastConverted"), \
@@ -183,7 +183,7 @@ class TestBroadcastReplan:
         try:
             from spark_rapids_tpu.plan.physical import collect_partitions
             collect_partitions(physical, ctx)
-            metrics = ctx.metrics.get("TpuShuffleExchange", {})
+            metrics = ctx.metrics.get("TpuShuffleExchangeExec", {})
         finally:
             ctx.close()
         assert not metrics.get("aqeBroadcastConverted"), \

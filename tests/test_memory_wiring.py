@@ -86,9 +86,9 @@ class TestMetrics:
         assert any("HashAggregate" in n for n in names), names
         d2h = [m for n, m in ctx.metrics.items() if "DeviceToHost" in n]
         assert d2h and d2h[0]["numOutputRows"] == 3
-        flt = [m for n, m in ctx.metrics.items() if n == "TpuFilter"]
+        flt = [m for n, m in ctx.metrics.items() if n == "TpuFilterExec"]
         assert flt and flt[0]["numOutputBatches"] >= 1
-        assert "opTimeMs" in flt[0]
+        assert "opTime" in flt[0]
 
 
 class TestSemaphore:

@@ -148,6 +148,49 @@ class TestSeededAntiPatterns:
             """)
         assert TL.lint_tree(fake_pkg) == []
 
+    def test_exec_without_metrics_flagged(self, fake_pkg):
+        _write(fake_pkg, "exec/blind.py", """
+            class TpuBlindExec:
+                def execute(self, ctx):
+                    return [iter([])]
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg)
+              if v.rule == "exec-no-metrics"]
+        assert len(vs) == 1 and "TpuBlindExec" in vs[0].message
+
+    def test_exec_with_metrics_passes(self, fake_pkg):
+        _write(fake_pkg, "exec/seen.py", """
+            class TpuSeenExec:
+                def execute(self, ctx):
+                    ctx.metric(self.node_name(), "numOutputBatches", 1)
+                    return [iter([])]
+
+            class TpuTimedExec:
+                def execute(self, ctx):
+                    with ctx.registry.timer("TpuTimedExec", "opTime"):
+                        pass
+                    return [iter([])]
+
+            class TpuTickedExec:
+                def execute(self, ctx):
+                    t0 = _tick(ctx, "TpuTickedExec", 0)
+                    return [iter([])]
+
+            class TpuInheritsExecuteExec(TpuSeenExec):
+                pass  # no execute() of its own: base covers it
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "exec-no-metrics"] == []
+
+    def test_exec_rule_scoped_to_exec_dir(self, fake_pkg):
+        _write(fake_pkg, "io/scanlike.py", """
+            class TpuElsewhereExec:
+                def execute(self, ctx):
+                    return [iter([])]
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "exec-no-metrics"] == []
+
 
 class TestRatchet:
     def _seed(self, fake_pkg, n):

@@ -270,7 +270,7 @@ class TestChunkedWindow:
         ctx = P.ExecContext(tpu.conf, catalog=tpu.device_manager.catalog)
         try:
             got = P.collect_partitions(physical, ctx)
-            chunked = ctx.metrics.get("TpuWindow", {}).get("chunkedWindow",
+            chunked = ctx.metrics.get("TpuWindowExec", {}).get("chunkedWindow",
                                                            0)
         finally:
             ctx.close()

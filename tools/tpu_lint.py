@@ -18,6 +18,13 @@ corrupt TPU performance or correctness silently:
 * ``plan-nondet`` (plan modules, ``plan/``): wall-clock/random/uuid calls
   in planning code — plan signatures and kernel-cache keys must be
   deterministic or caches silently miss (the ``Date.now`` class of bug).
+* ``exec-no-metrics`` (exec modules, ``exec/``): a ``Tpu*Exec`` class that
+  defines ``execute()`` but registers no metrics anywhere in its body
+  (no ``ctx.metric(...)`` / ``ctx.registry.timer(...)`` call) — every
+  exec's hot path must report at least its ESSENTIAL taxonomy metrics
+  (metrics/registry.py, docs/monitoring.md) or the query profile shows a
+  blind spot. Static approximation: the linter checks that SOME metric
+  registration exists, not its level.
 
 Existing debt is RATCHETED, not flooded: the checked-in baseline
 (``tools/tpu_lint_baseline.json``) records per-(file, rule) counts; the
@@ -47,6 +54,13 @@ from typing import Dict, List, Optional, Tuple
 #: relpath prefixes that scope the path-restricted rules
 KERNEL_SCOPE = ("ops/kernels/",)
 PLAN_SCOPE = ("plan/",)
+EXEC_SCOPE = ("exec/",)
+
+#: attribute-call names that count as "registers a metric" for
+#: exec-no-metrics (ctx.metric, ctx.registry.timer/add, registry sinks)
+_METRIC_CALL_ATTRS = frozenset({"metric", "timer"})
+#: module-level metric helpers (exec/execs.py) that also count
+_METRIC_HELPER_NAMES = frozenset({"_tick", "_counted_stream"})
 
 IGNORE_MARKER = "tpu-lint: ignore"
 
@@ -100,6 +114,7 @@ class _FileLinter(ast.NodeVisitor):
         self.lines = lines
         self.in_kernel = relpath.startswith(KERNEL_SCOPE)
         self.in_plan = relpath.startswith(PLAN_SCOPE)
+        self.in_exec = relpath.startswith(EXEC_SCOPE)
         self.violations: List[Violation] = []
         #: stack of (is_jit, frozenset(param names)) for enclosing functions
         self._funcs: List[Tuple[bool, frozenset]] = []
@@ -135,6 +150,38 @@ class _FileLinter(ast.NodeVisitor):
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        if self.in_exec:
+            self._check_exec_metrics(node)
+        self.generic_visit(node)
+
+    def _check_exec_metrics(self, node: ast.ClassDef):
+        """exec-no-metrics: a Tpu*Exec defining execute() must register at
+        least one metric somewhere in the class (subclasses inheriting
+        execute() are covered by their base)."""
+        import re
+        if not re.fullmatch(r"Tpu\w+Exec", node.name):
+            return
+        has_execute = any(
+            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == "execute" for n in node.body)
+        if not has_execute:
+            return
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Call):
+                continue
+            if isinstance(sub.func, ast.Attribute) \
+                    and sub.func.attr in _METRIC_CALL_ATTRS:
+                return
+            if isinstance(sub.func, ast.Name) \
+                    and sub.func.id in _METRIC_HELPER_NAMES:
+                return
+        self._flag(node, "exec-no-metrics",
+                   f"{node.name} defines execute() but never registers a "
+                   "metric (ctx.metric / ctx.registry.timer); its hot path "
+                   "is invisible to the query profile — wire up the "
+                   "ESSENTIAL taxonomy (docs/monitoring.md)")
 
     # -- rules ---------------------------------------------------------------
     def visit_Call(self, node: ast.Call):
