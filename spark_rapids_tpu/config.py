@@ -167,6 +167,61 @@ CONCURRENT_TPU_TASKS = conf_int(
     "Number of tasks that may hold the TPU concurrently "
     "(reference spark.rapids.sql.concurrentGpuTasks).")
 
+CONCURRENT_ACQUIRE_TIMEOUT = conf_float(
+    "spark.rapids.tpu.concurrentTpuTasks.acquireTimeout", 0.0,
+    "Seconds a task may block acquiring the admission semaphore before "
+    "failing with a diagnostic error naming the holding threads and their "
+    "held counts (a silent deadlock becomes an actionable failure). 0 "
+    "waits forever. See docs/fault-tolerance.md.")
+
+RETRY_MAX_RETRIES = conf_int(
+    "spark.rapids.tpu.retry.maxRetries", 3,
+    "In-place retries of an operator attempt after a classified OOM or "
+    "transient fault (memory/retry.py) before escalating: OOMs escalate "
+    "to splitting the input batch in half by rows (SplitAndRetryOOM at "
+    "unsplittable sites), transients re-raise. Each OOM retry first "
+    "synchronizes the device and spills every spillable buffer below "
+    "on-deck priority. See docs/fault-tolerance.md.")
+
+RETRY_BACKOFF_BASE_MS = conf_float(
+    "spark.rapids.tpu.retry.backoffBaseMs", 10.0,
+    "Base delay for the capped exponential retry backoff (doubles per "
+    "attempt, deterministic jitter derived from the site name). 0 "
+    "disables sleeping between retries (test hook).")
+
+RETRY_BACKOFF_MAX_MS = conf_float(
+    "spark.rapids.tpu.retry.backoffMaxMs", 1000.0,
+    "Ceiling on one retry backoff sleep, milliseconds.")
+
+FAULT_INJECTION_SITES = conf_str(
+    "spark.rapids.tpu.test.faultInjection.sites", "",
+    "Intended for tests: comma-separated retry-site names (or prefixes; "
+    "'*' matches every site) where the deterministic fault injector "
+    "raises synthetic faults (utils/fault_injection.py). Empty disables "
+    "injection. Site names are listed in docs/fault-tolerance.md.")
+
+FAULT_INJECTION_SEED = conf_int(
+    "spark.rapids.tpu.test.faultInjection.seed", 0,
+    "Phase/flavor seed for the fault injector: shifts WHICH visit of a "
+    "site faults and which transient flavor (remote-compile race vs "
+    "spill-disk OSError) is raised. Same seed = same fault schedule.")
+
+FAULT_INJECTION_OOM_EVERY_N = conf_int(
+    "spark.rapids.tpu.test.faultInjection.oomEveryN", 0,
+    "Raise a synthetic RESOURCE_EXHAUSTED at every Nth visit of each "
+    "matched injection site; negative N faults the FIRST |N| visits and "
+    "then heals (the schedule that exhausts a site's retries into a "
+    "split while still letting the query finish). 0 disables OOM "
+    "injection; N=1 faults every visit (drives sites to "
+    "SplitAndRetryOOM).")
+
+FAULT_INJECTION_TRANSIENT_EVERY_N = conf_int(
+    "spark.rapids.tpu.test.faultInjection.transientEveryN", 0,
+    "Raise a synthetic transient fault (remote-compile helper race or "
+    "spill-disk OSError, flavor chosen deterministically from the seed) "
+    "at every Nth visit of each matched injection site; negative N "
+    "faults the first |N| visits then heals. 0 disables.")
+
 HBM_ALLOC_FRACTION = conf_float(
     "spark.rapids.memory.tpu.allocFraction", 0.9,
     "Fraction of HBM the arena allocator may use "

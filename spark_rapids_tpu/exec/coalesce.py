@@ -60,6 +60,7 @@ class TpuCoalesceBatchesExec(TpuExec):
         return f"TpuCoalesceBatches ({self.goal!r})"
 
     def execute(self, ctx):
+        from ..memory import retry as R
         catalog: Optional[SP.BufferCatalog] = getattr(ctx, "catalog", None)
         single = isinstance(self.goal, RequireSingleBatch)
         target = None if single else self.goal.rows
@@ -76,29 +77,41 @@ class TpuCoalesceBatchesExec(TpuExec):
             direct: List[ColumnarBatch] = []  # no-catalog fallback
             pending_cap = 0
 
+            def concat_ids(ids):
+                from .execs import _pinned_concat
+                with ctx.registry.timer(name, "concatTime",
+                                        trace="coalesce.concat"):
+                    return _pinned_concat(catalog, ids)
+
+            def concat_direct(batches):
+                with ctx.registry.timer(name, "concatTime",
+                                        trace="coalesce.concat"):
+                    return _coalesce_device(list(batches))
+
             def flush():
                 nonlocal pending_cap
                 if pending:
-                    # Pin first so acquiring one buffer can't evict another
-                    # buffer of this same flush (on-deck semantics).
+                    # On OOM the accumulated ids split in half: each half
+                    # concatenates separately, so the goal degrades to two
+                    # smaller output batches instead of the query dying.
+                    outs = R.with_retry(ctx, f"{name}.concat",
+                                        list(pending), concat_ids,
+                                        split=R.halve_list, node=name)
                     for b in pending:
-                        catalog.pin(b)
-                    batches = [catalog.acquire_batch(b) for b in pending]
+                        catalog.free(b)
+                elif direct:
+                    outs = R.with_retry(ctx, f"{name}.concat",
+                                        list(direct), concat_direct,
+                                        split=R.halve_list, node=name)
                 else:
-                    batches = list(direct)
-                if not batches:
-                    return None
-                with ctx.registry.timer(name, "concatTime",
-                                        trace="coalesce.concat"):
-                    out = _coalesce_device(batches)
-                ctx.metric(name, "numInputBatches", len(batches))
-                ctx.metric(name, "numOutputBatches", 1)
-                for b in pending:
-                    catalog.free(b)
+                    return []
+                ctx.metric(name, "numInputBatches",
+                           len(pending) + len(direct))
+                ctx.metric(name, "numOutputBatches", len(outs))
                 pending.clear()
                 direct.clear()
                 pending_cap = 0
-                return out
+                return outs
 
             for db in part:
                 if db.capacity == 0:
@@ -110,12 +123,8 @@ class TpuCoalesceBatchesExec(TpuExec):
                     direct.append(db)
                 pending_cap += db.capacity
                 if not single and pending_cap >= target:
-                    out = flush()
-                    if out is not None:
-                        yield out
-            out = flush()
-            if out is not None:
-                yield out
+                    yield from flush()
+            yield from flush()
         return [run(p) for p in self.children[0].execute(ctx)]
 
 

@@ -634,13 +634,15 @@ class TpuSortExec(TpuExec):
 
         def gen():
             from ..config import SORT_EXTERNAL_THRESHOLD
+            from ..memory import retry as R
+            name = self.node_name()
             catalog = getattr(ctx, "catalog", None)
             if ctx.in_fusion or catalog is None:
                 merged = _accumulate_spillable(self.children[0], ctx, "sort")
                 if merged is None:
                     return
-                ctx.metric(self.node_name(), "numOutputBatches", 1)
-                with ctx.registry.timer(self.node_name(), "sortTime"):
+                ctx.metric(name, "numOutputBatches", 1)
+                with ctx.registry.timer(name, "sortTime"):
                     out = do_sort(merged)
                 yield out
                 return
@@ -657,20 +659,32 @@ class TpuSortExec(TpuExec):
                 if not ids:
                     return
                 if total <= threshold:
-                    for b in ids:
-                        catalog.pin(b)
-                    merged = _coalesce_device(
-                        [catalog.acquire_batch(b) for b in ids])
-                    ctx.metric(self.node_name(), "numOutputBatches", 1)
-                    with ctx.registry.timer(self.node_name(), "sortTime"):
-                        out = do_sort(merged)
+                    def assemble_and_sort(id_list):
+                        merged = _pinned_concat(catalog, id_list)
+                        with ctx.registry.timer(name, "sortTime"):
+                            return do_sort(merged)
+                    # Single-batch sorts cannot split (two sorted halves
+                    # are not a global sort): spill + retry only.
+                    out = R.with_retry(ctx, f"{name}.sort", ids,
+                                       assemble_and_sort, node=name)[0]
+                    ctx.metric(name, "numOutputBatches", 1)
                     yield out
                     return
                 from .external_sort import ExternalSorter
                 sorter = ExternalSorter(self.orders, schema, catalog,
-                                        key_exprs)
+                                        key_exprs, ctx=ctx)
                 for b in ids:
-                    sorter.add_batch(catalog.acquire_batch(b))
+                    # The reload itself can OOM (the batch may have
+                    # spilled), so acquisition runs under retry too; the
+                    # sort step then splits in half by rows when it cannot
+                    # fit — each half becomes its own sorted run, which
+                    # the merge tree absorbs naturally.
+                    batch = R.with_retry(ctx, f"{name}.runGeneration", b,
+                                         catalog.acquire_batch,
+                                         node=name)[0]
+                    R.with_retry(ctx, f"{name}.runGeneration", batch,
+                                 sorter.add_batch,
+                                 split=R.halve_by_rows, node=name)
                     catalog.free(b)
                 ids = []
                 n_out = 0
@@ -764,14 +778,20 @@ class TpuTopKExec(TpuExec):
         return [gen()]
 
 
-def _accumulate_spillable(child: PhysicalPlan, ctx,
-                          label: str) -> Optional[ColumnarBatch]:
+def _accumulate_spillable(child: PhysicalPlan, ctx, label: str,
+                          node: Optional[str] = None
+                          ) -> Optional[ColumnarBatch]:
     """Collect ALL of a child's batches into one, registering each with the
     spill catalog while accumulating so memory pressure can push earlier
     batches to host/disk (the reference makes join build sides and sort
     inputs spillable the same way, RapidsBufferStore.scala:40). Under
     whole-stage fusion tracing the catalog is bypassed (tracers cannot move
-    hosts)."""
+    hosts).
+
+    The assembly (unspill + concat) runs under the OOM-retry combinator
+    without a split: the consumer's contract is ONE batch, so exhausted
+    retries surface SplitAndRetryOOM naming the site."""
+    from ..memory import retry as R
     from ..memory import spill as SP
     catalog = getattr(ctx, "catalog", None)
     use_catalog = catalog is not None and not ctx.in_fusion
@@ -786,11 +806,12 @@ def _accumulate_spillable(child: PhysicalPlan, ctx,
                     db, SP.ACTIVE_BATCHING_PRIORITY))
         if not ids:
             return None
+
         with trace_range(f"{label}.assemble"):
-            for b in ids:
-                catalog.pin(b)
-            batches = [catalog.acquire_batch(b) for b in ids]
-            out = _coalesce_device(batches)
+            out = R.with_retry(ctx, f"{node or label}.assemble", ids,
+                               lambda id_list: _pinned_concat(catalog,
+                                                              id_list),
+                               node=node)[0]
     finally:
         # Free even when the child raises mid-stream (e.g. a transient
         # remote-compile failure that session._run_with_retries retries) —
@@ -799,6 +820,22 @@ def _accumulate_spillable(child: PhysicalPlan, ctx,
         for b in ids:
             catalog.free(b)
     return out
+
+
+def _pinned_concat(catalog, ids):
+    """Acquire + concat a set of catalog buffers with on-deck pinning
+    (pin first so acquiring one buffer can't evict another of the same
+    set); unpins in finally so a failed — and retried — attempt leaves
+    them spillable for the retry's spill-down. The one assembly routine
+    behind every with_retry'd concat site (coalesce flush, join build,
+    single-batch sort)."""
+    for b in ids:
+        catalog.pin(b)
+    try:
+        return _coalesce_device([catalog.acquire_batch(b) for b in ids])
+    finally:
+        for b in ids:
+            catalog.unpin(b)
 
 
 _concat_jit = jax.jit(KC.concat_batches, static_argnums=(1,))
@@ -1207,14 +1244,19 @@ class TpuShuffledHashJoinExec(TpuExec):
         dense_eligible = KJ.dense_joinable(jt, _bind_all(
             self.right_keys, right.schema)) and self.condition is None
 
-        def join_batch(probe, build):
+        def join_batch(probe, build, site, learn=True):
             # Optimistic output sizing: allocate from the learned exact
             # capacity for this join site when a previous run of this plan
             # observed it (ctx.join_caps, filled by the session's
             # overflow-learning retry), else from the probe capacity. The
             # real match count stays a deferred device-side observation the
             # session reads ONCE per query — no per-batch host syncs.
-            site = ctx.next_join_site()
+            # ``site`` is taken by the CALLER, outside the retry wrapper:
+            # a retried/split attempt must not consume extra ordinals or
+            # every later join's learned capacity would key-shift.
+            # ``learn=False`` on split halves: a half's match total would
+            # teach the session an UNDER-estimate of the full batch and
+            # the cached capacity would overflow on every later run.
             mode = 1 + ctx.dense_modes.get(site, 0)
             if mode == 2 and jt != "inner":
                 mode = 3  # swapped mode only exists for inner joins
@@ -1255,7 +1297,8 @@ class TpuShuffledHashJoinExec(TpuExec):
                     (out, hits), _ = kernel(probe, build, bucket_capacity(t))
             else:
                 ctx.overflow_flags.append(total > out_cap)
-                ctx.join_totals.append((site, total))
+                if learn:
+                    ctx.join_totals.append((site, total))
             if post_filter is not None:
                 out = post_filter(out)
             return out, hits
@@ -1264,8 +1307,10 @@ class TpuShuffledHashJoinExec(TpuExec):
 
         def gen():
             import time as _time
+            from ..memory import retry as R
             with ctx.registry.timer(name, "buildTime"):
-                build = _accumulate_spillable(right, ctx, "join.build")
+                build = _accumulate_spillable(right, ctx, "join.build",
+                                              node=name)
             hit_acc = None
             t0 = _time.perf_counter_ns()
             for part in left.execute(ctx):
@@ -1278,13 +1323,25 @@ class TpuShuffledHashJoinExec(TpuExec):
                             yield ColumnarBatch(probe.columns, probe.n_rows,
                                                 out_schema, live=probe.live)
                         continue
-                    out, hits = join_batch(probe, build)
+                    # Probe batches split in half by rows when retries
+                    # alone cannot fit the gather's output allocation —
+                    # each half joins against the same build table and
+                    # streams out as its own batch.
+                    site = ctx.next_join_site()
+                    tracker = R.SplitTracker(R.halve_by_rows)
+                    results = R.with_retry(
+                        ctx, f"{name}.probe", probe,
+                        lambda p: join_batch(p, build, site,
+                                             learn=not
+                                             tracker.split_happened),
+                        split=tracker, node=name)
                     t0 = _tick(ctx, name, t0)
-                    if hit_acc is None:
-                        hit_acc = hits
-                    elif hits is not None:
-                        hit_acc = hit_acc | hits
-                    yield out
+                    for out, hits in results:
+                        if hit_acc is None:
+                            hit_acc = hits
+                        elif hits is not None:
+                            hit_acc = hit_acc | hits
+                        yield out
             if jt == "full" and build is not None:
                 ctx.metric(name, "numOutputBatches", 1)
                 yield self._unmatched_build(build, hit_acc)

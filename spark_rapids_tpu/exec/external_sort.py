@@ -226,7 +226,7 @@ class ExternalSorter:
     """Streaming global sort: feed batches, then iterate sorted chunks."""
 
     def __init__(self, orders, schema: T.Schema, catalog,
-                 key_exprs=None):
+                 key_exprs=None, ctx=None):
         self.orders = orders
         self.schema = schema
         self.catalog = _TrackingCatalog(catalog)
@@ -235,6 +235,18 @@ class ExternalSorter:
         self.nf = [o.effective_nulls_first for o in orders]
         self._runs: List[_Run] = []
         self._sort_one = self._make_sort_one()
+        #: ExecContext for the OOM-retry combinator around merge steps
+        #: (spill + retry only — a merge step cannot split); None keeps
+        #: the bare-unit-test construction unchanged.
+        self._ctx = ctx
+
+    def _retry_step(self, tag: str, fn):
+        """One merge-tree device step under the retry combinator."""
+        if self._ctx is None:
+            return fn(None)
+        from ..memory import retry as R
+        return R.with_retry(self._ctx, f"ExternalSorter.{tag}", None, fn,
+                            node="ExternalSorter")[0]
 
     def release(self):
         """Free every chunk this sorter still has registered (safe to call
@@ -321,13 +333,15 @@ class ExternalSorter:
                 if r1.chunks and r2.chunks else \
                 (r1 if r1.chunks else (r2 if r2.chunks else None))
             if bound_run is None or not bound_run.chunks:
-                merged, n_emit = merge_ns(carry, chunk)
+                merged, n_emit = self._retry_step(
+                    "mergeStep", lambda _: merge_ns(carry, chunk))
                 n = int(jax.device_get(n_emit))
                 emit(merged, 0, n)
                 carry = None
                 continue
             sent = bound_run.peek_head_row(catalog, slice_k)
-            merged, n_emit = merge_s(carry, chunk, sent)
+            merged, n_emit = self._retry_step(
+                "mergeStep", lambda _: merge_s(carry, chunk, sent))
             n = int(jax.device_get(n_emit))
             total_live = int(jax.device_get(merged.n_rows))
             emit(merged, 0, n)

@@ -72,7 +72,12 @@ class TpuBroadcastExchangeExec(TpuExec):
             self._empty = True
             return None
         with trace_range("broadcast.collect"):
-            merged = _coalesce_device(batches)
+            from ..memory import retry as R
+            # The broadcast payload must be ONE batch (every consumer
+            # builds from it): spill + retry only, no split.
+            name = self.node_name()
+            merged = R.with_retry(ctx, f"{name}.collect", batches,
+                                  _coalesce_device, node=name)[0]
             # Payload size from the device buffer footprint; the IPC bytes
             # are only materialized if a multi-process transport needs them
             # — in-process, consumers share the device batch directly.
@@ -209,6 +214,7 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
             return db
 
         def gen():
+            from ..memory import retry as R
             with ctx.registry.timer(name, "buildTime"):
                 build_batches = []
                 for part in right.execute(ctx):
@@ -230,31 +236,45 @@ class TpuBroadcastNestedLoopJoinExec(TpuExec):
                                     live=probe.live))
                         continue
                     if jt in ("left_semi", "left_anti"):
-                        out, _ = kernel(probe, build, 0)
-                        yield counted(ColumnarBatch(out.columns, out.n_rows,
-                                                    out_schema,
-                                                    live=out.live))
+                        # The pair grid is the memory hazard (probe cap x
+                        # build cap): a probe half quarters it.
+                        for out in R.with_retry(
+                                ctx, f"{name}.pairGrid", probe,
+                                lambda p: kernel(p, build, 0)[0],
+                                split=R.halve_by_rows, node=name):
+                            yield counted(ColumnarBatch(
+                                out.columns, out.n_rows, out_schema,
+                                live=out.live))
                         continue
                     # Optimistic sizing + deferred overflow flag — same
                     # no-sync discipline as TpuShuffledHashJoinExec; the
                     # session retries with the learned exact capacity when
                     # the pair count exceeded the allocation.
                     site = ctx.next_join_site()
-                    out_cap = ctx.join_caps.get(site) or bucket_capacity(
-                        max(int(probe.capacity * ctx.join_growth), 128))
-                    (out, extra), n_match = kernel(probe, build, out_cap)
-                    if ctx.eager_overflow:
-                        t = int(n_match)
-                        if t > out_cap:
-                            (out, extra), _ = kernel(probe, build,
-                                                     bucket_capacity(t))
-                    else:
-                        ctx.overflow_flags.append(n_match > out_cap)
-                        ctx.join_totals.append((site, n_match))
-                    yield counted(out)
-                    if extra is not None:
-                        yield counted(_null_extend_right(extra, out_schema,
-                                                         n_right))
+                    tracker = R.SplitTracker(R.halve_by_rows)
+
+                    def sized_join(p):
+                        out_cap = ctx.join_caps.get(site) or \
+                            bucket_capacity(
+                                max(int(p.capacity * ctx.join_growth), 128))
+                        (out, extra), n_match = kernel(p, build, out_cap)
+                        if ctx.eager_overflow:
+                            t = int(n_match)
+                            if t > out_cap:
+                                (out, extra), _ = kernel(p, build,
+                                                         bucket_capacity(t))
+                        else:
+                            ctx.overflow_flags.append(n_match > out_cap)
+                            if not tracker.split_happened:
+                                ctx.join_totals.append((site, n_match))
+                        return out, extra
+                    for out, extra in R.with_retry(
+                            ctx, f"{name}.pairGrid", probe, sized_join,
+                            split=tracker, node=name):
+                        yield counted(out)
+                        if extra is not None:
+                            yield counted(_null_extend_right(
+                                extra, out_schema, n_right))
         return [gen()]
 
 

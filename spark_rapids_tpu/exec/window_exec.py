@@ -91,6 +91,7 @@ class TpuWindowExec(TpuExec):
 
         def run(parts):
             from ..config import WINDOW_EXTERNAL_THRESHOLD
+            from ..memory import retry as R
             from ..memory import spill as SP
             catalog = getattr(ctx, "catalog", None)
             batches = [db for part in parts for db in part]
@@ -103,9 +104,16 @@ class TpuWindowExec(TpuExec):
                     catalog.device_budget // 4
             total = sum(b.device_size_bytes for b in batches)
             if threshold is None or total <= threshold:
+                # Whole-partition contract: a window piece cannot split by
+                # rows without breaking its partition groups, so this site
+                # is spill + retry only (SplitAndRetryOOM when exhausted;
+                # the chunked path below is the real degradation valve).
+                def evaluate(bs):
+                    with ctx.registry.timer(name, "opTime"):
+                        return window_all(_coalesce_device(bs))
+                out = R.with_retry(ctx, f"{name}.evaluate", batches,
+                                   evaluate, node=name)[0]
                 ctx.metric(name, "numOutputBatches", 1)
-                with ctx.registry.timer(name, "opTime"):
-                    out = window_all(_coalesce_device(batches))
                 yield out
                 return
             for piece in _chunked_pieces(batches, common_parts,
@@ -113,7 +121,8 @@ class TpuWindowExec(TpuExec):
                                          threshold):
                 ctx.metric(name, "chunkedWindow", 1)
                 ctx.metric(name, "numOutputBatches", 1)
-                yield window_all(piece)
+                yield R.with_retry(ctx, f"{name}.evaluate", piece,
+                                   window_all, node=name)[0]
         return [run(self.children[0].execute(ctx))]
 
 
@@ -134,7 +143,7 @@ def _chunked_pieces(batches, part_exprs, child_schema, catalog, ctx,
 
     orders = [SortOrder(e) for e in part_exprs]
     sorter = ExternalSorter(orders, child_schema, catalog,
-                            key_exprs=list(part_exprs))
+                            key_exprs=list(part_exprs), ctx=ctx)
     try:
         slice_k = _slice_kernel(child_schema)
         from ..data.column import bucket_capacity

@@ -341,13 +341,22 @@ class TpuCsvScanExec:
         name = self.node_name()
 
         def gen():
+            from ..memory.retry import Classification, classify
+            from ..utils.fault_injection import maybe_inject
             for path in self.files:
                 try:
+                    maybe_inject(ctx, "io.csv.file")
                     with ctx.registry.timer(name, "opTime",
                                             trace="csv.decode_file"):
                         batches = list(decode_file(path, self._schema,
                                                    self.options))
-                except NotCsvDecodable:
+                except Exception as e:  # noqa: BLE001 - classify-narrowed
+                    # Out-of-scope files (NotCsvDecodable) and classified
+                    # device faults fall back to the host reader per file;
+                    # parser-logic bugs still fail loudly.
+                    if not isinstance(e, NotCsvDecodable) \
+                            and classify(e) == Classification.FATAL:
+                        raise
                     ctx.metric(name, "fileHostFallback", 1)
                     batches = self._host_file(path)
                 for b in batches:

@@ -726,14 +726,22 @@ class TpuOrcScanExec:
         name = self.node_name()
 
         def read(path, tail, si):
+            from ..memory.retry import Classification, classify
+            from ..utils.fault_injection import maybe_inject
             try:
+                maybe_inject(ctx, "io.orc.stripe")
                 with ctx.registry.timer(name, "opTime",
                                         trace="orc.device_decode_stripe"):
                     return decode_stripe(path, tail, si, self._schema)
-            except NotOrcDecodable:
+            except Exception as e:  # noqa: BLE001 - classify-narrowed
                 # parsers translate malformed-input errors to
-                # NotOrcDecodable at their boundary (_parse_boundary);
-                # decoder-logic bugs elsewhere still fail loudly
+                # NotOrcDecodable at their boundary (_parse_boundary), and
+                # classified device faults (OOM/transient) degrade to the
+                # host reader per stripe — the correctness baseline;
+                # decoder-logic bugs elsewhere still fail loudly.
+                if not isinstance(e, NotOrcDecodable) \
+                        and classify(e) == Classification.FATAL:
+                    raise
                 ctx.metric(name, "stripeHostFallback", 1)
                 return self._host_stripe(path, tail, si)
 

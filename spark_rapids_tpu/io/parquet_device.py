@@ -676,9 +676,11 @@ class TpuParquetScanExec:
         name = self.node_name()
 
         def read(path, meta, pq_schema, rg):
+            from ..utils.fault_injection import maybe_inject
             from ..utils.tracing import trace_range
             n_rows = meta.row_group(rg).num_rows
             try:
+                maybe_inject(ctx, "io.parquet.rowGroup")
                 with ctx.registry.timer(name, "opTime",
                                         trace="parquet.device_decode"):
                     yield decode_row_group(path, rg, self._schema,

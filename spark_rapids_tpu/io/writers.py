@@ -159,6 +159,27 @@ class _WriteFilesBase(PhysicalPlan):
         # Unique per job so append mode never collides with the files of an
         # earlier write (Spark embeds the job UUID the same way).
         self._job_id = uuid.uuid4().hex[:8]
+        self._prepare_result: bool = None
+        self._emitted: set = set()
+
+    def _prepare_once(self) -> bool:
+        """Apply the save mode exactly once per plan instance: a
+        dispatch-level transient retry (session._run_with_retries)
+        re-executes the plan, and re-applying the mode would rmtree fresh
+        output (overwrite), raise (error), or silently skip (ignore).
+        A re-execution instead deletes the previous attempt's own files
+        (task ids can shift when a batch split-and-retried, so
+        name-overwrite alone is not a sound cleanup)."""
+        if self._prepare_result is None:
+            self._prepare_result = prepare_target(self.path, self.mode)
+        elif self._prepare_result and self._emitted:
+            for p in self._emitted:
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+            self._emitted.clear()
+        return self._prepare_result
 
     @property
     def schema(self):
@@ -184,6 +205,7 @@ class _WriteFilesBase(PhysicalPlan):
         os.makedirs(target_dir, exist_ok=True)
         target = os.path.join(target_dir, self._file_name(task_id, file_no))
         stats.bytes += _write_one(data, self.fmt, target, self.options)
+        self._emitted.add(target)
         stats.files += 1
         stats.rows += n_rows
 
@@ -212,7 +234,7 @@ class CpuWriteFilesExec(_WriteFilesBase):
 
     def execute(self, ctx: ExecContext):
         stats = WriteStats()
-        if not prepare_target(self.path, self.mode):
+        if not self._prepare_once():
             return [iter([stats.to_batch()])]
         data_arrow = self._data_arrow()
         seen_dirs: set = set()
@@ -259,11 +281,12 @@ class TpuWriteFilesExec(_WriteFilesBase):
     def execute(self, ctx: ExecContext):
         import time as _time
         from ..config import PARQUET_DEVICE_ENCODE
+        from ..memory import retry as R
         from ..ops.kernels import rowops as KR
         name = self.node_name()
         t_start = _time.perf_counter_ns()
         stats = WriteStats()
-        if not prepare_target(self.path, self.mode):
+        if not self._prepare_once():
             return [iter([stats.to_batch()])]
         child_schema = self.children[0].schema
         part_ordinals = [child_schema.index_of(c) for c in self.partition_by]
@@ -271,26 +294,40 @@ class TpuWriteFilesExec(_WriteFilesBase):
         seen_dirs: set = set()
         device_encode = (self.fmt == "parquet" and not part_ordinals
                          and ctx.conf.get(PARQUET_DEVICE_ENCODE))
+
+        def device_sort(b):
+            """The writer's device-side memory hazard (dynamic-partition
+            sort). File emission stays OUTSIDE the retry: a retried
+            attempt must never re-write a committed file."""
+            if part_ordinals:
+                with trace_range("write.device_partition_sort"):
+                    b = KR.sort_batch(b, part_ordinals,
+                                      [True] * len(part_ordinals),
+                                      [True] * len(part_ordinals))
+            return b
+
         task_id = 0
         for part in self.children[0].execute(ctx):
             for db in part:
                 if int(db.n_rows) == 0:
                     continue
-                if part_ordinals:
-                    with trace_range("write.device_partition_sort"):
-                        db = KR.sort_batch(db, part_ordinals,
-                                           [True] * len(part_ordinals),
-                                           [True] * len(part_ordinals))
-                if device_encode and self._emit_device(db, task_id, stats):
+                # A split input emits two (smaller) files — content is
+                # identical; only the file count changes.
+                for piece in R.with_retry(ctx, f"{name}.deviceWrite", db,
+                                          device_sort,
+                                          split=R.halve_by_rows, node=name):
+                    if device_encode and self._emit_device(piece, task_id,
+                                                           stats):
+                        task_id += 1
+                        continue
+                    rb = piece.to_arrow()
+                    if not part_ordinals:
+                        self._emit(rb, self.path, task_id, 0, stats,
+                                   rb.num_rows)
+                    else:
+                        self._write_sorted_runs(rb, task_id, stats,
+                                                seen_dirs, data_arrow)
                     task_id += 1
-                    continue
-                rb = db.to_arrow()
-                if not part_ordinals:
-                    self._emit(rb, self.path, task_id, 0, stats, rb.num_rows)
-                else:
-                    self._write_sorted_runs(rb, task_id, stats, seen_dirs,
-                                            data_arrow)
-                task_id += 1
         # Writer metrics mirror WriteStats (BasicColumnarWriteStatsTracker):
         # the stats row is the query result, the metrics feed the profile.
         ctx.metric(name, "numOutputRows", stats.rows)
@@ -313,6 +350,7 @@ class TpuWriteFilesExec(_WriteFilesBase):
                     compression=self.options.get("compression") or "snappy")
             except NotDeviceEncodable:
                 return False
+        self._emitted.add(target)
         stats.bytes += n
         stats.files += 1
         stats.rows += int(db.n_rows)

@@ -18,18 +18,25 @@ host paths (oracle runs, planning, explain) alive regardless.
 
 from __future__ import annotations
 
+import logging
 import threading
 
-from ..config import (CONCURRENT_TPU_TASKS, DEVICE_BACKEND,
-                      DEVICE_SPILL_BUDGET, HBM_ALLOC_FRACTION,
-                      HOST_SPILL_STORAGE_SIZE, MEMORY_DEBUG, SPILL_DIR,
-                      TpuConf)
+from ..config import (CONCURRENT_ACQUIRE_TIMEOUT, CONCURRENT_TPU_TASKS,
+                      DEVICE_BACKEND, DEVICE_SPILL_BUDGET,
+                      HBM_ALLOC_FRACTION, HOST_SPILL_STORAGE_SIZE,
+                      MEMORY_DEBUG, SPILL_DIR, TpuConf)
 from .semaphore import TpuSemaphore
 
 #: Conservative HBM guess used when the backend can't report a size (CPU
 #: backend, or device never touched). Matches the reference's stance of a
 #: fraction-of-total pool (RapidsConf.scala:257).
 _DEFAULT_HBM_BYTES = 16 << 30
+
+#: Probe-shaped failures of ``device.memory_stats()``: the backend simply
+#: cannot report (CPU backends, plugin API drift). Tolerated alongside the
+#: retry taxonomy's OOM/transient classes; anything else raises.
+_PROBE_ERRORS = (NotImplementedError, AttributeError, TypeError,
+                 ValueError, KeyError)
 
 
 class DeviceManager:
@@ -40,11 +47,14 @@ class DeviceManager:
         self._backend = conf.get(DEVICE_BACKEND)
         self._frac = conf.get(HBM_ALLOC_FRACTION)
         self.debug = conf.get(MEMORY_DEBUG)
-        self.semaphore = TpuSemaphore(conf.get(CONCURRENT_TPU_TASKS))
+        self.semaphore = TpuSemaphore(
+            conf.get(CONCURRENT_TPU_TASKS),
+            conf.get(CONCURRENT_ACQUIRE_TIMEOUT))
         self._devices = None
         self._hbm_budget = None
         self._peak_in_use = 0
         self._init_lock = threading.Lock()
+        self._warned_probes: set = set()
         # Spill catalog: the GpuShuffleEnv.initStorage chain
         # (device -> host -> disk, GpuShuffleEnv.scala:52-69). The device
         # budget resolves lazily on the first budget check — by then device
@@ -70,6 +80,21 @@ class DeviceManager:
     def device(self):
         return self.devices[0]
 
+    def _classify_probe_failure(self, what: str, e: Exception) -> None:
+        """Narrowed swallow for memory-probe failures: OOM/transient
+        classes from the retry taxonomy and probe-shaped backend errors
+        degrade to defaults with ONE warning per probe; anything else —
+        a genuinely broken backend — raises instead of silently lying."""
+        from .retry import Classification, classify
+        if not isinstance(e, _PROBE_ERRORS) \
+                and classify(e) == Classification.FATAL:
+            raise e
+        if what not in self._warned_probes:
+            self._warned_probes.add(what)
+            logging.getLogger(__name__).warning(
+                "device memory probe %s failed (%s: %s); reporting "
+                "defaults from here on", what, type(e).__name__, e)
+
     @property
     def hbm_budget_bytes(self) -> int:
         """Fraction-of-HBM byte budget for the spill framework; jax doesn't
@@ -79,7 +104,8 @@ class DeviceManager:
             try:
                 stats = self.device.memory_stats() or {}
                 total = stats.get("bytes_limit", _DEFAULT_HBM_BYTES)
-            except Exception:
+            except Exception as e:  # noqa: BLE001 - classify-narrowed
+                self._classify_probe_failure("memory_stats(bytes_limit)", e)
                 total = _DEFAULT_HBM_BYTES
             self._hbm_budget = int(total * self._frac)
         return self._hbm_budget
@@ -92,7 +118,8 @@ class DeviceManager:
         key = (conf.get(DEVICE_BACKEND), conf.get(HBM_ALLOC_FRACTION),
                conf.get(DEVICE_SPILL_BUDGET),
                conf.get(HOST_SPILL_STORAGE_SIZE), conf.get(SPILL_DIR),
-               conf.get(CONCURRENT_TPU_TASKS))
+               conf.get(CONCURRENT_TPU_TASKS),
+               conf.get(CONCURRENT_ACQUIRE_TIMEOUT))
         with cls._lock:
             inst = cls._instances.get(key)
             if inst is None:
@@ -110,10 +137,14 @@ class DeviceManager:
         try:
             stats = self.device.memory_stats() or {}
             used = stats.get("bytes_in_use", 0)
-        except Exception:
+        except Exception as e:  # noqa: BLE001 - classify-narrowed
+            self._classify_probe_failure("memory_stats(bytes_in_use)", e)
             used = 0
-        if used > self._peak_in_use:
-            self._peak_in_use = used
+        # Under the init lock: concurrent queries race the read-compare-
+        # write otherwise and the watermark can go backwards.
+        with self._init_lock:
+            if used > self._peak_in_use:
+                self._peak_in_use = used
         return used
 
     def hbm_watermarks(self) -> dict:

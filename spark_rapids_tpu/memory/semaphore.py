@@ -5,6 +5,13 @@ The reference bounds concurrent tasks holding the GPU
 released by a completion listener (GpuSemaphore.scala:74-161). Our execution
 threads acquire it around device work; re-entrant per thread so nested
 operators don't deadlock.
+
+A stuck or leaked holder used to deadlock every other task silently
+(``acquire`` blocked forever); ``acquireTimeout``
+(``spark.rapids.tpu.concurrentTpuTasks.acquireTimeout``) turns that into a
+:class:`SemaphoreTimeoutError` naming the holding thread ids and their
+held counts — an actionable diagnostic instead of a hang
+(docs/fault-tolerance.md).
 """
 
 from __future__ import annotations
@@ -14,9 +21,17 @@ import time
 from typing import Dict
 
 
+class SemaphoreTimeoutError(RuntimeError):
+    """Task-admission acquire timed out — almost always a stuck or leaked
+    holder, not real contention. Classified FATAL by the retry taxonomy:
+    retrying against a wedged semaphore only hides the deadlock."""
+
+
 class TpuSemaphore:
-    def __init__(self, max_concurrent: int):
+    def __init__(self, max_concurrent: int, acquire_timeout_s: float = 0.0):
         self.max_concurrent = max_concurrent
+        #: seconds to block in acquire before raising; 0 = wait forever
+        self.acquire_timeout_s = acquire_timeout_s
         self._sem = threading.Semaphore(max_concurrent)
         self._held: Dict[int, int] = {}
         self._lock = threading.Lock()
@@ -25,19 +40,40 @@ class TpuSemaphore:
         #: (metrics/profile.py, GpuSemaphore's SEMAPHORE_WAIT analog).
         self.wait_ns = 0
 
+    def holders(self) -> Dict[int, int]:
+        """Snapshot of {thread ident: held count} (diagnostics)."""
+        with self._lock:
+            return dict(self._held)
+
     def acquire_if_necessary(self):
-        """Reentrant acquire (GpuSemaphore.acquireIfNecessary:74)."""
+        """Reentrant acquire (GpuSemaphore.acquireIfNecessary:74); raises
+        :class:`SemaphoreTimeoutError` when ``acquire_timeout_s`` elapses
+        without a slot."""
         tid = threading.get_ident()
         with self._lock:
             if self._held.get(tid, 0) > 0:
                 self._held[tid] += 1
                 return
         t0 = time.perf_counter_ns()
-        self._sem.acquire()
+        if self.acquire_timeout_s > 0:
+            acquired = self._sem.acquire(timeout=self.acquire_timeout_s)
+        else:
+            acquired = self._sem.acquire()
         waited = time.perf_counter_ns() - t0
         with self._lock:
             self.wait_ns += waited
-            self._held[tid] = self._held.get(tid, 0) + 1
+            if acquired:
+                self._held[tid] = self._held.get(tid, 0) + 1
+                return
+            holders = dict(self._held)
+        held_desc = ", ".join(
+            f"thread {t} holds {c}" for t, c in sorted(holders.items())) \
+            or "no recorded holders (leak outside acquire_if_necessary?)"
+        raise SemaphoreTimeoutError(
+            f"thread {tid} could not acquire the TPU task semaphore within "
+            f"{self.acquire_timeout_s:g}s "
+            f"(spark.rapids.tpu.concurrentTpuTasks.acquireTimeout); "
+            f"{self.max_concurrent} slot(s) total, {held_desc}")
 
     def release_if_necessary(self):
         tid = threading.get_ident()

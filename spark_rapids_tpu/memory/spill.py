@@ -75,8 +75,16 @@ class _Entry:
     freed: bool = False
 
 
+#: Compact the shared spill file once this fraction of its bytes is dead
+#: (freed ranges of a still-open catalog previously leaked until close).
+DISK_COMPACT_FRACTION = 0.5
+
+
 class SpillFile:
-    """Append-only shared spill file (RapidsDiskStore's block-manager file)."""
+    """Shared spill file (RapidsDiskStore's block-manager file): appends
+    serialized payloads, tracks freed ranges, and compacts itself when the
+    owner asks — so freed disk space reclaims during the catalog's
+    lifetime instead of leaking until close."""
 
     def __init__(self, spill_dir: Optional[str] = None):
         self._owns_dir = spill_dir is None
@@ -88,6 +96,7 @@ class SpillFile:
                                          dir=self.dir)
         os.close(fd)
         self._offset = 0
+        self._freed = 0
         self._lock = threading.Lock()
 
     def close(self):
@@ -108,9 +117,62 @@ class SpillFile:
             return offset, len(payload)
 
     def read(self, offset: int, length: int) -> bytes:
-        with open(self.path, "rb") as f:
-            f.seek(offset)
-            return f.read(length)
+        # Under the lock: compact() may be rewriting offsets concurrently.
+        with self._lock:
+            with open(self.path, "rb") as f:
+                f.seek(offset)
+                return f.read(length)
+
+    # -- space reclaim ------------------------------------------------------
+    @property
+    def size_bytes(self) -> int:
+        with self._lock:
+            return self._offset
+
+    @property
+    def freed_bytes(self) -> int:
+        with self._lock:
+            return self._freed
+
+    @property
+    def live_bytes(self) -> int:
+        """Bytes still referenced by live ranges (file size minus freed
+        ranges not yet reclaimed by compact()) — what the
+        diskSpillFileBytes metric reports."""
+        with self._lock:
+            return self._offset - self._freed
+
+    def free_range(self, offset: int, length: int) -> None:
+        """Mark [offset, offset+length) dead; the space reclaims at the
+        owner's next :meth:`compact` call."""
+        with self._lock:
+            self._freed += length
+
+    def freed_fraction(self) -> float:
+        with self._lock:
+            return self._freed / self._offset if self._offset else 0.0
+
+    def compact(self, live_ranges: Dict) -> Dict:
+        """Rewrite the file keeping only ``live_ranges`` ({key: (offset,
+        length)}); returns the keys' new ranges. The owner must hold its
+        own entry bookkeeping consistent (it passes every live range and
+        installs every returned one)."""
+        with self._lock:
+            fd, tmp = tempfile.mkstemp(prefix="spill_compact_",
+                                       suffix=".bin", dir=self.dir)
+            new_ranges: Dict = {}
+            pos = 0
+            with os.fdopen(fd, "wb") as out, open(self.path, "rb") as src:
+                for key, (offset, length) in sorted(
+                        live_ranges.items(), key=lambda kv: kv[1][0]):
+                    src.seek(offset)
+                    out.write(src.read(length))
+                    new_ranges[key] = (pos, length)
+                    pos += length
+            os.replace(tmp, self.path)
+            self._offset = pos
+            self._freed = 0
+            return new_ranges
 
 
 def _ipc_serialize(rb: pa.RecordBatch) -> bytes:
@@ -154,7 +216,11 @@ class BufferCatalog:
                         "reloaded_from_host": 0, "reloaded_from_disk": 0,
                         # byte counters feed the query profile's spillBytes
                         # (metrics/profile.py takes per-query deltas)
-                        "spill_bytes_to_host": 0, "spill_bytes_to_disk": 0}
+                        "spill_bytes_to_host": 0, "spill_bytes_to_disk": 0,
+                        # live size of the shared disk spill file (the
+                        # diskSpillFileBytes profile metric) + compactions
+                        "disk_spill_file_bytes": 0,
+                        "disk_spill_file_compactions": 0}
 
     @property
     def device_budget(self) -> int:
@@ -199,13 +265,16 @@ class BufferCatalog:
             if entry.tier == StorageTier.DEVICE:
                 return entry.device_batch
             if entry.tier == StorageTier.DISK:
-                payload = self._disk().read(*entry.disk_range)
+                disk = self._disk()
+                payload = disk.read(*entry.disk_range)
                 entry.host_batch = _ipc_deserialize(payload)
+                disk.free_range(*entry.disk_range)
                 entry.disk_range = None
                 entry.tier = StorageTier.HOST
                 self.host_bytes += entry.meta.size_bytes
                 heapq.heappush(self._host_heap, (entry.priority, buffer_id))
                 self.metrics["reloaded_from_disk"] += 1
+                self._maybe_compact_disk()
             # HOST -> DEVICE
             with trace_range("spill.reload_to_device"):
                 batch = ColumnarBatch.from_arrow(entry.host_batch,
@@ -236,8 +305,12 @@ class BufferCatalog:
             elif entry.tier == StorageTier.HOST:
                 self.host_bytes -= entry.meta.size_bytes
                 entry.host_batch = None
-            # disk bytes leak into the shared file until catalog close, the
-            # same policy as the reference's shuffle spill files.
+            elif entry.tier == StorageTier.DISK \
+                    and entry.disk_range is not None \
+                    and self._spill_file is not None:
+                self._spill_file.free_range(*entry.disk_range)
+                entry.disk_range = None
+                self._maybe_compact_disk()
 
     def pin(self, buffer_id: int):
         """Exclude a buffer from spilling while an operator actively uses it
@@ -308,10 +381,32 @@ class BufferCatalog:
                 break
             self._spill_host_entry(entry)
 
+    def spill_below(self, priority_ceiling: int) -> int:
+        """Synchronously spill every unpinned device buffer whose priority
+        is below ``priority_ceiling`` to the host tier (cascading to disk
+        via the host budget) — the OOM-retry drain (memory/retry.py):
+        everything except on-deck buffers leaves the device before the
+        attempt re-runs. Returns device bytes moved."""
+        moved = 0
+        with self._lock:
+            while True:
+                entry = self._pop_spillable(self._device_heap,
+                                            StorageTier.DEVICE,
+                                            max_priority=priority_ceiling)
+                if entry is None:
+                    break
+                moved += entry.meta.size_bytes
+                self._spill_device_entry(entry)
+        return moved
+
     def _pop_spillable(self, heap, tier: str,
-                       exclude: Optional[int] = None) -> Optional[_Entry]:
+                       exclude: Optional[int] = None,
+                       max_priority: Optional[int] = None
+                       ) -> Optional[_Entry]:
         """Pop the lowest-priority live entry still on ``tier``; stale heap
-        records (moved/freed buffers) are discarded lazily."""
+        records (moved/freed buffers) are discarded lazily. With
+        ``max_priority``, entries at or above it stay put (the heap pops
+        lowest-first, so the scan stops at the first such entry)."""
         skipped = []
         found = None
         while heap:
@@ -319,6 +414,9 @@ class BufferCatalog:
             entry = self._entries.get(bid)
             if entry is None or entry.freed or entry.tier != tier:
                 continue  # stale record
+            if max_priority is not None and priority >= max_priority:
+                skipped.append((priority, bid))
+                break
             if bid == exclude or bid in self._pinned:
                 skipped.append((priority, bid))
                 continue
@@ -353,6 +451,28 @@ class BufferCatalog:
         self.host_bytes -= entry.meta.size_bytes
         self.metrics["spilled_to_disk"] += 1
         self.metrics["spill_bytes_to_disk"] += len(payload)
+        self.metrics["disk_spill_file_bytes"] = self._disk().live_bytes
+
+    def _maybe_compact_disk(self):
+        """Compact the shared spill file once DISK_COMPACT_FRACTION of it
+        is dead (caller holds the catalog lock): live disk entries rewrite
+        contiguously and their ranges update in place, so long-lived
+        catalogs stop leaking freed disk space until close."""
+        f = self._spill_file
+        if f is None:
+            return
+        if f.freed_bytes == 0 or f.freed_fraction() < DISK_COMPACT_FRACTION:
+            self.metrics["disk_spill_file_bytes"] = f.live_bytes
+            return
+        live = {bid: e.disk_range for bid, e in self._entries.items()
+                if e.tier == StorageTier.DISK and not e.freed
+                and e.disk_range is not None}
+        with trace_range("spill.compact_disk"):
+            new_ranges = f.compact(live)
+        for bid, rng in new_ranges.items():
+            self._entries[bid].disk_range = rng
+        self.metrics["disk_spill_file_compactions"] += 1
+        self.metrics["disk_spill_file_bytes"] = f.live_bytes
 
     def _remove_host(self, entry: _Entry):
         entry.host_batch = None

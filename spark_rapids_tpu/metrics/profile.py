@@ -184,6 +184,9 @@ class QueryProfiler:
                 _delta(spill, self._spill0, "spill_bytes_to_host"),
             "spillBytesToDisk":
                 _delta(spill, self._spill0, "spill_bytes_to_disk"),
+            # Live size of the shared disk spill file (compaction keeps it
+            # from leaking freed ranges — memory/spill.py).
+            "diskSpillFileBytes": int(spill.get("disk_spill_file_bytes", 0)),
             "deviceStoreBytes": dm.catalog.device_bytes,
             **dm.hbm_watermarks(),
             "compile": {

@@ -135,6 +135,20 @@ TAXONOMY: Dict[str, MetricSpec] = {s.name: s for s in [
           "reports it)."),
     _spec("avgBatchRows", MetricKind.AVERAGE, DEBUG,
           "Average host-known rows per batch."),
+    _spec("retryCount", MetricKind.SUM, ESSENTIAL,
+          "Attempts re-run at the operator's retry sites after a "
+          "classified OOM or transient fault (memory/retry.py; "
+          "docs/fault-tolerance.md). Zero on a healthy run."),
+    _spec("splitAndRetryCount", MetricKind.SUM, ESSENTIAL,
+          "Input batches split in half by rows because retries alone "
+          "could not fit the operator in device memory (the reference's "
+          "splitSpillableInHalfByRows escalation)."),
+    _spec("retryBlockTimeNs", MetricKind.NANO_TIMING, MODERATE,
+          "Wall time spent blocked in retry backoff sleeps "
+          "(spark.rapids.tpu.retry.backoffBaseMs ladder)."),
+    _spec("retryWastedComputeNs", MetricKind.NANO_TIMING, MODERATE,
+          "Wall time of failed attempts whose work was thrown away and "
+          "re-run — the price of surviving the fault."),
 ]}
 
 #: Metrics recorded under names outside the taxonomy (operator-specific

@@ -76,12 +76,20 @@ class ExecContext:
     #: (site ordinal, traced dense-ineligible flag) observations feeding
     #: no_dense, mirroring join_totals.
     dense_fails: list = dataclasses.field(default_factory=list)
+    #: Deterministic fault injector (utils/fault_injection.py): None in
+    #: production (injection conf unset). TpuSession passes its
+    #: session-scoped injector so fault schedules survive dispatch
+    #: retries; bare contexts build one from conf.
+    fault_injector: object = None
     _join_site: int = 0
 
     def __post_init__(self):
         if self.registry is None:
             from ..metrics.registry import MetricsRegistry
             self.registry = MetricsRegistry.for_conf(self.conf)
+        if self.fault_injector is None:
+            from ..utils.fault_injection import FaultInjector
+            self.fault_injector = FaultInjector.maybe(self.conf)
 
     def next_join_site(self) -> int:
         """Deterministic per-execution ordinal for a join probe batch

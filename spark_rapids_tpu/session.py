@@ -68,6 +68,11 @@ class TpuSession:
         self._last_profile = None
         self._query_seq = 0
         self._event_log = None
+        # OOM-resilience layer (memory/retry.py, docs/fault-tolerance.md):
+        # the fault injector is SESSION-scoped so its deterministic visit
+        # counters survive per-dispatch context rebuilds.
+        from .utils.fault_injection import FaultInjector
+        self._fault_injector = FaultInjector.maybe(self.conf)
 
     # -- conf ---------------------------------------------------------------
     def with_conf(self, **kv) -> "TpuSession":
@@ -80,6 +85,8 @@ class TpuSession:
         s._last_profile = None
         s._query_seq = 0
         s._event_log = None
+        from .utils.fault_injection import FaultInjector
+        s._fault_injector = FaultInjector.maybe(s.conf)
         return s
 
     def compile_status(self) -> dict:
@@ -178,12 +185,23 @@ class TpuSession:
                           plan_sig: Optional[tuple] = None):
         """Run ``fn(ctx, mode) -> (result, overflowed)``; on a deferred join
         overflow, learn the exact output capacities from the run's observed
-        match totals and retry with them (cached per plan signature). The
-        axon remote compile helper occasionally fails transiently
-        (worker-hostname env races, helper restarts); those retry in
-        place."""
+        match totals and retry with them (cached per plan signature).
+
+        Dispatch failures route through the retry taxonomy
+        (memory/retry.py): transient faults (remote-compile/helper races,
+        spill-disk OSError) retry in place with the shared backoff policy;
+        a classified OOM that escaped every operator-level retry re-runs
+        the whole query after a device sync + full spill-down — the
+        task-retry analog — except for side-effecting (write) plans, which
+        must not re-execute after partial commits. Fatal errors propagate
+        untouched."""
+        import time
+
         import jax
         from .data.column import bucket_capacity
+        from .memory import retry as R
+        from .utils.fault_injection import maybe_inject
+        policy = R.RetryPolicy.from_conf(self.conf)
         cached = self._JOIN_CAP_CACHE.get(plan_sig) \
             if plan_sig is not None else None
         caps, dense_modes = (dict(cached[0]), dict(cached[1])) \
@@ -196,16 +214,25 @@ class TpuSession:
         # re-running the identical program.
         growth = 1.0
         force_eager = False
+        # Dispatch-retry totals live OUTSIDE the attempt loop: failed
+        # attempts' contexts are discarded, so the cumulative counts are
+        # re-recorded into each successful context — the profiled (last)
+        # one ends up carrying them.
+        dispatch_retries = 0
+        dispatch_block_ns = 0
         for attempt in range(attempts):
             eager = eager_only or force_eager or attempt == attempts - 1
-            for compile_try in range(3):
+            dispatch_try = 0
+            while True:
                 ctx = P.ExecContext(self.conf,
-                                    catalog=self.device_manager.catalog)
+                                    catalog=self.device_manager.catalog,
+                                    fault_injector=self._fault_injector)
                 ctx.join_caps = caps
                 ctx.dense_modes = dict(dense_modes)
                 ctx.join_growth = growth
                 ctx.eager_overflow = eager
                 try:
+                    maybe_inject(ctx, "session.dispatch")
                     # Task admission: bound concurrent queries holding the
                     # device (GpuSemaphore.acquireIfNecessary analog; conf
                     # spark.rapids.sql.concurrentTpuTasks). Wait time is
@@ -214,12 +241,34 @@ class TpuSession:
                     with self.device_manager.semaphore:
                         result, overflowed = fn(
                             ctx, "eager" if eager else "deferred")
+                    if dispatch_retries:
+                        ctx.metric("TpuSession", "retryCount",
+                                   dispatch_retries)
+                        ctx.metric("TpuSession", "retryBlockTimeNs",
+                                   dispatch_block_ns)
                     break
-                except Exception as e:  # noqa: BLE001 - filtered below
-                    transient = "remote_compile" in str(e) \
-                        or "tpu_compile_helper" in str(e)
-                    if not transient or compile_try == 2:
+                except Exception as e:  # noqa: BLE001 - classified below
+                    cls = R.classify(e)
+                    # Write plans (eager_only) committed partial output
+                    # already: re-running would duplicate it, so only the
+                    # pre-dispatch transient class (compile-helper races)
+                    # retries there — a mid-write disk OSError must NOT
+                    # re-execute the plan.
+                    transient_ok = cls == R.Classification.TRANSIENT and \
+                        not (eager_only and isinstance(e, OSError))
+                    retryable = transient_ok or \
+                        (cls == R.Classification.OOM and not eager_only)
+                    if not retryable or dispatch_try >= policy.max_retries:
                         raise
+                    if cls == R.Classification.OOM:
+                        R.synchronize_device()
+                        R.spill_device_below(ctx)
+                    dispatch_retries += 1
+                    t0 = time.perf_counter_ns()
+                    R.backoff_sleep(policy, "session.dispatch",
+                                    dispatch_try)
+                    dispatch_block_ns += time.perf_counter_ns() - t0
+                    dispatch_try += 1
                 finally:
                     ctx.close()
             if not overflowed:

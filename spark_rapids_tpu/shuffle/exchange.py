@@ -155,8 +155,25 @@ class ShuffleBufferCatalog:
                     if v[0] == "arena":
                         self._arena.free(v[1])
                         self._host_bytes -= v[2]
+                    elif v[0] == "disk" and self._spill_file is not None:
+                        self._spill_file.free_range(v[1], v[2])
                 else:
                     self._host_bytes -= len(v)
+            self._maybe_compact_disk()
+
+    def _maybe_compact_disk(self):
+        """Reclaim freed spill-file space (caller holds _lock): rewrite
+        the surviving disk blocks contiguously once half the file is dead
+        — mirrors BufferCatalog's compaction (memory/spill.py)."""
+        from ..memory.spill import DISK_COMPACT_FRACTION
+        f = self._spill_file
+        if f is None or f.freed_bytes == 0 \
+                or f.freed_fraction() < DISK_COMPACT_FRACTION:
+            return
+        live = {k: (v[1], v[2]) for k, v in self._blocks.items()
+                if isinstance(v, tuple) and v[0] == "disk"}
+        for k, (off, length) in f.compact(live).items():
+            self._blocks[k] = ("disk", off, length)
 
     def close(self):
         with self._lock:
@@ -264,31 +281,48 @@ class TpuShuffleExchangeExec(PhysicalPlan):
             build)
 
         # WRITE side (RapidsCachingWriter analog, host-serialized payloads).
+        from ..memory import retry as R
         name = self.node_name()
+
+        def partition_split(b):
+            """Device partition sort + result download for one input batch
+            — the exchange's memory hazard. Block serialization and
+            catalog writes stay OUTSIDE the retry: they are side-effecting
+            (a retried attempt must never double-add blocks)."""
+            with ctx.registry.timer(name, "opTime",
+                                    trace="shuffle.partition_split"):
+                sorted_batch, sorted_ids = partition_sort(b)
+                rb = sorted_batch.to_arrow()
+                ids_np = np.asarray(sorted_ids)[: rb.num_rows]
+            return rb, ids_np
+
         map_id = 0
         for part in self.children[0].execute(ctx):
             for db in part:
                 if int(db.n_rows) == 0:
                     continue
-                with ctx.registry.timer(name, "opTime",
-                                        trace="shuffle.partition_split"):
-                    sorted_batch, sorted_ids = partition_sort(db)
-                    rb = sorted_batch.to_arrow()
-                    ids_np = np.asarray(sorted_ids)[: rb.num_rows]
-                # Contiguous runs per partition id (ids are sorted).
-                starts = np.searchsorted(ids_np, np.arange(n_parts),
-                                         side="left")
-                ends = np.searchsorted(ids_np, np.arange(n_parts),
-                                       side="right")
-                for p in range(n_parts):
-                    if ends[p] > starts[p]:
-                        piece = rb.slice(starts[p], ends[p] - starts[p])
-                        with ctx.registry.timer(name, "serializationTime",
-                                                trace="shuffle.serialize"):
-                            payload = serialize_batch(piece, codec)
-                        ctx.metric(name, "shuffleBytesWritten", len(payload))
-                        catalog.add_block(shuffle_id, map_id, p, payload)
-                map_id += 1
+                # A split input batch serializes as two map tasks: row-to-
+                # partition routing is per-row, so reduce-side contents
+                # are unchanged.
+                for rb, ids_np in R.with_retry(
+                        ctx, f"{name}.partitionSplit", db, partition_split,
+                        split=R.halve_by_rows, node=name):
+                    # Contiguous runs per partition id (ids are sorted).
+                    starts = np.searchsorted(ids_np, np.arange(n_parts),
+                                             side="left")
+                    ends = np.searchsorted(ids_np, np.arange(n_parts),
+                                           side="right")
+                    for p in range(n_parts):
+                        if ends[p] > starts[p]:
+                            piece = rb.slice(starts[p], ends[p] - starts[p])
+                            with ctx.registry.timer(
+                                    name, "serializationTime",
+                                    trace="shuffle.serialize"):
+                                payload = serialize_batch(piece, codec)
+                            ctx.metric(name, "shuffleBytesWritten",
+                                       len(payload))
+                            catalog.add_block(shuffle_id, map_id, p, payload)
+                    map_id += 1
 
         # READ side (RapidsCachingReader analog): lazy fetch + re-upload.
         # Blocks free once every reduce partition is drained — or at query

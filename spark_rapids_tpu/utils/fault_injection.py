@@ -1,0 +1,172 @@
+"""Deterministic fault injection — the ``RmmSpark.forceRetryOOM`` analog.
+
+The reference validates its OOM-retry machinery by telling the allocator
+to fail on purpose (``RmmSpark.forceRetryOOM`` / ``forceSplitAndRetryOOM``)
+so retry, spill, and split paths run in CI without real memory pressure.
+XLA offers no such hook, so the TPU port injects at the engine's *retry
+sites* instead: every :func:`~..memory.retry.with_retry` boundary and the
+per-unit reader fallbacks call :func:`maybe_inject`, and a conf-driven
+injector raises synthetic faults there on a deterministic schedule.
+
+Configuration (all under ``spark.rapids.tpu.test.faultInjection.``):
+
+* ``sites`` — comma-separated site names or prefixes (``*`` = every
+  site); empty disables injection entirely (the default — production
+  paths never pay more than one ``None`` check).
+* ``oomEveryN`` — every Nth visit of a matched site raises a synthetic
+  ``RESOURCE_EXHAUSTED`` (classified OOM by the retry taxonomy's message
+  matching, exactly like a real XLA failure).
+* ``transientEveryN`` — every Nth visit raises a transient fault; the
+  flavor (remote-compile helper race vs spill-disk ``OSError``) is chosen
+  deterministically from the seed and visit number.
+* ``seed`` — shifts the fault phase (which visit faults first) and the
+  transient flavor schedule. Same conf = same fault schedule, always.
+
+Counters are per-injector and the injector is session-scoped
+(``TpuSession`` builds one per session; bare ``ExecContext`` builds one
+per context), so a query's fault schedule is reproducible and isolated.
+
+Site names are dotted, ``<node>.<boundary>`` (e.g.
+``TpuShuffledHashJoinExec.probe``, ``io.parquet.rowGroup``,
+``session.dispatch``); the full list registers at runtime
+(:func:`known_sites`) and is documented in docs/fault-tolerance.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import zlib
+from typing import Dict, Optional
+
+_SITES_LOCK = threading.Lock()
+_KNOWN_SITES: set = set()
+
+
+def register_site(site: str) -> None:
+    """Record a retry/injection site name (introspection + docs/tests).
+    Lock-free membership pre-check: this runs once per wrapped attempt on
+    the hot dispatch path, and after the first visit of a site it must
+    cost one set lookup, not a global lock."""
+    if site in _KNOWN_SITES:
+        return
+    with _SITES_LOCK:
+        _KNOWN_SITES.add(site)
+
+
+def known_sites() -> list:
+    """Every site name registered so far in this process, sorted."""
+    with _SITES_LOCK:
+        return sorted(_KNOWN_SITES)
+
+
+class InjectedFault(Exception):
+    """Base of all synthetic faults (never raised by production code)."""
+
+
+class InjectedResourceExhausted(InjectedFault):
+    """Synthetic device HBM exhaustion. The message carries the
+    ``RESOURCE_EXHAUSTED`` marker so the retry taxonomy classifies it
+    through the same string matching a real XlaRuntimeError hits."""
+
+
+class InjectedTransient(InjectedFault):
+    """Synthetic remote-compile helper race (message-marker classified)."""
+
+
+class InjectedDiskFault(InjectedFault, OSError):
+    """Synthetic spill-disk I/O failure (OSError => transient class)."""
+
+
+class FaultInjector:
+    """Deterministic per-site fault schedule (see module doc)."""
+
+    def __init__(self, seed: int, sites: str, oom_every_n: int,
+                 transient_every_n: int):
+        self.seed = int(seed)
+        self.patterns = [s.strip() for s in sites.split(",") if s.strip()]
+        self.oom_every_n = int(oom_every_n)
+        self.transient_every_n = int(transient_every_n)
+        self._counters: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        #: injected-fault tallies by flavor (test assertions read these)
+        self.injected = {"oom": 0, "transient": 0, "disk": 0}
+
+    @classmethod
+    def maybe(cls, conf) -> Optional["FaultInjector"]:
+        """The conf's injector, or None when injection is off (the
+        default). Duck-typed: anything without the conf entries (bare
+        test contexts) gets None."""
+        from ..config import (FAULT_INJECTION_OOM_EVERY_N,
+                              FAULT_INJECTION_SEED, FAULT_INJECTION_SITES,
+                              FAULT_INJECTION_TRANSIENT_EVERY_N)
+        if not hasattr(conf, "get"):
+            return None
+        try:
+            sites = conf.get(FAULT_INJECTION_SITES) or ""
+            oom_n = int(conf.get(FAULT_INJECTION_OOM_EVERY_N))
+            transient_n = int(conf.get(FAULT_INJECTION_TRANSIENT_EVERY_N))
+            seed = int(conf.get(FAULT_INJECTION_SEED))
+        except (AttributeError, TypeError):
+            return None
+        if not sites.strip() or (oom_n == 0 and transient_n == 0):
+            return None
+        return cls(seed, sites, oom_n, transient_n)
+
+    def matches(self, site: str) -> bool:
+        for p in self.patterns:
+            if p in ("*", "all") or site == p or site.startswith(p):
+                return True
+        return False
+
+    def visit_count(self, site: str) -> int:
+        with self._lock:
+            return self._counters.get(site, 0)
+
+    def _scheduled(self, n: int, every_n: int) -> bool:
+        """Positive N: every Nth visit faults (phase shifted by the seed).
+        Negative N: the FIRST |N| visits fault, then the site heals —
+        the schedule that drives a site through its whole retry ladder
+        (retries exhaust, input splits) and still lets the query finish."""
+        if every_n < 0:
+            return n <= -every_n
+        return every_n > 0 and (n + self.seed) % every_n == 0
+
+    def check(self, site: str) -> None:
+        """Count one visit of ``site``; raise this visit's scheduled
+        synthetic fault, if any. OOM schedules win ties with transient
+        schedules."""
+        if not self.matches(site):
+            return
+        # Flavor decision and tally both under the lock (concurrent sites
+        # — shuffle transport, warm-up worker — must not lose counts).
+        with self._lock:
+            n = self._counters.get(site, 0) + 1
+            self._counters[site] = n
+            if self._scheduled(n, self.oom_every_n):
+                flavor = "oom"
+            elif self._scheduled(n, self.transient_every_n):
+                flavor = "disk" if zlib.crc32(
+                    f"{site}:{n}:{self.seed}".encode()) & 1 else "transient"
+            else:
+                return
+            self.injected[flavor] += 1
+        if flavor == "oom":
+            raise InjectedResourceExhausted(
+                f"RESOURCE_EXHAUSTED: injected device HBM exhaustion at "
+                f"{site} (visit {n})")
+        if flavor == "disk":
+            raise InjectedDiskFault(
+                f"injected spill-disk I/O failure at {site} (visit {n})")
+        raise InjectedTransient(
+            f"injected remote_compile helper race at {site} (visit {n})")
+
+
+def maybe_inject(ctx, site: str) -> None:
+    """Register ``site`` and raise its scheduled fault, if the context
+    carries an active injector. The one-liner non-``with_retry`` sites
+    (per-unit reader fallbacks, the session dispatch loop) call this at
+    the top of their guarded region."""
+    register_site(site)
+    injector = getattr(ctx, "fault_injector", None)
+    if injector is not None:
+        injector.check(site)

@@ -1,0 +1,314 @@
+"""OOM-resilience layer unit tests (memory/retry.py,
+docs/fault-tolerance.md): the error taxonomy, the with_retry combinator
+(spill -> backoff -> split escalation), the catalog's priority-bounded
+spill-down, disk spill-file compaction, and the semaphore acquire
+timeout."""
+
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.memory import retry as R
+from spark_rapids_tpu.memory import spill as SP
+from spark_rapids_tpu.memory.semaphore import (SemaphoreTimeoutError,
+                                               TpuSemaphore)
+from spark_rapids_tpu.plan import physical as P
+
+
+def _ctx(**conf):
+    conf.setdefault("spark.rapids.tpu.retry.backoffBaseMs", 0.0)
+    return P.ExecContext(TpuConf(conf))
+
+
+class TestClassify:
+    def test_retry_oom_class(self):
+        assert R.classify(R.RetryOOM("x")) == R.Classification.OOM
+        assert R.classify(R.SplitAndRetryOOM("site")) == R.Classification.OOM
+
+    def test_xla_resource_exhausted_message(self):
+        e = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating "
+                         "1073741824 bytes")
+        assert R.classify(e) == R.Classification.OOM
+
+    def test_transient_markers_and_oserror(self):
+        assert R.classify(RuntimeError("remote_compile helper died")) \
+            == R.Classification.TRANSIENT
+        assert R.classify(RuntimeError("tpu_compile_helper restart")) \
+            == R.Classification.TRANSIENT
+        assert R.classify(OSError("disk full")) == R.Classification.TRANSIENT
+
+    def test_fatal_default(self):
+        assert R.classify(ValueError("bad plan")) == R.Classification.FATAL
+        assert R.classify(SemaphoreTimeoutError("wedged")) \
+            == R.Classification.FATAL
+
+    def test_deterministic_os_errors_are_fatal(self):
+        # Missing inputs / permissions / existing write targets reproduce
+        # identically — retrying only delays the real message.
+        for e in (FileNotFoundError("no such input"),
+                  PermissionError("denied"),
+                  FileExistsError("SaveMode.ErrorIfExists"),
+                  IsADirectoryError("dir"), NotADirectoryError("file")):
+            assert R.classify(e) == R.Classification.FATAL, e
+
+    def test_injected_faults_classify_through_generic_paths(self):
+        from spark_rapids_tpu.utils.fault_injection import (
+            InjectedDiskFault, InjectedResourceExhausted, InjectedTransient)
+        assert R.classify(InjectedResourceExhausted(
+            "RESOURCE_EXHAUSTED: injected")) == R.Classification.OOM
+        assert R.classify(InjectedTransient("remote_compile injected")) \
+            == R.Classification.TRANSIENT
+        assert R.classify(InjectedDiskFault("injected disk")) \
+            == R.Classification.TRANSIENT
+
+
+class TestBackoffPolicy:
+    def test_deterministic_jitter(self):
+        p = R.RetryPolicy(3, 10.0, 1000.0)
+        assert p.delay_seconds("site", 1) == p.delay_seconds("site", 1)
+        assert p.delay_seconds("a", 0) != p.delay_seconds("b", 0)
+
+    def test_exponential_and_capped(self):
+        p = R.RetryPolicy(3, 10.0, 25.0)
+        # attempt 4 raw = 160ms, capped at 25ms; jitter in [0.5x, 1x]
+        assert p.delay_seconds("s", 4) <= 0.025
+        assert p.delay_seconds("s", 4) >= 0.0125
+
+    def test_zero_base_disables(self):
+        assert R.RetryPolicy(3, 0.0, 1000.0).delay_seconds("s", 5) == 0.0
+
+
+class TestWithRetry:
+    def test_success_is_single_result_no_counters(self):
+        ctx = _ctx()
+        out = R.with_retry(ctx, "T.x", 21, lambda v: v * 2)
+        assert out == [42]
+        assert ctx.registry.node_metrics("T") == {}
+
+    def test_oom_retries_then_succeeds(self):
+        ctx = _ctx()
+        calls = []
+
+        def attempt(v):
+            calls.append(v)
+            if len(calls) < 3:
+                raise R.RetryOOM("pressure")
+            return v
+        assert R.with_retry(ctx, "T.x", 7, attempt) == [7]
+        assert len(calls) == 3
+        m = ctx.registry.node_metrics("T")
+        assert m["retryCount"] == 2
+        assert m["retryWastedComputeNs"] > 0
+
+    def test_split_escalation_processes_halves(self):
+        ctx = _ctx(**{"spark.rapids.tpu.retry.maxRetries": 0})
+        seen = []
+
+        def attempt(items):
+            if len(items) > 1:
+                raise R.RetryOOM("too big")
+            seen.append(items[0])
+            return items[0]
+        out = R.with_retry(ctx, "T.x", [1, 2, 3, 4], attempt,
+                           split=R.halve_list)
+        assert out == [1, 2, 3, 4] and seen == [1, 2, 3, 4]
+        m = ctx.registry.node_metrics("T")
+        assert m["splitAndRetryCount"] >= 1
+
+    def test_unsplittable_site_raises_naming_site(self):
+        ctx = _ctx(**{"spark.rapids.tpu.retry.maxRetries": 1})
+
+        def attempt(_):
+            raise R.RetryOOM("pressure")
+        with pytest.raises(R.SplitAndRetryOOM, match="T.build"):
+            R.with_retry(ctx, "T.build", None, attempt)
+
+    def test_transient_retries_then_raises(self):
+        ctx = _ctx(**{"spark.rapids.tpu.retry.maxRetries": 2})
+        calls = []
+
+        def attempt(_):
+            calls.append(1)
+            raise OSError("disk hiccup")
+        with pytest.raises(OSError):
+            R.with_retry(ctx, "T.x", None, attempt)
+        assert len(calls) == 3  # initial + maxRetries
+
+    def test_fatal_propagates_immediately(self):
+        ctx = _ctx()
+        calls = []
+
+        def attempt(_):
+            calls.append(1)
+            raise ValueError("logic bug")
+        with pytest.raises(ValueError):
+            R.with_retry(ctx, "T.x", None, attempt)
+        assert len(calls) == 1
+        assert ctx.registry.node_metrics("T") == {}
+
+    def test_in_fusion_is_passthrough(self):
+        ctx = _ctx()
+        ctx.in_fusion = True
+        calls = []
+
+        def attempt(v):
+            calls.append(v)
+            if len(calls) == 1:
+                raise R.RetryOOM("must not be caught")
+            return v
+        with pytest.raises(R.RetryOOM):
+            R.with_retry(ctx, "T.x", 1, attempt)
+
+    def test_halve_by_rows_round_trips(self):
+        from spark_rapids_tpu.data.batch import ColumnarBatch
+        rb = pa.RecordBatch.from_pydict(
+            {"v": np.arange(300, dtype=np.int64)})
+        halves = R.halve_by_rows(ColumnarBatch.from_arrow(rb))
+        vals = []
+        for h in halves:
+            vals.extend(h.to_arrow().column("v").to_pylist())
+        assert vals == list(range(300))
+
+    def test_halve_by_rows_refuses_single_row(self):
+        from spark_rapids_tpu.data.batch import ColumnarBatch
+        rb = pa.RecordBatch.from_pydict({"v": np.asarray([1], np.int64)})
+        with pytest.raises(R.SplitAndRetryOOM):
+            R.halve_by_rows(ColumnarBatch.from_arrow(rb))
+
+
+def _device_batch(n, seed=0):
+    from spark_rapids_tpu.data.batch import ColumnarBatch
+    rng = np.random.default_rng(seed)
+    return ColumnarBatch.from_arrow(pa.RecordBatch.from_pydict(
+        {"v": rng.integers(0, 1 << 30, n).astype(np.int64)}))
+
+
+class TestSpillBelow:
+    def test_spills_only_below_ceiling(self):
+        catalog = SP.BufferCatalog(1 << 30, 1 << 30)
+        try:
+            low = catalog.register_batch(_device_batch(256, 1),
+                                         SP.OUTPUT_FOR_SHUFFLE_PRIORITY)
+            mid = catalog.register_batch(_device_batch(256, 2),
+                                         SP.ACTIVE_BATCHING_PRIORITY)
+            deck = catalog.register_batch(_device_batch(256, 3),
+                                          SP.ACTIVE_ON_DECK_PRIORITY)
+            moved = catalog.spill_below(SP.ACTIVE_ON_DECK_PRIORITY)
+            assert moved > 0
+            assert catalog.tier_of(low) == SP.StorageTier.HOST
+            assert catalog.tier_of(mid) == SP.StorageTier.HOST
+            assert catalog.tier_of(deck) == SP.StorageTier.DEVICE
+        finally:
+            catalog.close()
+
+    def test_pinned_buffers_stay(self):
+        catalog = SP.BufferCatalog(1 << 30, 1 << 30)
+        try:
+            bid = catalog.register_batch(_device_batch(256),
+                                         SP.ACTIVE_BATCHING_PRIORITY)
+            catalog.pin(bid)
+            assert catalog.spill_below(SP.ACTIVE_ON_DECK_PRIORITY) == 0
+            assert catalog.tier_of(bid) == SP.StorageTier.DEVICE
+        finally:
+            catalog.close()
+
+
+class TestSpillFileReclaim:
+    def test_free_range_and_compact(self, tmp_path):
+        f = SP.SpillFile(str(tmp_path))
+        payloads = {k: bytes([65 + k]) * (100 + k) for k in range(4)}
+        ranges = {k: f.append(p) for k, p in payloads.items()}
+        total = sum(len(p) for p in payloads.values())
+        assert f.size_bytes == total
+        f.free_range(*ranges[0])
+        f.free_range(*ranges[2])
+        assert f.freed_bytes == len(payloads[0]) + len(payloads[2])
+        live = {k: ranges[k] for k in (1, 3)}
+        new_ranges = f.compact(live)
+        assert f.freed_bytes == 0
+        assert f.size_bytes == len(payloads[1]) + len(payloads[3])
+        for k, rng in new_ranges.items():
+            assert f.read(*rng) == payloads[k]
+        f.close()
+
+    def test_catalog_compacts_disk_and_survivors_read_back(self, tmp_path):
+        # 1-byte budgets: every registration cascades straight to disk.
+        catalog = SP.BufferCatalog(1, 1, str(tmp_path))
+        try:
+            batches = {i: _device_batch(256, seed=i) for i in range(4)}
+            expect = {i: b.to_arrow() for i, b in batches.items()}
+            ids = {i: catalog.register_batch(b)
+                   for i, b in batches.items()}
+            assert catalog.metrics["spilled_to_disk"] >= 4
+            size_before = catalog.metrics["disk_spill_file_bytes"]
+            assert size_before > 0
+            catalog.free(ids[0])
+            catalog.free(ids[1])
+            catalog.free(ids[2])
+            assert catalog.metrics["disk_spill_file_compactions"] >= 1
+            assert catalog.metrics["disk_spill_file_bytes"] < size_before
+            got = catalog.acquire_batch(ids[3]).to_arrow()
+            assert got.equals(expect[3])
+        finally:
+            catalog.close()
+
+
+class TestSemaphoreTimeout:
+    def test_timeout_names_holders(self):
+        sem = TpuSemaphore(1, acquire_timeout_s=0.2)
+        entered = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            sem.acquire_if_necessary()
+            entered.set()
+            release.wait(5)
+            sem.release_if_necessary()
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert entered.wait(5)
+        with pytest.raises(SemaphoreTimeoutError) as ei:
+            sem.acquire_if_necessary()
+        assert str(t.ident) in str(ei.value)
+        assert "holds 1" in str(ei.value)
+        release.set()
+        t.join(5)
+        # the slot is usable again after the holder releases
+        sem.acquire_if_necessary()
+        sem.release_if_necessary()
+
+    def test_no_timeout_waits(self):
+        sem = TpuSemaphore(1)  # default: wait forever, no raise
+        sem.acquire_if_necessary()
+        sem.release_if_necessary()
+
+
+class TestDeviceManagerNarrowing:
+    def _dm(self):
+        from spark_rapids_tpu.memory.device_manager import DeviceManager
+        return DeviceManager(TpuConf({}))
+
+    def test_probe_shapes_and_oom_swallowed(self):
+        dm = self._dm()
+        dm._classify_probe_failure("t", NotImplementedError("no stats"))
+        dm._classify_probe_failure("t", ValueError("weird plugin"))
+        dm._classify_probe_failure(
+            "t", RuntimeError("RESOURCE_EXHAUSTED: probe raced an alloc"))
+
+    def test_fatal_probe_errors_raise(self):
+        dm = self._dm()
+        with pytest.raises(RuntimeError):
+            dm._classify_probe_failure("t", RuntimeError("backend is gone"))
+
+    def test_warns_once_per_probe(self, caplog):
+        import logging
+        dm = self._dm()
+        with caplog.at_level(logging.WARNING,
+                             logger="spark_rapids_tpu.memory.device_manager"):
+            dm._classify_probe_failure("probeA", NotImplementedError("x"))
+            dm._classify_probe_failure("probeA", NotImplementedError("x"))
+        assert sum("probeA" in r.message for r in caplog.records) == 1

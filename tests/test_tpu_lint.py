@@ -191,6 +191,69 @@ class TestSeededAntiPatterns:
         assert [v for v in TL.lint_tree(fake_pkg)
                 if v.rule == "exec-no-metrics"] == []
 
+    def test_broad_except_in_device_module_flagged(self, fake_pkg):
+        _write(fake_pkg, "memory/swallow.py", """
+            def probe(dev):
+                try:
+                    return dev.memory_stats()
+                except Exception:
+                    return {}
+
+            def bare(dev):
+                try:
+                    return dev.memory_stats()
+                except:
+                    return {}
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg)
+              if v.rule == "except-too-broad"]
+        assert len(vs) == 2
+
+    def test_broad_except_routed_through_taxonomy_passes(self, fake_pkg):
+        _write(fake_pkg, "io/routed.py", """
+            from ..memory.retry import Classification, classify
+
+            def read(unit):
+                try:
+                    return decode(unit)
+                except Exception as e:
+                    if classify(e) == Classification.FATAL:
+                        raise
+                    return host_fallback(unit)
+
+            def read2(unit, R):
+                try:
+                    return decode(unit)
+                except Exception as e:
+                    if R.classify(e) == R.Classification.FATAL:
+                        raise
+                    return host_fallback(unit)
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "except-too-broad"] == []
+
+    def test_broad_except_outside_device_scope_not_flagged(self, fake_pkg):
+        _write(fake_pkg, "compile/persistish.py", """
+            def load(path):
+                try:
+                    return open(path).read()
+                except Exception:
+                    return None
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "except-too-broad"] == []
+
+    def test_narrow_except_in_device_scope_passes(self, fake_pkg):
+        _write(fake_pkg, "shuffle/narrow.py", """
+            def read(path):
+                try:
+                    return open(path).read()
+                except OSError:
+                    return None
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "except-too-broad"] == []
+
 
 class TestRatchet:
     def _seed(self, fake_pkg, n):

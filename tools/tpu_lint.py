@@ -25,6 +25,14 @@ corrupt TPU performance or correctness silently:
   (metrics/registry.py, docs/monitoring.md) or the query profile shows a
   blind spot. Static approximation: the linter checks that SOME metric
   registration exists, not its level.
+* ``except-too-broad`` (device-path modules: ``exec/``, ``memory/``,
+  ``shuffle/``, ``io/``): a bare ``except Exception`` (or untyped
+  ``except:``) handler that never consults the retry taxonomy
+  (memory/retry.py ``classify`` / ``RetryOOM`` / ``SplitAndRetryOOM``) —
+  such handlers swallow device OOMs and transient faults the
+  OOM-resilience layer exists to classify (docs/fault-tolerance.md).
+  Static approximation: the handler is clean if its body references any
+  taxonomy name.
 
 Existing debt is RATCHETED, not flooded: the checked-in baseline
 (``tools/tpu_lint_baseline.json``) records per-(file, rule) counts; the
@@ -55,6 +63,12 @@ from typing import Dict, List, Optional, Tuple
 KERNEL_SCOPE = ("ops/kernels/",)
 PLAN_SCOPE = ("plan/",)
 EXEC_SCOPE = ("exec/",)
+DEVICE_SCOPE = ("exec/", "memory/", "shuffle/", "io/")
+
+#: retry-taxonomy names whose presence marks a broad handler as
+#: classified (except-too-broad)
+_TAXONOMY_NAMES = frozenset({"classify", "Classification", "RetryOOM",
+                             "SplitAndRetryOOM"})
 
 #: attribute-call names that count as "registers a metric" for
 #: exec-no-metrics (ctx.metric, ctx.registry.timer/add, registry sinks)
@@ -115,6 +129,7 @@ class _FileLinter(ast.NodeVisitor):
         self.in_kernel = relpath.startswith(KERNEL_SCOPE)
         self.in_plan = relpath.startswith(PLAN_SCOPE)
         self.in_exec = relpath.startswith(EXEC_SCOPE)
+        self.in_device = relpath.startswith(DEVICE_SCOPE)
         self.violations: List[Violation] = []
         #: stack of (is_jit, frozenset(param names)) for enclosing functions
         self._funcs: List[Tuple[bool, frozenset]] = []
@@ -243,6 +258,39 @@ class _FileLinter(ast.NodeVisitor):
                 self._flag(node, "plan-nondet",
                            f"{tail}.{func.attr}() reads the wall clock in "
                            "plan code")
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self.in_device:
+            self._check_broad_except(node)
+        self.generic_visit(node)
+
+    def _check_broad_except(self, node: ast.ExceptHandler):
+        """except-too-broad: a catch-everything handler in a device-path
+        module must route through the retry taxonomy (any reference to
+        classify/Classification/RetryOOM/SplitAndRetryOOM in the handler
+        counts), or it silently swallows OOM/transient faults the
+        OOM-resilience layer should see."""
+        t = node.type
+        broad = t is None or (isinstance(t, ast.Name)
+                              and t.id in ("Exception", "BaseException"))
+        if not broad:
+            return
+        for sub in ast.walk(node):
+            names = []
+            if isinstance(sub, ast.Name):
+                names.append(sub.id)
+            elif isinstance(sub, ast.Attribute):
+                names.append(sub.attr)
+            for n in names:
+                # exact taxonomy names, or classify-routing helpers
+                # (classify / _classify_probe_failure / ...)
+                if n in _TAXONOMY_NAMES or "classify" in n.lower():
+                    return
+        self._flag(node, "except-too-broad",
+                   "bare `except Exception` in a device-path module "
+                   "swallows the OOMs and transient faults the retry "
+                   "taxonomy classifies; route through "
+                   "memory/retry.classify or narrow the exception type")
 
     def _check_branch(self, node):
         params = self._jit_params()
