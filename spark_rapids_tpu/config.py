@@ -486,6 +486,44 @@ TPU_MESH_ENABLED = conf_bool(
     "engine-integrated form of the reference's GPU-resident shuffle "
     "manager.")
 
+PIPELINE_ENABLED = conf_bool(
+    "spark.rapids.tpu.pipeline.enabled", True,
+    "Overlap the host-side execution pipeline (exec/pipeline.py): "
+    "independent fusion-boundary subtrees materialize concurrently on a "
+    "shared worker pool, file readers decode ahead with bounded prefetch, "
+    "the streaming download path starts the next batch's dispatch before "
+    "downloading the previous one, and shuffle serialization overlaps "
+    "device work. Results are bit-identical with the pipeline on or off; "
+    "a session with fault injection active always runs the serial path so "
+    "per-site fault schedules stay deterministic. See docs/tuning-guide.md.")
+
+PIPELINE_DECODE_THREADS = conf_int(
+    "spark.rapids.tpu.pipeline.decodeThreads", 0,
+    "Concurrent file/row-group decode tasks the pipeline layer runs on "
+    "the shared pool (scan decode + upload assembly). 0 = auto "
+    "(min(4, cpu count), at least 2). Raising it helps many-file scans on "
+    "hosts with spare cores; each in-flight decode holds one host batch "
+    "plus its upload buffers.")
+
+PIPELINE_PREFETCH_DEPTH = conf_int(
+    "spark.rapids.tpu.pipeline.prefetchDepth", 2,
+    "Bounded look-ahead of every pipeline stage: batches a prefetch "
+    "worker keeps ready ahead of its consumer, and decode tasks in "
+    "flight ahead of the scan cursor. Deeper prefetch hides more "
+    "producer latency at the price of that many extra live batches in "
+    "host memory and HBM (see docs/tuning-guide.md for sizing against "
+    "HBM pressure).")
+
+PIPELINE_BOUNDARY_PARALLELISM = conf_int(
+    "spark.rapids.tpu.pipeline.boundaryParallelism", 0,
+    "Independent fusion-boundary subtrees materialized concurrently "
+    "before a fused dispatch (exec/fusion.py). 0 = auto (min(4, cpu "
+    "count), at least 2); 1 forces serial boundary materialization. "
+    "Device admission of the concurrent workers is still bounded by "
+    "spark.rapids.sql.concurrentTpuTasks — the dispatching thread "
+    "releases its own slot while it waits, the reference's "
+    "release-during-shuffle discipline.")
+
 METRICS_LEVEL = conf_str(
     "spark.rapids.tpu.metrics.level", "MODERATE",
     "Operator metrics level: NONE disables the whole query-profile layer "
@@ -599,6 +637,22 @@ class TpuConf:
     @property
     def mesh_enabled(self) -> bool:
         return self.get(TPU_MESH_ENABLED)
+
+    @property
+    def pipeline_enabled(self) -> bool:
+        return self.get(PIPELINE_ENABLED)
+
+    @property
+    def pipeline_decode_threads(self) -> int:
+        return self.get(PIPELINE_DECODE_THREADS)
+
+    @property
+    def pipeline_prefetch_depth(self) -> int:
+        return self.get(PIPELINE_PREFETCH_DEPTH)
+
+    @property
+    def pipeline_boundary_parallelism(self) -> int:
+        return self.get(PIPELINE_BOUNDARY_PARALLELISM)
 
     @property
     def metrics_level(self) -> str:

@@ -110,14 +110,37 @@ class ColumnarBatch:
         when live rows occupy a smaller capacity bucket, then ONE batched
         ``jax.device_get`` for every buffer of every column.
         """
+        return self.to_arrow_finish(self.to_arrow_begin(async_copy=False))
+
+    def to_arrow_begin(self, async_copy: bool = True):
+        """Start a download without blocking on the data: materialize +
+        shrink, sync only the row-count scalar, and (where the backend
+        supports it) start an async device->host copy of every buffer.
+        Returns an opaque handle for :meth:`to_arrow_finish`. The split
+        lets the pipelined DeviceToHost path dispatch the NEXT batch's
+        device work while this batch's bytes are still in flight
+        (exec/pipeline.py; the reference's overlapped-download stance)."""
         from ..ops.kernels.rowops import physical_jit
         batch = physical_jit(self)
         n = int(batch.n_rows)
         cap = bucket_capacity(max(n, 1))
         batch = _shrink_batch(batch, cap) if cap < batch.capacity else batch
-        host = jax.device_get([c.device_buffers() for c in batch.columns])
-        arrays = [c.arrow_from_host(bufs, n)
-                  for c, bufs in zip(batch.columns, host)]
+        bufs = [c.device_buffers() for c in batch.columns]
+        if async_copy:
+            for leaf in jax.tree_util.tree_leaves(bufs):
+                start = getattr(leaf, "copy_to_host_async", None)
+                if callable(start):
+                    start()
+        return batch, n, bufs
+
+    def to_arrow_finish(self, handle) -> pa.RecordBatch:
+        """Block on a download started by :meth:`to_arrow_begin` and
+        assemble the host RecordBatch (one batched ``jax.device_get``;
+        a completed async copy makes it a cache read)."""
+        batch, n, bufs = handle
+        host = jax.device_get(bufs)
+        arrays = [c.arrow_from_host(hb, n)
+                  for c, hb in zip(batch.columns, host)]
         fields = [pa.field(f.name, T.to_arrow_type(f.data_type), f.nullable)
                   for f in self.schema]
         return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))

@@ -116,7 +116,10 @@ class HostToDeviceExec(TpuExec):
             if pending:
                 yield self._upload(pending, ctx)
         from ..utils.prefetch import prefetch_iter
-        return [prefetch_iter(run(p))
+        from . import pipeline
+        depth = pipeline.prefetch_depth(ctx.conf)
+        name = self.node_name()
+        return [prefetch_iter(run(p), depth=depth, ctx=ctx, node=name)
                 for p in self.children[0].execute(ctx)]
 
     def _upload(self, rbs: List[pa.RecordBatch],
@@ -156,20 +159,66 @@ class DeviceToHostExec(PhysicalPlan):
     def execute(self, ctx):
         name = self.node_name()
 
+        def emit(ctx, hb, t0):
+            # The download already synced the row count — the one place
+            # row metrics are free (GpuExec.NUM_OUTPUT_ROWS analog).
+            import time as _time
+            ctx.metric(name, "numOutputRows", hb.num_rows)
+            ctx.metric(name, "numOutputBatches", 1)
+            ctx.metric(name, "downloadBytes", hb.rb.nbytes)
+            ctx.metric(name, "opTime", _time.perf_counter_ns() - t0)
+            return hb
+
         def run(part):
             import time as _time
             for db in part:
                 t0 = _time.perf_counter_ns()
                 with trace_range("DeviceToHost.download"):
                     hb = HostBatch.from_device(db)
-                # The download already synced the row count — the one place
-                # row metrics are free (GpuExec.NUM_OUTPUT_ROWS analog).
-                ctx.metric(name, "numOutputRows", hb.num_rows)
-                ctx.metric(name, "numOutputBatches", 1)
-                ctx.metric(name, "downloadBytes", hb.rb.nbytes)
-                ctx.metric(name, "opTime", _time.perf_counter_ns() - t0)
-                yield hb
-        return [run(p) for p in self.children[0].execute(ctx)]
+                yield emit(ctx, hb, t0)
+
+        def run_overlapped(part):
+            # Pipelined streaming download: pulling the NEXT device batch
+            # (which dispatches its device work) and starting its async
+            # copy-to-host happen BEFORE blocking on the PREVIOUS batch's
+            # bytes — transfer and compute stay concurrent (the tentpole
+            # overlap; to_arrow_begin/finish split in data/batch.py).
+            # opTime carries only this batch's begin+finish spans, NOT the
+            # overlapped consumer/upstream time in between — overlapped
+            # profiles must stay comparable to serial ones.
+            import time as _time
+            pending = None  # (begin ns, batch, download handle)
+            for db in part:
+                t0 = _time.perf_counter_ns()
+                with trace_range("DeviceToHost.download_begin"):
+                    handle = db.to_arrow_begin()
+                begin_ns = _time.perf_counter_ns() - t0
+                if pending is not None:
+                    yield self._finish_download(ctx, emit, pending)
+                pending = (begin_ns, db, handle)
+            if pending is not None:
+                yield self._finish_download(ctx, emit, pending)
+
+        from . import pipeline
+        parts = self.children[0].execute(ctx)
+        if not pipeline.parallel_active(ctx):
+            return [run(p) for p in parts]
+        from ..utils.prefetch import prefetch_iter
+        depth = pipeline.prefetch_depth(ctx.conf)
+        return [prefetch_iter(run_overlapped(p), depth=depth, ctx=ctx,
+                              node=name)
+                for p in parts]
+
+    @staticmethod
+    def _finish_download(ctx, emit, pending):
+        import time as _time
+        begin_ns, db, handle = pending
+        t0 = _time.perf_counter_ns()
+        with trace_range("DeviceToHost.download"):
+            hb = HostBatch(db.to_arrow_finish(handle))
+        # emit() computes opTime as now - t0; shift t0 back by the begin
+        # span so both download phases (and nothing else) are counted.
+        return emit(ctx, hb, t0 - begin_ns)
 
 
 class DeviceSourceExec(TpuExec):

@@ -216,8 +216,12 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
         _FUSED_CACHE[sig] = fn
     # Boundary subtrees run eagerly (uploads, windows, shuffles, ...); their
     # materialized batches are the fused program's positional arguments.
-    inputs = tuple(tuple(tuple(p) for p in b.execute(ctx))
-                   for b in boundaries)
+    # Independent boundaries materialize CONCURRENTLY on the shared
+    # pipeline pool (exec/pipeline.py) — argument order and accumulator
+    # merge order stay deterministic; serial when the pipeline is off or
+    # a fault injector is active.
+    from . import pipeline as _pipeline
+    inputs = _pipeline.materialize_boundaries(boundaries, ctx)
     reg = ctx.registry
     import time as _time
     t_dispatch = _time.perf_counter_ns()

@@ -340,30 +340,41 @@ class TpuCsvScanExec:
     def execute(self, ctx):
         name = self.node_name()
 
-        def gen():
+        def read_file(path):
             from ..memory.retry import Classification, classify
             from ..utils.fault_injection import maybe_inject
-            for path in self.files:
-                try:
-                    maybe_inject(ctx, "io.csv.file")
-                    with ctx.registry.timer(name, "opTime",
-                                            trace="csv.decode_file"):
-                        batches = list(decode_file(path, self._schema,
-                                                   self.options))
-                except Exception as e:  # noqa: BLE001 - classify-narrowed
-                    # Out-of-scope files (NotCsvDecodable) and classified
-                    # device faults fall back to the host reader per file;
-                    # parser-logic bugs still fail loudly.
-                    if not isinstance(e, NotCsvDecodable) \
-                            and classify(e) == Classification.FATAL:
-                        raise
-                    ctx.metric(name, "fileHostFallback", 1)
-                    batches = self._host_file(path)
+            try:
+                maybe_inject(ctx, "io.csv.file")
+                with ctx.registry.timer(name, "opTime",
+                                        trace="csv.decode_file"):
+                    return list(decode_file(path, self._schema,
+                                            self.options))
+            except Exception as e:  # noqa: BLE001 - classify-narrowed
+                # Out-of-scope files (NotCsvDecodable) and classified
+                # device faults fall back to the host reader per file;
+                # parser-logic bugs still fail loudly.
+                if not isinstance(e, NotCsvDecodable) \
+                        and classify(e) == Classification.FATAL:
+                    raise
+                ctx.metric(name, "fileHostFallback", 1)
+                return self._host_file(path)
+
+        # Files decode ahead on the shared pipeline pool (bounded by
+        # decodeThreads/prefetchDepth), yielding in file order; with the
+        # pipeline off, the serial stream keeps its depth-2 prefetch
+        # worker (pre-pipeline behavior).
+        from ..exec import pipeline
+
+        def gen():
+            for batches in pipeline.ordered_map_iter(
+                    read_file, self.files, ctx, name):
                 for b in batches:
                     ctx.metric(name, "numOutputBatches", 1)
                     yield b
+        if pipeline.parallel_active(ctx):
+            return [gen()]
         from ..utils.prefetch import prefetch_iter
-        return [prefetch_iter(gen())]
+        return [prefetch_iter(gen(), ctx=ctx, node=name)]
 
     def _host_file(self, path: str) -> List[ColumnarBatch]:
         import pyarrow as pa

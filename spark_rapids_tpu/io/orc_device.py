@@ -725,7 +725,8 @@ class TpuOrcScanExec:
 
         name = self.node_name()
 
-        def read(path, tail, si):
+        def read(unit):
+            path, tail, si = unit
             from ..memory.retry import Classification, classify
             from ..utils.fault_injection import maybe_inject
             try:
@@ -745,14 +746,22 @@ class TpuOrcScanExec:
                 ctx.metric(name, "stripeHostFallback", 1)
                 return self._host_stripe(path, tail, si)
 
+        # Stripes decode ahead on the shared pipeline pool (bounded by
+        # decodeThreads/prefetchDepth), yielding in stripe order; with
+        # the pipeline off, the serial stream keeps its depth-2 prefetch
+        # worker (pre-pipeline behavior).
+        from ..exec import pipeline
+
         def gen():
-            for u in units:
-                b = read(*u)
+            for u, b in zip(units, pipeline.ordered_map_iter(
+                    read, units, ctx, name)):
                 ctx.metric(name, "numOutputBatches", 1)
                 ctx.metric(name, "numOutputRows", u[2].n_rows)
                 yield b
+        if pipeline.parallel_active(ctx):
+            return [gen()]
         from ..utils.prefetch import prefetch_iter
-        return [prefetch_iter(gen())]
+        return [prefetch_iter(gen(), ctx=ctx, node=name)]
 
     def _host_stripe(self, path, tail, si) -> ColumnarBatch:
         import pyarrow.orc as orc

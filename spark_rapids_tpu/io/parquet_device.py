@@ -675,7 +675,8 @@ class TpuParquetScanExec:
 
         name = self.node_name()
 
-        def read(path, meta, pq_schema, rg):
+        def read_unit(unit):
+            path, meta, pq_schema, rg = unit
             from ..utils.fault_injection import maybe_inject
             from ..utils.tracing import trace_range
             n_rows = meta.row_group(rg).num_rows
@@ -683,11 +684,9 @@ class TpuParquetScanExec:
                 maybe_inject(ctx, "io.parquet.rowGroup")
                 with ctx.registry.timer(name, "opTime",
                                         trace="parquet.device_decode"):
-                    yield decode_row_group(path, rg, self._schema,
-                                           meta=meta, pq_schema=pq_schema)
+                    batch = decode_row_group(path, rg, self._schema,
+                                             meta=meta, pq_schema=pq_schema)
                 ctx.metric(name, "deviceDecodedRowGroups", 1)
-                ctx.metric(name, "numOutputRows", n_rows)
-                ctx.metric(name, "numOutputBatches", 1)
             # ANY decode failure (unsupported shape, decompression codec
             # mismatch, corrupt/truncated page metadata) degrades to the
             # host reader for just this row group — the host result is the
@@ -704,12 +703,18 @@ class TpuParquetScanExec:
                         rb = pa.RecordBatch.from_pydict(
                             {n: [] for n in self._schema.names},
                             schema=T.schema_to_arrow(self._schema))
-                    yield ColumnarBatch.from_arrow(
+                    batch = ColumnarBatch.from_arrow(
                         rb.cast(T.schema_to_arrow(self._schema)))
                 ctx.metric(name, "hostFallbackRowGroups", 1)
-                ctx.metric(name, "numOutputRows", n_rows)
-                ctx.metric(name, "numOutputBatches", 1)
-        return [read(p, m, ps, rg) for p, m, ps, rg in units]
+            ctx.metric(name, "numOutputRows", n_rows)
+            ctx.metric(name, "numOutputBatches", 1)
+            return batch
+        # One partition per row group (the scan partition contract), but
+        # with the pipeline active the next `prefetchDepth` units decode
+        # on the shared pool while the consumer uploads/dispatches the
+        # current one — the reference's overlapped readPartFile stance.
+        from ..exec.pipeline import unit_partitions
+        return unit_partitions(read_unit, units, ctx, name)
 
 
 def scan_files(paths: List[str]) -> Optional[List[str]]:

@@ -42,11 +42,21 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import threading
 import time
 import zlib
 from typing import Callable, List, Optional
 
 _LOG = logging.getLogger(__name__)
+
+#: Serializes concurrent OOM recoveries: pipeline boundary workers
+#: (exec/pipeline.py) can hit OOM simultaneously, and the sync+spill
+#: sequence must run atomically — two interleaved spill-downs would each
+#: observe the other's half-freed state and could spill buffers the
+#: sibling's retry is about to re-pin. Device ALLOCATION concurrency is
+#: already bounded by the admission semaphore the workers hold; this lock
+#: only orders the recovery sequences among themselves.
+_OOM_RECOVERY_LOCK = threading.RLock()
 
 #: Hard ceiling on attempts one ``with_retry`` call may make across all
 #: split fragments — a runaway-injection backstop, far above any real
@@ -321,8 +331,9 @@ def with_retry(ctx, site: str, inputs, attempt: Callable,
                 ctx.metric(node, "retryWastedComputeNs",
                            time.perf_counter_ns() - t0)
                 if cls == Classification.OOM:
-                    synchronize_device()
-                    spill_device_below(ctx)
+                    with _OOM_RECOVERY_LOCK:
+                        synchronize_device()
+                        spill_device_below(ctx)
                     if retries >= policy.max_retries:
                         if split is None:
                             raise SplitAndRetryOOM(site) from e

@@ -75,6 +75,16 @@ class TpuSemaphore:
             f"(spark.rapids.tpu.concurrentTpuTasks.acquireTimeout); "
             f"{self.max_concurrent} slot(s) total, {held_desc}")
 
+    def released(self):
+        """Context manager that temporarily releases EVERY slot this
+        thread holds and re-acquires the same count on exit — the
+        reference's release-the-semaphore-while-blocked-on-IO discipline
+        (GpuSemaphore around shuffle fetches). The pipeline layer uses it
+        while the dispatching thread waits on boundary workers, so the
+        freed slots actually admit those workers
+        (spark.rapids.tpu.pipeline.boundaryParallelism)."""
+        return _Released(self)
+
     def release_if_necessary(self):
         tid = threading.get_ident()
         with self._lock:
@@ -94,3 +104,48 @@ class TpuSemaphore:
     def __exit__(self, *exc):
         self.release_if_necessary()
         return False
+
+
+class _Released:
+    """Release the calling thread's underlying permit for a scope, then
+    re-take it and restore the reentrant hold count. A thread holds
+    exactly ONE underlying permit no matter how deep its reentrancy
+    (acquire_if_necessary's fast path never touches the semaphore), so
+    exactly one permit moves in each direction — releasing per-hold
+    would inflate the counter past max_concurrent and over-admit."""
+
+    def __init__(self, sem: TpuSemaphore):
+        self._sem = sem
+        self._count = 0
+
+    def __enter__(self):
+        sem = self._sem
+        tid = threading.get_ident()
+        with sem._lock:
+            self._count = sem._held.pop(tid, 0)
+        if self._count:
+            sem._sem.release()
+        return self
+
+    def __exit__(self, *exc):
+        sem = self._sem
+        if not self._count:
+            return False
+        t0 = time.perf_counter_ns()
+        # Honor the acquireTimeout diagnostic here too: a wedged worker
+        # must surface as the named error, not a silent hang at re-entry.
+        if sem.acquire_timeout_s > 0:
+            acquired = sem._sem.acquire(timeout=sem.acquire_timeout_s)
+        else:
+            acquired = sem._sem.acquire()
+        tid = threading.get_ident()
+        with sem._lock:
+            sem.wait_ns += time.perf_counter_ns() - t0
+            if acquired:
+                sem._held[tid] = sem._held.get(tid, 0) + self._count
+                return False
+            holders = dict(sem._held)
+        raise SemaphoreTimeoutError(
+            f"thread {tid} could not re-acquire the TPU task semaphore "
+            f"within {sem.acquire_timeout_s:g}s after waiting on pipeline "
+            f"workers; holders: {holders or 'none recorded'}")

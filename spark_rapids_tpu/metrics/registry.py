@@ -149,6 +149,22 @@ TAXONOMY: Dict[str, MetricSpec] = {s.name: s for s in [
     _spec("retryWastedComputeNs", MetricKind.NANO_TIMING, MODERATE,
           "Wall time of failed attempts whose work was thrown away and "
           "re-run — the price of surviving the fault."),
+    _spec("prefetchProducerStallNs", MetricKind.NANO_TIMING, ESSENTIAL,
+          "Pipeline occupancy: time producers (prefetch workers, decode "
+          "tasks) spent blocked on a full bounded prefetch queue — the "
+          "consumer side is the bottleneck "
+          "(spark.rapids.tpu.pipeline.prefetchDepth)."),
+    _spec("prefetchConsumerStallNs", MetricKind.NANO_TIMING, ESSENTIAL,
+          "Pipeline occupancy: time consumers spent blocked waiting for "
+          "a prefetched batch or in-flight decode result — the producer "
+          "side is the bottleneck."),
+    _spec("decodeThreadBusyNs", MetricKind.NANO_TIMING, ESSENTIAL,
+          "Total busy time of shared-pool decode tasks (file/row-group "
+          "decode the pipeline layer overlapped with device work)."),
+    _spec("boundaryOverlapNs", MetricKind.NANO_TIMING, ESSENTIAL,
+          "Wall time saved by materializing independent fusion-boundary "
+          "subtrees concurrently: the sum of per-boundary times minus "
+          "elapsed time (spark.rapids.tpu.pipeline.boundaryParallelism)."),
 ]}
 
 #: Metrics recorded under names outside the taxonomy (operator-specific

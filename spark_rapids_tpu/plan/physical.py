@@ -81,7 +81,18 @@ class ExecContext:
     #: session-scoped injector so fault schedules survive dispatch
     #: retries; bare contexts build one from conf.
     fault_injector: object = None
+    #: Task-admission semaphore of the owning session's DeviceManager
+    #: (None in bare unit-test contexts). Pipeline boundary workers
+    #: acquire it so concurrent device allocation stays serialized through
+    #: the existing semaphore (exec/pipeline.py); the dispatching thread
+    #: releases its slot while waiting on them.
+    semaphore: object = None
     _join_site: int = 0
+    #: Base offset for next_join_site ordinals: pipeline boundary forks
+    #: get disjoint deterministic namespaces so concurrent materialization
+    #: cannot interleave ordinal assignment (capacity learning keys must
+    #: be stable across runs of the same plan).
+    _site_namespace: int = 0
 
     def __post_init__(self):
         if self.registry is None:
@@ -97,7 +108,30 @@ class ExecContext:
         runs of the same plan)."""
         s = self._join_site
         self._join_site += 1
-        return s
+        return self._site_namespace + s
+
+    def fork_for_boundary(self, ordinal: int) -> "ExecContext":
+        """A child context for one concurrently-materialized fusion
+        boundary (exec/pipeline.py): shares the conf, registry, catalog,
+        caps/modes dicts, and fault injector (all thread-safe or
+        read-only during execution) but gets PRIVATE accumulator lists —
+        merged back in boundary order by :meth:`absorb_boundary`, so
+        their contents never depend on worker interleaving — and a
+        disjoint join-site namespace keyed by the boundary ordinal, which
+        is plan-determined and therefore stable across runs."""
+        return dataclasses.replace(
+            self, cleanups=[], overflow_flags=[], join_totals=[],
+            dense_fails=[], _join_site=0,
+            _site_namespace=(ordinal + 1) << 20)
+
+    def absorb_boundary(self, child: "ExecContext") -> None:
+        """Merge a boundary fork's accumulators back (called in boundary
+        order, single-threaded, after every worker finished)."""
+        self.overflow_flags.extend(child.overflow_flags)
+        self.join_totals.extend(child.join_totals)
+        self.dense_fails.extend(child.dense_fails)
+        self.cleanups.extend(child.cleanups)
+        child.cleanups = []
 
     def metric(self, node: str, name: str, value):
         """Accumulate one metric observation. Thread-safe (warm-up and

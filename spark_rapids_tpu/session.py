@@ -64,6 +64,10 @@ class TpuSession:
         # cache, AOT warm-up worker (compile/, docs/compile-cache.md).
         from . import compile as compile_layer
         compile_layer.configure(self.conf)
+        # Pipelined execution layer: shared worker pool sizing
+        # (exec/pipeline.py, docs/tuning-guide.md).
+        from .exec import pipeline as pipeline_layer
+        pipeline_layer.configure(self.conf)
         # Query-profile layer (metrics/, docs/monitoring.md).
         self._last_profile = None
         self._query_seq = 0
@@ -82,12 +86,28 @@ class TpuSession:
         s._overrides = TpuOverrides(s.conf)
         from . import compile as compile_layer
         compile_layer.configure(s.conf)
+        from .exec import pipeline as pipeline_layer
+        pipeline_layer.configure(s.conf)
         s._last_profile = None
         s._query_seq = 0
         s._event_log = None
         from .utils.fault_injection import FaultInjector
         s._fault_injector = FaultInjector.maybe(s.conf)
         return s
+
+    def close(self) -> None:
+        """Quiesce session-owned background machinery: join every shared
+        pipeline worker thread (exec/pipeline.py — the conftest leak
+        check asserts none survive close). The pool is process-wide and
+        lazily recreated, so a session used after close keeps working;
+        close only guarantees no pipeline thread is left running NOW."""
+        from .exec import pipeline as pipeline_layer
+        leaked = pipeline_layer.shutdown()
+        if leaked:
+            import logging
+            logging.getLogger(__name__).warning(
+                "pipeline pool shutdown left %d worker(s) running: %s",
+                len(leaked), [t.name for t in leaked])
 
     def compile_status(self) -> dict:
         """Diagnostic snapshot of the compile-once layer: the process
@@ -226,7 +246,8 @@ class TpuSession:
             while True:
                 ctx = P.ExecContext(self.conf,
                                     catalog=self.device_manager.catalog,
-                                    fault_injector=self._fault_injector)
+                                    fault_injector=self._fault_injector,
+                                    semaphore=self.device_manager.semaphore)
                 ctx.join_caps = caps
                 ctx.dense_modes = dict(dense_modes)
                 ctx.join_growth = growth
@@ -261,8 +282,9 @@ class TpuSession:
                     if not retryable or dispatch_try >= policy.max_retries:
                         raise
                     if cls == R.Classification.OOM:
-                        R.synchronize_device()
-                        R.spill_device_below(ctx)
+                        with R._OOM_RECOVERY_LOCK:
+                            R.synchronize_device()
+                            R.spill_device_below(ctx)
                     dispatch_retries += 1
                     t0 = time.perf_counter_ns()
                     R.backoff_sleep(policy, "session.dispatch",

@@ -296,33 +296,65 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 ids_np = np.asarray(sorted_ids)[: rb.num_rows]
             return rb, ids_np
 
+        def write_map(rb, ids_np, this_map_id):
+            """Serialize one map task's partition slices into the catalog
+            (host-only work — blocks are keyed by map_id, so completion
+            order never affects reduce-side contents)."""
+            # Contiguous runs per partition id (ids are sorted).
+            starts = np.searchsorted(ids_np, np.arange(n_parts),
+                                     side="left")
+            ends = np.searchsorted(ids_np, np.arange(n_parts),
+                                   side="right")
+            for p in range(n_parts):
+                if ends[p] > starts[p]:
+                    piece = rb.slice(starts[p], ends[p] - starts[p])
+                    with ctx.registry.timer(
+                            name, "serializationTime",
+                            trace="shuffle.serialize"):
+                        payload = serialize_batch(piece, codec)
+                    ctx.metric(name, "shuffleBytesWritten",
+                               len(payload))
+                    catalog.add_block(shuffle_id, this_map_id, p, payload)
+
+        # Pipeline overlap: map-task serialization runs on the shared
+        # pool while the NEXT batch's partition sort dispatches on the
+        # device — ser/deser and device work stay concurrent. The device
+        # split + its retry site stay on this thread (deterministic
+        # injection schedules); catalog writes are lock-protected and
+        # keyed, so completion order is irrelevant.
+        from ..exec import pipeline
+        import collections
+        overlap = pipeline.parallel_active(ctx)
+        ser_pool = pipeline.get_pool() if overlap else None
+        ser_depth = pipeline.prefetch_depth(ctx.conf)
+        ser_futs = collections.deque()
         map_id = 0
-        for part in self.children[0].execute(ctx):
-            for db in part:
-                if int(db.n_rows) == 0:
-                    continue
-                # A split input batch serializes as two map tasks: row-to-
-                # partition routing is per-row, so reduce-side contents
-                # are unchanged.
-                for rb, ids_np in R.with_retry(
-                        ctx, f"{name}.partitionSplit", db, partition_split,
-                        split=R.halve_by_rows, node=name):
-                    # Contiguous runs per partition id (ids are sorted).
-                    starts = np.searchsorted(ids_np, np.arange(n_parts),
-                                             side="left")
-                    ends = np.searchsorted(ids_np, np.arange(n_parts),
-                                           side="right")
-                    for p in range(n_parts):
-                        if ends[p] > starts[p]:
-                            piece = rb.slice(starts[p], ends[p] - starts[p])
-                            with ctx.registry.timer(
-                                    name, "serializationTime",
-                                    trace="shuffle.serialize"):
-                                payload = serialize_batch(piece, codec)
-                            ctx.metric(name, "shuffleBytesWritten",
-                                       len(payload))
-                            catalog.add_block(shuffle_id, map_id, p, payload)
-                    map_id += 1
+        try:
+            for part in self.children[0].execute(ctx):
+                for db in part:
+                    if int(db.n_rows) == 0:
+                        continue
+                    # A split input batch serializes as two map tasks:
+                    # row-to-partition routing is per-row, so reduce-side
+                    # contents are unchanged.
+                    for rb, ids_np in R.with_retry(
+                            ctx, f"{name}.partitionSplit", db,
+                            partition_split, split=R.halve_by_rows,
+                            node=name):
+                        if overlap:
+                            ser_futs.append(ser_pool.submit(
+                                write_map, rb, ids_np, map_id))
+                            if len(ser_futs) >= max(ser_depth, 1):
+                                ser_futs.popleft().result()
+                        else:
+                            write_map(rb, ids_np, map_id)
+                        map_id += 1
+        finally:
+            # Every block must be in the catalog before the read side
+            # plans against observed sizes (and serializer failures must
+            # surface here, on the exchange, not at some later result()).
+            while ser_futs:
+                ser_futs.popleft().result()
 
         # READ side (RapidsCachingReader analog): lazy fetch + re-upload.
         # Blocks free once every reduce partition is drained — or at query
@@ -395,7 +427,14 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 drained["n"] += 1
                 if drained["n"] == len(specs):
                     catalog.unregister_shuffle(shuffle_id)
-        return [read_spec(s) for s in specs]
+        if not overlap:
+            return [read_spec(s) for s in specs]
+        # Reduce-side overlap: a prefetch worker deserializes + re-uploads
+        # the next block while the consumer computes over the previous one.
+        from ..utils.prefetch import prefetch_iter
+        return [prefetch_iter(read_spec(s), depth=ser_depth, ctx=ctx,
+                              node=name)
+                for s in specs]
 
 
 def _shuffle_env(ctx: ExecContext) -> ShuffleBufferCatalog:

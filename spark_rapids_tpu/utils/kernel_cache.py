@@ -97,8 +97,11 @@ def cached_kernel(kind: str, key: tuple, builder: Callable[[], Callable],
             return fn
     t0 = time.perf_counter_ns()
     raw = builder()
-    jitted = jax.jit(raw) if static_argnums is None else \
-        jax.jit(raw, static_argnums=static_argnums)
+    # The engine's ONE sanctioned runtime jit site: the cache above
+    # guarantees a single wrapper per structural key for the process
+    # lifetime — exactly the dedup the jit-nested lint rule routes
+    # every other module toward (it names cached_kernel as the fix).
+    jitted = jax.jit(raw, static_argnums=static_argnums)  # tpu-lint: ignore
     build_ns = time.perf_counter_ns() - t0
     with _LOCK:
         fn = _CACHE.setdefault(k, jitted)

@@ -1,57 +1,113 @@
 """Background prefetch for host->device pipelines.
 
 Double buffering: while the device computes over batch k, a worker
-thread decodes/converts/uploads batch k+1 (JAX dispatch is thread-safe;
-uploads enqueue on the transfer stream). This is the TPU-native analog
-of the reference's overlapped scan — its parquet reader assembles the
-next host buffer while cudf decodes the previous one on the GPU stream
+decodes/converts/uploads batch k+1 (JAX dispatch is thread-safe; uploads
+enqueue on the transfer stream). This is the TPU-native analog of the
+reference's overlapped scan — its parquet reader assembles the next host
+buffer while cudf decodes the previous one on the GPU stream
 (GpuParquetScan.scala:314 readPartFile / Table.readParquet split).
+
+The worker runs on the SHARED pipeline pool (exec/pipeline.py) instead of
+a raw thread per iterator (the raw-thread tpu_lint rule), its depth comes
+from ``spark.rapids.tpu.pipeline.prefetchDepth``, and stall time on both
+sides of the bounded queue is reported through the pipeline occupancy
+counters (``prefetchProducerStallNs`` / ``prefetchConsumerStallNs``) when
+a metric context is supplied.
 """
 
 from __future__ import annotations
 
 import queue
 import threading
-from typing import Iterable, Iterator
+import time
+from typing import Iterable, Iterator, Optional
 
 _STOP = object()
+_PENDING = object()
 
 
-def prefetch_iter(src: Iterable, depth: int = 2) -> Iterator:
-    """Iterate ``src`` on a worker thread, keeping up to ``depth`` items
-    ready. Exceptions re-raise at the consumer's next().
+def prefetch_iter(src: Iterable, depth: int = 2, ctx=None,
+                  node: Optional[str] = None) -> Iterator:
+    """Iterate ``src`` on a shared-pool worker, keeping up to ``depth``
+    items ready. Exceptions re-raise at the consumer's next().
 
     Abandonment-safe: when the consumer stops early (a LIMIT that never
     drains the stream, generator GC), the finally block signals the
-    worker and drains the queue, so neither the thread nor its queued
-    device batches outlive the consumer."""
+    worker and drains the queue, so neither the worker occupancy nor its
+    queued device batches outlive the consumer. Pool shutdown
+    (TpuSession.close) also unblocks both sides."""
+    from ..exec import pipeline as _pipeline
+    pool = _pipeline.get_pool()
     q: "queue.Queue" = queue.Queue(maxsize=max(depth, 1))
     cancelled = threading.Event()
+    stalls = {"producer": 0}
 
     def put(item) -> bool:
-        while not cancelled.is_set():
-            try:
-                q.put(item, timeout=0.1)
-                return True
-            except queue.Full:
-                continue
-        return False
+        try:
+            q.put_nowait(item)
+            return True
+        except queue.Full:
+            pass
+        t0 = time.perf_counter_ns()
+        try:
+            while not cancelled.is_set() \
+                    and not pool.shutting_down.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+        finally:
+            stalls["producer"] += time.perf_counter_ns() - t0
 
     def work():
         try:
-            for item in src:
-                if not put(item):
-                    return
-        except BaseException as e:  # noqa: BLE001 — re-raised at consumer
-            put((_STOP, e))
-            return
-        put((_STOP, None))
+            try:
+                for item in src:
+                    if not put(item):
+                        return
+            except BaseException as e:  # noqa: BLE001 — re-raised at consumer
+                put((_STOP, e))
+                return
+            put((_STOP, None))
+        finally:
+            if ctx is not None and node and stalls["producer"]:
+                ctx.metric(node, "prefetchProducerStallNs",
+                           stalls["producer"])
 
-    t = threading.Thread(target=work, daemon=True)
-    t.start()
+    fut = pool.submit(work)
+    consumer_stall = 0
     try:
         while True:
-            item = q.get()
+            try:
+                item = q.get_nowait()
+            except queue.Empty:
+                t0 = time.perf_counter_ns()
+                item = _PENDING
+                while item is _PENDING:
+                    try:
+                        item = q.get(timeout=0.5)
+                    except queue.Empty:
+                        if not fut.done():
+                            continue
+                        # Worker finished: its sentinel may have landed
+                        # between our timeout and this check — pick it
+                        # up rather than dropping a carried exception.
+                        try:
+                            item = q.get_nowait()
+                        except queue.Empty:
+                            # No sentinel at all: the process-wide pool
+                            # shut down under a live iteration (a
+                            # concurrent TpuSession.close). Truncating
+                            # silently would return wrong results — fail
+                            # loudly instead.
+                            raise RuntimeError(
+                                "pipeline pool shut down while this "
+                                "prefetch stream was still being "
+                                "consumed (TpuSession.close() during a "
+                                "live query?)") from None
+                consumer_stall += time.perf_counter_ns() - t0
             if isinstance(item, tuple) and len(item) == 2 \
                     and item[0] is _STOP:
                 if item[1] is not None:
@@ -65,3 +121,5 @@ def prefetch_iter(src: Iterable, depth: int = 2) -> Iterator:
                 q.get_nowait()
         except queue.Empty:
             pass
+        if ctx is not None and node and consumer_stall:
+            ctx.metric(node, "prefetchConsumerStallNs", consumer_stall)

@@ -83,6 +83,23 @@ def pytest_configure(config):
         pass
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Pipeline-worker leak check (docs/tuning-guide.md): every shared
+    pipeline pool thread must join on shutdown — the same guarantee
+    ``TpuSession.close`` makes. A worker that cannot be joined here is a
+    leaked producer (stuck put, undrained queue) and fails the run."""
+    import sys
+    mod = sys.modules.get("spark_rapids_tpu.exec.pipeline")
+    if mod is None:
+        return  # suite never touched the engine
+    leaked = mod.shutdown(timeout=15)
+    if leaked:
+        session.exitstatus = 1
+        print("ERROR: pipeline worker threads survived shutdown "
+              f"(TpuSession.close leak): {[t.name for t in leaked]}",
+              file=sys.stderr)
+
+
 #: Test modules that need the 8-device virtual mesh (single real chip
 #: cannot run them; the driver's dryrun_multichip covers that path).
 _NEEDS_VIRTUAL_MESH = {"test_distributed", "test_mesh"}

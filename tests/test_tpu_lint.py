@@ -254,6 +254,53 @@ class TestSeededAntiPatterns:
         assert [v for v in TL.lint_tree(fake_pkg)
                 if v.rule == "except-too-broad"] == []
 
+    def test_raw_thread_in_device_scope_flagged(self, fake_pkg):
+        _write(fake_pkg, "io/threads.py", """
+            import threading
+            from threading import Thread
+
+            def spawn(fn):
+                t = threading.Thread(target=fn, daemon=True)
+                t.start()
+                return t
+
+            def spawn_bare(fn):
+                return Thread(target=fn)
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg) if v.rule == "raw-thread"]
+        assert len(vs) == 2
+
+    def test_raw_thread_covers_utils_and_data(self, fake_pkg):
+        _write(fake_pkg, "utils/bg.py", """
+            import threading
+
+            def worker(fn):
+                return threading.Thread(target=fn)
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg) if v.rule == "raw-thread"]
+        assert len(vs) == 1
+
+    def test_raw_thread_outside_scope_not_flagged(self, fake_pkg):
+        _write(fake_pkg, "compile/warmish.py", """
+            import threading
+
+            def worker(fn):
+                return threading.Thread(target=fn)
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "raw-thread"] == []
+
+    def test_raw_thread_sanctioned_pool_site_suppressed(self, fake_pkg):
+        _write(fake_pkg, "exec/poolish.py", """
+            import threading
+
+            def submit(fn):
+                t = threading.Thread(target=fn)  # tpu-lint: ignore
+                return t
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "raw-thread"] == []
+
 
 class TestRatchet:
     def _seed(self, fake_pkg, n):

@@ -33,6 +33,13 @@ corrupt TPU performance or correctness silently:
   OOM-resilience layer exists to classify (docs/fault-tolerance.md).
   Static approximation: the handler is clean if its body references any
   taxonomy name.
+* ``raw-thread`` (device-path modules plus ``data/`` and ``utils/``): a
+  direct ``threading.Thread(...)`` construction — ad-hoc threads bypass
+  the shared pipeline pool (exec/pipeline.py), escape the
+  ``TpuSession.close`` leak check, and un-bound the pipeline's sized
+  concurrency. Route through ``exec.pipeline.get_pool().submit`` or
+  ``utils.prefetch.prefetch_iter`` instead; the pool's own spawn site
+  carries the ignore marker.
 
 Existing debt is RATCHETED, not flooded: the checked-in baseline
 (``tools/tpu_lint_baseline.json``) records per-(file, rule) counts; the
@@ -64,6 +71,9 @@ KERNEL_SCOPE = ("ops/kernels/",)
 PLAN_SCOPE = ("plan/",)
 EXEC_SCOPE = ("exec/",)
 DEVICE_SCOPE = ("exec/", "memory/", "shuffle/", "io/")
+#: raw-thread also covers the batch/upload and shared-utility layers —
+#: everywhere a stray Thread could carry device work past the pool.
+RAW_THREAD_SCOPE = DEVICE_SCOPE + ("data/", "utils/")
 
 #: retry-taxonomy names whose presence marks a broad handler as
 #: classified (except-too-broad)
@@ -130,6 +140,7 @@ class _FileLinter(ast.NodeVisitor):
         self.in_plan = relpath.startswith(PLAN_SCOPE)
         self.in_exec = relpath.startswith(EXEC_SCOPE)
         self.in_device = relpath.startswith(DEVICE_SCOPE)
+        self.in_raw_thread = relpath.startswith(RAW_THREAD_SCOPE)
         self.violations: List[Violation] = []
         #: stack of (is_jit, frozenset(param names)) for enclosing functions
         self._funcs: List[Tuple[bool, frozenset]] = []
@@ -206,6 +217,8 @@ class _FileLinter(ast.NodeVisitor):
             self._check_host_sync(node, func, root)
         if self.in_plan:
             self._check_nondet(node, func, root)
+        if self.in_raw_thread:
+            self._check_raw_thread(node, func, root)
         if self._funcs and (
                 (root == "jax" and isinstance(func, ast.Attribute)
                  and func.attr == "jit")
@@ -239,6 +252,21 @@ class _FileLinter(ast.NodeVisitor):
             self._flag(node, "host-sync",
                        f"{func.id}(...) on a non-constant concretizes a "
                        "traced value (host sync inside a kernel module)")
+
+    def _check_raw_thread(self, node: ast.Call, func, root):
+        """raw-thread: device-path (+ data/utils) modules must not spawn
+        ad-hoc threads — they bypass the shared pipeline pool's sizing
+        and the TpuSession.close leak check (exec/pipeline.py)."""
+        is_thread = (isinstance(func, ast.Attribute)
+                     and func.attr == "Thread" and root == "threading") \
+            or (isinstance(func, ast.Name) and func.id == "Thread")
+        if is_thread:
+            self._flag(node, "raw-thread",
+                       "threading.Thread in a device-path module bypasses "
+                       "the shared pipeline pool (worker reuse, sized "
+                       "concurrency, session-close leak check); route "
+                       "through exec.pipeline.get_pool().submit or "
+                       "utils.prefetch.prefetch_iter")
 
     def _check_nondet(self, node: ast.Call, func, root):
         if not isinstance(func, ast.Attribute):
