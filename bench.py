@@ -265,9 +265,15 @@ def run_suite(budget_s=DEFAULT_BUDGET_S,
     bb_tpu_u = tpcxbb.load(tpu, xbb_tables, cache=False)
     runs += [(name, q, bb_cpu, bb_tpu, bb_cpu_u, bb_tpu_u)
              for name, q in xbb_specs]
+    from spark_rapids_tpu.compile import executables as _executables
     from spark_rapids_tpu.exec import fusion
     profiles = {}
     skipped = {}
+    # Per-query compile breakdown (ISSUE 6): compile_seconds,
+    # kernels_compiled, executables_reused, cold_vs_cached_ratio land in
+    # the BENCH JSON so the win curve is machine-readable (the ROADMAP
+    # success metric is cold within 2x of cached, per query).
+    query_compile = {}
     for name, q, cpu_t, tpu_t, cpu_u, tpu_u in runs:
         elapsed = time.perf_counter() - suite_t0
         if budget_s and elapsed > budget_s:
@@ -284,11 +290,13 @@ def run_suite(budget_s=DEFAULT_BUDGET_S,
         try:
             with query_budget(per_query):
                 stats0 = KC.cache_stats()
+                exe0 = _executables.stats()
                 cpu_result = q(cpu_t).collect()       # oracle
                 tpu_result = q(tpu_t).collect()       # warmup + compile
                 assert tables_match(tpu_result, cpu_result), \
                     f"{name}: TPU result != CPU oracle result"
                 stats1 = KC.cache_stats()
+                exe1 = _executables.stats()
                 cpu_time = timed(lambda: q(cpu_t).collect())
                 tpu_time = timed(lambda: q(tpu_t).collect())
                 # Per-query QueryProfile of the last timed device run,
@@ -315,6 +323,20 @@ def run_suite(budget_s=DEFAULT_BUDGET_S,
         uncached_ratios.append(ucpu / utpu)
         cold_ratios.append(ucpu / ctpu)
         tpu_times.append(tpu_time)
+        reused0 = exe0["aot_hits"] + exe0["jit_calls"] - exe0["jit_compiles"]
+        reused1 = exe1["aot_hits"] + exe1["jit_calls"] - exe1["jit_compiles"]
+        query_compile[name] = {
+            # Fused-program compile time plus host kernel-build time paid
+            # by this query's warmup run.
+            "compile_seconds": round(
+                exe1["compile_seconds"] - exe0["compile_seconds"]
+                + (stats1["build_ns"] - stats0["build_ns"]) / 1e9, 3),
+            "kernels_compiled": stats1["misses"] - stats0["misses"],
+            "fused_compiles": exe1["jit_compiles"] - exe0["jit_compiles"],
+            "executables_reused": reused1 - reused0,
+            # ROADMAP success metric: cold within 2x of cached (<= 2.0).
+            "cold_vs_cached_ratio": round(ctpu / tpu_time, 3),
+        }
         # Perf evidence (VERDICT r3 item 1b): kernels compiled for this
         # query's warmup, fused-program count, and steady-state dispatch
         # counts — "compiles and matches" AND "how it runs".
@@ -322,6 +344,8 @@ def run_suite(budget_s=DEFAULT_BUDGET_S,
               f"tpu={tpu_time*1e3:.1f}ms ratio={cpu_time/tpu_time:.2f} "
               f"uncached_ratio={ucpu/utpu:.2f} cold_ratio={ucpu/ctpu:.2f} "
               f"kernels_compiled={stats1['misses'] - stats0['misses']} "
+              f"compile_s={query_compile[name]['compile_seconds']:.1f} "
+              f"cold_vs_cached={ctpu/tpu_time:.2f} "
               f"fused_programs={len(fusion._FUSED_CACHE)} "
               f"(warmup+compile {time.perf_counter()-t0:.0f}s)",
               file=sys.stderr)
@@ -341,12 +365,15 @@ def run_suite(budget_s=DEFAULT_BUDGET_S,
     # Compile-once layer counters (docs/compile-cache.md): how many fused
     # programs exist, how many AOT executables warm-up built, and how the
     # steady-state dispatches split between the AOT table and jit.
-    from spark_rapids_tpu.compile import executables as _executables
+    from spark_rapids_tpu.compile import budget as _compile_budget
     from spark_rapids_tpu.compile import warmup as _compile_warmup
     _aot = _executables.stats()
     print(f"[bench] compile-once: programs={_aot['programs']} "
           f"aot_executables={_aot['aot_executables']} "
           f"aot_hits={_aot['aot_hits']} jit_calls={_aot['jit_calls']} "
+          f"fused_compiles={_aot['jit_compiles']} "
+          f"compile_seconds={_aot['compile_seconds']:.1f} "
+          f"budget={_compile_budget.stats()} "
           f"warmup={_compile_warmup.stats()}", file=sys.stderr)
 
     if not tpu_times:
@@ -354,6 +381,7 @@ def run_suite(budget_s=DEFAULT_BUDGET_S,
             "metric": "tpch_tpcxbb_geomean_device_time",
             "value": 0.0, "unit": "ms", "vs_baseline": 0.0,
             "skipped": skipped,
+            "queries": query_compile,
             "error": "every query skipped by the wall-clock budget",
             **diag,
         }
@@ -373,6 +401,19 @@ def run_suite(budget_s=DEFAULT_BUDGET_S,
         "vs_baseline": round(geo_r, 3),
         "uncached_vs_baseline": round(_geo(uncached_ratios), 3),
         "cold_vs_baseline": round(_geo(cold_ratios), 3),
+        # Per-query compile breakdown + suite compile totals (ISSUE 6):
+        # the machine-readable compile win curve.
+        "queries": query_compile,
+        "compile": {
+            "fused_programs": _aot["programs"],
+            "fused_compiles": _aot["jit_compiles"],
+            "compile_seconds": round(_aot["compile_seconds"], 1),
+            "executables_reused": _aot["aot_hits"] + _aot["jit_calls"]
+            - _aot["jit_compiles"],
+            "cold_vs_cached_geomean": round(_geo(
+                [q["cold_vs_cached_ratio"] for q in query_compile.values()
+                 if q.get("cold_vs_cached_ratio", 0) > 0] or [1.0]), 3),
+        },
         **diag,
     }
     if skipped:

@@ -49,6 +49,7 @@ def configure(conf) -> dict:
 
     Returns a status dict (ladder + persistent-cache state) for
     diagnostics."""
+    from . import budget as _budget
     from . import persist as _persist
     from . import warmup as _warmup
     ladder = _ladder_from_conf(conf)
@@ -67,6 +68,7 @@ def configure(conf) -> dict:
     set_ladder(ladder)
     cache_status = _persist.configure(conf)
     _warmup.configure(conf)
+    _budget.configure(conf)
     return {"ladder": ladder, "persistent_cache": dict(cache_status)}
 
 
@@ -78,11 +80,13 @@ def _programs_exist() -> bool:
 
 
 def _ladder_from_conf(conf) -> BucketLadder:
-    from ..config import (TPU_CAPACITY_BUCKETING, TPU_LADDER_GROWTH,
-                          TPU_LADDER_MAX_CAPACITY, TPU_MIN_CAPACITY)
+    from ..config import (POLYMORPHIC_TIER_GROWTH, TPU_CAPACITY_BUCKETING,
+                          TPU_LADDER_GROWTH, TPU_LADDER_MAX_CAPACITY,
+                          TPU_MIN_CAPACITY)
     return BucketLadder(
         min_capacity=conf.get(TPU_MIN_CAPACITY),
         growth=conf.get(TPU_LADDER_GROWTH),
         max_capacity=conf.get(TPU_LADDER_MAX_CAPACITY),
         enabled=conf.get(TPU_CAPACITY_BUCKETING),
+        tier_growth=conf.get(POLYMORPHIC_TIER_GROWTH),
     )

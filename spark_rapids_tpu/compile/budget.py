@@ -1,0 +1,143 @@
+"""Compile-cost budget — feeds observed compile time back into fusion.
+
+The fusion planner's default is maximal: inline every join into ONE
+whole-stage program. That is the right call when compiles are cheap, and
+catastrophically wrong on slow remote-compile backends, where a
+many-join fused region (TPC-H q3: 18 kernels; bb_q01) can spend minutes
+in XLA while the query itself runs in milliseconds. This module closes
+the loop: the fused dispatch path reports how long each region's first
+compile actually took (:func:`note_compile`), and when a region blows
+``spark.rapids.tpu.fusion.compileBudgetSecs`` the plan's **split level**
+escalates so the NEXT build of the same plan splits the region at its
+most expensive boundary:
+
+* level 0 — inline everything the conf allows (the default planner).
+* level 1 — demote the single largest inlined join (by inline subtree
+  size) to a fusion boundary: the region splits roughly in half, each
+  half a separately cached compile.
+* level 2 — demote every join (the ``fusion.inlineJoins=false`` shape):
+  per-join kernels amortize across queries on their own.
+
+Levels are remembered per plan hash for the process and persisted in the
+compile manifest (:mod:`.persist`) when the cache is on, so a restarted
+process splits the historically expensive plans from the first build —
+"historically blew the budget" genuinely means history, not this
+process's first painful compile repeated every morning.
+
+Splitting never changes results (a demoted join just runs on the eager
+boundary path that ``fusion.inlineJoins=false`` already exercises); it
+only trades fused-region size against compile cost.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict
+
+from . import persist
+
+_LOG = logging.getLogger(__name__)
+
+#: Escalation ceiling: past "every join is a boundary" there is nothing
+#: coarser to split (scans/windows/shuffles are already boundaries).
+MAX_SPLIT_LEVEL = 2
+
+_LOCK = threading.Lock()
+_BUDGET_SECS = 120.0
+_LEVELS: Dict[str, int] = {}
+_SECONDS: Dict[str, float] = {}
+_STATS = {"compiles_noted": 0, "splits_escalated": 0}
+
+#: Bound the in-memory maps like the manifest bounds its index.
+_MAX_PLANS = 512
+
+
+def configure(conf) -> None:
+    """Apply the conf's budget key to the process (idempotent)."""
+    global _BUDGET_SECS
+    from ..config import FUSION_COMPILE_BUDGET_SECS
+    with _LOCK:
+        _BUDGET_SECS = float(conf.get(FUSION_COMPILE_BUDGET_SECS))
+
+
+def has_levels() -> bool:
+    """True when ANY plan has an escalated split level (in memory or in
+    the manifest) — the fused dispatch path's fast-path check, so the
+    common no-escalations process never pays a plan hash per dispatch."""
+    with _LOCK:
+        if _LEVELS:
+            return True
+    m = persist.manifest()
+    return m is not None and m.has_split_levels()
+
+
+def split_level(plan_hash: str) -> int:
+    """The fusion split level for ``plan_hash`` — in-memory history
+    first, then the compile manifest (a restarted process inherits the
+    previous one's escalations). Only ESCALATED levels are cached:
+    caching level-0 misses would let a later-configured manifest be
+    shadowed forever and could evict genuine escalations from the
+    bounded map."""
+    with _LOCK:
+        lvl = _LEVELS.get(plan_hash)
+    if lvl is not None:
+        return lvl
+    m = persist.manifest()
+    lvl = m.split_level(plan_hash) if m is not None else 0
+    if lvl:
+        with _LOCK:
+            while len(_LEVELS) >= _MAX_PLANS:
+                _LEVELS.pop(next(iter(_LEVELS)))
+            lvl = _LEVELS.setdefault(plan_hash, lvl)
+    return lvl
+
+
+def note_compile(plan_hash: str, seconds: float, level: int) -> None:
+    """Record one fused-region compile observed at ``level``; escalate
+    the plan's split level when it blew the budget. Called from the
+    fused dispatch path only for dispatches that actually compiled."""
+    with _LOCK:
+        _STATS["compiles_noted"] += 1
+        _SECONDS[plan_hash] = _SECONDS.get(plan_hash, 0.0) + float(seconds)
+        while len(_SECONDS) > _MAX_PLANS:
+            _SECONDS.pop(next(iter(_SECONDS)))
+        escalate = (_BUDGET_SECS > 0 and seconds > _BUDGET_SECS
+                    and level >= _LEVELS.get(plan_hash, 0)
+                    and level < MAX_SPLIT_LEVEL)
+        if escalate:
+            _LEVELS[plan_hash] = level + 1
+            _STATS["splits_escalated"] += 1
+    if not escalate:
+        return
+    _LOG.info(
+        "fused region for plan %s compiled in %.1fs (budget %.0fs); "
+        "future builds split the region at level %d (%s)",
+        plan_hash, seconds, _BUDGET_SECS, level + 1,
+        "largest join demoted to a boundary" if level + 1 == 1
+        else "every join demoted to a boundary")
+    m = persist.manifest()
+    if m is not None:
+        m.record_split_level(plan_hash, level + 1)
+
+
+def stats() -> dict:
+    with _LOCK:
+        return {
+            "budget_secs": _BUDGET_SECS,
+            "plans_tracked": len(_SECONDS),
+            "compile_seconds_total": round(sum(_SECONDS.values()), 3),
+            "splits_escalated": _STATS["splits_escalated"],
+            "compiles_noted": _STATS["compiles_noted"],
+            "split_levels": {h: lvl for h, lvl in _LEVELS.items() if lvl},
+        }
+
+
+def reset_for_tests() -> None:
+    global _BUDGET_SECS
+    with _LOCK:
+        _BUDGET_SECS = 120.0
+        _LEVELS.clear()
+        _SECONDS.clear()
+        for k in _STATS:
+            _STATS[k] = 0

@@ -20,8 +20,9 @@ fallback for shapes nobody warmed.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
-from typing import Dict, Tuple
+from typing import Dict, Set, Tuple
 
 import jax
 
@@ -61,9 +62,15 @@ class FusedProgram:
         self.fn = fn
         self.label = label
         self._aot: Dict[tuple, object] = {}
+        #: Aval signatures the jit path has already traced+compiled —
+        #: how dispatch knows a call is a reuse, not a fresh compile
+        #: (the polymorphic compile counters and the fusion compile-cost
+        #: budget both key off this).
+        self._jit_seen: Set[tuple] = set()
         self._lock = threading.Lock()
         self._stats = {"aot_hits": 0, "aot_call_errors": 0, "jit_calls": 0,
-                       "aot_compiles": 0}
+                       "aot_compiles": 0, "jit_compiles": 0,
+                       "compile_seconds": 0.0}
         _REGISTRY.add(self)
 
     def __call__(self, *args):
@@ -73,14 +80,46 @@ class FusedProgram:
         if exe is not None:
             try:
                 out = exe(*args)
-                self._stats["aot_hits"] += 1
+                with self._lock:
+                    self._stats["aot_hits"] += 1
                 return out
             except (TypeError, ValueError):
                 # Aval subtleties the signature cannot see (weak types,
                 # commitments): the jit path below is always correct.
-                self._stats["aot_call_errors"] += 1
-        self._stats["jit_calls"] += 1
-        return self.fn(*args)
+                with self._lock:
+                    self._stats["aot_call_errors"] += 1
+        with self._lock:
+            new_shape = key not in self._jit_seen
+        t0 = time.perf_counter()
+        out = self.fn(*args)
+        with self._lock:
+            self._stats["jit_calls"] += 1
+            if new_shape and key not in self._jit_seen:
+                # First call at this signature paid trace+compile (the
+                # execution itself dispatches async and is not waited on
+                # here, so the wall time is ~all compile).
+                self._jit_seen.add(key)
+                self._stats["jit_compiles"] += 1
+                self._stats["compile_seconds"] += time.perf_counter() - t0
+        return out
+
+    def seen(self, *args) -> bool:
+        """True when dispatching ``args`` cannot trigger a fresh XLA
+        compile: the aval signature is in the AOT table or has already
+        gone down the jit path."""
+        key = aval_signature(args)
+        with self._lock:
+            return key in self._aot or key in self._jit_seen
+
+    def jit_compiled(self, *args) -> bool:
+        """True when the jit path has compiled EXACTLY this signature.
+        Checked before/after a dispatch it attributes a compile to the
+        key that actually paid it — immune to concurrent compiles of
+        other signatures on the same program, and it still catches the
+        rare AOT-table fall-through that :meth:`seen` cannot."""
+        key = aval_signature(args)
+        with self._lock:
+            return key in self._jit_seen
 
     def compile_abstract(self, args: Tuple) -> str:
         """AOT-compile for the given (possibly abstract) argument tuple.
@@ -110,13 +149,20 @@ class FusedProgram:
 
 
 def stats() -> dict:
-    """Aggregate dispatch/warm-up counters over every live program."""
+    """Aggregate dispatch/warm-up counters over every live program.
+    ``jit_compiles`` counts distinct aval signatures actually compiled
+    through jit; ``jit_calls - jit_compiles + aot_hits`` is therefore
+    the number of dispatches an already-built executable served — the
+    polymorphic reuse the compile layer exists to maximize."""
     total = {"programs": 0, "aot_executables": 0, "aot_hits": 0,
-             "aot_call_errors": 0, "jit_calls": 0, "aot_compiles": 0}
+             "aot_call_errors": 0, "jit_calls": 0, "aot_compiles": 0,
+             "jit_compiles": 0, "compile_seconds": 0.0}
     for prog in list(_REGISTRY):
         s = prog.stats()
         total["programs"] += 1
         for k in ("aot_executables", "aot_hits", "aot_call_errors",
-                  "jit_calls", "aot_compiles"):
+                  "jit_calls", "aot_compiles", "jit_compiles",
+                  "compile_seconds"):
             total[k] += s[k]
+    total["compile_seconds"] = round(total["compile_seconds"], 6)
     return total

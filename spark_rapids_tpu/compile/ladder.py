@@ -21,6 +21,15 @@ makes that policy an object:
 * ``enabled=False`` degrades to bare lane alignment — one program per
   distinct 128-row count. Only sensible for debugging compile-cache
   behavior; the conf key existed since the seed and now actually works.
+* ``tier_growth`` spaces the POLYMORPHIC TIER ladder — the coarse
+  sub-ladder the shape-polymorphic fused path (``exec/fusion.py``) pads
+  boundary inputs onto, so one compiled executable serves every bucket
+  rung inside a tier. ``tier()`` maps a capacity to its tier; tiers are
+  always bucket rungs themselves, so the mapping is idempotent for any
+  ``growth``. 4.0 (default) bounds padding waste at 4x while merging
+  every ~2 power-of-two rungs into one executable; 16.0 merges 4 rungs
+  per executable (right for compile-dominated remote backends) at up to
+  16x padding. See docs/compile-cache.md.
 
 Rungs are always multiples of the 8x128 VPU lane layout. The ladder is
 process-global (``get_ladder``/``set_ladder``) because capacities bake into
@@ -51,11 +60,15 @@ class BucketLadder:
     growth: float = 2.0
     max_capacity: int = 0  # 0 = unbounded ladder
     enabled: bool = True
+    tier_growth: float = 4.0  # polymorphic tier spacing (see class doc)
 
     def __post_init__(self):
         if self.growth < 1.125:
             raise ValueError(f"ladder growth {self.growth} must be >= 1.125 "
                              "(below that rungs collapse to lane steps)")
+        if self.tier_growth < 1.125:
+            raise ValueError(f"tier growth {self.tier_growth} must be >= "
+                             "1.125 (below that tiers collapse to rungs)")
         if self.min_capacity < 1:
             raise ValueError("min_capacity must be positive")
 
@@ -128,6 +141,55 @@ class BucketLadder:
         while cap < hi:
             cap = self._next(cap)
             out.append(cap)
+        return out
+
+    def tier(self, n: int, min_capacity: int = LANE) -> int:
+        """The polymorphic capacity tier containing ``n``: the smallest
+        rung of the coarse tier ladder (``tier_growth`` spacing, anchored
+        at the base) that is >= ``bucket(n)``. Tier values are always
+        bucket rungs, so ``tier(tier(n)) == tier(n)`` for any growth.
+
+        The shape-polymorphic fused path pads boundary inputs up to their
+        tier, collapsing every rung inside it onto ONE executable. Above
+        the ladder top (``max_capacity``) dispatch already uses exact
+        lane-aligned fits, so no tiering applies there; with bucketing
+        disabled the tier degrades to the bare aligned fit too."""
+        cap = self.bucket(n, min_capacity)
+        if not self.enabled:
+            return cap
+        if self.max_capacity > 0:
+            top = self.bucket(self.max_capacity)
+            if cap >= top:
+                return cap
+        t = self.base
+        while t < cap:
+            # bucket() snaps the geometric tier point onto a real rung,
+            # which is what keeps the mapping idempotent for growths
+            # that are not integer powers of each other.
+            t = self.bucket(max(_align_up(t * self.tier_growth), t + LANE))
+        if self.max_capacity > 0:
+            t = min(t, self.bucket(self.max_capacity))
+        return t
+
+    def tiers(self, lo: int, hi: int) -> List[int]:
+        """Every polymorphic tier covering ``[lo, hi]``, ascending
+        (tools/bake_executables.py enumerates the corpus with this).
+        Above a configured ladder top there are no tiers — dispatch uses
+        exact lane-aligned fits there — so enumeration stops at the top
+        rung instead of degenerating to one entry per lane step; with
+        bucketing disabled no tier ladder exists at all, so only the
+        endpoints are returned."""
+        if not self.enabled:
+            lo_t, hi_t = self.tier(lo), self.tier(max(hi, lo))
+            return [lo_t] if hi_t <= lo_t else [lo_t, hi_t]
+        if self.max_capacity > 0:
+            hi = min(hi, self.bucket(self.max_capacity))
+        out = [cap := self.tier(lo)]
+        while cap < hi:
+            nxt = self.tier(cap + LANE)
+            if nxt <= cap:
+                break
+            out.append(cap := nxt)
         return out
 
 

@@ -29,7 +29,7 @@ import hashlib
 import json
 import os
 import threading
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 _LOCK = threading.Lock()
 _STATUS: Dict[str, object] = {"enabled": False, "reason": "not configured"}
@@ -185,6 +185,9 @@ class CompileManifest:
         self.path = path
         self._lock = threading.Lock()
         self._plans: Dict[str, List[tuple]] = {}
+        #: plan hash -> fusion split level (compile/budget.py): plans
+        #: whose fused region historically blew the compile budget.
+        self._levels: Dict[str, int] = {}
         self._load()
 
     def _load(self) -> None:
@@ -193,8 +196,11 @@ class CompileManifest:
                 data = json.load(f)
             for h, vecs in data.get("plans", {}).items():
                 self._plans[str(h)] = [_to_hashable(v) for v in vecs]
+            for h, lvl in data.get("split_levels", {}).items():
+                self._levels[str(h)] = int(lvl)
         except (OSError, ValueError):
             self._plans = {}
+            self._levels = {}
 
     def record(self, plan_hash_: str, cap_vector: tuple) -> bool:
         """Remember that ``plan_hash_`` ran with ``cap_vector``. Returns
@@ -210,18 +216,59 @@ class CompileManifest:
             self._flush_locked()
             return True
 
-    def vectors_for(self, plan_hash_: str) -> List[tuple]:
+    def vectors_for(self, plan_hash_: str,
+                    canonicalize: Optional[Callable] = None) -> List[tuple]:
+        """Recorded capacity vectors for a plan. With ``canonicalize``
+        (the polymorphic tier mapper — warmup.py passes capacity->tier),
+        vectors are mapped through it and DEDUPED post-map: a manifest
+        written by per-rung processes holds one vector per rung, and
+        replaying those raw would recompile the same polymorphic
+        executable once per recorded rung on every restart."""
         with self._lock:
-            return list(self._plans.get(plan_hash_, []))
+            vecs = list(self._plans.get(plan_hash_, []))
+        if canonicalize is None:
+            return vecs
+        out: List[tuple] = []
+        seen = set()
+        for v in vecs:
+            cv = canonicalize(v)
+            if cv not in seen:
+                seen.add(cv)
+                out.append(cv)
+        return out
+
+    def split_level(self, plan_hash_: str) -> int:
+        """Fusion split level recorded for a plan (compile/budget.py)."""
+        with self._lock:
+            return int(self._levels.get(plan_hash_, 0))
+
+    def has_split_levels(self) -> bool:
+        with self._lock:
+            return bool(self._levels)
+
+    def record_split_level(self, plan_hash_: str, level: int) -> None:
+        """Remember that ``plan_hash_``'s fused region blew the compile
+        budget and future builds should split at ``level``."""
+        with self._lock:
+            if self._levels.get(plan_hash_) == int(level):
+                return
+            self._levels[plan_hash_] = int(level)
+            while len(self._levels) > _MAX_PLANS:
+                self._levels.pop(next(iter(self._levels)))
+            self._flush_locked()
 
     def _flush_locked(self) -> None:
         data = {
             "comment": "Compile manifest: capacity vectors each plan "
                        "signature has executed with; warm-up replays "
-                       "them after restart (docs/compile-cache.md).",
+                       "them after restart (docs/compile-cache.md). "
+                       "split_levels records plans whose fused region "
+                       "blew the compile budget (compile/budget.py).",
             "plans": {h: [_to_jsonable(v) for v in vecs]
                       for h, vecs in self._plans.items()},
         }
+        if self._levels:
+            data["split_levels"] = dict(self._levels)
         tmp = self.path + ".tmp"
         try:
             with open(tmp, "w", encoding="utf-8") as f:

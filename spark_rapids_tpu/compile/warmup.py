@@ -34,6 +34,7 @@ step; a miss there costs one ordinary jit compile, nothing more.
 from __future__ import annotations
 
 import atexit
+import logging
 import threading
 import time
 from collections import deque
@@ -45,6 +46,8 @@ from . import persist
 from .executables import FusedProgram, abstract_like
 from .ladder import get_ladder
 
+_LOG = logging.getLogger(__name__)
+
 _CV = threading.Condition()
 _QUEUE: deque = deque()
 _WORKER: Optional[threading.Thread] = None
@@ -52,7 +55,8 @@ _INFLIGHT = 0
 _AUTO = False
 _AHEAD = 1
 _BEHIND = 0
-_STATS = {"scheduled": 0, "compiled": 0, "already_cached": 0, "errors": 0}
+_STATS = {"scheduled": 0, "compiled": 0, "already_cached": 0, "errors": 0,
+          "skipped_covered": 0}
 
 #: Worker exits after this long with nothing to do; it restarts on demand.
 _IDLE_EXIT_SECS = 60.0
@@ -133,13 +137,21 @@ def _rebucket_batch(batch, new_cap: int):
     return jax.tree_util.tree_map(leaf, batch)
 
 
-def note_run(program: FusedProgram, plan_sig: tuple, inputs) -> None:
+def note_run(program: FusedProgram, plan_sig: tuple, inputs,
+             polymorphic: bool = False) -> None:
     """Post-dispatch hook from the fused execution path: record the run's
     capacity vector in the compile manifest and schedule background AOT
     warm-ups. Called between program dispatch and the result download so
     scheduling overlaps the transfer; near-free when both the persistent
     cache and auto warm-up are off (``plan_sig`` is hashed only past the
-    early exit)."""
+    early exit).
+
+    With ``polymorphic`` (the caller dispatched tier-padded inputs),
+    every candidate rung is canonicalized through the ladder's tier
+    mapping first: a neighbor or manifest rung inside an already-running
+    tier CANNOT miss — its dispatch pads onto this very executable — so
+    warming it would only burn the compile thread. Skips are counted
+    (``skipped_covered``) and logged at DEBUG."""
     m = persist.manifest()
     with _CV:
         auto = _AUTO
@@ -147,18 +159,36 @@ def note_run(program: FusedProgram, plan_sig: tuple, inputs) -> None:
         return
     plan_hash_ = persist.plan_hash(plan_sig)
     vec = capacity_vector(inputs)
+    ladder = get_ladder()
+    canon = (lambda v: _map_vec(v, ladder.tier)) if polymorphic else None
     recorded: List[tuple] = []
     if m is not None:
-        recorded = m.vectors_for(plan_hash_)
+        recorded = m.vectors_for(plan_hash_, canonicalize=canon)
         m.record(plan_hash_, vec)
     if not auto or _SHUTDOWN:
         return
     seen = {vec}
     targets = []
+    skipped = 0
     for v in _neighbor_vectors(vec) + recorded:
-        if v not in seen:
-            seen.add(v)
-            targets.append(v)
+        cv = canon(v) if canon is not None else v
+        if cv in seen:
+            # Count only genuine tier collapses (the raw rung differed
+            # from its tier): a vector that was already a duplicate
+            # pre-canonicalization — e.g. the plan's own recorded tier
+            # on every steady-state dispatch — is not a skipped warm-up.
+            if cv != v:
+                skipped += 1
+            continue
+        seen.add(cv)
+        targets.append(cv)
+    if skipped:
+        with _CV:
+            _STATS["skipped_covered"] += skipped
+        _LOG.debug(
+            "plan %s: skipped %d neighbor/manifest rung warm-up(s) already "
+            "covered by the polymorphic tier executable", plan_hash_,
+            skipped)
     if not targets:
         return
     template = abstract_like(inputs)

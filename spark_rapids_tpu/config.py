@@ -412,6 +412,39 @@ TPU_LADDER_MAX_CAPACITY = conf_int(
     "fit instead of the next geometric rung, bounding padded HBM waste "
     "for huge batches. 0 = unbounded.")
 
+POLYMORPHIC_ENABLED = conf_bool(
+    "spark.rapids.tpu.polymorphic.enabled", True,
+    "Shape-polymorphic fused executables: pad a fused program's boundary "
+    "inputs up to coarse capacity TIERS (see polymorphic.tierGrowth) "
+    "before dispatch, so ONE compiled XLA executable serves every "
+    "bucket-ladder rung inside a tier instead of re-specializing per "
+    "rung — O(kernels) compiles instead of O(rungs x kernels). Row "
+    "counts stay dynamic scalar operands (the live-mask invariant makes "
+    "padded rows dead), so results are bit-identical to the per-rung "
+    "path, which remains available as the oracle by disabling this key. "
+    "See docs/compile-cache.md.")
+
+POLYMORPHIC_TIER_GROWTH = conf_float(
+    "spark.rapids.tpu.polymorphic.tierGrowth", 4.0,
+    "Geometric spacing of the polymorphic capacity tiers, anchored at "
+    "the bucket-ladder base. 4.0 bounds padded HBM/compute waste at 4x "
+    "while merging ~2 power-of-two rungs per executable; 16.0 merges 4 "
+    "rungs per executable (one compile per 16x of data growth — right "
+    "for slow remote-compile backends where compile time dominates) at "
+    "up to 16x padding. Tiers always land on bucket-ladder rungs. See "
+    "docs/tuning-guide.md for the padding-waste vs compile-count "
+    "tradeoff.")
+
+FUSION_COMPILE_BUDGET_SECS = conf_float(
+    "spark.rapids.tpu.fusion.compileBudgetSecs", 120.0,
+    "Compile-cost budget for one fused region: when compiling a fused "
+    "program takes longer than this (measured at first dispatch, "
+    "recorded per plan in the compile manifest), future builds of the "
+    "same plan SPLIT the fusion region at its most expensive boundary — "
+    "first the largest inlined join, then every join — trading one "
+    "giant compile for smaller cacheable ones (the q3/bb_q01 class of "
+    "compile blowups). 0 disables splitting. See docs/compile-cache.md.")
+
 COMPILE_CACHE_ENABLED = conf_bool(
     "spark.rapids.tpu.compileCache.enabled", False,
     "Persist XLA executables to disk (JAX persistent compilation cache) "
@@ -633,6 +666,14 @@ class TpuConf:
     @property
     def fusion_inline_joins(self) -> bool:
         return self.get(TPU_FUSION_INLINE_JOINS)
+
+    @property
+    def polymorphic_enabled(self) -> bool:
+        return self.get(POLYMORPHIC_ENABLED)
+
+    @property
+    def fusion_compile_budget_secs(self) -> float:
+        return self.get(FUSION_COMPILE_BUDGET_SECS)
 
     @property
     def mesh_enabled(self) -> bool:

@@ -159,6 +159,20 @@ def _shrink_batch(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
                          batch.n_rows, batch.schema)
 
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def _grow_batch(batch: ColumnarBatch, cap: int) -> ColumnarBatch:
+    """Copy a batch into a LARGER capacity bucket, padding every column
+    with dead rows (validity False, zero data — the padding-never-
+    changes-results invariant) and extending any lazy live mask with
+    False. The shape-polymorphic fused path (exec/fusion.py) pads
+    boundary inputs onto coarse capacity tiers with this, so one
+    compiled executable serves every bucket-ladder rung in a tier."""
+    live = None if batch.live is None else \
+        jnp.pad(batch.live, (0, cap - batch.live.shape[0]))
+    return ColumnarBatch(tuple(c.grow(cap) for c in batch.columns),
+                         batch.n_rows, batch.schema, live=live)
+
+
 @dataclasses.dataclass
 class HostBatch:
     """Host-side batch: the CPU oracle / fallback path currency."""

@@ -379,6 +379,40 @@ class DeviceColumn:
                                 self.offsets[: cap + 1], self.max_bytes)
         return DeviceColumn(self.data[:cap], self.validity[:cap], self.dtype)
 
+    def grow(self, cap: int) -> "DeviceColumn":
+        """Pad to a LARGER capacity with dead rows — the inverse of
+        :meth:`head`. Padding preserves the core invariant (rows at
+        index >= n_rows have validity False and zero data; flat-string
+        offsets clamp to the end), so growing a batch never changes
+        results. The shape-polymorphic fused path (exec/fusion.py) uses
+        this to canonicalize boundary inputs onto coarse capacity tiers.
+        Traceable: safe inside jit."""
+        old = self.capacity
+        if cap == old:
+            return self
+        assert cap > old, (cap, old)
+        pad = cap - old
+        validity = jnp.pad(self.validity, (0, pad))
+        if self.is_struct:
+            return DeviceColumn(
+                data=None, validity=validity, dtype=self.dtype,
+                children=tuple(c.grow(cap) for c in self.children))
+        if self.is_array:
+            return DeviceColumn(
+                data=jnp.pad(self.data, ((0, pad), (0, 0))),
+                validity=validity, dtype=self.dtype,
+                elem_validity=jnp.pad(self.elem_validity, ((0, pad), (0, 0))),
+                lengths=jnp.pad(self.lengths, (0, pad)))
+        if self.is_dict:
+            return self.replace_rows(validity,
+                                     codes=jnp.pad(self.codes, (0, pad)))
+        if self.is_string:
+            return DeviceColumn(self.data, validity, self.dtype,
+                                jnp.pad(self.offsets, (0, pad), mode="edge"),
+                                self.max_bytes)
+        return DeviceColumn(jnp.pad(self.data, (0, pad)), validity,
+                            self.dtype)
+
     def replace_rows(self, validity, data=None, codes=None) -> "DeviceColumn":
         """Same column with row-level arrays swapped (dict buffers kept)."""
         return DeviceColumn(

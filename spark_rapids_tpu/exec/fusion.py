@@ -21,7 +21,16 @@ Contract:
 * The fused callable is cached per structural plan signature (expression
   trees, schemas, static params — the :mod:`..utils.kernel_cache`
   discipline); ``jax.jit`` re-specializes per input capacity bucket through
-  the pytree avals, so re-running a query never recompiles.
+  the pytree avals, so re-running a query never recompiles. With
+  ``spark.rapids.tpu.polymorphic.enabled`` (default) boundary inputs are
+  padded onto coarse capacity TIERS first (compile/ladder.py ``tier()``),
+  so ONE compiled executable serves every ladder rung inside a tier —
+  O(kernels) compiles instead of O(rungs x kernels); the per-rung path
+  (conf off) stays as the bit-identity oracle.
+* Fusion regions split by compile-cost budget: when a region's compile
+  blew ``spark.rapids.tpu.fusion.compileBudgetSecs`` (recorded per plan
+  hash, persisted in the compile manifest), later builds demote the most
+  expensive join(s) to boundaries (compile/budget.py).
 * Results return through ONE ``jax.device_get`` of ``(n_rows, overflow
   flags, guess-shrunk batch)``. If the result had more rows than the guess
   bucket, the full batch (still device-resident) downloads in a second
@@ -40,9 +49,12 @@ import numpy as np
 import pyarrow as pa
 
 from .. import types as T
+from ..compile import budget as _budget
+from ..compile import persist as _persist
 from ..compile import warmup as _warmup
 from ..compile.executables import FusedProgram
-from ..data.batch import ColumnarBatch, _shrink_batch
+from ..compile.ladder import get_ladder
+from ..data.batch import ColumnarBatch, _grow_batch, _shrink_batch
 from ..data.column import bucket_capacity
 from ..plan.physical import ExecContext
 from ..utils.kernel_cache import plan_signature as _plan_sig
@@ -110,17 +122,21 @@ def _is_boundary(p, inline=None) -> bool:
     return bool(getattr(p, "columnar", False))
 
 
-def _split(plan, boundaries: List, inline=None) -> TpuExec:
+def _split(plan, boundaries: List, inline=None,
+           demote: frozenset = frozenset()) -> TpuExec:
     """Rebuild the device subtree with every boundary subtree replaced by a
     :class:`FusedInputExec` leaf; boundary nodes append to ``boundaries`` in
-    deterministic traversal order (the fused program's argument order)."""
+    deterministic traversal order (the fused program's argument order).
+    Nodes in ``demote`` (by identity — the compile-cost budget's split
+    decision, :func:`_budget_split`) become boundaries even though they
+    are inlineable."""
     inline = inline or _INLINE
-    if _is_boundary(plan, inline):
+    if id(plan) in demote or _is_boundary(plan, inline):
         boundaries.append(plan)
         return FusedInputExec(len(boundaries) - 1, plan.schema)
     if not isinstance(plan, inline):
         raise _NotFusable(type(plan).__name__)
-    kids = [_split(c, boundaries, inline) for c in plan.children]
+    kids = [_split(c, boundaries, inline, demote) for c in plan.children]
     return plan.with_children(kids) if kids else plan
 
 
@@ -147,6 +163,88 @@ _FUSED_CACHE = {}
 
 def clear_fused_cache() -> None:
     _FUSED_CACHE.clear()
+
+
+def _budget_split(device_plan, conf, base_hash: str):
+    """Apply the compile-cost budget's split decision for this plan
+    (compile/budget.py): returns ``(inline types, demoted node ids,
+    level)``. Level 1 demotes the single largest inlined join (by inline
+    subtree size — the region's most expensive boundary candidate, and
+    the cut that best halves the region); level 2 demotes every join."""
+    inline = _conf_inline(conf)
+    level = _budget.split_level(base_hash)
+    if level <= 0 or inline is _INLINE:
+        return inline, frozenset(), level
+    if level >= _budget.MAX_SPLIT_LEVEL:
+        return _INLINE, frozenset(), level
+    from .execs import TpuShuffledHashJoinExec
+    joins: List[list] = []  # [inline subtree size, pre-order slot, id]
+
+    def walk(p) -> int:
+        if _is_boundary(p, inline):
+            return 0
+        slot = None
+        if isinstance(p, TpuShuffledHashJoinExec):
+            slot = len(joins)
+            joins.append([0, slot, id(p)])
+        size = 1 + sum(walk(c) for c in p.children)
+        if slot is not None:
+            joins[slot][0] = size
+        return size
+    walk(device_plan)
+    if not joins:
+        return inline, frozenset(), level
+    joins.sort(key=lambda j: (-j[0], j[1]))
+    return inline, frozenset({joins[0][2]}), level
+
+
+def _has_inline_join(plan) -> bool:
+    """True when the (already split) fused region still inlines a join —
+    i.e. the compile-cost budget has a boundary left to demote."""
+    from .execs import TpuShuffledHashJoinExec
+    if isinstance(plan, TpuShuffledHashJoinExec):
+        return True
+    return any(_has_inline_join(c) for c in plan.children)
+
+
+#: Distinct (input aval signature, tier) pairs the tier padding has
+#: dispatched ``_grow_batch`` for. Each pair is one TINY XLA pad kernel
+#: compiled on first visit of a rung — the O(rungs x boundary-schemas)
+#: residue of tier padding (the fused programs themselves are O(tiers)).
+#: Tracked so the compile-count gate (tests/test_compile_gate.py) can
+#: ratchet it; these kernels bypass utils/kernel_cache, so the
+#: ``kernels_compiled`` counter alone would never see them growing.
+_PAD_PROGRAMS: set = set()
+
+
+def pad_program_count() -> int:
+    return len(_PAD_PROGRAMS)
+
+
+def _pad_inputs_to_tiers(inputs):
+    """Pad every boundary batch up to its polymorphic capacity tier
+    (compile/ladder.py tier()) so the fused program's input avals — and
+    therefore its compiled executable — are shared by every bucket rung
+    inside a tier. Row counts stay dynamic scalar operands; padded rows
+    are dead by the engine invariant, so results are bit-identical to
+    the per-rung path. Returns ``(padded inputs, rows of padding)``."""
+    from ..compile.executables import aval_signature
+    ladder = get_ladder()
+    pad_rows = 0
+
+    def rec(x):
+        nonlocal pad_rows
+        if isinstance(x, tuple):
+            return tuple(rec(v) for v in x)
+        if not x.columns:
+            return x
+        tier = ladder.tier(x.capacity)
+        if tier <= x.capacity:
+            return x
+        pad_rows += tier - x.capacity
+        _PAD_PROGRAMS.add((aval_signature((x,)), tier))
+        return _grow_batch(x, tier)
+    return rec(inputs), pad_rows
 
 
 def _build_fused(fused_plan, conf, join_growth: float, guess_rows: int,
@@ -198,8 +296,23 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     deferred overflow check tripped and the caller must retry with the
     learned exact join capacities (``ctx.join_caps``)."""
     device_plan = root.children[0]
+    # Compile-cost budget (compile/budget.py): a plan whose fused region
+    # historically blew the budget builds SPLIT — the most expensive
+    # join(s) demoted to boundaries — trading one giant compile for
+    # smaller cacheable ones. The base hash is the pre-split signature,
+    # so history accumulates across split levels; it is computed lazily
+    # (an extra full-tree signature walk) only when some plan actually
+    # escalated or when this dispatch is about to compile.
+    budget_secs = ctx.conf.fusion_compile_budget_secs \
+        if ctx.conf is not None else 0.0
+    base_hash = None
+    inline, demote, level = _conf_inline(ctx.conf), frozenset(), 0
+    if budget_secs > 0 and _budget.has_levels():
+        base_hash = _persist.plan_hash(_plan_sig(device_plan))
+        inline, demote, level = _budget_split(device_plan, ctx.conf,
+                                              base_hash)
     boundaries: List = []
-    fused_plan = _split(device_plan, boundaries, _conf_inline(ctx.conf))
+    fused_plan = _split(device_plan, boundaries, inline, demote)
     guess_rows = ctx.conf.collect_guess_rows
     caps = tuple(sorted(ctx.join_caps.items())) if ctx.join_caps else ()
     sig = (_plan_sig(fused_plan), float(ctx.join_growth), guess_rows, caps,
@@ -223,13 +336,38 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     from . import pipeline as _pipeline
     inputs = _pipeline.materialize_boundaries(boundaries, ctx)
     reg = ctx.registry
+    # Shape polymorphism (spark.rapids.tpu.polymorphic.enabled): pad the
+    # boundary inputs onto coarse capacity tiers so one executable serves
+    # every ladder rung in a tier. The unpadded per-rung path (conf off)
+    # is the bit-identity oracle.
+    polymorphic = ctx.conf is not None and ctx.conf.polymorphic_enabled
+    if polymorphic:
+        inputs, pad_rows = _pad_inputs_to_tiers(inputs)
+        if pad_rows and reg.enabled:
+            reg.add("WholeStageFusion", "polymorphicPadRows", pad_rows)
+    key_compiled_before = fn.jit_compiled(inputs)
     import time as _time
     t_dispatch = _time.perf_counter_ns()
     head, full = fn(inputs)
+    if budget_secs > 0 and not key_compiled_before \
+            and fn.jit_compiled(inputs):
+        # THIS key's dispatch paid trace+compile (per-key, so a
+        # concurrent thread compiling another signature on the same
+        # program cannot misattribute; and unlike seen() it catches the
+        # rare AOT-table fall-through): feed the observed cost back
+        # into the budget so chronically expensive regions split. A
+        # region with no inlined join left has nothing to demote —
+        # report at the ceiling so the level cannot escalate uselessly.
+        if base_hash is None:
+            base_hash = _persist.plan_hash(_plan_sig(device_plan))
+        _budget.note_compile(base_hash,
+                             (_time.perf_counter_ns() - t_dispatch) / 1e9,
+                             level if _has_inline_join(fused_plan)
+                             else _budget.MAX_SPLIT_LEVEL)
     # Between dispatch and download: record this run's capacity rungs in
     # the compile manifest and schedule neighbor-rung AOT warm-ups, so the
     # scheduling work overlaps the device->host transfer below.
-    _warmup.note_run(fn, sig, inputs)
+    _warmup.note_run(fn, sig, inputs, polymorphic=polymorphic)
     if reg.device_timing:
         # Device-time attribution (spark.rapids.tpu.metrics.deviceTiming):
         # fence the fused dispatch so dispatch->ready is measurable. The

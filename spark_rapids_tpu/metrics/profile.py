@@ -175,6 +175,7 @@ class QueryProfiler:
         spill = dm.catalog.metrics
         kc = _kc.cache_stats()
         exe = _exe.stats()
+        warm = _warmup.stats()
         engine = {
             "semaphoreWaitNs": dm.semaphore.wait_ns - self._sem0,
             "spillBytes":
@@ -197,8 +198,21 @@ class QueryProfiler:
                 "aotExecutables": exe.get("aot_executables", 0),
                 "aotHits": _delta(exe, self._exe0, "aot_hits"),
                 "jitCalls": _delta(exe, self._exe0, "jit_calls"),
-                "warmupCompiled": _delta(_warmup.stats(), self._warm0,
-                                         "compiled"),
+                # Polymorphic-tier counters (ISSUE 6): fused executables
+                # actually compiled this query vs dispatches an existing
+                # executable served (the cross-rung reuse the tier
+                # padding buys), and the compile seconds paid.
+                "fusedCompiles": _delta(exe, self._exe0, "jit_compiles"),
+                "fusedCompileSeconds": round(
+                    float(exe.get("compile_seconds", 0.0))
+                    - float(self._exe0.get("compile_seconds", 0.0)), 3),
+                "executablesReused":
+                    _delta(exe, self._exe0, "aot_hits")
+                    + _delta(exe, self._exe0, "jit_calls")
+                    - _delta(exe, self._exe0, "jit_compiles"),
+                "warmupCompiled": _delta(warm, self._warm0, "compiled"),
+                "warmupSkippedCovered": _delta(warm, self._warm0,
+                                               "skipped_covered"),
             },
         }
         return QueryProfile(

@@ -115,7 +115,7 @@ class TpuSession:
         program dispatch stats, and the operator kernel cache. See
         docs/compile-cache.md."""
         import dataclasses
-        from .compile import executables, ladder, persist, warmup
+        from .compile import budget, executables, ladder, persist, warmup
         from .exec import fusion
         from .utils import kernel_cache
         return {
@@ -124,7 +124,9 @@ class TpuSession:
             "warmup": warmup.stats(),
             "fused_programs": executables.stats(),
             "fused_cache_entries": len(fusion._FUSED_CACHE),
+            "pad_programs": fusion.pad_program_count(),
             "kernel_cache": kernel_cache.cache_stats(),
+            "compile_budget": budget.stats(),
         }
 
     # -- data sources -------------------------------------------------------

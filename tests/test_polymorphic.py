@@ -1,0 +1,483 @@
+"""Shape-polymorphic fused executables (ISSUE 6): one compiled program
+serves every bucket-ladder rung inside a polymorphic tier. Tier mapping,
+dead-row batch growth, one-executable-many-rungs (the acceptance
+criterion, asserted via the compile counters), bit-identity of the
+polymorphic path against the per-rung oracle and the CPU oracle —
+including a rung-boundary crossing mid-query and under PR-4 OOM
+injection where split-in-half changes row counts — the warm-up
+covered-rung skip, manifest tier dedupe, the compile-cost budget's
+region splitting, and the executable bake tool."""
+
+import warnings
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from spark_rapids_tpu.compile import budget, executables, persist, warmup
+from spark_rapids_tpu.compile.ladder import (BucketLadder, get_ladder,
+                                             set_ladder)
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.exec import fusion
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.workloads import tpch
+from spark_rapids_tpu.workloads.compare import tables_match
+
+
+@pytest.fixture(autouse=True)
+def _reset_compile_layer():
+    prev = get_ladder()
+    yield
+    set_ladder(prev)
+    persist.reset_for_tests()
+    warmup.reset_for_tests()
+    budget.reset_for_tests()
+
+
+def _session(**extra):
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.variableFloatAgg.enabled": True}
+    conf.update(extra)
+    # Non-default tier growth reconfigures the process ladder, which
+    # legitimately warns once programs exist; the fixture restores it.
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return TpuSession(conf)
+
+
+def _cpu():
+    return TpuSession({"spark.rapids.sql.enabled": False})
+
+
+class TestTierLadder:
+    def test_tier_contains_bucket_and_is_idempotent(self):
+        lad = BucketLadder(tier_growth=16.0)
+        assert lad.tier(1) == 128
+        assert lad.tier(129) == 2048          # rung 256 -> tier 2048
+        assert lad.tier(2048) == 2048         # tiers are their own tier
+        assert lad.tier(2049) == 32768
+        for n in (1, 100, 300, 513, 2048, 5000, 40000):
+            t = lad.tier(n)
+            assert t >= lad.bucket(n)
+            assert lad.tier(t) == t, n        # idempotent
+
+    def test_tier_idempotent_for_non_power_growth(self):
+        # Tiers snap onto real bucket rungs, so the mapping stays
+        # idempotent even when tier_growth is not a power of growth.
+        lad = BucketLadder(growth=1.5, tier_growth=16.0)
+        for n in (1, 200, 1000, 3000, 30000):
+            t = lad.tier(n)
+            assert lad.tier(t) == t, n
+            assert lad.bucket(t) == t, n      # a genuine rung
+
+    def test_tier_respects_ladder_top(self):
+        lad = BucketLadder(tier_growth=16.0, max_capacity=1024)
+        # Below the top, the tier clamps to the top rung.
+        assert lad.tier(300) == 1024
+        # At/above the top dispatch uses exact fits: no tiering.
+        assert lad.tier(1024) == lad.bucket(1024)
+        assert lad.tier(5000) == lad.bucket(5000)
+
+    def test_tier_disabled_bucketing_degrades(self):
+        lad = BucketLadder(enabled=False)
+        assert lad.tier(300) == lad.bucket(300)
+
+    def test_tiers_enumeration(self):
+        lad = BucketLadder(tier_growth=4.0)
+        assert lad.tiers(128, 1 << 20) == [128, 512, 2048, 8192, 32768,
+                                           131072, 524288, 2097152]
+
+    def test_tier_growth_validated(self):
+        with pytest.raises(ValueError):
+            BucketLadder(tier_growth=1.0)
+
+
+class TestGrowBatch:
+    def _roundtrip(self, rb, grow_to=512):
+        from spark_rapids_tpu.data.batch import ColumnarBatch, _grow_batch
+        b = ColumnarBatch.from_arrow(rb)
+        g = _grow_batch(b, grow_to)
+        assert g.capacity == grow_to
+        assert g.to_arrow() == b.to_arrow()
+        return g
+
+    def test_fixed_width_and_nulls(self):
+        self._roundtrip(pa.RecordBatch.from_pydict({
+            "i": pa.array([1, None, 3], pa.int64()),
+            "d": pa.array([1.5, 2.5, None], pa.float64()),
+            "b": pa.array([True, None, False], pa.bool_()),
+        }))
+
+    def test_strings_dict_encoded(self):
+        self._roundtrip(pa.RecordBatch.from_pydict({
+            "s": pa.array(["aa", None, "bb", "aa"], pa.string()),
+        }))
+
+    def test_flat_strings(self):
+        from spark_rapids_tpu.data.batch import ColumnarBatch, _grow_batch
+        from spark_rapids_tpu.data.column import DeviceColumn
+        from spark_rapids_tpu import types as T
+        import jax.numpy as jnp
+        col = DeviceColumn.string_from_host(
+            np.asarray([0, 2, 2, 5], np.int32),
+            np.frombuffer(b"abcde", np.uint8),
+            np.asarray([True, False, True]), 128)
+        b = ColumnarBatch((col,), jnp.asarray(3, jnp.int32),
+                          T.Schema([T.StructField("s", T.STRING, True)]))
+        g = _grow_batch(b, 256)
+        assert g.capacity == 256
+        assert g.to_arrow().column(0).to_pylist() == ["ab", None, "cde"]
+
+    def test_arrays_and_structs(self):
+        self._roundtrip(pa.RecordBatch.from_pydict({
+            "a": pa.array([[1, 2], None, [3]], pa.list_(pa.int64())),
+            "st": pa.array([{"x": 1}, None, {"x": 3}],
+                           pa.struct([("x", pa.int64())])),
+        }))
+
+    def test_lazy_live_mask_pads_false(self):
+        from spark_rapids_tpu.data.batch import ColumnarBatch, _grow_batch
+        import jax.numpy as jnp
+        rb = pa.RecordBatch.from_pydict(
+            {"v": np.arange(100, dtype=np.int64)})
+        b = ColumnarBatch.from_arrow(rb)
+        live = jnp.arange(b.capacity) % 2 == 0   # 50 scattered live rows
+        lazy = ColumnarBatch(b.columns, jnp.asarray(50, jnp.int32),
+                             b.schema, live=live)
+        g = _grow_batch(lazy, 512)
+        assert g.capacity == 512 and g.live.shape == (512,)
+        assert int(g.live.sum()) == int(live.sum())
+        want = [v for v in range(100) if v % 2 == 0]
+        assert g.to_arrow().column(0).to_pylist() == want
+
+
+class TestOneExecutablePerTier:
+    # The acceptance criterion: >= 3 distinct ladder rungs, each fused
+    # region compiled at most once per tier, results bit-identical.
+    SIZES = (300, 900, 2000)                  # rungs 512 / 1024 / 2048
+
+    def _run(self, name):
+        # The ladder is process-global and follows the most recently
+        # constructed session's conf: build the CPU oracle FIRST so the
+        # tiered session's ladder stays in force during the runs.
+        cpu = _cpu()
+        s = _session(**{"spark.rapids.tpu.polymorphic.tierGrowth": 16.0})
+        assert get_ladder().tier(512) == get_ladder().tier(2048) == 2048
+        q = tpch.QUERIES[name]
+        compiles = []
+        for n in self.SIZES:
+            tables = tpch.gen_tables(n, seed=7)
+            before = executables.stats()["jit_compiles"]
+            got = q(tpch.load(s, tables)).collect()
+            compiles.append(executables.stats()["jit_compiles"] - before)
+            want = q(tpch.load(cpu, tables)).collect()
+            assert tables_match(got, want, rel_tol=1e-9, abs_tol=1e-9), n
+        return compiles
+
+    def test_q1_compiles_once_per_tier(self):
+        fusion.clear_fused_cache()
+        compiles = self._run("q1")
+        # First rung pays the tier compile; the other rungs in the tier
+        # dispatch into the SAME executable (PR-2/PR-3 compile counters).
+        assert compiles[1] == 0 and compiles[2] == 0, compiles
+
+    def test_q3_compiles_once_per_tier(self):
+        fusion.clear_fused_cache()
+        compiles = self._run("q3")
+        assert compiles[1] == 0 and compiles[2] == 0, compiles
+
+    def test_same_program_object_serves_two_rungs(self):
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops import predicates as P
+        from spark_rapids_tpu.ops.expression import col, lit
+
+        def q(s, n):
+            rb = pa.RecordBatch.from_pydict({
+                "k": np.arange(n, dtype=np.int64) % 7,
+                "v": np.arange(n, dtype=np.int64)})
+            return (s.create_dataframe(rb)
+                    .where(P.GreaterThan(col("v"), lit(1)))
+                    .group_by(col("k"))
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s")))
+        fusion.clear_fused_cache()
+        s = _session(**{"spark.rapids.tpu.polymorphic.tierGrowth": 16.0})
+        q(s, 200).collect()                   # rung 256 -> tier 2048
+        q(s, 400).collect()                   # rung 512 -> same tier
+        programs = [p for p in fusion._FUSED_CACHE.values()
+                    if isinstance(p, executables.FusedProgram)]
+        assert len(programs) == 1
+        st = programs[0].stats()
+        assert st["jit_calls"] == 2 and st["jit_compiles"] == 1, st
+
+
+class TestBitIdentityOracle:
+    """The per-rung path (polymorphic.enabled=false) is the bit-identity
+    oracle for the padded path, on q1/q3/q6 across >= 3 ladder rungs."""
+
+    @pytest.mark.parametrize("name", ["q1", "q3", "q6"])
+    def test_polymorphic_on_off_cpu(self, name):
+        cpu = _cpu()
+        on = _session()
+        off = _session(
+            **{"spark.rapids.tpu.polymorphic.enabled": False})
+        q = tpch.QUERIES[name]
+        for n in (300, 900, 2000):            # rungs 512 / 1024 / 2048
+            tables = tpch.gen_tables(n, seed=11)
+            want = q(tpch.load(cpu, tables)).collect()
+            got_on = q(tpch.load(on, tables)).collect()
+            got_off = q(tpch.load(off, tables)).collect()
+            assert tables_match(got_on, want, rel_tol=1e-9, abs_tol=1e-9)
+            assert tables_match(got_off, want, rel_tol=1e-9, abs_tol=1e-9)
+
+    def test_rung_boundary_crossing_mid_query(self):
+        # One query mixing capacities: a 200-row (rung 256) and a
+        # 1500-row (rung 2048) input meet in a union + aggregate, so the
+        # fused program sees two different rungs in ONE dispatch.
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+
+        def q(s):
+            a = s.create_dataframe(pa.RecordBatch.from_pydict({
+                "k": np.arange(200, dtype=np.int64) % 5,
+                "v": np.arange(200, dtype=np.int64)}))
+            b = s.create_dataframe(pa.RecordBatch.from_pydict({
+                "k": np.arange(1500, dtype=np.int64) % 5,
+                "v": np.arange(1500, dtype=np.int64) * 3}))
+            return (a.union(b).group_by(col("k"))
+                    .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s"),
+                         AGG.AggregateExpression(AGG.Count(), "c")))
+        want = q(_cpu()).collect().sort_by("k")
+        got_on = q(_session()).collect().sort_by("k")
+        got_off = q(_session(
+            **{"spark.rapids.tpu.polymorphic.enabled": False})) \
+            .collect().sort_by("k")
+        assert got_on.equals(want)
+        assert got_off.equals(want)
+
+    def test_bit_identity_under_oom_injection(self):
+        # PR-4 fault injection: join-probe OOMs exhaust retries and the
+        # probe batch splits in half by rows — capacities change
+        # mid-query, and every half pads onto its tier. Joins run as
+        # boundaries (inlineJoins=false) so the probe site is visited;
+        # the rest of the plan stays on the polymorphic fused path.
+        inject = {
+            "spark.rapids.tpu.retry.backoffBaseMs": 0.0,
+            "spark.rapids.tpu.retry.maxRetries": 1,
+            "spark.rapids.tpu.test.faultInjection.sites":
+                "TpuShuffledHashJoinExec.probe,"
+                "TpuBroadcastHashJoinExec.probe",
+            "spark.rapids.tpu.test.faultInjection.oomEveryN": -4,
+            "spark.rapids.tpu.fusion.inlineJoins": False,
+        }
+        tables = tpch.gen_tables(1 << 10, seed=7)
+        q = tpch.QUERIES["q3"]
+        want = q(tpch.load(_cpu(), tables)).collect()
+        on = _session(**inject)
+        off = _session(
+            **dict(inject,
+                   **{"spark.rapids.tpu.polymorphic.enabled": False}))
+        got_on = q(tpch.load(on, tables)).collect()
+        got_off = q(tpch.load(off, tables)).collect()
+        assert tables_match(got_on, want, rel_tol=1e-9, abs_tol=1e-9)
+        assert tables_match(got_off, want, rel_tol=1e-9, abs_tol=1e-9)
+        assert on._fault_injector.injected["oom"] > 0
+
+
+class TestWarmupCoveredSkip:
+    def test_neighbor_rung_inside_tier_is_skipped(self):
+        import jax
+        from spark_rapids_tpu.data.batch import ColumnarBatch
+        set_ladder(BucketLadder(tier_growth=16.0))
+        warmup.reset_for_tests()
+        warmup.configure(TpuConf({
+            "spark.rapids.tpu.warmup.auto": True,
+            "spark.rapids.tpu.warmup.rungsAhead": 0,
+            "spark.rapids.tpu.warmup.rungsBehind": 1,
+        }))
+        prog = executables.FusedProgram(jax.jit(lambda x: x))
+        rb = pa.RecordBatch.from_pydict(
+            {"a": np.arange(2000, dtype=np.int64)})
+        inputs = ((ColumnarBatch.from_arrow(rb),),)   # capacity 2048, a tier
+        warmup.note_run(prog, ("sig",), inputs, polymorphic=True)
+        st = warmup.stats()
+        # The rung below (1024) canonicalizes onto tier 2048 — already
+        # covered by the executable that just ran: nothing scheduled.
+        assert st["scheduled"] == 0
+        assert st["skipped_covered"] == 1, st
+
+    def test_steady_state_does_not_inflate_skip_counter(self, tmp_path,
+                                                        monkeypatch):
+        # The plan's own recorded tier vector comes back from the
+        # manifest on every dispatch; it is a pre-canonicalization
+        # duplicate, NOT a skipped warm-up, and must not count.
+        import jax
+        from spark_rapids_tpu.data.batch import ColumnarBatch
+        monkeypatch.delenv("JAX_ENABLE_COMPILATION_CACHE", raising=False)
+        monkeypatch.setattr(persist, "_apply_jax_config",
+                            lambda d, secs: None)
+        persist.configure(TpuConf({
+            "spark.rapids.tpu.compileCache.enabled": True,
+            "spark.rapids.tpu.compileCache.dir": str(tmp_path / "xla")}))
+        set_ladder(BucketLadder(tier_growth=16.0))
+        warmup.reset_for_tests()
+        warmup.configure(TpuConf({
+            "spark.rapids.tpu.warmup.auto": True,
+            "spark.rapids.tpu.warmup.rungsAhead": 0,
+            "spark.rapids.tpu.warmup.rungsBehind": 0,
+        }))
+        prog = executables.FusedProgram(jax.jit(lambda x: x))
+        rb = pa.RecordBatch.from_pydict(
+            {"a": np.arange(2000, dtype=np.int64)})
+        inputs = ((ColumnarBatch.from_arrow(rb),),)
+        for _ in range(3):                    # steady state: same tier
+            warmup.note_run(prog, ("sig",), inputs, polymorphic=True)
+        st = warmup.stats()
+        assert st["skipped_covered"] == 0 and st["scheduled"] == 0, st
+
+    def test_per_rung_path_still_warms(self):
+        import jax
+        from spark_rapids_tpu.data.batch import ColumnarBatch
+        warmup.reset_for_tests()
+        warmup.configure(TpuConf({
+            "spark.rapids.tpu.warmup.auto": True,
+            "spark.rapids.tpu.warmup.rungsAhead": 1,
+        }))
+        prog = executables.FusedProgram(jax.jit(lambda x: x))
+        rb = pa.RecordBatch.from_pydict(
+            {"a": np.arange(100, dtype=np.int64)})
+        inputs = ((ColumnarBatch.from_arrow(rb),),)
+        warmup.note_run(prog, ("sig",), inputs, polymorphic=False)
+        st = warmup.stats()
+        assert st["scheduled"] == 1 and st["skipped_covered"] == 0, st
+        assert warmup.drain(120)
+
+
+class TestManifestTierDedupe:
+    def test_vectors_for_dedupes_canonicalized(self, tmp_path):
+        m = persist.CompileManifest(str(tmp_path / persist.MANIFEST_NAME))
+        for cap in (256, 512, 1024):          # one vector per rung
+            m.record("p", ((cap,),))
+        lad = BucketLadder(tier_growth=16.0)
+        canon = lambda v: warmup._map_vec(v, lad.tier)  # noqa: E731
+        # Raw replay would rebuild the SAME tier executable 3 times; the
+        # canonicalized replay collapses them to one.
+        assert m.vectors_for("p") == [((256,),), ((512,),), ((1024,),)]
+        assert m.vectors_for("p", canonicalize=canon) == [((2048,),)]
+
+    def test_split_levels_roundtrip(self, tmp_path):
+        path = str(tmp_path / persist.MANIFEST_NAME)
+        m = persist.CompileManifest(path)
+        assert m.split_level("p") == 0
+        m.record_split_level("p", 2)
+        m2 = persist.CompileManifest(path)    # a new process
+        assert m2.split_level("p") == 2
+
+
+class TestCompileBudgetSplit:
+    def _join_query(self, s, fact, dim):
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+        return (s.create_dataframe(fact)
+                .join(s.create_dataframe(dim), on="k", how="inner")
+                .group_by(col("cat"))
+                .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "sv")))
+
+    def test_blown_budget_splits_region_bit_identically(self):
+        rng = np.random.default_rng(0)
+        fact = pa.RecordBatch.from_pydict({
+            "k": rng.integers(0, 50, 3000).astype(np.int64),
+            "v": rng.integers(-100, 100, 3000).astype(np.int64)})
+        dim = pa.RecordBatch.from_pydict({
+            "k": np.arange(50, dtype=np.int64),
+            "cat": (np.arange(50, dtype=np.int64) % 7)})
+        want = self._join_query(_cpu(), fact, dim).collect().sort_by("cat")
+        budget.reset_for_tests()
+        fusion.clear_fused_cache()
+        # Every compile blows a ~zero budget: level escalates 0 -> 1
+        # (largest join demoted) -> 2 (every join demoted) across
+        # builds, results identical throughout. Auto-broadcast off so
+        # the join plans SHUFFLED and inlines into the fused region —
+        # a region with no inlined join has nothing to demote and never
+        # escalates.
+        s = _session(
+            **{"spark.rapids.tpu.fusion.compileBudgetSecs": 1e-9,
+               "spark.rapids.sql.autoBroadcastJoinRows": -1})
+        for _ in range(3):
+            got = self._join_query(s, fact, dim).collect().sort_by("cat")
+            assert got.equals(want)
+        st = budget.stats()
+        assert st["splits_escalated"] >= 1, st
+        assert max(st["split_levels"].values()) >= 1, st
+
+    def test_budget_disabled_never_splits(self):
+        budget.reset_for_tests()
+        budget.configure(TpuConf(
+            {"spark.rapids.tpu.fusion.compileBudgetSecs": 0.0}))
+        budget.note_compile("h", 1e9, 0)
+        assert budget.split_level("h") == 0
+        assert budget.stats()["splits_escalated"] == 0
+
+    def test_split_level_read_through_manifest(self, tmp_path,
+                                               monkeypatch):
+        monkeypatch.delenv("JAX_ENABLE_COMPILATION_CACHE", raising=False)
+        monkeypatch.setattr(persist, "_apply_jax_config",
+                            lambda d, secs: None)
+        persist.configure(TpuConf({
+            "spark.rapids.tpu.compileCache.enabled": True,
+            "spark.rapids.tpu.compileCache.dir": str(tmp_path / "xla")}))
+        budget.reset_for_tests()
+        budget.configure(TpuConf(
+            {"spark.rapids.tpu.fusion.compileBudgetSecs": 0.5}))
+        budget.note_compile("h", 10.0, 0)     # blows the budget
+        assert budget.split_level("h") == 1
+        budget.reset_for_tests()              # "restart" the process
+        assert budget.split_level("h") == 1   # inherited via the manifest
+
+
+class TestFusedProgramCompileStats:
+    def test_seen_and_compile_counters(self):
+        import jax
+        import jax.numpy as jnp
+        prog = executables.FusedProgram(
+            jax.jit(lambda x: jax.tree_util.tree_map(lambda v: v * 2, x)))
+        x = jnp.arange(128, dtype=jnp.int64)
+        assert not prog.seen(x)
+        prog(x)
+        assert prog.seen(x)
+        prog(x)                               # reuse, not a compile
+        y = jnp.arange(256, dtype=jnp.int64)
+        prog(y)
+        st = prog.stats()
+        assert st["jit_calls"] == 3 and st["jit_compiles"] == 2, st
+        assert st["compile_seconds"] > 0
+        # AOT-warmed shapes count as seen: dispatch cannot compile.
+        big = jax.ShapeDtypeStruct((512,), jnp.int64)
+        prog.compile_abstract((big,))
+        assert prog.seen(jnp.arange(512, dtype=jnp.int64))
+
+
+class TestBakeTool:
+    def test_bake_smoke_populates_manifest(self, tmp_path, monkeypatch):
+        from tools import bake_executables
+        monkeypatch.delenv("JAX_ENABLE_COMPILATION_CACHE", raising=False)
+        monkeypatch.setattr(persist, "_apply_jax_config",
+                            lambda d, secs: None)
+        args = bake_executables.parse_args([
+            "--cache-dir", str(tmp_path / "xla"),
+            "--suites", "tpch", "--queries", "q6",
+            "--min-rows", "128", "--max-rows", "300"])
+        summary = bake_executables.bake(args)
+        assert summary["queries_run"] == len(summary["row_tiers"])
+        assert not summary["queries_failed"]
+        assert summary["fused_programs"] >= 1
+        import os
+        assert os.path.exists(os.path.join(str(tmp_path / "xla"),
+                                           persist.MANIFEST_NAME))
+
+    def test_bake_refuses_env_kill_switch(self, monkeypatch, tmp_path):
+        from tools import bake_executables
+        monkeypatch.setenv("JAX_ENABLE_COMPILATION_CACHE", "false")
+        args = bake_executables.parse_args(
+            ["--cache-dir", str(tmp_path / "xla")])
+        with pytest.raises(SystemExit):
+            bake_executables.bake(args)

@@ -1,0 +1,146 @@
+"""Pre-bake the fused-executable corpus so a cold process starts warm.
+
+The compile layer gives a RUNNING process three defenses against the
+compile tax (bucket ladder, polymorphic tiers, AOT warm-up) — but a
+brand-new process with an empty persistent cache still pays every
+compile once. This tool pays that bill OFFLINE: it runs the TPC-H /
+TPC-DS / TPCxBB query shapes at one data size per polymorphic tier with
+the persistent XLA cache + compile manifest enabled, so the executables
+land on disk and the manifest records every (plan, tier) pair. A cold
+production process pointed at the same cache directory then replays
+yesterday's corpus through AOT warm-up (compile/warmup.py) and
+deserializes executables in milliseconds instead of compiling for
+minutes — the BENCH_r05 class of 351-646s warmups becomes a one-time
+bake.
+
+Usage:
+
+    python -m tools.bake_executables --cache-dir /var/cache/srtpu-xla \
+        [--suites tpch,tpcxbb,tpcds] [--queries q1,q3,q6] \
+        [--min-rows 4096] [--max-rows 1048576] [--json]
+
+Row counts are chosen as the polymorphic tier capacities covering
+[min-rows, max-rows] (compile/ladder.py ``tiers()``), so each run lands
+exactly one executable per (plan, tier). The environment kill-switch
+``JAX_ENABLE_COMPILATION_CACHE=false`` aborts the bake — there would be
+nothing to persist.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Pre-bake the persistent XLA executable corpus for "
+                    "the TPC-H/TPC-DS/TPCxBB operator shapes")
+    ap.add_argument("--cache-dir", default=None,
+                    help="persistent cache directory (default: the "
+                         "engine default, ~/.cache/spark_rapids_tpu/xla)")
+    ap.add_argument("--suites", default="tpch,tpcxbb",
+                    help="comma-separated suites: tpch, tpcds, tpcxbb")
+    ap.add_argument("--queries", default="",
+                    help="comma-separated query names to bake (default: "
+                         "every query in the suite)")
+    ap.add_argument("--min-rows", type=int, default=1 << 12,
+                    help="smallest fact-table row count to bake")
+    ap.add_argument("--max-rows", type=int, default=1 << 20,
+                    help="largest fact-table row count to bake")
+    ap.add_argument("--conf", action="append", default=[],
+                    help="extra conf key=value (repeatable), e.g. "
+                         "spark.rapids.tpu.polymorphic.tierGrowth=16")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON line")
+    return ap.parse_args(argv)
+
+
+def bake(args) -> dict:
+    from spark_rapids_tpu.compile import executables, persist, warmup
+    from spark_rapids_tpu.compile.ladder import get_ladder
+    from spark_rapids_tpu.session import TpuSession
+
+    if persist._env_killed():
+        raise SystemExit(
+            "JAX_ENABLE_COMPILATION_CACHE=false is set: the persistent "
+            "cache cannot be written, so there is nothing to bake. Unset "
+            "it (see docs/compile-cache.md) and re-run.")
+
+    conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.tpu.compileCache.enabled": True,
+        # The bake IS the warm-up; background neighbor warm-ups would
+        # only re-enqueue tiers this loop visits anyway.
+        "spark.rapids.tpu.warmup.auto": False,
+    }
+    if args.cache_dir:
+        conf["spark.rapids.tpu.compileCache.dir"] = args.cache_dir
+    for kv in args.conf:
+        k, _, v = kv.partition("=")
+        conf[k.strip()] = v.strip()
+    session = TpuSession(conf)
+    status = persist.status()
+    if not status.get("enabled"):
+        raise SystemExit(f"persistent cache failed to enable: "
+                         f"{status.get('reason')}")
+
+    only = {q.strip() for q in args.queries.split(",") if q.strip()}
+    row_targets = get_ladder().tiers(max(args.min_rows, 128),
+                                     max(args.max_rows, args.min_rows))
+    suites = []
+    for name in (s.strip() for s in args.suites.split(",") if s.strip()):
+        if name == "tpch":
+            from spark_rapids_tpu.workloads import tpch as mod
+        elif name == "tpcds":
+            from spark_rapids_tpu.workloads import tpcds as mod
+        elif name == "tpcxbb":
+            from spark_rapids_tpu.workloads import tpcxbb as mod
+        else:
+            raise SystemExit(f"unknown suite {name!r} "
+                             "(expected tpch, tpcds, tpcxbb)")
+        suites.append((name, mod))
+
+    t0 = time.perf_counter()
+    ran, failed = 0, {}
+    for suite_name, mod in suites:
+        queries = {n: q for n, q in mod.QUERIES.items()
+                   if not only or n in only}
+        for rows in row_targets:
+            tables = mod.load(session, mod.gen_tables(rows, seed=42),
+                              cache=False)
+            for qname, q in sorted(queries.items()):
+                label = f"{suite_name}.{qname}@{rows}"
+                try:
+                    q(tables).collect()
+                    ran += 1
+                    print(f"[bake] {label} ok", file=sys.stderr)
+                except Exception as e:  # noqa: BLE001 - bake every shape we can
+                    failed[label] = f"{type(e).__name__}: {e}"
+                    print(f"[bake] {label} FAILED: {failed[label]}",
+                          file=sys.stderr)
+    warmup.drain(300)
+    exe = executables.stats()
+    return {
+        "cache_dir": persist.status().get("dir"),
+        "row_tiers": row_targets,
+        "queries_run": ran,
+        "queries_failed": failed,
+        "fused_programs": exe["programs"],
+        "fused_compiles": exe["jit_compiles"],
+        "compile_seconds": round(exe["compile_seconds"], 1),
+        "bake_seconds": round(time.perf_counter() - t0, 1),
+    }
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    summary = bake(args)
+    print(json.dumps(summary) if args.json else json.dumps(summary, indent=1))
+
+
+if __name__ == "__main__":
+    main()
