@@ -222,6 +222,33 @@ FAULT_INJECTION_TRANSIENT_EVERY_N = conf_int(
     "at every Nth visit of each matched injection site; negative N "
     "faults the first |N| visits then heals. 0 disables.")
 
+FAULT_INJECTION_NET_EVERY_N = conf_int(
+    "spark.rapids.tpu.test.faultInjection.netEveryN", 0,
+    "Apply a deterministic NETWORK fault at every Nth visit of the "
+    "matched shuffle-transport site (shuffle.fetchBlock — one visit per "
+    "block fetch; the 'sites' patterns gate it). Negative N faults the "
+    "first |N| "
+    "visits then heals — the schedule that exercises refetch and "
+    "recompute while letting the query finish. The fault class per "
+    "visit is chosen deterministically from the seed among "
+    "faultInjection.netFaults. 0 disables.")
+
+FAULT_INJECTION_NET_FAULTS = conf_str(
+    "spark.rapids.tpu.test.faultInjection.netFaults",
+    "peerDeath,torn,bitFlip,stall",
+    "Comma-separated network fault classes the injector may apply: "
+    "peerDeath (connection dies mid-fetch), torn (payload truncated "
+    "mid-block), bitFlip (one payload bit corrupted — caught by CRC32C), "
+    "stall (peer stops sending past "
+    "spark.rapids.tpu.shuffle.net.requestTimeout). A single name pins "
+    "every injected fault to that class.")
+
+FAULT_INJECTION_NET_STALL_SECS = conf_float(
+    "spark.rapids.tpu.test.faultInjection.netStallSecs", 0.05,
+    "How long an injected 'stall' fault blocks before surfacing as the "
+    "request-timeout failure the real stalled peer would produce (kept "
+    "small so CI fault matrices stay fast).")
+
 HBM_ALLOC_FRACTION = conf_float(
     "spark.rapids.memory.tpu.allocFraction", 0.9,
     "Fraction of HBM the arena allocator may use "
@@ -359,6 +386,57 @@ SHUFFLE_MAX_INFLIGHT_BYTES = conf_int(
     "spark.rapids.shuffle.maxReceiveInflightBytes", 1 << 30,
     "Throttle on bytes being fetched concurrently by the shuffle client "
     "(reference RapidsShuffleTransport.scala:418-425).")
+
+SHUFFLE_NET_CONNECT_TIMEOUT = conf_float(
+    "spark.rapids.tpu.shuffle.net.connectTimeout", 5.0,
+    "Seconds the shuffle wire client waits to establish a TCP connection "
+    "to a peer's NetShuffleServer before the attempt counts as a fetch "
+    "failure (retried by RetryingBlockIterator, then escalated to "
+    "recompute/blacklist). See docs/fault-tolerance.md.")
+
+SHUFFLE_NET_REQUEST_TIMEOUT = conf_float(
+    "spark.rapids.tpu.shuffle.net.requestTimeout", 30.0,
+    "Seconds the shuffle wire client waits on any single socket "
+    "read/write once connected — the slow-peer stall bound: a peer that "
+    "stops sending mid-block fails this fetch attempt instead of "
+    "wedging the query. See docs/fault-tolerance.md.")
+
+SHUFFLE_NET_ENABLED = conf_bool(
+    "spark.rapids.tpu.shuffle.net.enabled", False,
+    "Route reduce-side shuffle reads through the TCP wire plane: the "
+    "exchange serves its block catalog from a NetShuffleServer and "
+    "fetches every block back through the full protocol-v3 client "
+    "(handshake, CRC32C verification, timeouts, retry/refetch, "
+    "recompute escalation) over a real loopback socket — the same code "
+    "path a remote peer exercises, used to harden and CI-gate the "
+    "distributed plane. Off by default: in-process reads skip the wire.")
+
+SHUFFLE_NET_MAX_PEER_FAILURES = conf_int(
+    "spark.rapids.tpu.shuffle.net.maxPeerFailures", 3,
+    "Exhausted fetch attempts (full retry ladders, not individual "
+    "refetches) against one peer before the MapOutputTracker "
+    "blacklists it for the session: later reads stop dialing it and go "
+    "straight to lineage recompute. 0 disables blacklisting.")
+
+QUERY_DEADLINE_SECS = conf_float(
+    "spark.rapids.tpu.query.deadlineSecs", 0.0,
+    "Wall-clock budget for one query, seconds. Cooperatively cancels "
+    "in-flight shuffle fetches, pipeline waits, and retry/backoff loops "
+    "once exceeded, raising QueryDeadlineExceeded naming the slowest "
+    "site (classified fatal — deadlines are a contract, not a fault to "
+    "retry). The per-tenant time-budget primitive of the multi-tenant "
+    "serving roadmap. 0 (default) disables. See docs/fault-tolerance.md.")
+
+SHUFFLE_CHECKSUM_ENABLED = conf_bool(
+    "spark.rapids.tpu.shuffle.checksum.enabled", True,
+    "Compute and verify CRC32C checksums on every shuffle block "
+    "(catalog registration, wire protocol v3 fetches, local reads) and "
+    "every spill range, so corruption surfaces as a typed transient "
+    "error — recovered by refetch or map recompute — never as a wrong "
+    "answer. Disabling skips verification across every SHUFFLE catalog "
+    "tier including its disk spill file (kill switch; the wire protocol "
+    "still carries checksums, and the OOM spill catalog always "
+    "verifies).")
 
 # ---------------------------------------------------------------------------
 # TPU-specific knobs (no reference analog; new hardware, new keys)

@@ -53,7 +53,7 @@ from ..data.batch import ColumnarBatch
 from ..data.column import DeviceColumn, bucket_capacity
 from ..ops.expression import BoundReference, Expression
 from ..ops.kernels import rowops as KR
-from ..parallel.mesh import PART_AXIS, make_mesh
+from ..parallel.mesh import PART_AXIS, make_mesh, shard_map
 from ..plan.physical import ExecContext
 from ..shuffle import ici
 from ..shuffle.partitioning import pmod_partition, spark_hash_columns_device
@@ -797,7 +797,7 @@ def _mesh_core_collect(device_plan, ctx: ExecContext,
             return out_bufs, out.n_rows.reshape(1), flag.reshape(1)
 
         spec = PartitionSpec(PART_AXIS)
-        run = jax.jit(jax.shard_map(
+        run = jax.jit(shard_map(
             spmd, mesh=mesh,
             in_specs=(spec, spec, PartitionSpec()),
             out_specs=(spec, spec, spec)))

@@ -282,12 +282,33 @@ def parallel_active(ctx) -> bool:
 
 def _stalled_result(f: Future, ctx, node: Optional[str]):
     """future.result() with the blocked time accounted to the consumer
-    stall counter — the signal that the producer side is the bottleneck."""
+    stall counter — the signal that the producer side is the bottleneck.
+    An active query deadline bounds the wait: an expired deadline raises
+    QueryDeadlineExceeded instead of blocking on a slow producer forever
+    (cooperative cancellation — the worker's in-flight unit completes and
+    is discarded)."""
+    from concurrent.futures import TimeoutError as _FutTimeout
+    deadline = getattr(ctx, "deadline", None)
     if f.done():
         return f.result()
     t0 = time.perf_counter_ns()
     try:
-        return f.result()
+        if deadline is None:
+            return f.result()
+        while True:
+            try:
+                return f.result(timeout=max(deadline.remaining(), 0.0))
+            except _FutTimeout:
+                # On py3.11+ futures.TimeoutError IS the builtin
+                # TimeoutError, which a WORKER can legitimately raise
+                # (requestTimeout, injected stall). A done future means
+                # the exception came from the work — re-raise it instead
+                # of misreading it as a wait-timeout and spinning.
+                if f.done():
+                    return f.result()
+                # Raises once expired; a spurious early wake just re-arms.
+                deadline.check(f"pipeline.wait:{node or 'prefetch'}",
+                               ctx, node)
     finally:
         if ctx is not None and node:
             ctx.metric(node, "prefetchConsumerStallNs",

@@ -119,6 +119,11 @@ _DETERMINISTIC_OS_ERRORS = (FileNotFoundError, PermissionError,
 
 def classify(exc: BaseException) -> str:
     """Classify an exception into the retry taxonomy (see module doc)."""
+    from ..utils.deadline import QueryDeadlineExceeded
+    if isinstance(exc, QueryDeadlineExceeded):
+        # A deadline is a user contract, not a fault: retrying through it
+        # would spend wall time the user explicitly capped.
+        return Classification.FATAL
     if isinstance(exc, RetryOOM):
         return Classification.OOM
     msg = str(exc)
@@ -211,8 +216,14 @@ def spill_device_below(ctx, priority_ceiling: Optional[int] = None) -> int:
 def backoff_sleep(policy: RetryPolicy, site: str, attempt: int,
                   ctx=None, node: Optional[str] = None) -> None:
     """Sleep the policy's backoff for this attempt, accounting the block
-    time to the node's ``retryBlockTimeNs``."""
+    time to the node's ``retryBlockTimeNs``. An active query deadline
+    bounds the sleep and cancels the retry once expired (a retry ladder
+    must never outlive the user's wall-clock contract)."""
     delay = policy.delay_seconds(site, attempt)
+    deadline = getattr(ctx, "deadline", None)
+    if deadline is not None:
+        deadline.check(site, ctx, node)
+        delay = deadline.bound(delay)
     if delay <= 0:
         return
     t0 = time.perf_counter_ns()
@@ -304,6 +315,7 @@ def with_retry(ctx, site: str, inputs, attempt: Callable,
     from ..utils.fault_injection import register_site
     register_site(site)
     injector = getattr(ctx, "fault_injector", None)
+    deadline = getattr(ctx, "deadline", None)
     policy = _policy_of(ctx)
     work: List = [inputs]
     results: List = []
@@ -320,6 +332,8 @@ def with_retry(ctx, site: str, inputs, attempt: Callable,
                     "schedule or unrecoverable memory pressure)")
             t0 = time.perf_counter_ns()
             try:
+                if deadline is not None:
+                    deadline.check(site, ctx, node)
                 if injector is not None:
                     injector.check(site)
                 results.append(attempt(item))

@@ -84,9 +84,17 @@ class SpillFile:
     """Shared spill file (RapidsDiskStore's block-manager file): appends
     serialized payloads, tracks freed ranges, and compacts itself when the
     owner asks — so freed disk space reclaims during the catalog's
-    lifetime instead of leaking until close."""
+    lifetime instead of leaking until close.
 
-    def __init__(self, spill_dir: Optional[str] = None):
+    Durability (ISSUE 7): every appended range records its CRC32C and
+    every read verifies it, so disk bit rot (or a concurrent writer
+    scribbling over the file) surfaces as a typed
+    :class:`~..utils.checksum.ChecksumError` — classified transient by
+    the retry taxonomy — instead of deserializing garbage into a query
+    answer."""
+
+    def __init__(self, spill_dir: Optional[str] = None,
+                 verify: bool = True):
         self._owns_dir = spill_dir is None
         self.dir = spill_dir or tempfile.mkdtemp(prefix="tpu_spill_")
         os.makedirs(self.dir, exist_ok=True)
@@ -97,6 +105,12 @@ class SpillFile:
         os.close(fd)
         self._offset = 0
         self._freed = 0
+        #: offset -> (length, crc32c) of every live appended range
+        self._crcs: Dict[int, Tuple[int, int]] = {}
+        #: False = record checksums but skip verification (the shuffle
+        #: catalog threads spark.rapids.tpu.shuffle.checksum.enabled here
+        #: so the kill switch covers its disk tier too)
+        self.verify = verify
         self._lock = threading.Lock()
 
     def close(self):
@@ -109,19 +123,42 @@ class SpillFile:
             shutil.rmtree(self.dir, ignore_errors=True)
 
     def append(self, payload: bytes) -> Tuple[int, int]:
+        from ..utils import checksum as CK
+        crc = CK.crc32c(payload)
         with self._lock:
             offset = self._offset
             with open(self.path, "ab") as f:
                 f.write(payload)
             self._offset += len(payload)
+            self._crcs[offset] = (len(payload), crc)
             return offset, len(payload)
 
-    def read(self, offset: int, length: int) -> bytes:
+    def read_with_crc(self, offset: int, length: int
+                      ) -> Tuple[bytes, Optional[int]]:
+        """(payload, recorded crc32c or None) WITHOUT verification — for
+        callers that must verify outside their own wider lock (the
+        shuffle catalog's disk tier). None when the range has no
+        recorded checksum or verification is disabled."""
         # Under the lock: compact() may be rewriting offsets concurrently.
         with self._lock:
             with open(self.path, "rb") as f:
                 f.seek(offset)
-                return f.read(length)
+                payload = f.read(length)
+            rec = self._crcs.get(offset)
+        if self.verify and rec is not None and rec[0] == length:
+            return payload, rec[1]
+        return payload, None
+
+    def read(self, offset: int, length: int) -> bytes:
+        from ..utils import checksum as CK
+        # Verification runs OUTSIDE the lock — the payload is a private
+        # copy, and a full-payload CRC pass must not serialize readers.
+        payload, crc = self.read_with_crc(offset, length)
+        if crc is not None:
+            CK.verify(payload, crc,
+                      f"spill range [{offset}:{offset + length}) of "
+                      f"{self.path}")
+        return payload
 
     # -- space reclaim ------------------------------------------------------
     @property
@@ -147,6 +184,9 @@ class SpillFile:
         owner's next :meth:`compact` call."""
         with self._lock:
             self._freed += length
+            rec = self._crcs.get(offset)
+            if rec is not None and rec[0] == length:
+                del self._crcs[offset]
 
     def freed_fraction(self) -> float:
         with self._lock:
@@ -157,21 +197,40 @@ class SpillFile:
         length)}); returns the keys' new ranges. The owner must hold its
         own entry bookkeeping consistent (it passes every live range and
         installs every returned one)."""
+        from ..utils import checksum as CK
         with self._lock:
             fd, tmp = tempfile.mkstemp(prefix="spill_compact_",
                                        suffix=".bin", dir=self.dir)
             new_ranges: Dict = {}
+            new_crcs: Dict[int, Tuple[int, int]] = {}
             pos = 0
             with os.fdopen(fd, "wb") as out, open(self.path, "rb") as src:
                 for key, (offset, length) in sorted(
                         live_ranges.items(), key=lambda kv: kv[1][0]):
                     src.seek(offset)
-                    out.write(src.read(length))
+                    payload = src.read(length)
+                    # Verify while relocating: compaction must not launder
+                    # rotted bytes into a fresh file with a fresh crc.
+                    rec = self._crcs.get(offset)
+                    if not self.verify:
+                        new_crcs[pos] = rec if rec is not None \
+                            and rec[0] == length \
+                            else (length, CK.crc32c(payload))
+                    elif rec is not None and rec[0] == length:
+                        CK.verify(payload, rec[1],
+                                  f"spill range [{offset}:"
+                                  f"{offset + length}) of {self.path} "
+                                  "during compaction")
+                        new_crcs[pos] = (length, rec[1])
+                    else:
+                        new_crcs[pos] = (length, CK.crc32c(payload))
+                    out.write(payload)
                     new_ranges[key] = (pos, length)
                     pos += length
             os.replace(tmp, self.path)
             self._offset = pos
             self._freed = 0
+            self._crcs = new_crcs
             return new_ranges
 
 

@@ -35,6 +35,19 @@ from .registry import NONE, MetricsRegistry, level_name, parse_level
 #: Profile schema version (bump on incompatible event-log layout changes).
 VERSION = 1
 
+#: Durability counters (ISSUE 7) summed across nodes into the engine
+#: section — ALSO the list TpuSession harvests from attempts discarded by
+#: the join-sizing re-run ladder (one list, or a new counter silently
+#: stops surviving dispatch retries).
+DURABILITY_COUNTERS = ("checksumFailures", "shuffleBlocksRefetched",
+                       "mapTasksRecomputed", "deadlineCancels",
+                       "peersBlacklisted")
+
+#: The subset of DURABILITY_COUNTERS the profile reads from process-wide
+#: stats deltas instead of the per-query registry (they span discarded
+#: dispatch attempts natively, so the session must NOT also carry them).
+PROCESS_DELTA_COUNTERS = ("checksumFailures",)
+
 
 def plan_profile_hash(plan_sig: tuple) -> str:
     """Short stable hash of a structural plan signature
@@ -88,6 +101,9 @@ class QueryProfile:
         comp = self.engine.get("compile")
         if comp:
             lines.append(f"+ compile  {_fmt_metrics(comp)}")
+        dur = self.engine.get("durability")
+        if dur:
+            lines.append(f"+ durability  {_fmt_metrics(dur)}")
         return "\n".join(lines) + "\n"
 
 
@@ -141,10 +157,12 @@ class QueryProfiler:
         self._t0 = time.perf_counter_ns()
         from ..compile import executables as _exe
         from ..compile import warmup as _warmup
+        from ..utils import checksum as _ck
         from ..utils import kernel_cache as _kc
         self._kc0 = _kc.cache_stats()
         self._exe0 = _exe.stats()
         self._warm0 = _warmup.stats()
+        self._ck0 = _ck.stats()
         dm = session.device_manager
         self._spill0 = dict(dm.catalog.metrics)
         self._sem0 = dm.semaphore.wait_ns
@@ -161,6 +179,7 @@ class QueryProfiler:
 
         from ..compile import executables as _exe
         from ..compile import warmup as _warmup
+        from ..utils import checksum as _ck
         from ..utils import kernel_cache as _kc
         wall_ns = time.perf_counter_ns() - self._t0
         registry: MetricsRegistry = ctx.registry
@@ -176,6 +195,7 @@ class QueryProfiler:
         kc = _kc.cache_stats()
         exe = _exe.stats()
         warm = _warmup.stats()
+        ck = _ck.stats()
         engine = {
             "semaphoreWaitNs": dm.semaphore.wait_ns - self._sem0,
             "spillBytes":
@@ -214,6 +234,21 @@ class QueryProfiler:
                 "warmupSkippedCovered": _delta(warm, self._warm0,
                                                "skipped_covered"),
             },
+            # Distributed-durability counters (ISSUE 7,
+            # docs/fault-tolerance.md): a clean run reads all zeros; after
+            # an injected or real fault the non-zero counters PROVE the
+            # recovery machinery ran (bench.py surfaces them as the
+            # per-query `faults` section).
+            "durability": {
+                # checksumFailures comes from the process-wide stats
+                # delta (it spans discarded attempts natively); the rest
+                # sum the per-query registry.
+                "checksumVerified": _delta(ck, self._ck0, "verified"),
+                **{name: (_delta(ck, self._ck0, "failures")
+                          if name == "checksumFailures"
+                          else _registry_total(registry, name))
+                   for name in DURABILITY_COUNTERS},
+            },
         }
         return QueryProfile(
             query_id=query_id,
@@ -230,6 +265,18 @@ class QueryProfiler:
 
 def _delta(now: dict, base: dict, key: str) -> int:
     return int(now.get(key, 0)) - int(base.get(key, 0))
+
+
+def _registry_total(registry: MetricsRegistry, name: str) -> int:
+    """Sum one metric name across every node of a per-query registry
+    (the durability counters are recorded under whichever operator hit
+    the fault; the engine section wants the query total)."""
+    total = 0
+    for node in registry.node_names():
+        v = registry.node_metrics(node).get(name)
+        if isinstance(v, (int, float)):
+            total += int(v)
+    return total
 
 
 def _tree_of(plan, registry: MetricsRegistry) -> dict:

@@ -165,6 +165,28 @@ TAXONOMY: Dict[str, MetricSpec] = {s.name: s for s in [
           "Wall time saved by materializing independent fusion-boundary "
           "subtrees concurrently: the sum of per-boundary times minus "
           "elapsed time (spark.rapids.tpu.pipeline.boundaryParallelism)."),
+    _spec("checksumFailures", MetricKind.SUM, ESSENTIAL,
+          "Shuffle-block / spill-range CRC32C verifications that FAILED "
+          "(utils/checksum.py; docs/fault-tolerance.md). Every failure "
+          "was recovered by refetch or map recompute, or surfaced as a "
+          "typed error — never as data. Zero on a healthy run."),
+    _spec("shuffleBlocksRefetched", MetricKind.SUM, ESSENTIAL,
+          "Shuffle blocks fetched again after a transport failure or "
+          "checksum mismatch (only blocks not yet yielded re-fetch; "
+          "shuffle/net.py). Zero on a healthy run."),
+    _spec("mapTasksRecomputed", MetricKind.SUM, ESSENTIAL,
+          "Map tasks deterministically re-executed from lineage because "
+          "their shuffle blocks were lost or corrupt past refetch (the "
+          "Spark stage-retry analog; shuffle/exchange.py "
+          "MapOutputTracker). Zero on a healthy run."),
+    _spec("deadlineCancels", MetricKind.SUM, ESSENTIAL,
+          "Cooperative cancellations raised by the query deadline "
+          "(spark.rapids.tpu.query.deadlineSecs): in-flight fetches, "
+          "pipeline waits, and retry loops that observed an expired "
+          "deadline and raised QueryDeadlineExceeded."),
+    _spec("peersBlacklisted", MetricKind.SUM, ESSENTIAL,
+          "Shuffle peers excluded for the session after repeated fetch "
+          "failures (spark.rapids.tpu.shuffle.net.maxPeerFailures)."),
 ]}
 
 #: Metrics recorded under names outside the taxonomy (operator-specific

@@ -21,8 +21,9 @@ from typing import Tuple
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .mesh import shard_map
 
 from .. import types as T
 from ..data.column import DeviceColumn

@@ -87,6 +87,15 @@ class ExecContext:
     #: the existing semaphore (exec/pipeline.py); the dispatching thread
     #: releases its slot while waiting on them.
     semaphore: object = None
+    #: Query wall-clock budget (utils/deadline.py): None unless
+    #: spark.rapids.tpu.query.deadlineSecs is set. Cooperative sites
+    #: (retry loops, shuffle fetches, pipeline waits) call
+    #: deadline.check() and raise QueryDeadlineExceeded once expired.
+    deadline: object = None
+    #: Session-scoped shuffle MapOutputTracker (shuffle/exchange.py):
+    #: lineage recompute + peer blacklist state that must survive
+    #: per-query context rebuilds. Lazily created for bare contexts.
+    shuffle_tracker: object = None
     _join_site: int = 0
     #: Base offset for next_join_site ordinals: pipeline boundary forks
     #: get disjoint deterministic namespaces so concurrent materialization
@@ -380,6 +389,15 @@ class CpuHashAggregateExec(PhysicalPlan):
                         gi = len(self.groupings) + i
                         cols.append(pc.is_nan(cols[gi]))
                         names.append(f"_n{i}")
+                        # Non-NaN valid presence: distinguishes an all-NaN
+                        # group (Spark min = NaN) from one where pyarrow's
+                        # NaN-skipping min found a real value. Needed
+                        # because pyarrow's empty-after-skip identity is
+                        # version-dependent (null in older builds, +/-inf
+                        # in pyarrow >= 22).
+                        cols.append(pc.fill_null(
+                            pc.invert(pc.is_nan(cols[gi])), False))
+                        names.append(f"_f{i}")
                 if hb.num_rows:
                     rows.append(pa.RecordBatch.from_arrays(cols, names=names))
 
@@ -409,6 +427,7 @@ class CpuHashAggregateExec(PhysicalPlan):
         for i, a in enumerate(self.aggregates):
             if self._nan_minmax(a):
                 aggs.append((f"_n{i}", "max"))
+                aggs.append((f"_f{i}", "max"))
         if not aggs:
             aggs = [(keys[0], "count")] if keys else []
         grouped = table.group_by(keys, use_threads=False).aggregate(aggs)
@@ -430,10 +449,16 @@ class CpuHashAggregateExec(PhysicalPlan):
                     # Any NaN contribution: the max IS NaN.
                     arr = pc.if_else(has_nan, nan, arr)
                 else:
-                    # All-NaN group: pyarrow skipped every value -> null;
-                    # Spark's answer is NaN.
-                    arr = pc.if_else(pc.and_(pc.is_null(arr), has_nan),
-                                     nan, arr)
+                    # All-NaN group: pyarrow skipped every value (yielding
+                    # its empty identity — null, or +/-inf on pyarrow>=22);
+                    # Spark's answer is NaN. A group with any non-NaN value
+                    # keeps pyarrow's NaN-skipping min, which IS Spark's
+                    # (NaN orders greatest).
+                    has_real = pc.fill_null(
+                        grouped.column(f"_f{i}_max").combine_chunks(),
+                        False)
+                    arr = pc.if_else(
+                        pc.and_(pc.invert(has_real), has_nan), nan, arr)
             arrays.append(arr.cast(T.to_arrow_type(a.func.data_type)))
         rb_out = pa.RecordBatch.from_arrays(arrays, schema=out_arrow)
         return [iter([HostBatch(rb_out)])]

@@ -77,6 +77,11 @@ class TpuSession:
         # counters survive per-dispatch context rebuilds.
         from .utils.fault_injection import FaultInjector
         self._fault_injector = FaultInjector.maybe(self.conf)
+        # Distributed durability layer (ISSUE 7): the shuffle map-output
+        # tracker is session-scoped so lineage recompute budgets and peer
+        # blacklists persist across queries (docs/fault-tolerance.md).
+        from .shuffle.exchange import MapOutputTracker
+        self._shuffle_tracker = MapOutputTracker(self.conf)
 
     # -- conf ---------------------------------------------------------------
     def with_conf(self, **kv) -> "TpuSession":
@@ -93,6 +98,8 @@ class TpuSession:
         s._event_log = None
         from .utils.fault_injection import FaultInjector
         s._fault_injector = FaultInjector.maybe(s.conf)
+        from .shuffle.exchange import MapOutputTracker
+        s._shuffle_tracker = MapOutputTracker(s.conf)
         return s
 
     def close(self) -> None:
@@ -222,8 +229,13 @@ class TpuSession:
         import jax
         from .data.column import bucket_capacity
         from .memory import retry as R
+        from .utils.deadline import Deadline
         from .utils.fault_injection import maybe_inject
         policy = R.RetryPolicy.from_conf(self.conf)
+        # One deadline spans the WHOLE query including its retry ladder
+        # (spark.rapids.tpu.query.deadlineSecs): re-running after a fault
+        # does not reset the user's wall-clock contract.
+        deadline = Deadline.maybe(self.conf)
         cached = self._JOIN_CAP_CACHE.get(plan_sig) \
             if plan_sig is not None else None
         caps, dense_modes = (dict(cached[0]), dict(cached[1])) \
@@ -242,6 +254,28 @@ class TpuSession:
         # one ends up carrying them.
         dispatch_retries = 0
         dispatch_block_ns = 0
+        # Same for the durability counters (ISSUE 7): a shuffle refetch or
+        # map recompute on an attempt that later overflows (join sizing)
+        # would vanish with its context, under-reporting recovery in the
+        # profile and the bench `faults` section.
+        durability_carry: Dict[str, int] = {}
+
+        def _harvest_durability(c) -> None:
+            from .metrics.profile import (DURABILITY_COUNTERS,
+                                          PROCESS_DELTA_COUNTERS,
+                                          _registry_total)
+            for cname in DURABILITY_COUNTERS:
+                if cname in PROCESS_DELTA_COUNTERS:
+                    # The profile reads these from process-wide stats
+                    # deltas, which span discarded attempts natively —
+                    # carrying the registry value would be dead data at
+                    # best, a double count if the profile ever switched
+                    # to summing the registry.
+                    continue
+                total = _registry_total(c.registry, cname)
+                if total:
+                    durability_carry[cname] = \
+                        durability_carry.get(cname, 0) + total
         for attempt in range(attempts):
             eager = eager_only or force_eager or attempt == attempts - 1
             dispatch_try = 0
@@ -249,12 +283,17 @@ class TpuSession:
                 ctx = P.ExecContext(self.conf,
                                     catalog=self.device_manager.catalog,
                                     fault_injector=self._fault_injector,
-                                    semaphore=self.device_manager.semaphore)
+                                    semaphore=self.device_manager.semaphore,
+                                    deadline=deadline,
+                                    shuffle_tracker=self._shuffle_tracker)
                 ctx.join_caps = caps
                 ctx.dense_modes = dict(dense_modes)
                 ctx.join_growth = growth
                 ctx.eager_overflow = eager
                 try:
+                    if deadline is not None:
+                        deadline.check("session.dispatch", ctx,
+                                       "TpuSession")
                     maybe_inject(ctx, "session.dispatch")
                     # Task admission: bound concurrent queries holding the
                     # device (GpuSemaphore.acquireIfNecessary analog; conf
@@ -283,6 +322,7 @@ class TpuSession:
                         (cls == R.Classification.OOM and not eager_only)
                     if not retryable or dispatch_try >= policy.max_retries:
                         raise
+                    _harvest_durability(ctx)
                     if cls == R.Classification.OOM:
                         with R._OOM_RECOVERY_LOCK:
                             R.synchronize_device()
@@ -296,6 +336,10 @@ class TpuSession:
                 finally:
                     ctx.close()
             if not overflowed:
+                # Recovery that happened on discarded attempts still
+                # belongs to this query's profile.
+                for cname, v in durability_carry.items():
+                    ctx.metric("TpuSession", cname, v)
                 if plan_sig is not None and (caps or dense_modes):
                     if len(self._JOIN_CAP_CACHE) > 512:
                         self._JOIN_CAP_CACHE.pop(
@@ -303,6 +347,7 @@ class TpuSession:
                     self._JOIN_CAP_CACHE[plan_sig] = (caps,
                                                       dict(dense_modes))
                 return result
+            _harvest_durability(ctx)  # overflowed attempt: ctx discarded
             # Learn exact capacities from this run's observations (one
             # batched download). Totals observed downstream of a truncated
             # join are underestimates; max() keeps monotone convergence

@@ -46,12 +46,23 @@ class ShuffleBufferCatalog:
     """Maps (shuffle_id, map_id, reduce_id) -> serialized shuffle blocks;
     lifecycle mirrors ShuffleBufferCatalog.scala:50 (register on write, free
     on shuffle unregister). Payloads overflow from host memory to a spill
-    file beyond ``host_budget_bytes``."""
+    file beyond ``host_budget_bytes``.
+
+    Durability (ISSUE 7): every block records its CRC32C at registration
+    and every payload read verifies it — across all three storage tiers
+    (arena, plain bytes, disk) and across the wire (the stored checksum
+    rides protocol-v3 META/FETCH). Verification failures raise the typed
+    :class:`~.transport.ShuffleBlockCorruptError`, which the read path
+    recovers from via lineage recompute (:class:`MapOutputTracker`) —
+    corrupt bytes never deserialize into an answer."""
 
     def __init__(self, host_budget_bytes: int = 1 << 30,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 verify_checksums: bool = True):
         self.host_budget = host_budget_bytes
+        self.verify_checksums = verify_checksums
         self._blocks: Dict[Tuple[int, int, int], object] = {}
+        self._crcs: Dict[Tuple[int, int, int], int] = {}
         self._host_bytes = 0
         self._lock = threading.Lock()
         self._spill_dir = spill_dir
@@ -62,18 +73,23 @@ class ShuffleBufferCatalog:
         # back to bytes, over-budget falls through to disk.
         from ..native.arena import HostArena
         self._arena = HostArena(host_budget_bytes)
-        self.metrics = {"blocks": 0, "bytes_written": 0, "spilled_blocks": 0}
+        self.metrics = {"blocks": 0, "bytes_written": 0, "spilled_blocks": 0,
+                        "checksum_failures": 0}
 
     def _disk(self):
         if self._spill_file is None:
             from ..memory.spill import SpillFile
-            self._spill_file = SpillFile(self._spill_dir)
+            self._spill_file = SpillFile(self._spill_dir,
+                                         verify=self.verify_checksums)
         return self._spill_file
 
     def add_block(self, shuffle_id: int, map_id: int, reduce_id: int,
                   payload: bytes):
+        from ..utils import checksum as CK
+        crc = CK.crc32c(payload)
         with self._lock:
             key = (shuffle_id, map_id, reduce_id)
+            self._crcs[key] = crc
             self.metrics["blocks"] += 1
             self.metrics["bytes_written"] += len(payload)
             if self._host_bytes + len(payload) > self.host_budget:
@@ -98,6 +114,42 @@ class ShuffleBufferCatalog:
             return self._disk().read(offset, length)
         return v
 
+    def _read_for_verify(self, key: Tuple[int, int, int]
+                         ) -> Tuple[bytes, Optional[int]]:
+        """(payload, crc-to-verify-or-None) for one block; caller holds
+        _lock. NO verification happens here — every tier's CRC pass runs
+        in :meth:`_verify_payload` outside the catalog lock (the disk
+        tier reads unverified via SpillFile.read_with_crc; its recorded
+        crc equals this catalog's registration crc). None = skip: kill
+        switch off or no recorded checksum."""
+        v = self._blocks[key]
+        if isinstance(v, tuple) and v[0] == "disk":
+            payload, crc = self._disk().read_with_crc(v[1], v[2])
+        else:
+            payload = self._read_block(v)
+            crc = self._crcs.get(key)
+        if not self.verify_checksums:
+            crc = None
+        return payload, crc
+
+    def _verify_payload(self, key: Tuple[int, int, int], payload: bytes,
+                        crc: Optional[int]) -> bytes:
+        """Verify OUTSIDE the catalog lock (the payload is a private
+        copy; a full-payload CRC pass must not serialize every other
+        reader and writer on the catalog-wide lock)."""
+        if crc is None:
+            return payload
+        from ..utils import checksum as CK
+        from .transport import ShuffleBlockCorruptError
+        try:
+            CK.verify(payload, crc, f"shuffle block {key}")
+        except CK.ChecksumError as e:
+            with self._lock:
+                self.metrics["checksum_failures"] += 1
+            raise ShuffleBlockCorruptError(key, crc, e.actual,
+                                           source="catalog") from None
+        return payload
+
     def _keys_for_reduce(self, shuffle_id: int, reduce_id: int,
                          map_range: Optional[Tuple[int, int]]
                          ) -> List[Tuple[int, int, int]]:
@@ -112,31 +164,62 @@ class ShuffleBufferCatalog:
     def blocks_for_reduce(self, shuffle_id: int, reduce_id: int,
                           map_range: Optional[Tuple[int, int]] = None
                           ) -> List[bytes]:
+        return [p for _mid, p in self.blocks_with_ids_for_reduce(
+            shuffle_id, reduce_id, map_range)]
+
+    def blocks_with_ids_for_reduce(self, shuffle_id: int, reduce_id: int,
+                                   map_range: Optional[Tuple[int, int]]
+                                   = None):
+        """Lazily yield (map_id, payload) per block of the reduce
+        partition, verified, in map order — the streaming read the
+        recovery path needs (it must know WHICH map outputs were already
+        delivered before a corruption surfaced). Keys snapshot under the
+        lock; each payload reads under the lock at yield time
+        (position-independent keying makes that safe against concurrent
+        registration) and verifies outside it."""
         with self._lock:
             keys = self._keys_for_reduce(shuffle_id, reduce_id, map_range)
-            return [self._read_block(self._blocks[k]) for k in keys]
+        for k in keys:
+            with self._lock:
+                payload, crc = self._read_for_verify(k)
+            yield k[1], self._verify_payload(k, payload, crc)
 
     def block_metas_for_reduce(self, shuffle_id: int, reduce_id: int,
                                map_range: Optional[Tuple[int, int]] = None
-                               ) -> List[Tuple[int, int]]:
-        """(map_id, size_bytes) per block of the reduce partition, sorted
-        by map_id — metadata only. Serving META must not materialize
-        payloads (arena copies / disk reads); a k-block fetch then reads
-        each payload exactly once via :meth:`read_block`."""
+                               ) -> List[Tuple[int, int, int]]:
+        """(map_id, size_bytes, crc32c) per block of the reduce
+        partition, sorted by map_id — metadata only. Serving META must
+        not materialize payloads (arena copies / disk reads); a k-block
+        fetch then reads each payload exactly once via
+        :meth:`read_block`."""
         with self._lock:
             keys = self._keys_for_reduce(shuffle_id, reduce_id, map_range)
             return [(k[1], self._blocks[k][2]
                      if isinstance(self._blocks[k], tuple)
-                     else len(self._blocks[k])) for k in keys]
+                     else len(self._blocks[k]),
+                     self._crcs.get(k, 0)) for k in keys]
 
     def read_block(self, shuffle_id: int, map_id: int,
                    reduce_id: int) -> bytes:
         """One block payload by its stable (shuffle, map, reduce) key — the
         reference's tag scheme. Position-independent, so blocks added
         between a client's META and FETCH can't shift addressing."""
+        key = (shuffle_id, map_id, reduce_id)
         with self._lock:
-            return self._read_block(
-                self._blocks[(shuffle_id, map_id, reduce_id)])
+            payload, crc = self._read_for_verify(key)
+        return self._verify_payload(key, payload, crc)
+
+    def read_block_with_crc(self, shuffle_id: int, map_id: int,
+                            reduce_id: int) -> Tuple[bytes, int]:
+        """(payload, crc32c) for the wire server: the payload is verified
+        at rest before serving, and the registration checksum travels
+        with it so the peer verifies end-to-end."""
+        key = (shuffle_id, map_id, reduce_id)
+        with self._lock:
+            payload, crc = self._read_for_verify(key)
+            stored = self._crcs.get(key, 0)
+        self._verify_payload(key, payload, crc)
+        return payload, stored
 
     def sizes_for_shuffle(self, shuffle_id: int
                           ) -> Dict[Tuple[int, int], int]:
@@ -151,6 +234,7 @@ class ShuffleBufferCatalog:
         with self._lock:
             for k in [k for k in self._blocks if k[0] == shuffle_id]:
                 v = self._blocks.pop(k)
+                self._crcs.pop(k, None)
                 if isinstance(v, tuple):
                     if v[0] == "arena":
                         self._arena.free(v[1])
@@ -178,10 +262,253 @@ class ShuffleBufferCatalog:
     def close(self):
         with self._lock:
             self._blocks.clear()
+            self._crcs.clear()
             self._arena.close()
             if self._spill_file is not None:
                 self._spill_file.close()
                 self._spill_file = None
+
+
+class MapOutputTracker:
+    """Map-output lineage registry + peer health — the driver-side
+    ``MapOutputTracker`` / stage-retry analog, session-scoped so
+    blacklists and recompute budgets survive per-query context rebuilds.
+
+    Two recovery roles (ISSUE 7):
+
+    * **Lineage recompute.** Each live shuffle registers a deterministic
+      closure that re-runs its map side for ONE reduce partition and
+      returns ``[(map_id, payload)]``. When the fetch plane exhausts
+      retries (:class:`~.net.ShuffleFetchFailedError`) or a block fails
+      checksum past refetch
+      (:class:`~.transport.ShuffleBlockCorruptError`), the read path asks
+      the tracker to regenerate the partition instead of failing the
+      query — only map outputs not already delivered are re-yielded, and
+      the regenerated bytes of already-delivered outputs must match their
+      recorded checksums (a diverged recompute raises rather than mixing
+      generations: never a wrong answer).
+    * **Peer health.** Exhausted fetch ladders against a peer count
+      toward ``spark.rapids.tpu.shuffle.net.maxPeerFailures``; a peer
+      over the limit is blacklisted for the session — later reads skip
+      the dial and go straight to lineage (``peersBlacklisted`` metric).
+
+    For multi-process topologies the driver/harness can register a
+    **peer lineage** callback (``set_peer_lineage``) that regenerates a
+    DEAD peer's map outputs locally from its input-shard assignment —
+    the Spark semantics of rescheduling a lost executor's map tasks."""
+
+    #: recompute attempts allowed per (shuffle, reduce) before the
+    #: original error propagates — repeated corruption of regenerated
+    #: data means the fault is not in the stored bytes.
+    MAX_RECOMPUTES = 2
+
+    def __init__(self, conf=None):
+        from ..config import SHUFFLE_NET_MAX_PEER_FAILURES
+        try:
+            self.max_peer_failures = int(
+                conf.get(SHUFFLE_NET_MAX_PEER_FAILURES))
+        except (AttributeError, TypeError):
+            self.max_peer_failures = SHUFFLE_NET_MAX_PEER_FAILURES.default
+        self._lineage: Dict[int, object] = {}
+        self._peer_lineage = None
+        self._peer_failures: Dict[Tuple[str, int], int] = {}
+        self._blacklist: set = set()
+        self._recomputes: Dict[Tuple[int, int], int] = {}
+        self._lock = threading.Lock()
+        self.metrics = {"map_tasks_recomputed": 0, "recomputes": 0,
+                        "peers_blacklisted": 0}
+
+    # -- lineage ------------------------------------------------------------
+    def register_shuffle(self, shuffle_id: int, lineage) -> None:
+        """``lineage(reduce_id) -> [(map_id, payload)]`` re-runs the map
+        side of ``shuffle_id`` for one reduce partition (registered by
+        the exchange after its write phase)."""
+        with self._lock:
+            self._lineage[shuffle_id] = lineage
+
+    def unregister_shuffle(self, shuffle_id: int) -> None:
+        with self._lock:
+            self._lineage.pop(shuffle_id, None)
+            for k in [k for k in self._recomputes if k[0] == shuffle_id]:
+                del self._recomputes[k]
+
+    def has_lineage(self, shuffle_id: int) -> bool:
+        with self._lock:
+            return shuffle_id in self._lineage
+
+    def recompute(self, shuffle_id: int, reduce_id: int, ctx=None,
+                  node: str = "TpuShuffleExchangeExec"):
+        """Regenerate one reduce partition's blocks from lineage, or None
+        when no lineage is registered / the recompute budget for this
+        partition is spent. Returns ``[(map_id, payload)]``."""
+        with self._lock:
+            fn = self._lineage.get(shuffle_id)
+            if fn is None:
+                return None
+            key = (shuffle_id, reduce_id)
+            if self._recomputes.get(key, 0) >= self.MAX_RECOMPUTES:
+                return None
+            self._recomputes[key] = self._recomputes.get(key, 0) + 1
+        out = fn(reduce_id)
+        with self._lock:
+            self.metrics["recomputes"] += 1
+            self.metrics["map_tasks_recomputed"] += len(out)
+        if ctx is not None and hasattr(ctx, "metric"):
+            ctx.metric(node, "mapTasksRecomputed", len(out))
+        return out
+
+    # -- peer health --------------------------------------------------------
+    def set_peer_lineage(self, fn) -> None:
+        """``fn(peer, shuffle_id, reduce_id) -> [(map_id, payload)] |
+        None`` regenerates a remote peer's map outputs locally (the
+        driver knows every rank's input-shard assignment)."""
+        with self._lock:
+            self._peer_lineage = fn
+
+    def recompute_peer(self, peer, shuffle_id: int, reduce_id: int,
+                       ctx=None, node: str = "ShuffleFetch"):
+        with self._lock:
+            fn = self._peer_lineage
+        if fn is None:
+            return None
+        out = fn(peer, shuffle_id, reduce_id)
+        if out is None:
+            return None
+        with self._lock:
+            self.metrics["recomputes"] += 1
+            self.metrics["map_tasks_recomputed"] += len(out)
+        if ctx is not None and hasattr(ctx, "metric"):
+            ctx.metric(node, "mapTasksRecomputed", len(out))
+        return out
+
+    def record_peer_failure(self, peer, ctx=None,
+                            node: str = "ShuffleFetch") -> bool:
+        """Count one exhausted fetch ladder against ``peer``; True when
+        this failure crossed the blacklist threshold."""
+        peer = tuple(peer)
+        with self._lock:
+            n = self._peer_failures.get(peer, 0) + 1
+            self._peer_failures[peer] = n
+            if self.max_peer_failures <= 0 or peer in self._blacklist \
+                    or n < self.max_peer_failures:
+                return False
+            self._blacklist.add(peer)
+            self.metrics["peers_blacklisted"] += 1
+        if ctx is not None and hasattr(ctx, "metric"):
+            ctx.metric(node, "peersBlacklisted", 1)
+        return True
+
+    def is_blacklisted(self, peer) -> bool:
+        with self._lock:
+            return tuple(peer) in self._blacklist
+
+    def peer_failures(self, peer) -> int:
+        with self._lock:
+            return self._peer_failures.get(tuple(peer), 0)
+
+
+def _tracker_of(ctx) -> MapOutputTracker:
+    """The context's session-scoped tracker (TpuSession passes its own so
+    blacklists persist across queries); bare contexts lazily get one."""
+    tracker = getattr(ctx, "shuffle_tracker", None)
+    if tracker is None:
+        tracker = MapOutputTracker(getattr(ctx, "conf", None))
+        try:
+            ctx.shuffle_tracker = tracker
+        except AttributeError:  # frozen test doubles
+            pass
+    return tracker
+
+
+def _missing_from_lineage(regen, delivered, map_range, peer,
+                          shuffle_id: int, reduce_id: int):
+    """The ONE generation-mixing guard both recovery paths share
+    (:func:`fetch_with_recovery` and the exchange's internal
+    ``recovered_payloads``): given a lineage recompute of a whole reduce
+    partition and the blocks already delivered downstream
+    (``{map_id: crc32c-of-delivered-payload}``), return the
+    ``[(map_id, payload)]`` still missing — after checking that the
+    regenerated bytes of every delivered map id match what was delivered
+    (serialization is deterministic, so equal content means equal
+    bytes). A recompute whose segmentation diverged — possible only when
+    the ORIGINAL map run OOM-split a batch that the recompute did not,
+    or vice versa — fails CLOSED with a typed error naming the peer
+    rather than mixing shuffle generations; with nothing delivered yet
+    (the common case: corruption detected on a partition's first read)
+    any segmentation is safe."""
+    from ..utils import checksum as CK
+    from .net import ShuffleFetchFailedError
+    if map_range is not None:
+        # Honor the caller's map range like the fetch did, or a
+        # range-split read would see rows outside its slice twice.
+        regen = [(mid, p) for mid, p in regen
+                 if map_range[0] <= mid < map_range[1]]
+    regen_ids = {mid for mid, _ in regen}
+    diverged = not set(delivered) <= regen_ids or any(
+        mid in delivered and delivered[mid] is not None
+        and CK.crc32c(payload) != delivered[mid]
+        for mid, payload in regen)
+    if diverged:
+        raise ShuffleFetchFailedError(
+            tuple(peer), shuffle_id, reduce_id,
+            "lineage recompute diverged from the already-delivered map "
+            f"outputs {sorted(delivered)} — refusing to mix shuffle "
+            "generations")
+    return [(mid, p) for mid, p in regen if mid not in delivered]
+
+
+def fetch_with_recovery(peer, shuffle_id: int, reduce_id: int,
+                        tracker: MapOutputTracker, ctx=None,
+                        node: str = "ShuffleFetch", **iterator_kw):
+    """Fetch one reduce partition from a REMOTE peer with the full
+    recovery ladder (the reduce-task entry point for multi-process
+    shuffle): stream-fetch with per-block verify and refetch
+    (:class:`~.net.RetryingBlockIterator`) -> on exhaustion or corruption,
+    count the peer failure (blacklisting it past maxPeerFailures) and
+    regenerate its missing map outputs from peer lineage (delivered
+    blocks are checked against the regenerated bytes — see
+    :func:`_missing_from_lineage`) -> only when no lineage exists,
+    re-raise the typed error naming the peer. Yields payload bytes in
+    map order; a blacklisted peer skips the dial entirely."""
+    from .net import RetryingBlockIterator, ShuffleFetchFailedError
+    from .transport import ShuffleBlockCorruptError
+    map_range = iterator_kw.get("map_range")
+
+    def _regenerated(delivered):
+        regen = tracker.recompute_peer(peer, shuffle_id, reduce_id, ctx,
+                                       node)
+        if regen is None:
+            return None
+        return _missing_from_lineage(regen, delivered, map_range, peer,
+                                     shuffle_id, reduce_id)
+
+    if tracker.is_blacklisted(peer):
+        out = _regenerated({})
+        if out is None:
+            raise ShuffleFetchFailedError(
+                tuple(peer), shuffle_id, reduce_id,
+                f"peer blacklisted after {tracker.peer_failures(peer)} "
+                "fetch failures and no peer lineage is registered")
+        for _mid, payload in out:
+            yield payload
+        return
+    it = RetryingBlockIterator(
+        tuple(peer), shuffle_id, reduce_id, ctx=ctx, node=node,
+        with_map_ids=True, **iterator_kw)
+    try:
+        for _mid, payload in it:
+            yield payload
+        return
+    except (ShuffleFetchFailedError, ShuffleBlockCorruptError) as e:
+        tracker.record_peer_failure(peer, ctx, node)
+        # The iterator already verified every delivered payload against
+        # its descriptor checksum — reuse those crcs for the generation
+        # guard instead of re-hashing on the healthy path.
+        out = _regenerated(dict(it.delivered_crcs))
+        if out is None:
+            raise e
+    for _mid, payload in out:
+        yield payload
 
 
 _next_shuffle_id = [0]
@@ -356,6 +683,55 @@ class TpuShuffleExchangeExec(PhysicalPlan):
             while ser_futs:
                 ser_futs.popleft().result()
 
+        # Lineage registration (ISSUE 7, the stage-retry analog): a
+        # deterministic closure that re-runs THIS exchange's map side for
+        # one reduce partition — re-executing the child subtree through
+        # the same cached partition kernel and serializer — so a block
+        # lost to corruption or a dead transport recomputes instead of
+        # failing the query. Registered with the session-scoped
+        # MapOutputTracker; recovery consumers verify regenerated bytes
+        # against the original checksums before trusting partial mixes.
+        # Known limit: map ids count with_retry pieces, so a recompute
+        # whose OOM-split schedule differs from the original write's
+        # segments differently — the shared guard then fails CLOSED
+        # (typed error, never mixed generations); with nothing delivered
+        # yet (the common case) any segmentation recovers fine.
+        tracker = _tracker_of(ctx)
+
+        def recompute_reduce(target_p: int):
+            out = []
+            mid = 0
+            for part in self.children[0].execute(ctx):
+                for db in part:
+                    if int(db.n_rows) == 0:
+                        continue
+                    for rb, ids_np in R.with_retry(
+                            ctx, f"{name}.partitionSplit", db,
+                            partition_split, split=R.halve_by_rows,
+                            node=name):
+                        lo = int(np.searchsorted(ids_np, target_p, "left"))
+                        hi = int(np.searchsorted(ids_np, target_p,
+                                                 "right"))
+                        if hi > lo:
+                            piece = rb.slice(lo, hi - lo)
+                            out.append((mid,
+                                        serialize_batch(piece, codec)))
+                        mid += 1
+            return out
+
+        tracker.register_shuffle(shuffle_id, recompute_reduce)
+        ctx.add_cleanup(lambda: tracker.unregister_shuffle(shuffle_id))
+
+        # Wire plane (spark.rapids.tpu.shuffle.net.enabled): serve this
+        # catalog over TCP and fetch every reduce-side block back through
+        # the full protocol-v3 client — handshake, CRC32C verification,
+        # conf timeouts, streaming refetch — over a real loopback socket.
+        # The identical code path a remote peer takes, so the distributed
+        # plane is exercised (and fault-injected) by ordinary queries.
+        from ..config import SHUFFLE_NET_ENABLED
+        net_server = _net_serve(ctx, catalog) \
+            if ctx.conf.get(SHUFFLE_NET_ENABLED) else None
+
         # READ side (RapidsCachingReader analog): lazy fetch + re-upload.
         # Blocks free once every reduce partition is drained — or at query
         # end via the context cleanup (a limit may never start some
@@ -401,6 +777,52 @@ class TpuShuffleExchangeExec(PhysicalPlan):
             specs = [aqe.CoalescedSpec(p, p + 1) for p in range(n_parts)]
         drained = {"n": 0}
 
+        def recovered_payloads(p, map_range):
+            """One reduce partition's verified payloads, in map order,
+            surviving corruption and transport failure: stream from the
+            wire plane (or the verified local catalog), and on a typed
+            durability error regenerate the partition from lineage —
+            through the shared :func:`_missing_from_lineage` guard, so a
+            diverged recompute raises instead of mixing generations."""
+            from ..utils import checksum as CK
+            from .net import RetryingBlockIterator, ShuffleFetchFailedError
+            from .transport import ShuffleBlockCorruptError
+            delivered_ids: set = set()
+            try:
+                if net_server is not None:
+                    src = RetryingBlockIterator(
+                        net_server.address, shuffle_id, p, ctx=ctx,
+                        node=name, map_range=map_range, with_map_ids=True)
+                else:
+                    src = catalog.blocks_with_ids_for_reduce(
+                        shuffle_id, p, map_range)
+                for mid, payload in src:
+                    delivered_ids.add(mid)
+                    yield payload
+                return
+            except (ShuffleFetchFailedError, ShuffleBlockCorruptError,
+                    CK.ChecksumError):
+                # No peer-failure accounting here: the wire plane's
+                # server is this query's own ephemeral loopback (nothing
+                # would ever dial it again); blacklisting belongs to the
+                # real remote path (fetch_with_recovery).
+                peer = net_server.address if net_server is not None \
+                    else ("local", 0)
+                regen = tracker.recompute(shuffle_id, p, ctx=ctx,
+                                          node=name)
+                if regen is None:
+                    raise
+                # Delivered payloads passed verification, so their crcs
+                # ARE the catalog's stored registration crcs — no extra
+                # hashing on the healthy path.
+                stored = {m: c for m, _l, c in
+                          catalog.block_metas_for_reduce(shuffle_id, p)}
+                missing = _missing_from_lineage(
+                    regen, {mid: stored.get(mid) for mid in delivered_ids},
+                    map_range, peer, shuffle_id, p)
+            for _mid, payload in missing:
+                yield payload
+
         def read_spec(spec):
             try:
                 if isinstance(spec, aqe.PartialReducerSpec):
@@ -414,8 +836,7 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                     pieces = [(p, None)
                               for p in range(spec.start, spec.end)]
                 for p, map_range in pieces:
-                    for payload in catalog.blocks_for_reduce(
-                            shuffle_id, p, map_range):
+                    for payload in recovered_payloads(p, map_range):
                         ctx.metric(name, "shuffleBytesRead", len(payload))
                         with ctx.registry.timer(
                                 name, "deserializationTime",
@@ -441,11 +862,26 @@ def _shuffle_env(ctx: ExecContext) -> ShuffleBufferCatalog:
     """Per-context shuffle storage (GpuShuffleEnv.initStorage analog)."""
     env = getattr(ctx, "_shuffle_catalog", None)
     if env is None:
-        from ..config import HOST_SPILL_STORAGE_SIZE, SPILL_DIR
-        env = ShuffleBufferCatalog(ctx.conf.get(HOST_SPILL_STORAGE_SIZE),
-                                   ctx.conf.get(SPILL_DIR))
+        from ..config import (HOST_SPILL_STORAGE_SIZE,
+                              SHUFFLE_CHECKSUM_ENABLED, SPILL_DIR)
+        env = ShuffleBufferCatalog(
+            ctx.conf.get(HOST_SPILL_STORAGE_SIZE),
+            ctx.conf.get(SPILL_DIR),
+            verify_checksums=ctx.conf.get(SHUFFLE_CHECKSUM_ENABLED))
         ctx._shuffle_catalog = env
         # Query-end teardown: free any still-pinned blocks and delete the
         # spill file so long sessions don't accumulate host memory/disk.
         ctx.add_cleanup(env.close)
     return env
+
+
+def _net_serve(ctx: ExecContext, catalog: ShuffleBufferCatalog):
+    """One loopback NetShuffleServer per context catalog (the wire plane
+    of spark.rapids.tpu.shuffle.net.enabled), closed at query end."""
+    server = getattr(ctx, "_shuffle_net_server", None)
+    if server is None:
+        from .net import NetShuffleServer
+        server = NetShuffleServer(catalog)
+        ctx._shuffle_net_server = server
+        ctx.add_cleanup(server.close)
+    return server

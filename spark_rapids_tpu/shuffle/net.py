@@ -12,25 +12,41 @@ through the same :class:`~.transport.ShuffleClient` state machine
 (bounce buffers + inflight throttle) that the in-process
 :class:`~.transport.LocalTransport` feeds.
 
-Protocol (length-prefixed binary, little-endian):
+Protocol v3 (length-prefixed binary, little-endian) — v3 adds end-to-end
+CRC32C integrity (ISSUE 7):
 
 * handshake: server greets ``b"SRTPU" + version`` on accept; a client that
   sees anything else disconnects (the management-port validation role).
 * ``META  (op=1, shuffle_id, reduce_id)`` ->
-  ``ok, n, n * (u32 map_id, u64 length)`` — metadata only; the server
-  never materializes payloads to answer META.
+  ``ok, n, n * (u32 map_id, u64 length, u32 crc32c)`` — metadata only;
+  the server never materializes payloads to answer META. The per-block
+  CRC32C recorded at registration rides the metadata so the client can
+  verify every payload independently of the connection that carried it.
+  ``crc32c=0`` is reserved as "no checksum recorded" (a serving catalog
+  without checksum support); clients skip verification for such blocks.
 * ``FETCH (op=2, shuffle_id, reduce_id, map_id)`` -> ``ok, u64 len,
-  bytes`` — keyed by the stable (shuffle, map, reduce) block id (the
-  reference's tag scheme), not by position in a catalog snapshot, so
-  blocks registered between META and FETCH cannot shift addressing.
+  u32 crc32c, bytes`` — keyed by the stable (shuffle, map, reduce) block
+  id (the reference's tag scheme), not by position in a catalog snapshot,
+  so blocks registered between META and FETCH cannot shift addressing.
+  The server verifies the block against its stored checksum BEFORE
+  sending — corruption at rest on the serving side answers as a protocol
+  error, not as bytes.
 * errors -> ``ok=1, u32 msg_len, msg`` and the connection stays usable.
 
+Timeouts are conf-driven (``spark.rapids.tpu.shuffle.net.connectTimeout``
+/ ``requestTimeout``) — a dead or stalled peer fails the attempt instead
+of wedging the query, and the query deadline (utils/deadline.py) bounds
+them further.
+
 :class:`RetryingBlockIterator` is the task-facing
-``RapidsShuffleIterator`` analog: it drains fetched blocks, retries
-transient failures with backoff, and raises
-:class:`ShuffleFetchFailedError` (naming the peer) when retries exhaust —
-the signal an upper layer uses to recompute the map outputs, exactly the
-role ``FetchFailedException`` plays for Spark's stage retry.
+``RapidsShuffleIterator`` analog: it STREAMS blocks as they arrive and
+verify, retries transient failures with backoff — refetching only the
+blocks not yet yielded — and raises :class:`ShuffleFetchFailedError`
+(naming the peer and carrying exactly which map outputs are missing)
+when retries exhaust: the signal the exchange's
+:class:`~.exchange.MapOutputTracker` uses to recompute the missing map
+tasks from lineage, exactly the role ``FetchFailedException`` plays for
+Spark's stage retry.
 """
 
 from __future__ import annotations
@@ -40,33 +56,43 @@ import socketserver
 import struct
 import threading
 import time
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Iterator, List, Optional, Tuple
 
-from .transport import (BlockDescriptor, BounceBufferPool, ShuffleClient,
-                        Throttle, Transport)
+from ..utils import checksum as CK
+from ..utils.deadline import QueryDeadlineExceeded
+from .transport import (BlockDescriptor, BounceBufferPool,
+                        ShuffleBlockCorruptError, ShuffleClient, Throttle,
+                        Transport)
 
 MAGIC = b"SRTPU"
-VERSION = 2
+#: v3: CRC32C in META entries and FETCH responses (ISSUE 7).
+VERSION = 3
 
 _OP_META = 1
 _OP_FETCH = 2
 
 _REQ = struct.Struct("<BIII")  # op, shuffle_id, reduce_id, map_id
+_META_ENTRY = struct.Struct("<IQI")  # map_id, length, crc32c
+_FETCH_HEAD = struct.Struct("<QI")  # length, crc32c (after the ok byte)
 
 
 class ShuffleFetchFailedError(Exception):
     """Fetch retries exhausted against a peer
-    (RapidsShuffleFetchFailedException analog): carries the peer address
-    and the (shuffle, reduce) that must be recomputed."""
+    (RapidsShuffleFetchFailedException analog): carries the peer address,
+    the (shuffle, reduce) that must be recovered, and which map outputs
+    were already delivered — the recompute path regenerates only the
+    rest."""
 
     def __init__(self, peer: Tuple[str, int], shuffle_id: int,
-                 reduce_id: int, cause: str):
+                 reduce_id: int, cause: str,
+                 yielded_map_ids: Optional[frozenset] = None):
         super().__init__(
             f"shuffle {shuffle_id} reduce {reduce_id} fetch from "
             f"{peer[0]}:{peer[1]} failed: {cause}")
         self.peer = peer
         self.shuffle_id = shuffle_id
         self.reduce_id = reduce_id
+        self.yielded_map_ids = frozenset(yielded_map_ids or ())
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -77,6 +103,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed")
         out.extend(chunk)
     return bytes(out)
+
+
+def _block_payload_crc(catalog, shuffle_id: int, map_id: int,
+                       reduce_id: int) -> Tuple[bytes, int]:
+    """One (payload, crc32c) from any catalog: durability-aware catalogs
+    verify at rest and return their stored crc; plain ones get a fresh
+    computation (the wire is still covered end-to-end)."""
+    reader = getattr(catalog, "read_block_with_crc", None)
+    if reader is not None:
+        return reader(shuffle_id, map_id, reduce_id)
+    payload = catalog.read_block(shuffle_id, map_id, reduce_id)
+    return payload, CK.crc32c(payload)
 
 
 class _Handler(socketserver.BaseRequestHandler):
@@ -94,23 +132,40 @@ class _Handler(socketserver.BaseRequestHandler):
                     metas = catalog.block_metas_for_reduce(shuffle_id,
                                                            reduce_id)
                     resp = bytearray(struct.pack("<BI", 0, len(metas)))
-                    for mid, length in metas:
-                        resp += struct.pack("<IQ", mid, length)
+                    for entry in metas:
+                        mid, length = entry[0], entry[1]
+                        crc = entry[2] if len(entry) > 2 else 0
+                        resp += _META_ENTRY.pack(mid, length, crc)
                     self.request.sendall(bytes(resp))
                 elif op == _OP_FETCH:
                     try:
-                        payload = catalog.read_block(shuffle_id, map_id,
-                                                     reduce_id)
+                        payload, crc = _block_payload_crc(
+                            catalog, shuffle_id, map_id, reduce_id)
                     except KeyError:
                         raise KeyError(
                             f"no block map {map_id} for shuffle "
                             f"{shuffle_id} reduce {reduce_id}") from None
-                    self.request.sendall(struct.pack("<BQ", 0, len(payload)))
+                    self.request.sendall(
+                        struct.pack("<B", 0)
+                        + _FETCH_HEAD.pack(len(payload), crc))
                     self.request.sendall(payload)
                 else:
                     raise ValueError(f"bad opcode {op}")
-            except (ConnectionError, OSError):
-                return
+            except (ConnectionError, OSError) as e:
+                # Socket-plane failure: connection is gone. EXCEPT the
+                # catalog's own typed corruption signal (an IOError so the
+                # retry taxonomy buckets it transient): that must answer
+                # as a protocol error so the peer can escalate to
+                # recompute instead of seeing a silent disconnect.
+                if not isinstance(e, (ShuffleBlockCorruptError,
+                                      CK.ChecksumError)):
+                    return
+                msg = str(e).encode()
+                try:
+                    self.request.sendall(
+                        struct.pack("<BI", 1, len(msg)) + msg)
+                except OSError:
+                    return
             except Exception as e:  # noqa: BLE001 - protocol error reply
                 msg = str(e).encode()
                 try:
@@ -145,12 +200,15 @@ class NetShuffleServer:
 
 class NetTransport(Transport):
     """TCP client side of the wire (one connection, request/response).
-    Raises ConnectionError on handshake mismatch."""
+    Raises ConnectionError on handshake mismatch. Timeouts come from the
+    shuffle.net confs via the callers (RetryingBlockIterator /
+    exchange)."""
 
-    def __init__(self, peer: Tuple[str, int], connect_timeout: float = 5.0):
+    def __init__(self, peer: Tuple[str, int], connect_timeout: float = 5.0,
+                 request_timeout: float = 30.0):
         self.peer = peer
         self._sock = socket.create_connection(peer, timeout=connect_timeout)
-        self._sock.settimeout(30.0)
+        self._sock.settimeout(request_timeout)
         greeting = _recv_exact(self._sock, len(MAGIC) + 1)
         if greeting[:len(MAGIC)] != MAGIC or greeting[-1] != VERSION:
             self._sock.close()
@@ -177,10 +235,14 @@ class NetTransport(Transport):
             (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
             out = []
             for _ in range(n):
-                mid, length = struct.unpack(
-                    "<IQ", _recv_exact(self._sock, 12))
+                mid, length, crc = _META_ENTRY.unpack(
+                    _recv_exact(self._sock, _META_ENTRY.size))
+                # crc=0 is the wire encoding of "no checksum recorded"
+                # (a crc-less serving catalog): verification must skip,
+                # not fail every healthy block against zero.
                 out.append(BlockDescriptor((shuffle_id, mid, reduce_id),
-                                           length, block_no=mid))
+                                           length, block_no=mid,
+                                           crc=crc or None))
             return out
 
     def fetch_block_chunks(self, desc: BlockDescriptor, chunk_size: int):
@@ -189,7 +251,13 @@ class NetTransport(Transport):
             self._sock.sendall(_REQ.pack(_OP_FETCH, sid, rid, mid))
             status = _recv_exact(self._sock, 1)[0]
             self._check_error(status)
-            (length,) = struct.unpack("<Q", _recv_exact(self._sock, 8))
+            length, crc = _FETCH_HEAD.unpack(
+                _recv_exact(self._sock, _FETCH_HEAD.size))
+            if desc.crc is None and crc:
+                # Fetch without a prior META (direct addressing): adopt
+                # the wire-carried checksum so the client still verifies
+                # (0 = the serving side has no checksum for this block).
+                desc.crc = crc
             remaining = length
             try:
                 while remaining > 0:
@@ -209,19 +277,44 @@ class NetTransport(Transport):
                     self.close()
 
 
-class RetryingBlockIterator:
-    """Task-facing fetch iterator with retry (RapidsShuffleIterator:46).
+def _net_timeouts(ctx) -> Tuple[float, float]:
+    """(connect, request) timeouts from the context's conf, else the conf
+    defaults — satellite of ISSUE 7 (previously hardcoded 5.0/30.0)."""
+    from ..config import (SHUFFLE_NET_CONNECT_TIMEOUT,
+                          SHUFFLE_NET_REQUEST_TIMEOUT)
+    conf = getattr(ctx, "conf", None)
+    try:
+        return (float(conf.get(SHUFFLE_NET_CONNECT_TIMEOUT)),
+                float(conf.get(SHUFFLE_NET_REQUEST_TIMEOUT)))
+    except (AttributeError, TypeError):
+        return (SHUFFLE_NET_CONNECT_TIMEOUT.default,
+                SHUFFLE_NET_REQUEST_TIMEOUT.default)
 
-    Pulls every block of (shuffle_id, reduce_id) from ``peer``. Transient
-    failures (connection resets, short reads) reconnect and retry up to
-    ``max_retries`` with exponential backoff; exhaustion raises
-    :class:`ShuffleFetchFailedError` for the recompute path."""
+
+class RetryingBlockIterator:
+    """Task-facing STREAMING fetch iterator with retry
+    (RapidsShuffleIterator:46).
+
+    Pulls every block of (shuffle_id, reduce_id) from ``peer``, yielding
+    each block as soon as it arrives and passes CRC32C verification —
+    blocks are never buffered for the whole partition (the pre-ISSUE-7
+    iterator held every block in memory before yielding the first).
+    Transient failures (connection resets, short reads, checksum
+    mismatches, timeouts) reconnect and retry up to ``max_retries`` with
+    exponential backoff, REFETCHING ONLY the blocks not yet yielded;
+    exhaustion raises :class:`ShuffleFetchFailedError` carrying the
+    already-yielded map ids for the recompute path. An optional ``ctx``
+    threads in conf timeouts, the query deadline, the network fault
+    injector, and metric attribution (``shuffleBlocksRefetched``)."""
 
     def __init__(self, peer: Tuple[str, int], shuffle_id: int,
                  reduce_id: int, bounce: Optional[BounceBufferPool] = None,
                  throttle: Optional[Throttle] = None, max_retries: int = 3,
                  backoff_s: float = 0.05,
-                 transport_factory: Optional[Callable[[], Transport]] = None):
+                 transport_factory: Optional[Callable[[], Transport]] = None,
+                 ctx=None, node: str = "ShuffleFetch",
+                 map_range: Optional[Tuple[int, int]] = None,
+                 with_map_ids: bool = False):
         self.peer = peer
         self.shuffle_id = shuffle_id
         self.reduce_id = reduce_id
@@ -229,29 +322,79 @@ class RetryingBlockIterator:
         self.throttle = throttle or Throttle(64 << 20)
         self.max_retries = max_retries
         self.backoff_s = backoff_s
-        self._factory = transport_factory or (lambda: NetTransport(peer))
+        self.ctx = ctx
+        self.node = node
+        self.map_range = map_range
+        self.with_map_ids = with_map_ids
+        self.connect_timeout, self.request_timeout = _net_timeouts(ctx)
+        self._factory = transport_factory or (
+            lambda: NetTransport(peer, self.connect_timeout,
+                                 self.request_timeout))
+        #: map_id -> verified crc32c (or None for crc-less blocks) of
+        #: every block yielded so far — recovery consumers
+        #: (fetch_with_recovery) read this instead of re-hashing payloads
+        #: the client already verified. Reset at each __iter__.
+        self.delivered_crcs: dict = {}
 
-    def __iter__(self):
+    def _metric(self, name: str, value: int) -> None:
+        if self.ctx is not None and hasattr(self.ctx, "metric"):
+            self.ctx.metric(self.node, name, value)
+
+    def __iter__(self) -> Iterator:
+        deadline = getattr(self.ctx, "deadline", None)
+        self.delivered_crcs = {}
+        yielded: set = set()
+        attempted: set = set()
         last_error = "unknown"
         for attempt in range(self.max_retries + 1):
-            blocks: List[bytes] = []
-            errors: List[str] = []
+            prev_attempted = frozenset(attempted)
             transport = None
             try:
                 transport = self._factory()
-                client = ShuffleClient(transport, self.bounce, self.throttle)
-                client.fetch(self.shuffle_id, self.reduce_id,
-                             blocks.append, errors.append)
+                client = ShuffleClient(transport, self.bounce,
+                                       self.throttle, ctx=self.ctx,
+                                       node=self.node)
+                descs = transport.request_metadata(self.shuffle_id,
+                                                   self.reduce_id)
+                if self.map_range is not None:
+                    lo, hi = self.map_range
+                    descs = [d for d in descs if lo <= d.tag[1] < hi]
+                pending = [d for d in descs if d.tag[1] not in yielded]
+                for desc in pending:
+                    if deadline is not None:
+                        deadline.check(
+                            f"shuffle.fetch {self.peer[0]}:{self.peer[1]}",
+                            self.ctx, self.node)
+                    # Count ONLY blocks a previous attempt actually
+                    # started fetching — a block never tried before is a
+                    # first fetch, not a refetch (keeps the recovery
+                    # counters honest about work redone).
+                    if desc.tag[1] in prev_attempted:
+                        self._metric("shuffleBlocksRefetched", 1)
+                    attempted.add(desc.tag[1])
+                    payload = client.fetch_one(desc)
+                    yielded.add(desc.tag[1])
+                    self.delivered_crcs[desc.tag[1]] = desc.crc
+                    yield (desc.tag[1], payload) if self.with_map_ids \
+                        else payload
+                return
+            except QueryDeadlineExceeded:
+                raise
+            except GeneratorExit:
+                raise
             except Exception as e:  # noqa: BLE001 - retried below
-                errors.append(str(e))
+                last_error = f"{type(e).__name__}: {e}"
             finally:
                 if transport is not None and hasattr(transport, "close"):
                     transport.close()
-            if not errors:
-                yield from blocks
-                return
-            last_error = errors[0]
             if attempt < self.max_retries:
-                time.sleep(self.backoff_s * (2 ** attempt))
+                delay = self.backoff_s * (2 ** attempt)
+                if deadline is not None:
+                    deadline.check(
+                        f"shuffle.fetch {self.peer[0]}:{self.peer[1]}",
+                        self.ctx, self.node)
+                    delay = deadline.bound(delay)
+                time.sleep(delay)
         raise ShuffleFetchFailedError(self.peer, self.shuffle_id,
-                                      self.reduce_id, last_error)
+                                      self.reduce_id, last_error,
+                                      yielded_map_ids=yielded)

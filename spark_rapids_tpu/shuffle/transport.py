@@ -47,11 +47,36 @@ class Transaction:
 class BlockDescriptor:
     """(address, length, tag) transfer descriptor (AddressLengthTag
     analog); ``block_no`` is the block's ordinal within its reduce
-    partition, the tag component a fetch uses to address it."""
+    partition, the tag component a fetch uses to address it. ``crc`` is
+    the block's CRC32C recorded at registration (wire protocol v3) —
+    the client verifies every received payload against it; None means
+    the serving side predates checksums (verification skipped)."""
 
     tag: Tuple[int, int, int]  # (shuffle_id, map_id, reduce_id)
     length: int
     block_no: int = 0
+    crc: Optional[int] = None
+
+
+class ShuffleBlockCorruptError(IOError):
+    """A fetched/read shuffle block failed CRC32C verification.
+
+    ``IOError`` so the retry taxonomy (memory/retry.py) classifies it
+    transient: the fetch plane refetches, and past refetch the
+    MapOutputTracker (shuffle/exchange.py) recomputes the map task from
+    lineage — corrupt bytes must never deserialize into an answer."""
+
+    def __init__(self, tag: Tuple[int, int, int], expected: int,
+                 actual: int, source: str = ""):
+        sid, mid, rid = tag
+        where = f" from {source}" if source else ""
+        super().__init__(
+            f"shuffle block (shuffle {sid}, map {mid}, reduce {rid})"
+            f"{where} failed checksum: stored crc32c={expected:#010x}, "
+            f"computed {actual:#010x}")
+        self.tag = tag
+        self.expected = expected
+        self.actual = actual
 
 
 class BounceBufferPool:
@@ -116,12 +141,15 @@ class ShuffleServer:
     def handle_metadata_request(self, shuffle_id: int, reduce_id: int
                                 ) -> List[BlockDescriptor]:
         out = []
+        metas = self.catalog.block_metas_for_reduce(shuffle_id, reduce_id) \
+            if hasattr(self.catalog, "block_metas_for_reduce") else None
         for i, payload in enumerate(
                 self.catalog.blocks_for_reduce(shuffle_id, reduce_id)):
             ShuffleTableMeta.decode(payload)  # header sanity, like the
             # reference validating flatbuffer metadata before advertising
-            out.append(BlockDescriptor((shuffle_id, 0, reduce_id),
-                                       len(payload), block_no=i))
+            mid, crc = (metas[i][0], metas[i][2]) if metas else (0, None)
+            out.append(BlockDescriptor((shuffle_id, mid, reduce_id),
+                                       len(payload), block_no=i, crc=crc))
         return out
 
     def handle_transfer_request(self, shuffle_id: int, reduce_id: int
@@ -132,19 +160,100 @@ class ShuffleServer:
 class ShuffleClient:
     """Fetch-side state machine (RapidsShuffleClient analog): metadata
     request -> throttled transfer requests -> bounce-buffer chunked receive
-    -> completed blocks handed to the consumer callback."""
+    -> CRC32C verification -> completed blocks handed to the consumer.
+
+    Verification happens HERE, transport-agnostically, so the in-process
+    :class:`LocalTransport` reads and the TCP wire take the identical
+    integrity path. An optional ``ctx`` threads in the query deadline
+    (cooperative fetch cancellation), the deterministic network fault
+    injector (the four ISSUE-7 fault classes apply to this client's
+    stream), and metric attribution."""
 
     def __init__(self, transport: "Transport", bounce: BounceBufferPool,
-                 throttle: Throttle):
+                 throttle: Throttle, ctx=None, node: str = "ShuffleFetch"):
         self.transport = transport
         self.bounce = bounce
         self.throttle = throttle
         self._next_txn = 0
-        self.metrics = {"fetches": 0, "bytes": 0, "chunks": 0, "errors": 0}
+        self._ctx = ctx
+        self._node = node
+        self._injector = getattr(ctx, "fault_injector", None)
+        self._deadline = getattr(ctx, "deadline", None)
+        self.metrics = {"fetches": 0, "bytes": 0, "chunks": 0, "errors": 0,
+                        "crc_failures": 0}
 
     def _txn(self) -> Transaction:
         self._next_txn += 1
         return Transaction(self._next_txn)
+
+    def _apply_pre_fault(self, fault: Optional[str], desc) -> None:
+        """Connection-level injected faults (before any byte arrives)."""
+        if fault == "peerDeath":
+            close = getattr(self.transport, "close", None)
+            if close is not None:
+                close()
+            raise ConnectionError(
+                f"injected peer death mid-fetch of block {desc.tag}")
+        if fault == "stall":
+            import time
+            time.sleep(self._injector.net_stall_secs)
+            raise TimeoutError(
+                f"injected slow-peer stall past requestTimeout fetching "
+                f"block {desc.tag}")
+
+    @staticmethod
+    def _apply_payload_fault(fault: Optional[str], payload: bytes) -> bytes:
+        """Payload-level injected faults (torn / corrupted bytes)."""
+        if fault == "torn" and payload:
+            return payload[:-1]
+        if fault == "bitFlip" and payload:
+            return bytes([payload[0] ^ 0x01]) + payload[1:]
+        return payload
+
+    def fetch_one(self, desc: BlockDescriptor) -> bytes:
+        """Fetch and VERIFY one block (throttled, bounce-chunked). Raises
+        :class:`ShuffleBlockCorruptError` on checksum mismatch, IOError
+        on short reads, connection errors verbatim — the per-block unit
+        the streaming RetryingBlockIterator refetches."""
+        if self._deadline is not None:
+            self._deadline.check("shuffle.fetchBlock", self._ctx,
+                                 self._node)
+        fault = self._injector.check_net("shuffle.fetchBlock") \
+            if self._injector is not None else None
+        self.throttle.acquire(desc.length)
+        try:
+            self._apply_pre_fault(fault, desc)
+            chunks = []
+            for chunk in self.transport.fetch_block_chunks(
+                    desc, self.bounce.buffer_size):
+                buf = self.bounce.acquire()
+                try:
+                    n = len(chunk)
+                    buf[:n] = chunk
+                    chunks.append(bytes(buf[:n]))
+                    self.metrics["chunks"] += 1
+                finally:
+                    self.bounce.release(buf)
+            payload = self._apply_payload_fault(fault, b"".join(chunks))
+            if len(payload) != desc.length:
+                raise IOError(
+                    f"short read: {len(payload)} != {desc.length}")
+            if desc.crc is not None:
+                from ..utils import checksum as CK
+                try:
+                    CK.verify(payload, desc.crc,
+                              f"shuffle block {desc.tag}", self._ctx,
+                              self._node)
+                except CK.ChecksumError as e:
+                    self.metrics["crc_failures"] += 1
+                    raise ShuffleBlockCorruptError(
+                        desc.tag, desc.crc, e.actual,
+                        source="fetch") from None
+            self.metrics["fetches"] += 1
+            self.metrics["bytes"] += len(payload)
+            return payload
+        finally:
+            self.throttle.release(desc.length)
 
     def fetch(self, shuffle_id: int, reduce_id: int,
               on_block: Callable[[bytes], None],
@@ -158,34 +267,19 @@ class ShuffleClient:
             self.metrics["errors"] += 1
             on_error(str(e))
             return txn
+        from ..utils.deadline import QueryDeadlineExceeded
         for desc in descriptors:
-            self.throttle.acquire(desc.length)
             try:
-                chunks = []
-                for chunk in self.transport.fetch_block_chunks(
-                        desc, self.bounce.buffer_size):
-                    buf = self.bounce.acquire()
-                    try:
-                        n = len(chunk)
-                        buf[:n] = chunk
-                        chunks.append(bytes(buf[:n]))
-                        self.metrics["chunks"] += 1
-                    finally:
-                        self.bounce.release(buf)
-                payload = b"".join(chunks)
-                if len(payload) != desc.length:
-                    raise IOError(
-                        f"short read: {len(payload)} != {desc.length}")
-                self.metrics["fetches"] += 1
-                self.metrics["bytes"] += len(payload)
-                on_block(payload)
+                on_block(self.fetch_one(desc))
+            except QueryDeadlineExceeded:
+                # Deadline cancellation is a query contract, not a fetch
+                # failure to swallow into the retry ladder.
+                raise
             except Exception as e:
                 txn.complete(TransactionStatus.ERROR, str(e))
                 self.metrics["errors"] += 1
                 on_error(str(e))
                 return txn
-            finally:
-                self.throttle.release(desc.length)
         txn.complete(TransactionStatus.SUCCESS)
         return txn
 

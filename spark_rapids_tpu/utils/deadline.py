@@ -1,0 +1,124 @@
+"""Query deadlines — cooperative cancellation with attribution.
+
+``spark.rapids.tpu.query.deadlineSecs`` bounds one query's wall time: a
+:class:`Deadline` is created per ``TpuSession.execute`` call, rides the
+``ExecContext``, and every long-running cooperative site — the retry
+ladder's attempts and backoff sleeps (memory/retry.py), in-flight shuffle
+fetches (shuffle/net.py), pipeline prefetch/boundary waits
+(exec/pipeline.py, utils/prefetch.py), and the session dispatch loop —
+calls :meth:`Deadline.check` at its loop boundaries. An expired deadline
+raises :class:`QueryDeadlineExceeded` **naming the slowest site** (the
+site that accumulated the most wall time between checks), which the retry
+taxonomy classifies FATAL: a deadline is a user contract, not a fault to
+retry through. This is the enforcement primitive the multi-tenant
+serving roadmap item needs (per-tenant time budgets).
+
+Cancellation is cooperative, like Spark task kill: device work already
+dispatched runs to completion, but no new fetch, retry, sleep, or
+dispatch starts once the deadline passes, and sleeps/timeouts are bounded
+by the remaining budget so a site never oversleeps the deadline.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+
+class QueryDeadlineExceeded(RuntimeError):
+    """The query ran past ``spark.rapids.tpu.query.deadlineSecs``.
+
+    Carries where the deadline was observed (``site``) and the site that
+    consumed the most wall time (``slowest_site``) — the first place to
+    look when deciding whether the deadline or the query is wrong."""
+
+    def __init__(self, limit_s: float, site: str,
+                 slowest_site: Optional[str] = None,
+                 slowest_s: float = 0.0, elapsed_s: float = 0.0):
+        msg = (f"query exceeded its {limit_s:.3g}s deadline "
+               f"(spark.rapids.tpu.query.deadlineSecs) after "
+               f"{elapsed_s:.3g}s, observed at '{site}'")
+        if slowest_site and slowest_site != site:
+            msg += (f"; slowest site: '{slowest_site}' "
+                    f"({slowest_s:.3g}s attributed)")
+        elif slowest_site:
+            msg += f" ({slowest_s:.3g}s attributed there)"
+        super().__init__(msg)
+        self.limit_s = limit_s
+        self.site = site
+        self.slowest_site = slowest_site
+        self.slowest_s = slowest_s
+
+
+class Deadline:
+    """One query's wall-clock budget with per-site time attribution.
+
+    Sites call :meth:`check` at their cooperative cancellation points;
+    the interval since the previous check anywhere in the query is
+    attributed to the checking site (the work it just finished), so an
+    expired deadline can name the slowest site without any extra timers
+    on the healthy path. Thread-safe: pipeline workers and the
+    dispatching thread check concurrently."""
+
+    def __init__(self, seconds: float):
+        self.limit_s = float(seconds)
+        self._t0 = time.monotonic()
+        self._deadline = self._t0 + self.limit_s
+        self._last = self._t0
+        self._elapsed: dict = {}
+        self._lock = threading.Lock()
+        self._cancelled = False
+
+    @classmethod
+    def maybe(cls, conf) -> Optional["Deadline"]:
+        """A Deadline when the conf sets a positive deadlineSecs, else
+        None (the default — the healthy path pays one None check)."""
+        from ..config import QUERY_DEADLINE_SECS
+        try:
+            secs = float(conf.get(QUERY_DEADLINE_SECS))
+        except (AttributeError, TypeError, ValueError):
+            return None
+        return cls(secs) if secs > 0 else None
+
+    def remaining(self) -> float:
+        """Seconds left (negative once expired)."""
+        return self._deadline - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return time.monotonic() > self._deadline
+
+    def bound(self, seconds: float) -> float:
+        """Clamp a sleep/timeout to the remaining budget (>= 0) so no
+        cooperative site oversleeps the deadline."""
+        return max(0.0, min(float(seconds), self.remaining()))
+
+    def check(self, site: str, ctx=None, node: Optional[str] = None) -> None:
+        """Attribute elapsed time to ``site``; raise
+        :class:`QueryDeadlineExceeded` once expired. ``ctx``/``node``
+        record the ``deadlineCancels`` metric on the first raise."""
+        now = time.monotonic()
+        with self._lock:
+            self._elapsed[site] = self._elapsed.get(site, 0.0) \
+                + (now - self._last)
+            self._last = now
+            if now <= self._deadline:
+                return
+            first = not self._cancelled
+            self._cancelled = True
+            slowest = max(self._elapsed, key=self._elapsed.get)
+            slowest_s = self._elapsed[slowest]
+        if first and ctx is not None:
+            try:
+                ctx.metric(node or site.split(".", 1)[0],
+                           "deadlineCancels", 1)
+            except Exception:  # noqa: BLE001 - accounting only
+                pass
+        raise QueryDeadlineExceeded(self.limit_s, site, slowest,
+                                    slowest_s, now - self._t0)
+
+    def site_times(self) -> dict:
+        """Per-site attributed seconds so far (diagnostics)."""
+        with self._lock:
+            return dict(self._elapsed)

@@ -77,26 +77,42 @@ class InjectedDiskFault(InjectedFault, OSError):
     """Synthetic spill-disk I/O failure (OSError => transient class)."""
 
 
+#: Network fault classes the injector can apply at transport sites
+#: (ISSUE 7): what each does is implemented by the shuffle client
+#: (shuffle/transport.py applies the returned flavor to its stream).
+NET_FAULT_CLASSES = ("peerDeath", "torn", "bitFlip", "stall")
+
+
 class FaultInjector:
     """Deterministic per-site fault schedule (see module doc)."""
 
     def __init__(self, seed: int, sites: str, oom_every_n: int,
-                 transient_every_n: int):
+                 transient_every_n: int, net_every_n: int = 0,
+                 net_faults: str = "", net_stall_secs: float = 0.05):
         self.seed = int(seed)
         self.patterns = [s.strip() for s in sites.split(",") if s.strip()]
         self.oom_every_n = int(oom_every_n)
         self.transient_every_n = int(transient_every_n)
+        self.net_every_n = int(net_every_n)
+        self.net_faults = tuple(
+            f for f in (s.strip() for s in (net_faults or "").split(","))
+            if f in NET_FAULT_CLASSES) or NET_FAULT_CLASSES
+        self.net_stall_secs = float(net_stall_secs)
         self._counters: Dict[str, int] = {}
         self._lock = threading.Lock()
         #: injected-fault tallies by flavor (test assertions read these)
         self.injected = {"oom": 0, "transient": 0, "disk": 0}
+        self.injected.update({f"net.{c}": 0 for c in NET_FAULT_CLASSES})
 
     @classmethod
     def maybe(cls, conf) -> Optional["FaultInjector"]:
         """The conf's injector, or None when injection is off (the
         default). Duck-typed: anything without the conf entries (bare
         test contexts) gets None."""
-        from ..config import (FAULT_INJECTION_OOM_EVERY_N,
+        from ..config import (FAULT_INJECTION_NET_EVERY_N,
+                              FAULT_INJECTION_NET_FAULTS,
+                              FAULT_INJECTION_NET_STALL_SECS,
+                              FAULT_INJECTION_OOM_EVERY_N,
                               FAULT_INJECTION_SEED, FAULT_INJECTION_SITES,
                               FAULT_INJECTION_TRANSIENT_EVERY_N)
         if not hasattr(conf, "get"):
@@ -106,11 +122,16 @@ class FaultInjector:
             oom_n = int(conf.get(FAULT_INJECTION_OOM_EVERY_N))
             transient_n = int(conf.get(FAULT_INJECTION_TRANSIENT_EVERY_N))
             seed = int(conf.get(FAULT_INJECTION_SEED))
+            net_n = int(conf.get(FAULT_INJECTION_NET_EVERY_N))
+            net_faults = conf.get(FAULT_INJECTION_NET_FAULTS) or ""
+            net_stall = float(conf.get(FAULT_INJECTION_NET_STALL_SECS))
         except (AttributeError, TypeError):
             return None
-        if not sites.strip() or (oom_n == 0 and transient_n == 0):
+        if not sites.strip() \
+                or (oom_n == 0 and transient_n == 0 and net_n == 0):
             return None
-        return cls(seed, sites, oom_n, transient_n)
+        return cls(seed, sites, oom_n, transient_n, net_n, net_faults,
+                   net_stall)
 
     def matches(self, site: str) -> bool:
         for p in self.patterns:
@@ -159,6 +180,28 @@ class FaultInjector:
                 f"injected spill-disk I/O failure at {site} (visit {n})")
         raise InjectedTransient(
             f"injected remote_compile helper race at {site} (visit {n})")
+
+    def check_net(self, site: str) -> Optional[str]:
+        """Count one visit of a TRANSPORT site; return the network fault
+        class scheduled for this visit (one of :data:`NET_FAULT_CLASSES`),
+        or None. Unlike :meth:`check` this does not raise — the shuffle
+        client applies the class to its own stream (close the connection,
+        truncate the payload, flip a bit, stall past the request timeout),
+        so the failure arrives through the exact error path the real
+        fault would take. Deterministic like every other schedule: same
+        conf, same visit, same class."""
+        if self.net_every_n == 0 or not self.matches(site):
+            return None
+        with self._lock:
+            n = self._counters.get(site, 0) + 1
+            self._counters[site] = n
+            if not self._scheduled(n, self.net_every_n):
+                return None
+            flavor = self.net_faults[
+                zlib.crc32(f"net:{site}:{n}:{self.seed}".encode())
+                % len(self.net_faults)]
+            self.injected[f"net.{flavor}"] += 1
+            return flavor
 
 
 def maybe_inject(ctx, site: str) -> None:

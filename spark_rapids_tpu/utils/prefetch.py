@@ -85,10 +85,19 @@ def prefetch_iter(src: Iterable, depth: int = 2, ctx=None,
             except queue.Empty:
                 t0 = time.perf_counter_ns()
                 item = _PENDING
+                deadline = getattr(ctx, "deadline", None)
                 while item is _PENDING:
                     try:
                         item = q.get(timeout=0.5)
                     except queue.Empty:
+                        if deadline is not None:
+                            # Cooperative deadline cancellation: stop
+                            # waiting on a slow producer once the query's
+                            # wall-clock budget is spent (the finally
+                            # block tears the worker down).
+                            deadline.check(
+                                f"prefetch.wait:{node or 'stream'}",
+                                ctx, node)
                         if not fut.done():
                             continue
                         # Worker finished: its sentinel may have landed

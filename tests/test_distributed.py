@@ -8,11 +8,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec
 
 from spark_rapids_tpu import types as T
-from spark_rapids_tpu.parallel.mesh import PART_AXIS, make_mesh
+from spark_rapids_tpu.parallel.mesh import PART_AXIS, make_mesh, shard_map
 from spark_rapids_tpu.parallel.distributed import distributed_sum_by_key
 from spark_rapids_tpu.shuffle import ici
 from spark_rapids_tpu.shuffle.partitioning import (

@@ -96,8 +96,9 @@ class TestWire:
         cat = ShuffleBufferCatalog(host_budget_bytes=0)
         p = _payload(1)
         cat.add_block(9, 0, 0, p)
+        from spark_rapids_tpu.utils.checksum import crc32c
         metas = cat.block_metas_for_reduce(9, 0)
-        assert metas == [(0, len(p))]
+        assert metas == [(0, len(p), crc32c(p))]
         assert cat._spill_file is not None  # block went to disk
         assert cat.read_block(9, 0, 0) == p
         cat.close()
@@ -283,3 +284,186 @@ class TestCrossProcess:
         finally:
             proc.kill()
             proc.wait()
+
+
+_MATRIX_BLOCKS = [bytes([m + 1]) * 1000 for m in range(3)]
+
+DYING_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, os.getcwd())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+from spark_rapids_tpu.shuffle.exchange import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.net import NetShuffleServer
+cat = ShuffleBufferCatalog()
+for m in range(3):
+    cat.add_block(9, m, 0, bytes([m + 1]) * 1000)
+real = cat.read_block_with_crc
+served = [0]
+def dying(sid, mid, rid):
+    served[0] += 1
+    if served[0] > 1:
+        os._exit(1)  # the peer dies mid-fetch, after serving one block
+    return real(sid, mid, rid)
+cat.read_block_with_crc = dying
+srv = NetShuffleServer(cat)
+print(srv.address[1], flush=True)
+time.sleep(30)
+"""
+
+CORRUPT_CHILD = r"""
+import os, sys, time
+sys.path.insert(0, os.getcwd())
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+from spark_rapids_tpu.shuffle.exchange import ShuffleBufferCatalog
+from spark_rapids_tpu.shuffle.net import NetShuffleServer
+cat = ShuffleBufferCatalog()
+for m in range(3):
+    cat.add_block(9, m, 0, bytes([m + 1]) * 1000)
+# Bit rot on the serving side: map 1's stored bytes no longer match the
+# checksum recorded at registration.
+cat._crcs[(9, 1, 0)] ^= 0xFFFF
+srv = NetShuffleServer(cat)
+print(srv.address[1], flush=True)
+time.sleep(30)
+"""
+
+
+def _spawn(child_src):
+    proc = subprocess.Popen(
+        [sys.executable, "-c", child_src], stdout=subprocess.PIPE,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        text=True)
+    port = int(proc.stdout.readline())
+    return proc, ("127.0.0.1", port)
+
+
+def _recovery_env():
+    """(ctx, tracker-with-lineage): the driver-side knowledge a real
+    scheduler has — every rank's map outputs are deterministically
+    regenerable from its input-shard assignment."""
+    import types
+
+    from spark_rapids_tpu.config import TpuConf
+    from spark_rapids_tpu.shuffle.exchange import MapOutputTracker
+    conf = TpuConf({
+        "spark.rapids.tpu.shuffle.net.connectTimeout": 0.5,
+        "spark.rapids.tpu.shuffle.net.requestTimeout": 0.3,
+        "spark.rapids.tpu.shuffle.net.maxPeerFailures": 1,
+    })
+    ctx = types.SimpleNamespace(conf=conf, deadline=None,
+                                fault_injector=None)
+    tracker = MapOutputTracker(conf)
+    tracker.set_peer_lineage(
+        lambda peer, sid, rid: [(m, _MATRIX_BLOCKS[m]) for m in range(3)])
+    return ctx, tracker
+
+
+class TestTwoProcessRecoveryMatrix:
+    """The ISSUE-7 recovery matrix against a REAL second process: a peer
+    killed mid-fetch, a block corrupted at rest on the peer, and a peer
+    stalled past requestTimeout must each recover bit-identically via
+    refetch/recompute — or raise the typed error naming the peer."""
+
+    def test_peer_killed_mid_fetch_recomputes(self):
+        from spark_rapids_tpu.shuffle.exchange import fetch_with_recovery
+        proc, peer = _spawn(DYING_CHILD)
+        ctx, tracker = _recovery_env()
+        try:
+            got = list(fetch_with_recovery(
+                peer, 9, 0, tracker, ctx=ctx, max_retries=1,
+                backoff_s=0.01))
+            # Bit-identical: one block arrived over the wire before the
+            # peer died; lineage regenerated exactly the missing two.
+            assert got == _MATRIX_BLOCKS
+            assert tracker.metrics["map_tasks_recomputed"] > 0
+            assert tracker.is_blacklisted(peer)
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_corrupt_block_on_peer_recomputes(self):
+        from spark_rapids_tpu.shuffle.exchange import fetch_with_recovery
+        proc, peer = _spawn(CORRUPT_CHILD)
+        ctx, tracker = _recovery_env()
+        try:
+            got = list(fetch_with_recovery(
+                peer, 9, 0, tracker, ctx=ctx, max_retries=1,
+                backoff_s=0.01))
+            assert got == _MATRIX_BLOCKS
+            assert tracker.metrics["map_tasks_recomputed"] > 0
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def test_corrupt_block_without_lineage_is_typed(self):
+        proc, peer = _spawn(CORRUPT_CHILD)
+        ctx, _ = _recovery_env()
+        try:
+            it = RetryingBlockIterator(peer, 9, 0, ctx=ctx, max_retries=1,
+                                       backoff_s=0.01)
+            with pytest.raises(ShuffleFetchFailedError) as ei:
+                list(it)
+            # The typed error names the peer and carries what arrived.
+            assert ei.value.peer == peer
+            assert ei.value.yielded_map_ids == frozenset({0})
+            assert "checksum" in str(ei.value)
+        finally:
+            proc.kill()
+            proc.wait()
+
+    def _stall_server(self):
+        """A handshaking server that then goes silent — the slow-peer
+        stall the requestTimeout exists for."""
+        srv = socket.socket()
+        srv.bind(("127.0.0.1", 0))
+        srv.listen(4)
+        stop = threading.Event()
+
+        def run():
+            while not stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except OSError:
+                    return
+                try:
+                    conn.sendall(MAGIC + bytes([3]))
+                except OSError:
+                    pass
+                # ...and never answer another byte.
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return srv, stop
+
+    def test_stalled_peer_times_out_and_recomputes(self):
+        from spark_rapids_tpu.shuffle.exchange import fetch_with_recovery
+        srv, stop = self._stall_server()
+        ctx, tracker = _recovery_env()
+        try:
+            t0 = time.monotonic()
+            got = list(fetch_with_recovery(
+                srv.getsockname(), 9, 0, tracker, ctx=ctx, max_retries=1,
+                backoff_s=0.01))
+            assert got == _MATRIX_BLOCKS
+            assert tracker.metrics["map_tasks_recomputed"] == 3
+            # The stall was bounded by requestTimeout (0.3s x 2 attempts),
+            # not by any 30s default.
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            stop.set()
+            srv.close()
+
+    def test_stalled_peer_without_lineage_names_peer(self):
+        srv, stop = self._stall_server()
+        ctx, _ = _recovery_env()
+        peer = srv.getsockname()
+        try:
+            with pytest.raises(ShuffleFetchFailedError) as ei:
+                list(RetryingBlockIterator(peer, 9, 0, ctx=ctx,
+                                           max_retries=1, backoff_s=0.01))
+            assert ei.value.peer == tuple(peer)
+            assert "timed out" in str(ei.value).lower()
+        finally:
+            stop.set()
+            srv.close()
