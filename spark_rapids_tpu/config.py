@@ -450,11 +450,39 @@ TOPK_THRESHOLD = conf_int(
 
 TPU_PALLAS_ENABLED = conf_bool(
     "spark.rapids.tpu.pallas.enabled", False,
-    "Run the string row-hash (Spark murmur3 over UTF-8 bytes) as a "
-    "hand-written Pallas TPU kernel that walks the whole mix chain in "
-    "VMEM, instead of the default jnp emulation XLA schedules per step. "
-    "On non-TPU backends the kernel runs in Pallas interpreter mode "
-    "(slow; intended for tests).")
+    "Run the join/sort/groupby/string hot paths through the hand-written "
+    "Pallas TPU kernel library (ops/kernels/pallas/: fused hash-join "
+    "build+probe with the key table VMEM-resident across the probe grid, "
+    "sorted-order segmented aggregation, blockwise bitonic sort over a "
+    "packed key lane, ragged string gather/compare, and the string "
+    "murmur3 row hash) instead of the default jnp implementations — "
+    "which remain the bit-identity oracles. Read PER SESSION at "
+    "dispatch; shapes a kernel cannot serve fall back to the oracle "
+    "with a recorded reason (QueryProfile engine.pallas). On non-TPU "
+    "backends kernels run in Pallas interpreter mode (slow; intended "
+    "for tests). See docs/tuning-guide.md.")
+
+TPU_PALLAS_KERNELS = conf_str(
+    "spark.rapids.tpu.pallas.kernels", "all",
+    "Comma-separated Pallas kernel families to enable when "
+    "spark.rapids.tpu.pallas.enabled is on: hash, joinProbe, segmented, "
+    "sortStep, strings — or 'all' (default). Use with "
+    "tools/kernel_bench.py's per-kernel A/B (BENCH_kernels.json) to "
+    "enable only the families that win on your shapes.")
+
+TPU_PALLAS_VMEM_BUDGET = conf_int(
+    "spark.rapids.tpu.pallas.vmemBudgetBytes", 8 << 20,
+    "Byte budget a Pallas kernel may keep resident in VMEM (join key "
+    "tables, whole sort lanes, ragged source matrices). Shapes over "
+    "budget fall back to the jnp oracle and record a 'vmem' fallback "
+    "reason. TPU cores have ~16MB VMEM; the default leaves headroom for "
+    "blocks and double buffering.")
+
+TPU_PALLAS_BLOCK_ROWS = conf_int(
+    "spark.rapids.tpu.pallas.blockRows", 256,
+    "Rows per Pallas grid step (rounded down to a divisor of the batch "
+    "capacity). Larger blocks amortize grid overhead, smaller ones cut "
+    "VMEM residency per step.")
 
 TPU_UPLOAD_CACHE_BYTES = conf_int(
     "spark.rapids.tpu.uploadCache.maxBytes", 1 << 30,

@@ -673,13 +673,16 @@ class TpuSortExec(TpuExec):
         key_exprs = [o.child.bind(schema) for o in self.orders]
         asc = [o.ascending for o in self.orders]
         nf = [o.effective_nulls_first for o in self.orders]
+        pallas = ctx.pallas  # per-session Pallas gate, read at dispatch
 
         def build():
             def do_sort(b):
                 keys = [e.eval_device(b) for e in key_exprs]
-                return KR.sort_batch_by_columns(b, keys, asc, nf)
+                return KR.sort_batch_by_columns(b, keys, asc, nf,
+                                                pallas=pallas)
             return do_sort
-        do_sort = cached_kernel("sort", kernel_key(key_exprs, asc, nf), build)
+        do_sort = cached_kernel(
+            "sort", kernel_key(key_exprs, asc, nf, pallas.token()), build)
 
         def gen():
             from ..config import SORT_EXTERNAL_THRESHOLD
@@ -956,20 +959,22 @@ class TpuHashAggregateExec(TpuExec):
         agg_key = kernel_key(groupings, [(a.name, a.func) for a in aggs],
                              buf_schema)
 
-        def build_partial(dense_mode):
+        def build_partial(dense_mode, pallas):
             def partial(batch: ColumnarBatch):
                 return _aggregate_batch(batch, groupings, aggs, buf_schema,
                                         n_keys, update_mode=True,
-                                        dense_mode=dense_mode)
+                                        dense_mode=dense_mode,
+                                        pallas=pallas)
             return partial
 
-        def build_merge(dense_mode):
+        def build_merge(dense_mode, pallas):
             def merge(batch: ColumnarBatch):
                 key_refs = [BoundReference(i, f.data_type, f.nullable)
                             for i, f in enumerate(buf_schema)][:n_keys]
                 return _aggregate_batch(batch, key_refs, aggs, buf_schema,
                                         n_keys, update_mode=False,
-                                        dense_mode=dense_mode)
+                                        dense_mode=dense_mode,
+                                        pallas=pallas)
             return merge
 
         def gen():
@@ -980,12 +985,17 @@ class TpuHashAggregateExec(TpuExec):
             site = ctx.next_join_site()
             dense_mode = 1 if ctx.eager_overflow else \
                 min(ctx.dense_modes.get(site, 0), 1)
+            # Per-session Pallas gate: read at dispatch, folded into the
+            # process-wide kernel-cache key so sessions with different
+            # gates never share a traced kernel.
+            pallas = ctx.pallas
+            pkey = agg_key + (dense_mode, pallas.token())
             partial_k = cached_kernel(
-                "agg_partial", agg_key + (dense_mode,),
-                lambda: build_partial(dense_mode))
+                "agg_partial", pkey,
+                lambda: build_partial(dense_mode, pallas))
             merge_k = cached_kernel(
-                "agg_merge", agg_key + (dense_mode,),
-                lambda: build_merge(dense_mode))
+                "agg_merge", pkey,
+                lambda: build_merge(dense_mode, pallas))
 
             def run_k(k, b):
                 out, fail = k(b)
@@ -1083,7 +1093,7 @@ def finalize_agg_kernel(n_keys: int, aggregates: List[AGG.AggregateExpression],
 def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
                      aggs: List[AGG.AggregateExpression],
                      buf_schema: T.Schema, n_keys: int,
-                     update_mode: bool, dense_mode: int = 1):
+                     update_mode: bool, dense_mode: int = 1, pallas=None):
     """One grouping pass. update_mode: inputs are raw rows (evaluate agg
     children, apply update ops). merge mode: inputs are buffer columns.
 
@@ -1123,7 +1133,7 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
     if keys:
         key_cols, results, n_groups, group_live, fail = \
             KG.grouped_aggregate(keys, live, triples,
-                                 dense_mode=dense_mode)
+                                 dense_mode=dense_mode, pallas=pallas)
         if fail is False:
             fail = None  # statically exact path: nothing to observe
     else:
@@ -1148,14 +1158,22 @@ def _aggregate_batch(batch: ColumnarBatch, key_exprs: List[Expression],
 
 
 def hash_join_kernel(jt: str, lkeys: List[Expression],
-                     rkeys: List[Expression], out_schema: T.Schema):
+                     rkeys: List[Expression], out_schema: T.Schema,
+                     pallas=None):
     """Process-cached local equi-join kernel ``(probe, build, out_cap)``.
 
     Shared by the streaming exec and the SPMD mesh path (exec/mesh.py):
     both are, per shard, exactly this local join. Semantics per join type:
     semi/anti return a compacted probe; left/full expand unmatched probe
     rows with nulls; full also returns the build-side hit mask for the
-    caller's unmatched-build pass."""
+    caller's unmatched-build pass. ``pallas`` is the caller's per-session
+    gate snapshot (ExecContext.pallas): it selects the fused VMEM
+    build+probe for the dense modes and the ragged string gather for the
+    output assembly, and rides the cache key so differently-gated
+    sessions never share a kernel."""
+    from ..ops.kernels.pallas import resolve as _pallas_resolve
+    pallas = _pallas_resolve(pallas)
+
     def kernel_impl(probe, build, out_cap, dense=0):
         pk = [e.eval_device(probe) for e in lkeys]
         bk = [e.eval_device(build) for e in rkeys]
@@ -1165,12 +1183,12 @@ def hash_join_kernel(jt: str, lkeys: List[Expression],
             # a dense-fail flag the retry machinery consumes; no overflow
             # possible.
             return KJ.dense_join(jt, probe, build, pk[0], bk[0],
-                                 out_schema)
+                                 out_schema, pallas=pallas)
         if dense == 2:
             # Swapped mode (inner only): the table builds over the
             # UNIQUE-keyed probe side — the dim.join(fact) shape.
             return KJ.dense_join_swapped(probe, build, pk[0], bk[0],
-                                         out_schema)
+                                         out_schema, pallas=pallas)
         hits = None
         if jt != "full" and len(bk) == 1 \
                 and KJ.binsearch_joinable(bk[0]) \
@@ -1197,13 +1215,16 @@ def hash_join_kernel(jt: str, lkeys: List[Expression],
             lo, exp_counts, build_at_rank, out_cap)
         real = matched[p_idx]
         out_live = jnp.arange(out_cap, dtype=jnp.int32) < n_out
-        pcols = KR.gather_columns(probe.columns, p_idx, out_live)
-        bcols = KR.gather_columns(build.columns, b_idx, out_live & real)
+        pcols = KR.gather_columns(probe.columns, p_idx, out_live,
+                                  pallas=pallas)
+        bcols = KR.gather_columns(build.columns, b_idx, out_live & real,
+                                  pallas=pallas)
         out = ColumnarBatch(tuple(pcols) + tuple(bcols), n_out, out_schema)
         return (out, hits), total
 
     return cached_kernel(
-        "hash_join", kernel_key(jt, lkeys, rkeys, out_schema),
+        "hash_join",
+        kernel_key(jt, lkeys, rkeys, out_schema, pallas.token()),
         lambda: kernel_impl, static_argnums=(2, 3))
 
 
@@ -1287,7 +1308,8 @@ class TpuShuffledHashJoinExec(TpuExec):
         rkeys = _bind_all(self.right_keys, right.schema)
         jt = self.join_type
         out_schema = self._schema
-        kernel = hash_join_kernel(jt, lkeys, rkeys, out_schema)
+        kernel = hash_join_kernel(jt, lkeys, rkeys, out_schema,
+                                  pallas=ctx.pallas)
         post_filter = join_post_filter(self.condition, out_schema)
 
         dense_eligible = KJ.dense_joinable(jt, _bind_all(

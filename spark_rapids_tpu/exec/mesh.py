@@ -83,14 +83,16 @@ def _require(cond: bool, why: str):
 
 
 def _exchange_by_key(batch: ColumnarBatch, key_exprs: List[Expression],
-                     n_parts: int, bucket_cap: int, flags: List
-                     ) -> ColumnarBatch:
+                     n_parts: int, bucket_cap: int, flags: List,
+                     pallas=None) -> ColumnarBatch:
     """Repartition a local shard batch by Spark-murmur3 of the keys: rows
     whose keys hash to chip p land on chip p. One scatter into
     [n_parts, bucket_cap] send buffers, one XLA all_to_all, one compaction.
-    Appends a bucket-overflow flag (psum-reduced) to ``flags``."""
+    Appends a bucket-overflow flag (psum-reduced) to ``flags``.
+    ``pallas`` is the session's gate snapshot (string keys route through
+    the VMEM murmur3 kernel when enabled)."""
     keys = [e.eval_device(batch) for e in key_exprs]
-    h = spark_hash_columns_device(keys)
+    h = spark_hash_columns_device(keys, pallas=pallas)
     pid = pmod_partition(h, n_parts)
     return _exchange_by_pid(batch, pid, n_parts, bucket_cap, flags)
 
@@ -242,9 +244,11 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
                                        n_keys, update_mode=True,
                                        dense_mode=1)
             cap = max(part.capacity // n_parts, 128)
+            from ..ops.kernels.pallas import from_conf as _pallas_from_conf
             shuffled = _exchange_by_key(
                 part, key_refs, n_parts,
-                bucket_capacity(int(cap * bucket_growth)), flags)
+                bucket_capacity(int(cap * bucket_growth)), flags,
+                pallas=_pallas_from_conf(conf))
             merged, _ = _aggregate_batch(shuffled, key_refs, aggs,
                                          buf_schema, n_keys,
                                          update_mode=False, dense_mode=1)
@@ -291,7 +295,9 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
         lkeys = _bind_all(node.left_keys, left.schema)
         rkeys = _bind_all(node.right_keys, right_src.schema)
         out_schema = node.schema
-        kernel = hash_join_kernel(jt, lkeys, rkeys, out_schema)
+        from ..ops.kernels.pallas import from_conf as _pallas_from_conf
+        kernel = hash_join_kernel(jt, lkeys, rkeys, out_schema,
+                                  pallas=_pallas_from_conf(conf))
         post = join_post_filter(node.condition, out_schema)
         unmatched = unmatched_build_kernel(left.schema, out_schema) \
             if jt == "full" else None
@@ -310,8 +316,14 @@ def _compile(node, sources: List, n_parts: int, bucket_growth: float,
                     max(int(probe.capacity * bucket_growth) // n_parts, 128))
                 bcap = bucket_capacity(
                     max(int(build.capacity * bucket_growth) // n_parts, 128))
-                probe = _exchange_by_key(probe, lkeys, n_parts, pcap, flags)
-                build = _exchange_by_key(build, rkeys, n_parts, bcap, flags)
+                from ..ops.kernels.pallas import \
+                    from_conf as _pallas_from_conf2
+                probe = _exchange_by_key(probe, lkeys, n_parts, pcap,
+                                         flags,
+                                         pallas=_pallas_from_conf2(conf))
+                build = _exchange_by_key(build, rkeys, n_parts, bcap,
+                                         flags,
+                                         pallas=_pallas_from_conf2(conf))
             out_cap = bucket_capacity(
                 max(int(probe.capacity * node.growth * bucket_growth), 128))
             if jt in ("left_semi", "left_anti"):

@@ -104,6 +104,14 @@ class QueryProfile:
         dur = self.engine.get("durability")
         if dur:
             lines.append(f"+ durability  {_fmt_metrics(dur)}")
+        pal = self.engine.get("pallas")
+        if pal and (pal.get("enabled") or pal.get("kernels")):
+            kparts = [f"{k}={m.get('staged', 0)}"
+                      for k, m in sorted(pal.get("kernels", {}).items())]
+            lines.append("+ pallas  [enabled="
+                         f"{pal.get('enabled')}"
+                         + (", " + ", ".join(kparts) if kparts else "")
+                         + "]")
         return "\n".join(lines) + "\n"
 
 
@@ -157,12 +165,15 @@ class QueryProfiler:
         self._t0 = time.perf_counter_ns()
         from ..compile import executables as _exe
         from ..compile import warmup as _warmup
+        from ..ops.kernels import pallas as _pallas
         from ..utils import checksum as _ck
         from ..utils import kernel_cache as _kc
         self._kc0 = _kc.cache_stats()
         self._exe0 = _exe.stats()
         self._warm0 = _warmup.stats()
         self._ck0 = _ck.stats()
+        self._pallas0 = _pallas.stats()
+        self._pallas_keys0 = _pallas.snapshot_program_keys()
         dm = session.device_manager
         self._spill0 = dict(dm.catalog.metrics)
         self._sem0 = dm.semaphore.wait_ns
@@ -179,6 +190,7 @@ class QueryProfiler:
 
         from ..compile import executables as _exe
         from ..compile import warmup as _warmup
+        from ..ops.kernels import pallas as _pallas
         from ..utils import checksum as _ck
         from ..utils import kernel_cache as _kc
         wall_ns = time.perf_counter_ns() - self._t0
@@ -234,6 +246,17 @@ class QueryProfiler:
                 "warmupSkippedCovered": _delta(warm, self._warm0,
                                                "skipped_covered"),
             },
+            # Pallas kernel attribution (ISSUE 8, docs/monitoring.md):
+            # per-kernel stagings (each staging is one launch per dispatch
+            # of the program it was traced into), newly-compiled pallas
+            # program signatures, and the fallback reasons where a kernel
+            # was requested but the jnp oracle ran. Empty when the gate is
+            # off — the section itself proves which kernels served the
+            # query.
+            "pallas": _pallas_section(self._session, self._pallas0,
+                                      _pallas.stats(),
+                                      registry.device_timing,
+                                      self._pallas_keys0),
             # Distributed-durability counters (ISSUE 7,
             # docs/fault-tolerance.md): a clean run reads all zeros; after
             # an injected or real fault the non-zero counters PROVE the
@@ -265,6 +288,43 @@ class QueryProfiler:
 
 def _delta(now: dict, base: dict, key: str) -> int:
     return int(now.get(key, 0)) - int(base.get(key, 0))
+
+
+def _pallas_section(session, base: dict, now: dict,
+                    device_timing: bool = False,
+                    base_keys: dict = None) -> dict:
+    """The ``engine.pallas`` section: gate state + per-kernel deltas of
+    staged launches / compiled programs / fallback reasons over this
+    query (process-wide stats deltas, like checksumFailures — Pallas
+    wrappers run at trace time, below the per-query registry).
+
+    Under ``spark.rapids.tpu.metrics.deviceTiming`` each kernel that
+    staged this query also gets ``deviceTimeNs``: a fenced zero-input
+    replay of its staged program signatures (a traced pallas_call
+    inlines into the fused XLA program, so its share of the fused
+    dispatch cannot be split out; the replay measures the same program
+    in isolation — same opt-in, fence-free default as the fused
+    deviceTime)."""
+    from ..ops.kernels import pallas as PAL
+    enabled = PAL.from_conf(session.conf).enabled
+    probe = PAL.probe_device_times(base_keys or {}) \
+        if device_timing and enabled else {}
+    kernels = {}
+    for name in sorted(now):
+        cur, old = now[name], base.get(name, {})
+        staged = cur["staged"] - old.get("staged", 0)
+        programs = cur["programs"] - old.get("programs", 0)
+        fb0 = old.get("fallbacks", {})
+        fallbacks = {r: n - fb0.get(r, 0)
+                     for r, n in cur["fallbacks"].items()
+                     if n - fb0.get(r, 0)}
+        if staged or programs or fallbacks:
+            kernels[name] = {"staged": staged, "programsCompiled": programs,
+                             **({"fallbacks": fallbacks} if fallbacks
+                                else {}),
+                             **({"deviceTimeNs": probe[name]}
+                                if name in probe else {})}
+    return {"enabled": enabled, "kernels": kernels}
 
 
 def _registry_total(registry: MetricsRegistry, name: str) -> int:

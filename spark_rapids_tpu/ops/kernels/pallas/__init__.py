@@ -1,0 +1,280 @@
+"""Pallas TPU kernel package — the custom-kernel escape hatch, grown from
+one kernel (the PR-0 murmur3 row hash) into a library covering the
+operators BENCH_r05 showed losing even warm (q3 ratio 0.29: join, sort,
+group-by inner loops that plain jnp leaves to XLA's HBM-round-trip
+scheduling; ROADMAP open item 2). The reference keeps these paths in
+hand-written libcudf CUDA (SURVEY §7); the TPU idiom followed here is the
+Ragged-Paged-Attention one (PAPERS.md): ragged/blocked data tiled through
+VMEM with masked tails, tables kept VMEM-resident across a grid.
+
+Kernel families (one module each, all gated off by default):
+
+* ``hash``      — string murmur3 row hash (:mod:`.hashing`, the original
+  kernel; oracle ``shuffle.partitioning.murmur3_bytes_rows``).
+* ``joinProbe`` — fused direct-address hash-join build+probe with the key
+  table resident in VMEM across the probe grid (:mod:`.join_probe`;
+  oracle: the segment-scatter + gather pair in ``kernels.join.dense_join``).
+* ``segmented`` — sorted-order segmented aggregation, one VMEM pass per
+  row block (:mod:`.segmented`; oracle ``jax.ops.segment_{sum,min,max}``
+  as used by ``kernels.groupby._sort_grouped_aggregate``).
+* ``sortStep``  — blockwise bitonic sort over a packed single-lane key
+  (:mod:`.sort_steps`; oracle the ``lax.sort`` in
+  ``kernels.rowops._permute_by_sort``).
+* ``strings``   — ragged string gather/compare over the ``[capacity, W]``
+  char-matrix layout (:mod:`.strings`; oracle the plain jnp row gather /
+  rowwise compare in ``kernels.rowops`` / ``kernels.groupby``).
+
+Discipline (enforced by the ``pallas-no-oracle`` tpu_lint rule): every
+``pallas_call`` site lives in a function whose docstring names its jnp
+oracle twin; the jnp implementation remains the default AND the
+bit-identity oracle, and on non-TPU backends every kernel runs in Pallas
+INTERPRETER mode so the differential tests exercise the kernel logic
+everywhere.
+
+Gating is PER SESSION (the PR-5 pipeline-sizing fix applied to this
+layer): dispatch sites read a :class:`PallasConf` snapshot resolved from
+the session's ``TpuConf`` (``ExecContext.pallas``), and the snapshot's
+:meth:`PallasConf.token` participates in every affected kernel-cache key,
+so two concurrent sessions with different gates can never poison each
+other's process-wide kernel caches. Un-threaded (ctx-less) call sites
+resolve to DISABLED — the oracle path — never to a process global;
+``configure()``/``enabled()`` survive only as a legacy introspection
+surface with no dispatch effect.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional, Tuple
+
+#: every kernel family name, in the order docs list them
+KERNEL_FAMILIES = ("hash", "joinProbe", "segmented", "sortStep", "strings")
+
+
+@dataclasses.dataclass(frozen=True)
+class PallasConf:
+    """Immutable per-session snapshot of the Pallas gates.
+
+    ``kernels`` empty = every family (when ``enabled``). ``vmem_budget``
+    bounds the bytes a kernel may keep resident in VMEM (tables, whole
+    lanes); a shape over budget falls back to the jnp oracle and records
+    a ``vmem`` fallback reason. Hashable — :meth:`token` feeds the
+    kernel-cache keys of every dispatch site that consults this conf."""
+
+    enabled: bool = False
+    kernels: Tuple[str, ...] = ()
+    vmem_budget: int = 8 << 20
+    block_rows: int = 256
+
+    def wants(self, family: str) -> bool:
+        return self.enabled and (not self.kernels or family in self.kernels)
+
+    def token(self) -> tuple:
+        """Hashable identity for kernel-cache keys. Collapses every
+        fully-disabled conf to one token so the default path never
+        fragments the cache."""
+        if not self.enabled:
+            return ("pallas", False)
+        return ("pallas", True, self.kernels, self.vmem_budget,
+                self.block_rows)
+
+
+#: The disabled conf — the default path everywhere.
+DISABLED = PallasConf()
+
+_PROCESS_DEFAULT = DISABLED
+_LOCK = threading.Lock()
+
+# Per-kernel attribution (ISSUE 8): staged counts (times a kernel wrapper
+# actually emitted a pallas_call into a trace — each staging is one
+# launch per dispatch of the surrounding program), distinct program
+# signatures (pallas_call jits bypass the operator kernel cache, so this
+# is the compile-budget ratchet's counter, like the PR-6 pad kernels),
+# and fallback reasons (requested but ineligible -> jnp oracle ran).
+_STATS: Dict[str, dict] = {}
+
+
+def _kernel_stats(name: str) -> dict:
+    s = _STATS.get(name)
+    if s is None:
+        s = _STATS[name] = {"staged": 0, "programs": set(),
+                            "fallbacks": {}}
+    return s
+
+
+def note_staged(kernel: str, program_key: tuple) -> None:
+    """Record one pallas_call staging of ``kernel`` under a distinct
+    program signature (shape/dtype key)."""
+    with _LOCK:
+        s = _kernel_stats(kernel)
+        s["staged"] += 1
+        s["programs"].add(program_key)
+
+
+def note_fallback(kernel: str, reason: str) -> None:
+    """Record that ``kernel`` was requested but the jnp oracle ran."""
+    with _LOCK:
+        f = _kernel_stats(kernel)["fallbacks"]
+        f[reason] = f.get(reason, 0) + 1
+
+
+def stats() -> Dict[str, dict]:
+    """Snapshot: {kernel: {staged, programs, fallbacks{reason: n}}} with
+    ``programs`` as a count (the distinct pallas_call jit signatures —
+    the compile-gate ratchet reads this)."""
+    with _LOCK:
+        return {k: {"staged": s["staged"], "programs": len(s["programs"]),
+                    "fallbacks": dict(s["fallbacks"])}
+                for k, s in sorted(_STATS.items())}
+
+
+def program_count() -> int:
+    """Total distinct pallas program signatures staged process-wide
+    (``TpuSession.compile_status()['pallas_programs']``)."""
+    with _LOCK:
+        return sum(len(s["programs"]) for s in _STATS.values())
+
+
+def reset_stats_for_tests() -> None:
+    with _LOCK:
+        _STATS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Device-time probes (spark.rapids.tpu.metrics.deviceTiming)
+# ---------------------------------------------------------------------------
+
+#: kernel family -> replay fn (program_key -> zero-input timed callable or
+#: None). Registered by each kernel module at import; a family that staged
+#: anything has necessarily been imported.
+_REPLAY: Dict[str, object] = {}
+
+
+def register_replay(kernel: str):
+    def deco(fn):
+        _REPLAY[kernel] = fn
+        return fn
+    return deco
+
+
+def snapshot_program_keys() -> Dict[str, frozenset]:
+    """{kernel: frozenset of staged program signatures} — the baseline
+    :func:`probe_device_times` diffs against (the public :func:`stats`
+    carries only counts)."""
+    with _LOCK:
+        return {k: frozenset(s["programs"]) for k, s in _STATS.items()}
+
+
+def probe_device_times(base_keys: Dict[str, frozenset],
+                       reps: int = 3) -> Dict[str, int]:
+    """Fenced per-kernel device time for every program signature staged
+    since ``base_keys`` (a :func:`snapshot_program_keys` snapshot):
+    replay each NEWLY staged pallas program on zero inputs of the SAME
+    shapes, block until ready, take the median. Returns
+    {kernel: total ns}. Programs staged by earlier queries are excluded,
+    so a query's ``deviceTimeNs`` attributes only its own compiles.
+
+    This runs real device work and fences — exactly the trade the
+    ``spark.rapids.tpu.metrics.deviceTiming`` conf already opts into for
+    the fused dispatch (a traced pallas_call inlines into the fused XLA
+    program, so its device time cannot be split out of that dispatch;
+    the replay measures the same program signature in isolation)."""
+    import time as _time
+
+    import jax
+    with _LOCK:
+        todo = {k: sorted(s["programs"] - base_keys.get(k, frozenset()))
+                for k, s in _STATS.items()}
+    out: Dict[str, int] = {}
+    for kernel, keys in todo.items():
+        replay = _REPLAY.get(kernel)
+        if replay is None:
+            continue
+        total = 0
+        for key in keys:
+            fn = replay(key)
+            if fn is None:
+                continue
+            try:
+                # Whitelisted fences: this IS the deviceTiming probe —
+                # it only runs under the opt-in metrics.deviceTiming
+                # conf, never on the default dispatch path.
+                jax.block_until_ready(fn())  # tpu-lint: ignore
+                times = []
+                for _ in range(reps):
+                    t0 = _time.perf_counter_ns()
+                    jax.block_until_ready(fn())  # tpu-lint: ignore
+                    times.append(_time.perf_counter_ns() - t0)
+                times.sort()
+                total += times[len(times) // 2]
+            except Exception:  # noqa: BLE001 — probes are best-effort
+                continue
+        if total:
+            out[kernel] = total
+    return out
+
+
+def interpret_mode() -> bool:
+    """Interpreter mode off-TPU: kernels are testable on the CPU backend
+    (the same trick the ORC/parquet device decoders use)."""
+    import jax
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Conf resolution
+# ---------------------------------------------------------------------------
+
+
+def from_conf(conf) -> PallasConf:
+    """Resolve a :class:`PallasConf` from a TpuConf (or anything
+    duck-typed with ``get``). None -> the process default."""
+    if conf is None:
+        return _PROCESS_DEFAULT
+    from ....config import (TPU_PALLAS_BLOCK_ROWS, TPU_PALLAS_ENABLED,
+                            TPU_PALLAS_KERNELS, TPU_PALLAS_VMEM_BUDGET)
+    if not conf.get(TPU_PALLAS_ENABLED):
+        return DISABLED
+    raw = conf.get(TPU_PALLAS_KERNELS) or ""
+    names = tuple(sorted(s.strip() for s in str(raw).split(",")
+                         if s.strip() and s.strip().lower() != "all"))
+    unknown = [n for n in names if n not in KERNEL_FAMILIES]
+    if unknown:
+        raise ValueError(
+            f"unknown spark.rapids.tpu.pallas.kernels entries {unknown}; "
+            f"valid: {', '.join(KERNEL_FAMILIES)} (or 'all')")
+    return PallasConf(
+        enabled=True, kernels=names,
+        # host-side conf values, not traced scalars
+        vmem_budget=int(conf.get(TPU_PALLAS_VMEM_BUDGET)),  # tpu-lint: ignore
+        block_rows=int(conf.get(TPU_PALLAS_BLOCK_ROWS)))  # tpu-lint: ignore
+
+
+def resolve(pallas) -> PallasConf:
+    """Normalize a dispatch-site argument: an explicit PallasConf wins;
+    None means DISABLED. A ctx-less call site cannot know which session
+    it serves, and most of them trace into kernels whose cache keys do
+    not carry a gate token — falling back to a process-global default
+    there would reintroduce the exact cross-session poisoning the
+    per-session gate exists to prevent, so the un-threaded default is
+    the oracle path, always."""
+    if isinstance(pallas, PallasConf):
+        return pallas
+    return DISABLED
+
+
+def configure(enabled: bool) -> None:
+    """LEGACY process-default recorder. Kept only so existing callers
+    (TpuSession construction, old tests) and :func:`enabled` keep
+    working; since ISSUE 8 NO dispatch site consults it — the gate is
+    read exclusively from the per-session conf (ExecContext.pallas),
+    so concurrent sessions cannot override each other."""
+    global _PROCESS_DEFAULT
+    _PROCESS_DEFAULT = PallasConf(enabled=bool(enabled))
+
+
+def enabled() -> bool:
+    """Legacy process-default state (introspection only — see
+    :func:`configure`)."""
+    return _PROCESS_DEFAULT.enabled

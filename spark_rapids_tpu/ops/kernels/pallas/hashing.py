@@ -1,0 +1,106 @@
+"""String murmur3 row hash — the original Pallas kernel, now one family
+of the kernel package (``hash``).
+
+The jnp twin is a W-step unrolled chain of vector ops over the
+``[capacity, W]`` char matrix, which XLA schedules as W+W/4 separate HBM
+round trips at worst. The Pallas version walks the whole chain in VMEM:
+one read of the char block, one write of the hash lane.
+
+Semantics: bit-for-bit Spark Murmur3_x86_32.hashUnsafeBytes, matching
+``shuffle.partitioning.murmur3_bytes_rows`` (4-byte little-endian blocks,
+then signed single-byte tail, length-folded fmix).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from . import interpret_mode, note_staged, register_replay
+
+#: Rows per grid step. 256 int32 lanes x W chars stays far under VMEM
+#: (W <= 1024 -> 1 MB block) while giving the VPU full sublanes.
+_BLOCK_ROWS = 256
+
+
+def _murmur3_rows_kernel(mat_ref, len_ref, seed_ref, out_ref):
+    """One [B, W] char block -> [B, 1] hashes, whole chain in VMEM.
+
+    The mix/finalize steps come from shuffle.partitioning's
+    xp-parameterized helpers (pure jnp with xp=jnp, traceable inside the
+    kernel) — ONE definition of Spark's murmur3 constants serves both the
+    jnp oracle and this kernel, so they cannot desynchronize."""
+    from ....shuffle.partitioning import _fmix_len, _mix_h1, _mix_k1, _u32
+    mat = mat_ref[:, :]                        # int32 [B, W], PAD == -1
+    lens = len_ref[:, 0]                       # int32 [B]
+    h1 = seed_ref[:, 0].astype(jnp.uint32)     # running per-row hash
+    w = mat.shape[1]
+    valid = mat != -1
+    chars = jnp.where(valid, mat, 0).astype(jnp.uint32)
+    for b in range(w // 4):
+        i = b * 4
+        k1 = (chars[:, i]
+              | (chars[:, i + 1] << _u32(jnp, 8))
+              | (chars[:, i + 2] << _u32(jnp, 16))
+              | (chars[:, i + 3] << _u32(jnp, 24)))
+        nh = _mix_h1(jnp, h1, _mix_k1(jnp, k1))
+        h1 = jnp.where(lens >= (i + 4), nh, h1)
+    # Tail bytes go through the full mix one at a time as SIGNED ints
+    # (Murmur3_x86_32.hashUnsafeBytes).
+    signed = jnp.where(valid, mat, 0)
+    signed = jnp.where(signed > 127, signed - 256, signed)
+    tail_start = (lens // 4) * 4
+    for pos in range(w):
+        in_tail = (pos >= tail_start) & (pos < lens)
+        nh = _mix_h1(jnp, h1, _mix_k1(jnp, signed[:, pos].astype(jnp.uint32)))
+        h1 = jnp.where(in_tail, nh, h1)
+    out_ref[:, 0] = _fmix_len(jnp, h1, lens)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _murmur3_rows_call(mat, lens, seed, *, interpret: bool):
+    """Oracle: ``shuffle.partitioning.murmur3_bytes_rows`` (xp=jnp)."""
+    from jax.experimental import pallas as pl
+    n, w = mat.shape
+    block = min(_BLOCK_ROWS, n)
+    grid = (n + block - 1) // block
+    return pl.pallas_call(
+        _murmur3_rows_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.uint32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((block, w), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(mat, lens, seed)
+
+
+def murmur3_bytes_rows(mat: jnp.ndarray, lengths: jnp.ndarray,
+                       seed: jnp.ndarray) -> jnp.ndarray:
+    """Pallas twin of ``shuffle.partitioning.murmur3_bytes_rows``.
+
+    ``mat`` is the int16 ``[n, W]`` char matrix (PAD -1 past each row's
+    end), ``lengths`` int32 per-row byte counts, ``seed`` the uint32
+    per-row running hash. Returns uint32 ``[n]``.
+    """
+    n, w = mat.shape
+    note_staged("hash", (n, w))
+    lens2 = lengths.astype(jnp.int32).reshape(n, 1)
+    seed2 = jnp.broadcast_to(seed.astype(jnp.uint32), (n,)).reshape(n, 1)
+    out = _murmur3_rows_call(mat.astype(jnp.int32), lens2, seed2,
+                             interpret=interpret_mode())
+    return out[:, 0]
+
+
+@register_replay("hash")
+def _replay(key):
+    """Zero-input fenced replay at a staged shape (deviceTiming probe)."""
+    n, w = key
+    return lambda: _murmur3_rows_call(
+        jnp.full((n, w), -1, jnp.int32), jnp.zeros((n, 1), jnp.int32),
+        jnp.zeros((n, 1), jnp.uint32), interpret=interpret_mode())

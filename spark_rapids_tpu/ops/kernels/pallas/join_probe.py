@@ -1,0 +1,144 @@
+"""Fused direct-address hash-join build + probe (family ``joinProbe``).
+
+The jnp path in ``kernels.join.dense_join`` issues the build as two XLA
+segment scatters over an HBM-resident table and then pays TWO more full
+HBM gather passes for the probe (``cnt_tbl[pslot]``, ``row_tbl[pslot]``).
+This kernel fuses all four: grid step 0 builds the count/first-row table
+into VMEM scratch, and every probe grid step gathers against that same
+VMEM-resident table — the table is read from HBM zero times during the
+probe (the Ragged-Paged-Attention residency idiom, PAPERS.md). Scratch
+persists across grid steps because the TPU grid is sequential.
+
+Eligibility is static: the table plus one probe block must fit the
+session's VMEM budget (``spark.rapids.tpu.pallas.vmemBudgetBytes``);
+over-budget shapes fall back to the jnp oracle with a ``vmem`` fallback
+reason recorded.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import (PallasConf, interpret_mode, note_fallback, note_staged,
+               register_replay)
+
+
+def _divisor_block(cap: int, want: int) -> int:
+    """Largest power-of-two block <= want that divides cap (capacities
+    are 128-row aligned, so this terminates at or above 128 for bucketed
+    batches and at 1 in the degenerate unit-test case)."""
+    b = max(min(want, cap), 1)
+    while cap % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _build_probe_kernel(cap_b: int, tbl: int,
+                        bslot_ref, pslot_ref, cnt_ref, row_ref, max_ref,
+                        tbl_cnt, tbl_row, max_scr):
+    """Grid step 0 builds the table in VMEM scratch; every step probes it.
+
+    Oracle: the ``jax.ops.segment_sum`` / ``segment_min`` build plus the
+    ``cnt_tbl[pslot]`` / ``row_tbl[pslot]`` gathers in
+    ``kernels.join.dense_join`` (and ``dense_join_swapped``)."""
+    from jax.experimental import pallas as pl
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        bs = bslot_ref[:, 0]                  # pre-sentineled: bad -> tbl
+        iota_b = jax.lax.broadcasted_iota(jnp.int32, (cap_b, 1), 0)[:, 0]
+        # Table build: count per slot + first build row per slot. The
+        # spare slot ``tbl`` absorbs dead/null/out-of-range rows exactly
+        # like the oracle's num_segments=tbl+1 slice.
+        cnt = jnp.zeros((tbl + 1,), jnp.int32).at[bs].add(1)
+        # Empty slots read the segment_min identity (int32 max), exactly
+        # like the oracle's num_segments=tbl+1 scatter.
+        row = jnp.full((tbl + 1,), jnp.iinfo(jnp.int32).max,
+                       jnp.int32).at[bs].min(iota_b)
+        tbl_cnt[:, 0] = cnt
+        tbl_row[:, 0] = row
+        max_scr[0, 0] = jnp.max(cnt[:tbl])
+
+    ps = pslot_ref[:, 0]                      # in [0, tbl)
+    tc = tbl_cnt[:, 0]
+    tr = tbl_row[:, 0]
+    safe = jnp.clip(ps, 0, tbl - 1)
+    cnt_ref[:, 0] = tc[safe]
+    row_ref[:, 0] = tr[safe]
+    max_ref[0, 0] = max_scr[0, 0]
+
+
+@functools.partial(jax.jit, static_argnames=("cap_b", "tbl", "block",
+                                             "interpret"))
+def _build_probe_call(bslot, pslot, *, cap_b: int, tbl: int, block: int,
+                      interpret: bool):
+    """Oracle: ``kernels.join.dense_join``'s segment-scatter build + probe
+    gathers (see :func:`dense_build_probe`)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    cap_p = pslot.shape[0]
+    grid = cap_p // block
+    kernel = functools.partial(_build_probe_kernel, cap_b, tbl)
+    return pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((cap_p, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((cap_p, 1), jnp.int32),
+                   jax.ShapeDtypeStruct((1, 1), jnp.int32)),
+        grid=(grid,),
+        in_specs=[
+            # Build slots: the WHOLE build side resident across the grid.
+            pl.BlockSpec((cap_b, 1), lambda i: (0, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_specs=(pl.BlockSpec((block, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((block, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((1, 1), lambda i: (0, 0))),
+        scratch_shapes=[pltpu.VMEM((tbl + 1, 1), jnp.int32),
+                        pltpu.VMEM((tbl + 1, 1), jnp.int32),
+                        pltpu.VMEM((1, 1), jnp.int32)],
+        interpret=interpret,
+    )(bslot.reshape(cap_b, 1), pslot.reshape(cap_p, 1))
+
+
+def dense_build_probe(bslot: jnp.ndarray, pslot: jnp.ndarray, tbl: int,
+                      pallas: PallasConf
+                      ) -> Optional[Tuple[jnp.ndarray, jnp.ndarray,
+                                          jnp.ndarray]]:
+    """Fused build+probe for the direct-address join.
+
+    ``bslot`` int32[cap_b]: each build row's table slot, pre-sentineled to
+    ``tbl`` for dead/null/out-of-range rows. ``pslot`` int32[cap_p] in
+    [0, tbl). Returns ``(cnt_at_probe, row_at_probe, max_slot_count)``
+    bit-identical to the jnp oracle in ``kernels.join.dense_join``
+    (``cnt_tbl[pslot]``, ``row_tbl[pslot]``, ``max(cnt_tbl)`` — the
+    duplicate-key fail test ``any(cnt_tbl > 1)`` equals
+    ``max_slot_count > 1``), or None when the shape is ineligible and the
+    caller must run the oracle."""
+    cap_b = bslot.shape[0]   # static python int (aval shape)
+    cap_p = pslot.shape[0]
+    # Residency budget: the scratch table (2 int32 lanes) + the resident
+    # build slots + one probe block.
+    resident = (tbl + 1) * 8 + cap_b * 4 + pallas.block_rows * 12
+    if resident > pallas.vmem_budget:
+        note_fallback("joinProbe", "vmem")
+        return None
+    block = _divisor_block(cap_p, pallas.block_rows)
+    note_staged("joinProbe", (cap_b, cap_p, tbl, block))
+    cnt, row, mx = _build_probe_call(
+        bslot.astype(jnp.int32), pslot.astype(jnp.int32),
+        cap_b=cap_b, tbl=tbl, block=block, interpret=interpret_mode())
+    return cnt[:, 0], row[:, 0], mx[0, 0]
+
+
+@register_replay("joinProbe")
+def _replay(key):
+    """Zero-input fenced replay at a staged shape (deviceTiming probe)."""
+    cap_b, cap_p, tbl, block = key
+    return lambda: _build_probe_call(
+        jnp.full(cap_b, tbl, jnp.int32), jnp.zeros(cap_p, jnp.int32),
+        cap_b=cap_b, tbl=tbl, block=block, interpret=interpret_mode())

@@ -1,0 +1,163 @@
+"""Sorted-order segmented aggregation (family ``segmented``).
+
+The group-by sort path (``kernels.groupby._sort_grouped_aggregate``)
+reduces every lane with ``jax.ops.segment_{sum,min,max}`` — each one an
+XLA scatter over the full HBM-resident output, one round trip per
+reduction kind. This kernel makes it one VMEM pass per row block: the
+block's contributions collapse to a B-wide partial entirely in VMEM
+(segment ids within a block of a sorted, prefix-dense id lane span at
+most B positions), then combine into a dynamically-positioned B-wide
+window of the output — a read-modify-write that is safe because the TPU
+grid is sequential.
+
+Contract: ``gid`` must be NONDECREASING and prefix-dense
+(``gid[i] - gid[j] <= i - j``, the ``cumsum(boundary) - 1`` shape the
+grouping sort produces) — that is what bounds a block's segment span to
+its row count.
+
+Bit-identity: integer/bool sums, min, and max combine exactly across
+blocks. FLOAT SUMS DO NOT (the block-partial fold reassociates the
+additions), so float-sum lanes are statically ineligible and fall back
+to the jnp oracle with a ``float-sum-order`` reason — measured, not
+assumed (tools/kernel_bench.py A/Bs what remains).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import (PallasConf, interpret_mode, note_fallback, note_staged,
+               register_replay)
+from .join_probe import _divisor_block
+
+_OPS = ("sum", "min", "max")
+
+
+def _neutral(dtype, op: str):
+    if op == "sum":
+        return jnp.zeros((), dtype)
+    if jnp.issubdtype(dtype, jnp.floating):
+        v = jnp.inf if op == "min" else -jnp.inf
+        return jnp.asarray(v, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.asarray(info.max if op == "min" else info.min, dtype)
+
+
+def _segment_reduce_kernel(op: str, init_ref, x_ref, g_ref, out_ref):
+    """One [B, L] block -> combine into out[g0 : g0+B, :] in VMEM.
+
+    Oracle: ``jax.ops.segment_sum`` / ``segment_min`` / ``segment_max``
+    with ``num_segments=capacity`` (the group-by sort path's ``seg`` /
+    ``seg_many`` callbacks in ``kernels.groupby``)."""
+    from jax.experimental import pallas as pl
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _():
+        out_ref[:, :] = init_ref[:, :]
+
+    x = x_ref[:, :]                       # [B, L]
+    g = g_ref[:, 0]                       # [B] nondecreasing
+    b = x.shape[0]
+    g0 = g[0]
+    local = jnp.clip(g - g0, 0, b - 1)    # prefix-dense => span < B
+    neutral = _neutral(x.dtype, op)
+    partial = jnp.full((b, x.shape[1]), neutral, x.dtype)
+    if op == "sum":
+        partial = partial.at[local].add(x)
+    elif op == "min":
+        partial = partial.at[local].min(x)
+    else:
+        partial = partial.at[local].max(x)
+    cur = out_ref[pl.ds(g0, b), :]
+    if op == "sum":
+        out_ref[pl.ds(g0, b), :] = cur + partial
+    elif op == "min":
+        out_ref[pl.ds(g0, b), :] = jnp.minimum(cur, partial)
+    else:
+        out_ref[pl.ds(g0, b), :] = jnp.maximum(cur, partial)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "capacity", "block",
+                                             "interpret"))
+def _segment_reduce_call(x, gid, *, op: str, capacity: int, block: int,
+                         interpret: bool):
+    """Oracle: ``jax.ops.segment_{sum,min,max}`` (see
+    :func:`segment_reduce_sorted`)."""
+    from jax.experimental import pallas as pl
+    n, lanes = x.shape
+    grid = n // block
+    init = jnp.full((capacity + block, lanes), _neutral(x.dtype, op),
+                    x.dtype)
+    kernel = functools.partial(_segment_reduce_kernel, op)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((capacity + block, lanes), x.dtype),
+        grid=(grid,),
+        in_specs=[
+            # The output window is the WHOLE padded result, resident
+            # across the grid (RMW at a dynamic per-block offset).
+            pl.BlockSpec((capacity + block, lanes), lambda i: (0, 0)),
+            pl.BlockSpec((block, lanes), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((capacity + block, lanes),
+                               lambda i: (0, 0)),
+        input_output_aliases={0: 0},
+        interpret=interpret,
+    )(init, x, gid.reshape(n, 1))
+    return out[:capacity]
+
+
+def segment_reduce_sorted(x: jnp.ndarray, gid: jnp.ndarray, capacity: int,
+                          op: str, pallas: PallasConf
+                          ) -> Optional[jnp.ndarray]:
+    """Pallas twin of ``jax.ops.segment_{sum,min,max}(x, gid,
+    num_segments=capacity)`` for a sorted prefix-dense ``gid``.
+
+    ``x`` is [n] or [n, L]; returns the dense [capacity(, L)] reduction,
+    or None when ineligible (caller runs the oracle): float sums
+    (reassociation breaks bit-identity), empty lanes, or a padded output
+    window over the VMEM budget."""
+    if op not in _OPS:
+        return None
+    squeeze = x.ndim == 1
+    if squeeze:
+        x = x.reshape(-1, 1)
+    n, lanes = x.shape       # static python ints (aval shape)
+    if n == 0 or lanes == 0:
+        note_fallback("segmented", "empty")
+        return None
+    if op == "sum" and jnp.issubdtype(x.dtype, jnp.floating):
+        note_fallback("segmented", "float-sum-order")
+        return None
+    if x.dtype == jnp.bool_:
+        note_fallback("segmented", "bool-lane")
+        return None
+    block = _divisor_block(n, pallas.block_rows)
+    itemsize = jnp.dtype(x.dtype).itemsize
+    resident = (capacity + block) * lanes * itemsize \
+        + block * (lanes * itemsize + 4)
+    if resident > pallas.vmem_budget:
+        note_fallback("segmented", "vmem")
+        return None
+    note_staged("segmented", (op, n, lanes, capacity, block,
+                              jnp.dtype(x.dtype).name))
+    out = _segment_reduce_call(x, gid.astype(jnp.int32), op=op,
+                               capacity=capacity, block=block,
+                               interpret=interpret_mode())
+    return out[:, 0] if squeeze else out
+
+
+@register_replay("segmented")
+def _replay(key):
+    """Zero-input fenced replay at a staged shape (deviceTiming probe)."""
+    op, n, lanes, capacity, block, dtype = key
+    return lambda: _segment_reduce_call(
+        jnp.zeros((n, lanes), dtype),
+        jnp.zeros(n, jnp.int32), op=op, capacity=capacity, block=block,
+        interpret=interpret_mode())

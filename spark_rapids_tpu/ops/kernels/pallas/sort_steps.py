@@ -1,0 +1,114 @@
+"""Blockwise bitonic sort over a packed key lane (family ``sortStep``).
+
+``lax.sort`` is the dominant cost of the single-batch sort and the
+external-sort run-generation paths (ROADMAP: a 2-operand 1M sort costs
+~20s to compile and a full O(n log n) HBM pass to run). When the sort
+keys pack into ONE int64 lane (dead-flag + null bucket + a <=32-bit key +
+the row index — see ``kernels.rowops.packed_sort_lane``), the whole
+bitonic network runs inside VMEM: log^2(n) compare-exchange passes that
+never touch HBM, then one gather pass moves the payload by the resulting
+permutation. Lanes are UNIQUE by construction (the row index rides the
+low bits), so the unstable bitonic network reproduces the stable
+``lax.sort`` order bit-for-bit.
+
+Eligibility is static: single packable key, capacity a power-of-two pad
+away from the VMEM budget. Everything else falls back to the oracle with
+a recorded reason.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import (PallasConf, interpret_mode, note_fallback, note_staged,
+               register_replay)
+
+#: Bits of the packed lane reserved for the row index (low bits). Bounds
+#: eligible capacities to 2^27 rows — far above the bucket-ladder top.
+INDEX_BITS = 27
+
+#: Sentinel for pad rows: sorts after every real lane (bit 63 is never
+#: set by the packing, so int64 compare order is unsigned-correct).
+_PAD_LANE = jnp.iinfo(jnp.int64).max
+
+
+def _bitonic_kernel(lane_ref, out_ref):
+    """Full bitonic sort network over the VMEM-resident lane; emits the
+    original index of each sorted position.
+
+    Oracle: ``jax.lax.sort`` (stable) over the unpacked operands plus
+    iota — see ``kernels.rowops._permute_by_sort``; lanes are unique so
+    the orders coincide exactly."""
+    lane = lane_ref[:, 0]
+    n = lane.shape[0]
+    iota = jnp.arange(n, dtype=jnp.int32)
+    logn = n.bit_length() - 1 if isinstance(n, int) else 0
+
+    def stage(kp, arr):
+        k = 1 << (kp + 1)
+        up = (iota & k) == 0
+
+        def sub(jp, arr):
+            j = (1 << kp) >> jp
+            partner = iota ^ j
+            other = arr[partner]
+            lesser = jnp.minimum(arr, other)
+            greater = jnp.maximum(arr, other)
+            keep_small = (iota < partner) == up
+            return jnp.where(keep_small, lesser, greater)
+        return jax.lax.fori_loop(0, kp + 1, sub, arr)
+
+    sorted_lane = jax.lax.fori_loop(0, logn, stage, lane)
+    out_ref[:, 0] = (sorted_lane
+                     & jnp.int64((1 << INDEX_BITS) - 1)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _bitonic_call(lane, *, interpret: bool):
+    """Oracle: stable ``jax.lax.sort`` of the unpacked operands (see
+    :func:`packed_argsort`)."""
+    from jax.experimental import pallas as pl
+    n = lane.shape[0]
+    return pl.pallas_call(
+        _bitonic_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        interpret=interpret,
+    )(lane.reshape(n, 1))
+
+
+def packed_argsort(lane: jnp.ndarray, pallas: PallasConf
+                   ) -> Optional[jnp.ndarray]:
+    """Sorting permutation of a packed int64 key lane.
+
+    ``lane`` int64[n], bit 63 clear, row index in the low
+    :data:`INDEX_BITS` bits (lanes unique). Returns int32[n] ``perm``
+    with ``lane[perm]`` ascending — bit-identical to the stable
+    ``lax.sort`` order of the unpacked operands — or None when the
+    padded lane exceeds the VMEM budget."""
+    n = lane.shape[0]        # static python int (aval shape)
+    if n == 0:
+        note_fallback("sortStep", "empty")
+        return None
+    n2 = 1 << (n - 1).bit_length()
+    if n2 * 8 > pallas.vmem_budget:
+        note_fallback("sortStep", "vmem")
+        return None
+    if n2 > n:
+        # Pad lanes sort after every real lane and are sliced off below.
+        lane = jnp.concatenate(
+            [lane, jnp.full(n2 - n, _PAD_LANE, jnp.int64)])
+    note_staged("sortStep", (n2,))
+    perm = _bitonic_call(lane, interpret=interpret_mode())[:, 0]
+    return perm[:n]
+
+
+@register_replay("sortStep")
+def _replay(key):
+    """Zero-input fenced replay at a staged shape (deviceTiming probe)."""
+    (n2,) = key
+    return lambda: _bitonic_call(jnp.arange(n2, dtype=jnp.int64),
+                                 interpret=interpret_mode())

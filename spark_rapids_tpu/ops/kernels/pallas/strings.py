@@ -1,0 +1,143 @@
+"""Ragged string gather / compare over the char-matrix layout (family
+``strings``).
+
+Flat (non-dictionary) strings live as a ``[capacity, W]`` int16 char
+matrix (PAD == -1 past each row's end) — the same ragged layout the
+murmur3 kernel walks. The jnp twins (a row gather ``mat[idx]`` in
+``kernels.rowops.gather_column``; a rowwise ``jnp.all(a == b, axis=1)``
+compare in ``kernels.groupby._equal_adjacent``) each cost W-column HBM
+traffic that XLA schedules per-operand at worst. These kernels keep the
+source matrix (gather) or both row blocks (compare) in VMEM and emit the
+result in one pass, masked tails included — the Ragged-Paged-Attention
+tiling idiom (PAPERS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from . import (PallasConf, interpret_mode, note_fallback, note_staged,
+               register_replay)
+from .join_probe import _divisor_block
+
+
+def _gather_kernel(mat_ref, idx_ref, valid_ref, out_ref):
+    """One output block gathered from the VMEM-resident source matrix.
+
+    Oracle: ``jnp.where(valid[:, None], mat[clip(idx)], PAD)`` — the
+    flat-string branch of ``kernels.rowops.gather_column``."""
+    mat = mat_ref[:, :]                       # [n, W] resident
+    idx = idx_ref[:, 0]
+    valid = valid_ref[:, 0] != 0
+    safe = jnp.clip(idx, 0, mat.shape[0] - 1)
+    rows = mat[safe]
+    out_ref[:, :] = jnp.where(valid[:, None], rows,
+                              jnp.asarray(-1, mat.dtype))
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _gather_call(mat, idx, valid, *, block: int, interpret: bool):
+    """Oracle: the jnp row gather in ``kernels.rowops.gather_column``."""
+    from jax.experimental import pallas as pl
+    n, w = mat.shape
+    m = idx.shape[0]
+    return pl.pallas_call(
+        _gather_kernel,
+        out_shape=jax.ShapeDtypeStruct((m, w), mat.dtype),
+        grid=(m // block,),
+        in_specs=[
+            pl.BlockSpec((n, w), lambda i: (0, 0)),  # resident source
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+            pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, w), lambda i: (i, 0)),
+        interpret=interpret,
+    )(mat, idx.reshape(m, 1), valid.reshape(m, 1))
+
+
+def ragged_gather(mat: jnp.ndarray, idx: jnp.ndarray, valid: jnp.ndarray,
+                  pallas: PallasConf) -> Optional[jnp.ndarray]:
+    """Gather rows of a ``[n, W]`` char matrix at ``idx`` (int32[m]),
+    PAD-blanking rows where ``valid`` is False — bit-identical to the
+    jnp twin in ``kernels.rowops.gather_column``; None when the source
+    matrix exceeds the VMEM budget."""
+    n, w = mat.shape         # static python ints (aval shape)
+    m = idx.shape[0]
+    if n == 0 or m == 0 or w == 0:
+        note_fallback("strings", "empty")
+        return None
+    block = _divisor_block(m, max(1, pallas.block_rows // max(1, w // 64)))
+    itemsize = jnp.dtype(mat.dtype).itemsize
+    if n * w * itemsize + block * w * itemsize > pallas.vmem_budget:
+        note_fallback("strings", "vmem")
+        return None
+    note_staged("strings", ("gather", n, m, w, block))
+    return _gather_call(mat, idx.astype(jnp.int32),
+                        valid.astype(jnp.int8), block=block,
+                        interpret=interpret_mode())
+
+
+def _row_equal_kernel(a_ref, b_ref, out_ref):
+    """Rowwise equality of two char blocks, whole W chain in VMEM.
+
+    Oracle: ``jnp.all(a == b, axis=1)`` — the string branch of
+    ``kernels.groupby._equal_adjacent``."""
+    a = a_ref[:, :]
+    b = b_ref[:, :]
+    out_ref[:, 0] = jnp.all(a == b, axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _row_equal_call(a, b, *, block: int, interpret: bool):
+    """Oracle: ``jnp.all(a == b, axis=1)`` (see :func:`ragged_row_equal`)."""
+    from jax.experimental import pallas as pl
+    n, w = a.shape
+    return pl.pallas_call(
+        _row_equal_kernel,
+        out_shape=jax.ShapeDtypeStruct((n, 1), jnp.bool_),
+        grid=(n // block,),
+        in_specs=[
+            pl.BlockSpec((block, w), lambda i: (i, 0)),
+            pl.BlockSpec((block, w), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block, 1), lambda i: (i, 0)),
+        interpret=interpret,
+    )(a, b)
+
+
+def ragged_row_equal(a: jnp.ndarray, b: jnp.ndarray,
+                     pallas: PallasConf) -> Optional[jnp.ndarray]:
+    """bool[n]: rows of two ``[n, W]`` char matrices compare equal —
+    bit-identical to ``jnp.all(a == b, axis=1)`` (the jnp twin in
+    ``kernels.groupby._equal_adjacent``); None when ineligible."""
+    n, w = a.shape           # static python ints (aval shape)
+    if n == 0 or w == 0:
+        note_fallback("strings", "empty")
+        return None
+    block = _divisor_block(n, pallas.block_rows)
+    itemsize = jnp.dtype(a.dtype).itemsize
+    if 2 * block * w * itemsize > pallas.vmem_budget:
+        note_fallback("strings", "vmem")
+        return None
+    note_staged("strings", ("equal", n, w, block))
+    return _row_equal_call(a, b, block=block,
+                           interpret=interpret_mode())[:, 0]
+
+
+@register_replay("strings")
+def _replay(key):
+    """Zero-input fenced replay at a staged shape (deviceTiming probe)."""
+    if key[0] == "gather":
+        _, n, m, w, block = key
+        return lambda: _gather_call(
+            jnp.full((n, w), -1, jnp.int16), jnp.zeros(m, jnp.int32),
+            jnp.zeros(m, jnp.int8), block=block,
+            interpret=interpret_mode())
+    _, n, w, block = key
+    z = jnp.full((n, w), -1, jnp.int16)
+    return lambda: _row_equal_call(z, z, block=block,
+                                   interpret=interpret_mode())

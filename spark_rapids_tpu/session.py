@@ -59,6 +59,10 @@ class TpuSession:
         from .data import upload_cache
         from .ops.kernels import pallas_kernels
         upload_cache.set_budget(self.conf.get(TPU_UPLOAD_CACHE_BYTES))
+        # Legacy process-default only: every dispatch site with an
+        # ExecContext reads the PER-SESSION gate (ExecContext.pallas,
+        # ops/kernels/pallas/) — concurrent sessions no longer override
+        # each other through this call (ISSUE 8).
         pallas_kernels.configure(self.conf.get(TPU_PALLAS_ENABLED))
         # Compile-once layer: bucket ladder, persistent XLA executable
         # cache, AOT warm-up worker (compile/, docs/compile-cache.md).
@@ -125,6 +129,7 @@ class TpuSession:
         from .compile import budget, executables, ladder, persist, warmup
         from .exec import fusion
         from .utils import kernel_cache
+        from .ops.kernels import pallas as pallas_lib
         return {
             "ladder": dataclasses.asdict(ladder.get_ladder()),
             "persistent_cache": persist.status(),
@@ -134,6 +139,12 @@ class TpuSession:
             "pad_programs": fusion.pad_program_count(),
             "kernel_cache": kernel_cache.cache_stats(),
             "compile_budget": budget.stats(),
+            # Pallas pallas_call jits bypass the operator kernel cache
+            # (like the pad kernels above), so they get their own
+            # visibility + compile-gate ratchet (ISSUE 8;
+            # tests/test_compile_gate.py pallas_programs_budget).
+            "pallas_programs": pallas_lib.program_count(),
+            "pallas_kernels": pallas_lib.stats(),
         }
 
     # -- data sources -------------------------------------------------------

@@ -1,0 +1,442 @@
+"""Pallas kernel library tests (ISSUE 8, ops/kernels/pallas/).
+
+Every kernel family runs in INTERPRETER mode on the CPU test backend, so
+these differential tests exercise the real kernel logic everywhere:
+
+* per-kernel fuzz against the jnp oracle twin — all dtypes, empty /
+  one-row / full-tier shapes, dead-row masks, duplicate and out-of-range
+  keys, stability under all-equal keys;
+* the per-session gate: concurrent sessions with different gates keep
+  their own behavior (the PR-5 pipeline-sizing bug class, fixed here for
+  Pallas), and the default path stages NOTHING;
+* end-to-end: TPC-H q3/q5 with the gate on are bit-identical to the
+  gate-off oracle AND to the CPU oracle, including under PR-4 OOM
+  injection; QueryProfile's ``engine.pallas`` section reports per-kernel
+  launches (+ device time under metrics.deviceTiming) — the ISSUE 8
+  acceptance criterion.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu.ops.kernels import pallas as PAL
+from spark_rapids_tpu.ops.kernels.pallas import join_probe as JP
+from spark_rapids_tpu.ops.kernels.pallas import segmented as SEG
+from spark_rapids_tpu.ops.kernels.pallas import sort_steps as SS
+from spark_rapids_tpu.ops.kernels.pallas import strings as STR
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.workloads import tpch
+from spark_rapids_tpu.workloads.compare import tables_match
+
+CONF = PAL.PallasConf(enabled=True)
+
+
+def _cpu():
+    return TpuSession({"spark.rapids.sql.enabled": False})
+
+
+def _tpu(**extra):
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.variableFloatAgg.enabled": True}
+    conf.update(extra)
+    return TpuSession(conf)
+
+
+# ---------------------------------------------------------------------------
+# joinProbe — fused direct-address build+probe
+# ---------------------------------------------------------------------------
+
+
+class TestJoinProbe:
+    def _oracle(self, bslot, pslot, tbl, cap_b):
+        ok = bslot < tbl
+        cnt_tbl = jax.ops.segment_sum(ok.astype(jnp.int32), bslot,
+                                      num_segments=tbl + 1)[:tbl]
+        iota = jnp.arange(cap_b, dtype=jnp.int32)
+        row_tbl = jax.ops.segment_min(jnp.where(ok, iota, cap_b), bslot,
+                                      num_segments=tbl + 1)[:tbl]
+        return cnt_tbl[pslot], row_tbl[pslot], jnp.any(cnt_tbl > 1)
+
+    @pytest.mark.parametrize("cap_b,cap_p,dead_frac,dup", [
+        (128, 128, 0.0, False),      # minimal bucket
+        (256, 1024, 0.3, False),     # dead rows sentineled out
+        (384, 896, 0.1, True),       # duplicate build keys -> dup flag
+        (128, 256, 1.0, False),      # ALL rows dead (empty build)
+    ])
+    def test_matches_oracle(self, cap_b, cap_p, dead_frac, dup):
+        rng = np.random.default_rng(cap_b * cap_p)
+        tbl = cap_b * 4
+        kb = rng.integers(0, tbl // 2 if dup else tbl, cap_b)
+        if dup:
+            kb[1] = kb[0]            # force one collision
+        okb = rng.random(cap_b) >= dead_frac
+        bslot = jnp.asarray(np.where(okb, kb, tbl), jnp.int32)
+        pslot = jnp.asarray(rng.integers(0, tbl, cap_p), jnp.int32)
+        want = self._oracle(bslot, pslot, tbl, cap_b)
+        got = JP.dense_build_probe(bslot, pslot, tbl, CONF)
+        assert got is not None
+        assert (np.asarray(want[0]) == np.asarray(got[0])).all()
+        assert (np.asarray(want[1]) == np.asarray(got[1])).all()
+        assert bool(want[2]) == bool(got[2] > 1)
+
+    def test_one_live_row(self):
+        cap_b = cap_p = 128
+        tbl = cap_b * 4
+        bslot = jnp.full(cap_b, tbl, jnp.int32).at[0].set(7)
+        pslot = jnp.zeros(cap_p, jnp.int32).at[3].set(7)
+        cnt, row, mx = JP.dense_build_probe(bslot, pslot, tbl, CONF)
+        assert int(cnt[3]) == 1 and int(row[3]) == 0 and int(mx) == 1
+        assert int(cnt[0]) == 0
+
+    def test_vmem_budget_falls_back(self):
+        tiny = PAL.PallasConf(enabled=True, vmem_budget=1024)
+        base = PAL.stats().get("joinProbe", {}).get("fallbacks", {})
+        got = JP.dense_build_probe(jnp.zeros(1024, jnp.int32),
+                                   jnp.zeros(1024, jnp.int32), 4096, tiny)
+        assert got is None
+        now = PAL.stats()["joinProbe"]["fallbacks"]
+        assert now.get("vmem", 0) == base.get("vmem", 0) + 1
+
+
+# ---------------------------------------------------------------------------
+# segmented — sorted-order segmented reduction
+# ---------------------------------------------------------------------------
+
+
+def _sorted_gid(rng, n, density=0.1):
+    bnd = np.zeros(n, bool)
+    bnd[0] = True
+    bnd[rng.random(n) < density] = True
+    return jnp.asarray(np.cumsum(bnd) - 1, jnp.int32)
+
+
+class TestSegmented:
+    @pytest.mark.parametrize("op", ["sum", "min", "max"])
+    @pytest.mark.parametrize("dtype", [jnp.int32, jnp.int64])
+    def test_int_lanes_match_oracle(self, op, dtype):
+        rng = np.random.default_rng(hash((op, dtype.__name__)) % 2**32)
+        n = 1024
+        gid = _sorted_gid(rng, n)
+        x = jnp.asarray(rng.integers(-10**6, 10**6, n), dtype)
+        f = {"sum": jax.ops.segment_sum, "min": jax.ops.segment_min,
+             "max": jax.ops.segment_max}[op]
+        want = f(x, gid, num_segments=n)
+        got = SEG.segment_reduce_sorted(x, gid, n, op, CONF)
+        assert got is not None
+        assert (np.asarray(want) == np.asarray(got)).all()
+
+    @pytest.mark.parametrize("op", ["min", "max"])
+    def test_float_minmax_bit_identical(self, op):
+        # min/max select, never combine -> exact for floats too (NaN is
+        # stripped by the aggregation layer before any seg lane).
+        rng = np.random.default_rng(5)
+        n = 512
+        gid = _sorted_gid(rng, n)
+        x = jnp.asarray(rng.standard_normal(n))
+        f = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        want = f(x, gid, num_segments=n)
+        got = SEG.segment_reduce_sorted(x, gid, n, op, CONF)
+        assert (np.asarray(want) == np.asarray(got)).all()
+
+    def test_float_sum_falls_back(self):
+        # Block-partial reassociation breaks float-sum bit identity, so
+        # float sums are statically ineligible (reason recorded).
+        x = jnp.ones(256, jnp.float64)
+        gid = jnp.zeros(256, jnp.int32)
+        assert SEG.segment_reduce_sorted(x, gid, 256, "sum", CONF) is None
+        assert PAL.stats()["segmented"]["fallbacks"]["float-sum-order"] >= 1
+
+    def test_2d_lanes_and_every_row_own_group(self):
+        rng = np.random.default_rng(6)
+        n = 256
+        gid = jnp.arange(n, dtype=jnp.int32)       # max-span blocks
+        x = jnp.asarray(rng.integers(-50, 50, (n, 5)), jnp.int64)
+        want = jax.ops.segment_sum(x, gid, num_segments=n)
+        got = SEG.segment_reduce_sorted(x, gid, n, "sum", CONF)
+        assert (np.asarray(want) == np.asarray(got)).all()
+
+    def test_single_group_and_single_row(self):
+        x = jnp.asarray([7], jnp.int64)
+        gid = jnp.zeros(1, jnp.int32)
+        got = SEG.segment_reduce_sorted(x, gid, 1, "sum", CONF)
+        assert got is not None and int(got[0]) == 7
+        # all rows one group
+        x = jnp.arange(512, dtype=jnp.int64)
+        gid = jnp.zeros(512, jnp.int32)
+        want = jax.ops.segment_sum(x, gid, num_segments=512)
+        got = SEG.segment_reduce_sorted(x, gid, 512, "sum", CONF)
+        assert (np.asarray(want) == np.asarray(got)).all()
+
+    def test_empty_falls_back(self):
+        x = jnp.zeros((0,), jnp.int64)
+        gid = jnp.zeros((0,), jnp.int32)
+        assert SEG.segment_reduce_sorted(x, gid, 0, "sum", CONF) is None
+
+
+# ---------------------------------------------------------------------------
+# sortStep — packed-lane bitonic argsort
+# ---------------------------------------------------------------------------
+
+
+class TestSortStep:
+    def _lane(self, keys32, n):
+        u = keys32.astype(np.int64) + 2**31
+        return jnp.asarray((u << SS.INDEX_BITS) | np.arange(n), jnp.int64)
+
+    @pytest.mark.parametrize("n", [1, 7, 128, 777, 1024])
+    def test_matches_stable_sort(self, n):
+        rng = np.random.default_rng(n)
+        keys = rng.integers(-2**31, 2**31, n).astype(np.int64)
+        perm = SS.packed_argsort(self._lane(keys, n), CONF)
+        assert perm is not None
+        want = jax.lax.sort(
+            (jnp.asarray(keys), jnp.arange(n, dtype=jnp.int32)),
+            num_keys=1, is_stable=True)[1]
+        assert (np.asarray(perm) == np.asarray(want)).all()
+
+    def test_all_equal_keys_preserve_stability(self):
+        # The row index rides the low bits, so equal keys keep input
+        # order exactly like the stable lax.sort oracle.
+        n = 640
+        keys = np.zeros(n, np.int64)
+        perm = SS.packed_argsort(self._lane(keys, n), CONF)
+        assert (np.asarray(perm) == np.arange(n)).all()
+
+    def test_empty_and_vmem_fallbacks(self):
+        assert SS.packed_argsort(jnp.zeros((0,), jnp.int64), CONF) is None
+        tiny = PAL.PallasConf(enabled=True, vmem_budget=64)
+        assert SS.packed_argsort(jnp.zeros(1024, jnp.int64), tiny) is None
+
+
+# ---------------------------------------------------------------------------
+# strings — ragged gather / compare
+# ---------------------------------------------------------------------------
+
+
+class TestStrings:
+    @pytest.mark.parametrize("n,m,w", [(128, 128, 1), (300, 512, 24),
+                                       (64, 1024, 48)])
+    def test_gather_matches_oracle(self, n, m, w):
+        rng = np.random.default_rng(n * m)
+        mat = jnp.asarray(rng.integers(-1, 128, (n, w)), jnp.int16)
+        idx = jnp.asarray(rng.integers(-5, n + 5, m), jnp.int32)
+        valid = jnp.asarray(rng.random(m) < 0.8)
+        got = STR.ragged_gather(mat, idx, valid, CONF)
+        assert got is not None
+        want = jnp.where(valid[:, None], mat[jnp.clip(idx, 0, n - 1)],
+                         jnp.asarray(-1, jnp.int16))
+        assert (np.asarray(want) == np.asarray(got)).all()
+
+    def test_row_equal_matches_oracle(self):
+        rng = np.random.default_rng(9)
+        n, w = 512, 16
+        a = jnp.asarray(rng.integers(-1, 128, (n, w)), jnp.int16)
+        flip = jnp.asarray(rng.random((n, w)) < 0.02)
+        b = jnp.where(flip, jnp.asarray(0, jnp.int16), a)
+        got = STR.ragged_row_equal(a, b, CONF)
+        assert got is not None
+        want = jnp.all(a == b, axis=1)
+        assert (np.asarray(want) == np.asarray(got)).all()
+
+    def test_empty_falls_back(self):
+        z = jnp.zeros((0, 8), jnp.int16)
+        assert STR.ragged_gather(z, jnp.zeros((0,), jnp.int32),
+                                 jnp.zeros((0,), jnp.bool_), CONF) is None
+        assert STR.ragged_row_equal(z, z, CONF) is None
+
+
+# ---------------------------------------------------------------------------
+# Gate plumbing — per-session, cache-key isolation, defaults
+# ---------------------------------------------------------------------------
+
+
+class TestGate:
+    def test_from_conf_parses_families(self):
+        from spark_rapids_tpu.config import TpuConf
+        c = TpuConf({"spark.rapids.tpu.pallas.enabled": True,
+                     "spark.rapids.tpu.pallas.kernels":
+                         "joinProbe, segmented"})
+        p = PAL.from_conf(c)
+        assert p.wants("joinProbe") and p.wants("segmented")
+        assert not p.wants("sortStep") and not p.wants("hash")
+        # 'all' (the default) wants every family
+        p_all = PAL.from_conf(
+            TpuConf({"spark.rapids.tpu.pallas.enabled": True}))
+        assert all(p_all.wants(k) for k in PAL.KERNEL_FAMILIES)
+        # disabled wants nothing and collapses to ONE cache token
+        off = PAL.from_conf(TpuConf({}))
+        assert not any(off.wants(k) for k in PAL.KERNEL_FAMILIES)
+        assert off.token() == PAL.DISABLED.token()
+
+    def test_from_conf_rejects_unknown_family(self):
+        from spark_rapids_tpu.config import TpuConf
+        with pytest.raises(ValueError, match="unknown"):
+            PAL.from_conf(TpuConf({
+                "spark.rapids.tpu.pallas.enabled": True,
+                "spark.rapids.tpu.pallas.kernels": "warpSpeed"}))
+
+    def test_exec_context_resolves_per_session_conf(self):
+        from spark_rapids_tpu.plan.physical import ExecContext
+        on = ExecContext(_tpu(**{
+            "spark.rapids.tpu.pallas.enabled": True}).conf)
+        off = ExecContext(_tpu().conf)
+        assert on.pallas.enabled and not off.pallas.enabled
+        assert on.pallas.token() != off.pallas.token()
+
+    def test_concurrent_sessions_do_not_override_each_other(self):
+        """The ISSUE 8 satellite: constructing a second session with the
+        gate OFF used to flip the process-global flag under the first
+        session's feet. Now the first session keeps staging Pallas
+        kernels after the second session is created and used."""
+        data = {"k": list(range(1000)), "v": [1.0] * 1000}
+        dim = {"k": list(range(100)), "w": list(range(100))}
+
+        def join_q(s):
+            df = s.create_dataframe(data)
+            d = s.create_dataframe(dim)
+            return df.join(d, on="k").collect()
+
+        on = _tpu(**{"spark.rapids.tpu.pallas.enabled": True})
+        off = _tpu()                      # constructed AFTER, gate off
+        want = join_q(_cpu())
+        base = PAL.stats().get("joinProbe", {}).get("staged", 0)
+        got_off = join_q(off)             # must stage nothing
+        mid = PAL.stats().get("joinProbe", {}).get("staged", 0)
+        assert mid == base, "gate-off session staged a Pallas kernel"
+        got_on = join_q(on)               # must STILL stage (per-session)
+        after = PAL.stats().get("joinProbe", {}).get("staged", 0)
+        assert after > mid, \
+            "gate-on session lost its gate to the off session"
+        assert tables_match(got_on, want) and tables_match(got_off, want)
+        assert got_on.equals(got_off)
+
+    def test_disabled_default_stages_nothing(self):
+        snap = PAL.stats()
+        tables = tpch.gen_tables(1 << 9, seed=3)
+        s = _tpu()
+        tpch.QUERIES["q3"](tpch.load(s, tables)).collect()
+        assert PAL.stats() == snap
+
+
+# ---------------------------------------------------------------------------
+# End-to-end bit-identity (the acceptance criterion)
+# ---------------------------------------------------------------------------
+
+
+class TestQueryBitIdentity:
+    @pytest.mark.parametrize("qname", ["q3", "q5"])
+    def test_on_off_and_cpu(self, qname):
+        tables = tpch.gen_tables(1 << 10, seed=7)
+        q = tpch.QUERIES[qname]
+        want = q(tpch.load(_cpu(), tables)).collect()
+        on = _tpu(**{"spark.rapids.tpu.pallas.enabled": True})
+        off = _tpu()
+        got_on = q(tpch.load(on, tables)).collect()
+        got_off = q(tpch.load(off, tables)).collect()
+        assert tables_match(got_on, want, rel_tol=1e-9, abs_tol=1e-9)
+        assert tables_match(got_off, want, rel_tol=1e-9, abs_tol=1e-9)
+        assert got_on.equals(got_off), \
+            f"{qname}: pallas on/off not bit-identical"
+
+    @pytest.mark.parametrize("qname", ["q3", "q5"])
+    def test_bit_identical_under_oom_injection(self, qname):
+        """PR-4 fault injection at every retryable site: the split-in-half
+        escalation changes batch capacities mid-query, so this exercises
+        the kernels across shapes while faults force retries."""
+        inject = {
+            "spark.rapids.tpu.test.faultInjection.sites": "*",
+            "spark.rapids.tpu.test.faultInjection.seed": 11,
+            "spark.rapids.tpu.test.faultInjection.oomEveryN": -3,
+        }
+        tables = tpch.gen_tables(1 << 10, seed=7)
+        q = tpch.QUERIES[qname]
+        want = q(tpch.load(_cpu(), tables)).collect()
+        on = _tpu(**{"spark.rapids.tpu.pallas.enabled": True}, **inject)
+        off = _tpu(**inject)
+        got_on = q(tpch.load(on, tables)).collect()
+        got_off = q(tpch.load(off, tables)).collect()
+        assert tables_match(got_on, want, rel_tol=1e-9, abs_tol=1e-9)
+        assert got_on.equals(got_off), \
+            f"{qname}: pallas on/off diverged under OOM injection"
+
+    def test_string_shuffle_hash_query(self):
+        """String-keyed aggregation over a hash exchange: the murmur3
+        kernel family end-to-end, per-session gate (the original
+        pallas_kernels test, rebased on the package)."""
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+        data = {"k": ["apple", "pear", "fig", "apple", "kiwi", "fig",
+                      "dragonfruit", ""] * 40,
+                "v": list(range(320))}
+
+        def q(s):
+            df = s.create_dataframe(data)
+            out = df.group_by(col("k")).agg(
+                AGG.AggregateExpression(AGG.Sum(col("v")), "s"))
+            return sorted(out.collect().to_pylist(), key=str)
+
+        on = _tpu(**{"spark.rapids.tpu.pallas.enabled": True,
+                     "spark.sql.shuffle.partitions": 4})
+        assert q(on) == q(_cpu())
+
+
+class TestProfileAttribution:
+    def test_q3_profile_reports_launches_and_device_time(self):
+        """ISSUE 8 acceptance: QueryProfile reports per-kernel launches +
+        device time for a TPC-H q3 run.
+
+        The section attributes the kernels staged into the programs THIS
+        query compiled (Pallas wrappers run at trace time; a warm query
+        reusing cached programs reads zero deltas — cumulative per-kernel
+        state lives in compile_status()['pallas_kernels']). A distinct
+        blockRows gives this session its own kernel-cache token, so the
+        q3 trace is cold here no matter which tests ran before."""
+        tables = tpch.gen_tables(1 << 10, seed=7)
+        on = _tpu(**{
+            "spark.rapids.tpu.pallas.enabled": True,
+            "spark.rapids.tpu.pallas.blockRows": 128,
+            "spark.rapids.tpu.metrics.level": "ESSENTIAL",
+            "spark.rapids.tpu.metrics.deviceTiming": True})
+        tpch.QUERIES["q3"](tpch.load(on, tables)).collect()
+        prof = on.last_query_profile()
+        pal = prof.engine["pallas"]
+        assert pal["enabled"] is True
+        assert pal["kernels"], "no Pallas kernel attributed for q3"
+        jp = pal["kernels"]["joinProbe"]
+        assert jp["staged"] > 0
+        assert jp.get("deviceTimeNs", 0) > 0
+        assert "pallas" in prof.render()
+
+    def test_fence_free_default_has_no_device_time(self):
+        tables = tpch.gen_tables(1 << 9, seed=4)
+        on = _tpu(**{"spark.rapids.tpu.pallas.enabled": True,
+                     "spark.rapids.tpu.pallas.blockRows": 64,
+                     "spark.rapids.tpu.metrics.level": "ESSENTIAL"})
+        tpch.QUERIES["q3"](tpch.load(on, tables)).collect()
+        pal = on.last_query_profile().engine["pallas"]
+        assert pal["kernels"], "cold trace expected to stage kernels"
+        for m in pal["kernels"].values():
+            assert "deviceTimeNs" not in m
+
+    def test_probe_attributes_only_new_programs(self):
+        """The deviceTiming replay probe diffs against the query-start
+        program-key snapshot: programs staged by EARLIER queries must not
+        be re-timed into a later query's deviceTimeNs."""
+        before = PAL.snapshot_program_keys()
+        x = jnp.arange(192, dtype=jnp.int64)       # distinctive shape
+        gid = jnp.zeros(192, jnp.int32)
+        assert SEG.segment_reduce_sorted(x, gid, 192, "sum", CONF) \
+            is not None
+        after = PAL.snapshot_program_keys()
+        probed = PAL.probe_device_times(before, reps=1)
+        assert probed.get("segmented", 0) > 0
+        assert PAL.probe_device_times(after, reps=1) == {}
+
+    def test_compile_status_exposes_pallas_programs(self):
+        s = _tpu()
+        status = s.compile_status()
+        assert status["pallas_programs"] == PAL.program_count()
+        assert isinstance(status["pallas_kernels"], dict)

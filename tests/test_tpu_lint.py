@@ -301,6 +301,54 @@ class TestSeededAntiPatterns:
         assert [v for v in TL.lint_tree(fake_pkg)
                 if v.rule == "raw-thread"] == []
 
+    def test_pallas_call_without_oracle_flagged(self, fake_pkg):
+        _write(fake_pkg, "ops/kernels/pallas/orphan.py", """
+            from jax.experimental import pallas as pl
+
+            def call_it(x):
+                \"\"\"A kernel wrapper that forgot its twin.\"\"\"
+                return pl.pallas_call(lambda r, o: None,
+                                      out_shape=None)(x)
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg)
+              if v.rule == "pallas-no-oracle"]
+        assert len(vs) == 1
+
+    def test_pallas_call_with_oracle_docstring_passes(self, fake_pkg):
+        _write(fake_pkg, "ops/kernels/pallas/twinned.py", """
+            from jax.experimental import pallas as pl
+
+            def call_it(x):
+                \"\"\"Oracle: jax.ops.segment_sum (kernels.groupby).\"\"\"
+                return pl.pallas_call(lambda r, o: None,
+                                      out_shape=None)(x)
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "pallas-no-oracle"] == []
+
+    def test_pallas_rule_scoped_to_kernel_modules(self, fake_pkg):
+        # Outside ops/kernels/ the rule stays quiet (e.g. a doc example).
+        _write(fake_pkg, "compile/not_kernels.py", """
+            from jax.experimental import pallas as pl
+
+            def call_it(x):
+                return pl.pallas_call(lambda r, o: None,
+                                      out_shape=None)(x)
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "pallas-no-oracle"] == []
+
+    def test_pallas_call_at_module_level_flagged(self, fake_pkg):
+        # No enclosing function at all -> no docstring to name the twin.
+        _write(fake_pkg, "ops/kernels/pallas/toplevel.py", """
+            from jax.experimental import pallas as pl
+
+            CALL = pl.pallas_call(lambda r, o: None, out_shape=None)
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg)
+              if v.rule == "pallas-no-oracle"]
+        assert len(vs) == 1
+
 
 class TestRatchet:
     def _seed(self, fake_pkg, n):

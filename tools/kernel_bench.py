@@ -1,0 +1,313 @@
+"""kernel_bench — per-kernel Pallas vs jnp A/B across ladder tiers.
+
+Theseus (PAPERS.md) motivates MEASURING each kernel's data-movement win
+rather than asserting it: this tool runs every Pallas kernel family
+(ops/kernels/pallas/) against its jnp oracle twin on identical inputs at
+several bucket-ladder tiers, verifies the results match bit-for-bit, and
+emits a machine-readable ``BENCH_kernels.json``:
+
+    {"metric": "pallas_kernel_ab", "backend": ..., "interpret": ...,
+     "results": [{"kernel", "case", "rows", "pallas_ms", "jnp_ms",
+                  "speedup", "match"}, ...],
+     "geomean_speedup": ...}
+
+``speedup`` > 1 means the Pallas kernel wins at that shape. On non-TPU
+backends the kernels run in INTERPRETER mode — the timings then measure
+the interpreter, not the hardware (``interpret: true`` flags this), but
+the bit-identity column is still meaningful; run on real TPU hardware
+for the win curve. A per-kernel loss is a result, not a failure: use
+``spark.rapids.tpu.pallas.kernels`` to enable only the families that
+win on your shapes (docs/tuning-guide.md).
+
+CLI::
+
+    python -m tools.kernel_bench                       # default tiers
+    python -m tools.kernel_bench --tiers 1024,16384
+    python -m tools.kernel_bench --reps 5 --out BENCH_kernels.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+
+def _timed(fn, reps: int) -> float:
+    import jax
+    import numpy as np
+    jax.block_until_ready(fn())          # warmup + compile
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn())
+        times.append(time.perf_counter() - t0)
+    return float(np.median(times))
+
+
+def _record(results, kernel, case, rows, pallas_fn, jnp_fn, match,
+            reps) -> None:
+    p_s = _timed(pallas_fn, reps)
+    j_s = _timed(jnp_fn, reps)
+    results.append({
+        "kernel": kernel, "case": case, "rows": rows,
+        "pallas_ms": round(p_s * 1e3, 3),
+        "jnp_ms": round(j_s * 1e3, 3),
+        "speedup": round(j_s / p_s, 3) if p_s > 0 else 0.0,
+        "match": bool(match),
+    })
+    print(f"[kernel_bench] {kernel}/{case} rows={rows} "
+          f"pallas={p_s*1e3:.2f}ms jnp={j_s*1e3:.2f}ms "
+          f"speedup={j_s/p_s:.2f} match={bool(match)}", file=sys.stderr)
+
+
+def bench_hash(results, conf, rows: int, reps: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_tpu.ops.kernels.pallas import hashing
+    from spark_rapids_tpu.shuffle import partitioning as PT
+    rng = np.random.default_rng(rows)
+    w = 32
+    lens = rng.integers(0, w + 1, rows).astype(np.int32)
+    mat = np.full((rows, w), -1, np.int16)
+    for i in range(rows):          # ragged fill; cheap at bench sizes
+        mat[i, :lens[i]] = rng.integers(0, 256, lens[i])
+    mat_d, lens_d = jnp.asarray(mat), jnp.asarray(lens)
+    seed = jnp.full(rows, 42, jnp.uint32)
+    oracle = jax.jit(lambda m, ln, s: PT.murmur3_bytes_rows(jnp, m, ln, s))
+    want = oracle(mat_d, lens_d, seed)
+    got = hashing.murmur3_bytes_rows(mat_d, lens_d, seed)
+    match = bool((np.asarray(want) == np.asarray(got)).all())
+    _record(results, "hash", "murmur3_w32", rows,
+            lambda: hashing.murmur3_bytes_rows(mat_d, lens_d, seed),
+            lambda: oracle(mat_d, lens_d, seed), match, reps)
+
+
+def bench_join_probe(results, conf, rows: int, reps: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_tpu.ops.kernels.pallas import join_probe
+    rng = np.random.default_rng(rows + 1)
+    cap_b = max(rows // 8, 128)          # dimension build side
+    tbl = cap_b * 4
+    okb = rng.random(cap_b) < 0.9
+    bslot = jnp.asarray(np.where(okb, rng.integers(0, tbl, cap_b), tbl),
+                        jnp.int32)
+    pslot = jnp.asarray(rng.integers(0, tbl, rows), jnp.int32)
+
+    def oracle_fn(bs, ps):
+        ok = bs < tbl
+        cnt_tbl = jax.ops.segment_sum(ok.astype(jnp.int32), bs,
+                                      num_segments=tbl + 1)[:tbl]
+        iota = jnp.arange(cap_b, dtype=jnp.int32)
+        row_tbl = jax.ops.segment_min(jnp.where(ok, iota, cap_b), bs,
+                                      num_segments=tbl + 1)[:tbl]
+        return cnt_tbl[ps], row_tbl[ps], jnp.any(cnt_tbl > 1)
+    oracle = jax.jit(oracle_fn)
+    got = join_probe.dense_build_probe(bslot, pslot, tbl, conf)
+    if got is None:
+        print(f"[kernel_bench] joinProbe rows={rows}: ineligible (vmem)",
+              file=sys.stderr)
+        return
+    want = oracle(bslot, pslot)
+    match = bool((np.asarray(want[0]) == np.asarray(got[0])).all()
+                 and (np.asarray(want[1]) == np.asarray(got[1])).all()
+                 and bool(want[2]) == bool(got[2] > 1))
+    _record(results, "joinProbe", f"build{cap_b}_probe{rows}", rows,
+            lambda: join_probe.dense_build_probe(bslot, pslot, tbl, conf),
+            lambda: oracle(bslot, pslot), match, reps)
+
+
+def bench_segmented(results, conf, rows: int, reps: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_tpu.ops.kernels.pallas import segmented
+    rng = np.random.default_rng(rows + 2)
+    bnd = np.zeros(rows, bool)
+    bnd[0] = True
+    bnd[rng.random(rows) < 0.05] = True
+    gid = jnp.asarray(np.cumsum(bnd) - 1, jnp.int32)
+    for op, f in (("sum", jax.ops.segment_sum),
+                  ("min", jax.ops.segment_min),
+                  ("max", jax.ops.segment_max)):
+        x = jnp.asarray(rng.integers(-10**6, 10**6, rows), jnp.int64)
+        oracle = jax.jit(lambda v, g, f=f: f(v, g, num_segments=rows))
+        got = segmented.segment_reduce_sorted(x, gid, rows, op, conf)
+        if got is None:
+            print(f"[kernel_bench] segmented/{op} rows={rows}: ineligible",
+                  file=sys.stderr)
+            continue
+        want = oracle(x, gid)
+        match = bool((np.asarray(want) == np.asarray(got)).all())
+        _record(results, "segmented", op, rows,
+                lambda: segmented.segment_reduce_sorted(x, gid, rows, op,
+                                                        conf),
+                lambda: oracle(x, gid), match, reps)
+
+
+def bench_sort_step(results, conf, rows: int, reps: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_tpu.ops.kernels.pallas import sort_steps
+    rng = np.random.default_rng(rows + 3)
+    keys = rng.integers(-2**31, 2**31, rows).astype(np.int64)
+    u = keys + 2**31
+    lane = jnp.asarray((u << sort_steps.INDEX_BITS)
+                       | np.arange(rows), jnp.int64)
+    keys_d = jnp.asarray(keys.astype(np.int32))
+    iota = jnp.arange(rows, dtype=jnp.int32)
+    oracle = jax.jit(lambda k, i: jax.lax.sort((k, i), num_keys=1,
+                                               is_stable=True)[1])
+    got = sort_steps.packed_argsort(lane, conf)
+    if got is None:
+        print(f"[kernel_bench] sortStep rows={rows}: ineligible (vmem)",
+              file=sys.stderr)
+        return
+    want = oracle(keys_d, iota)
+    match = bool((np.asarray(want) == np.asarray(got)).all())
+    _record(results, "sortStep", "bitonic_argsort_i32key", rows,
+            lambda: sort_steps.packed_argsort(lane, conf),
+            lambda: oracle(keys_d, iota), match, reps)
+
+
+def bench_strings(results, conf, rows: int, reps: int) -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from spark_rapids_tpu.ops.kernels.pallas import strings
+    rng = np.random.default_rng(rows + 4)
+    w = 24
+    src = max(rows // 2, 128)
+    mat = jnp.asarray(rng.integers(-1, 128, (src, w)), jnp.int16)
+    idx = jnp.asarray(rng.integers(0, src, rows), jnp.int32)
+    valid = jnp.asarray(rng.random(rows) < 0.95)
+    oracle_g = jax.jit(lambda m, i, v: jnp.where(
+        v[:, None], m[jnp.clip(i, 0, m.shape[0] - 1)],
+        jnp.asarray(-1, m.dtype)))
+    got = strings.ragged_gather(mat, idx, valid, conf)
+    if got is not None:
+        want = oracle_g(mat, idx, valid)
+        match = bool((np.asarray(want) == np.asarray(got)).all())
+        _record(results, "strings", f"ragged_gather_w{w}", rows,
+                lambda: strings.ragged_gather(mat, idx, valid, conf),
+                lambda: oracle_g(mat, idx, valid), match, reps)
+    a = jnp.asarray(rng.integers(-1, 128, (rows, w)), jnp.int16)
+    b = jnp.where(jnp.asarray(rng.random((rows, w)) < 0.98), a,
+                  jnp.asarray(0, jnp.int16))
+    oracle_e = jax.jit(lambda x, y: jnp.all(x == y, axis=1))
+    got = strings.ragged_row_equal(a, b, conf)
+    if got is not None:
+        want = oracle_e(a, b)
+        match = bool((np.asarray(want) == np.asarray(got)).all())
+        _record(results, "strings", f"ragged_equal_w{w}", rows,
+                lambda: strings.ragged_row_equal(a, b, conf),
+                lambda: oracle_e(a, b), match, reps)
+
+
+BENCHES = {
+    "hash": bench_hash,
+    "joinProbe": bench_join_probe,
+    "segmented": bench_segmented,
+    "sortStep": bench_sort_step,
+    "strings": bench_strings,
+}
+
+
+def run(tiers, kernels, reps: int) -> dict:
+    import jax
+    from spark_rapids_tpu.ops.kernels import pallas as PAL
+    conf = PAL.PallasConf(enabled=True, vmem_budget=64 << 20)
+    interpret = PAL.interpret_mode()
+    results: list = []
+    for rows in tiers:
+        for name in kernels:
+            try:
+                BENCHES[name](results, conf, rows, reps)
+            except Exception as e:  # noqa: BLE001 — a kernel failure is a
+                # RESULT (recorded, the suite continues), not an abort.
+                print(f"[kernel_bench] {name} rows={rows} FAILED: {e}",
+                      file=sys.stderr)
+                results.append({"kernel": name, "case": "error",
+                                "rows": rows, "pallas_ms": 0.0,
+                                "jnp_ms": 0.0, "speedup": 0.0,
+                                "match": False,
+                                "error": f"{type(e).__name__}: {e}"})
+    speedups = [r["speedup"] for r in results
+                if r["speedup"] > 0 and r["match"]]
+    return {
+        "metric": "pallas_kernel_ab",
+        "backend": jax.default_backend(),
+        "interpret": interpret,
+        "note": ("interpreter-mode timings measure the Pallas interpreter,"
+                 " not hardware; bit-identity (match) is still meaningful")
+                if interpret else "compiled-kernel timings",
+        "results": results,
+        "matched": all(r["match"] for r in results) if results else False,
+        "geomean_speedup": round(
+            math.exp(sum(math.log(s) for s in speedups) / len(speedups)),
+            3) if speedups else 0.0,
+    }
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="tools.kernel_bench",
+        description="A/B every Pallas kernel against its jnp oracle twin "
+                    "across ladder tiers; emits BENCH_kernels.json")
+    ap.add_argument("--tiers", default=None,
+                    help="comma-separated row tiers (default: "
+                         "1024,4096 in interpreter mode, "
+                         "16384,65536,262144 on TPU)")
+    ap.add_argument("--kernels", default="all",
+                    help="comma-separated kernel families (default all): "
+                         + ",".join(BENCHES))
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--out", default=None,
+                    help="output path (default BENCH_kernels.json next to "
+                         "the repo root)")
+    return ap.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    from spark_rapids_tpu.ops.kernels import pallas as PAL
+    if args.tiers:
+        tiers = [int(t) for t in args.tiers.split(",") if t.strip()]
+    else:
+        tiers = [1 << 10, 1 << 12] if PAL.interpret_mode() \
+            else [1 << 14, 1 << 16, 1 << 18]
+    kernels = list(BENCHES) if args.kernels == "all" else \
+        [k.strip() for k in args.kernels.split(",") if k.strip()]
+    unknown = [k for k in kernels if k not in BENCHES]
+    if unknown:
+        print(f"unknown kernels: {unknown}; valid: {list(BENCHES)}",
+              file=sys.stderr)
+        return 2
+    try:
+        out = run(tiers, kernels, args.reps)
+    except Exception as e:  # noqa: BLE001 — the JSON must always land
+        import traceback
+        traceback.print_exc()
+        out = {"metric": "pallas_kernel_ab", "results": [],
+               "matched": False, "geomean_speedup": 0.0,
+               "error": f"{type(e).__name__}: {e}"}
+    path = args.out or os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "BENCH_kernels.json")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(out, f, indent=1)
+        f.write("\n")
+    print(f"[kernel_bench] wrote {path}", file=sys.stderr)
+    print(json.dumps({k: v for k, v in out.items() if k != "results"}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

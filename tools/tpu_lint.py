@@ -40,6 +40,13 @@ corrupt TPU performance or correctness silently:
   concurrency. Route through ``exec.pipeline.get_pool().submit`` or
   ``utils.prefetch.prefetch_iter`` instead; the pool's own spawn site
   carries the ignore marker.
+* ``pallas-no-oracle`` (kernel modules, ``ops/kernels/``): a
+  ``pallas_call`` site whose enclosing function's docstring does not
+  name its jnp oracle twin (the word "oracle"). Every hand-written
+  Pallas kernel must keep a jnp implementation as the default path AND
+  the bit-identity oracle (ops/kernels/pallas/, ISSUE 8); the docstring
+  reference is the ratcheted, statically-checkable trace of that
+  discipline as the kernel count grows.
 
 Existing debt is RATCHETED, not flooded: the checked-in baseline
 (``tools/tpu_lint_baseline.json``) records per-(file, rule) counts; the
@@ -144,6 +151,8 @@ class _FileLinter(ast.NodeVisitor):
         self.violations: List[Violation] = []
         #: stack of (is_jit, frozenset(param names)) for enclosing functions
         self._funcs: List[Tuple[bool, frozenset]] = []
+        #: stack of enclosing-function docstrings (pallas-no-oracle)
+        self._func_docs: List[str] = []
 
     # -- helpers ------------------------------------------------------------
     def _suppressed(self, node: ast.AST) -> bool:
@@ -171,8 +180,10 @@ class _FileLinter(ast.NodeVisitor):
             + ([args.vararg] if args.vararg else [])
             + ([args.kwarg] if args.kwarg else []))
         self._funcs.append((is_jit, params))
+        self._func_docs.append(ast.get_docstring(node) or "")
         self.generic_visit(node)
         self._funcs.pop()
+        self._func_docs.pop()
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
@@ -215,6 +226,7 @@ class _FileLinter(ast.NodeVisitor):
         root = _call_root(func)
         if self.in_kernel:
             self._check_host_sync(node, func, root)
+            self._check_pallas_oracle(node, func)
         if self.in_plan:
             self._check_nondet(node, func, root)
         if self.in_raw_thread:
@@ -252,6 +264,26 @@ class _FileLinter(ast.NodeVisitor):
             self._flag(node, "host-sync",
                        f"{func.id}(...) on a non-constant concretizes a "
                        "traced value (host sync inside a kernel module)")
+
+    def _check_pallas_oracle(self, node: ast.Call, func):
+        """pallas-no-oracle: every ``pallas_call`` site must sit inside a
+        function whose docstring names its jnp oracle twin — the
+        statically-checkable trace of the oracle discipline
+        (ops/kernels/pallas/; every kernel keeps a jnp default path that
+        is also its bit-identity oracle)."""
+        name = func.attr if isinstance(func, ast.Attribute) else (
+            func.id if isinstance(func, ast.Name) else None)
+        if name != "pallas_call":
+            return
+        if self._func_docs and "oracle" in self._func_docs[-1].lower():
+            return
+        self._flag(node, "pallas-no-oracle",
+                   "pallas_call site whose enclosing function's docstring "
+                   "does not name its jnp oracle twin; every Pallas "
+                   "kernel keeps a jnp default path as its bit-identity "
+                   "oracle — say which one (e.g. 'Oracle: "
+                   "jax.ops.segment_sum') in the docstring "
+                   "(ops/kernels/pallas/, docs/tuning-guide.md)")
 
     def _check_raw_thread(self, node: ast.Call, func, root):
         """raw-thread: device-path (+ data/utils) modules must not spawn
