@@ -32,9 +32,9 @@ only trades fused-region size against compile cost.
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Dict
 
+from ..utils import lockdep
 from . import persist
 
 _LOG = logging.getLogger(__name__)
@@ -43,7 +43,7 @@ _LOG = logging.getLogger(__name__)
 #: coarser to split (scans/windows/shuffles are already boundaries).
 MAX_SPLIT_LEVEL = 2
 
-_LOCK = threading.Lock()
+_LOCK = lockdep.lock("budget._LOCK")
 _BUDGET_SECS = 120.0
 _LEVELS: Dict[str, int] = {}
 _SECONDS: Dict[str, float] = {}

@@ -19,10 +19,11 @@ fallback for shapes nobody warmed.
 
 from __future__ import annotations
 
-import threading
 import time
 import weakref
 from typing import Dict, Set, Tuple
+
+from ..utils import lockdep
 
 import jax
 
@@ -67,7 +68,7 @@ class FusedProgram:
         #: (the polymorphic compile counters and the fusion compile-cost
         #: budget both key off this).
         self._jit_seen: Set[tuple] = set()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("FusedProgram._lock")
         self._stats = {"aot_hits": 0, "aot_call_errors": 0, "jit_calls": 0,
                        "aot_compiles": 0, "jit_compiles": 0,
                        "compile_seconds": 0.0}

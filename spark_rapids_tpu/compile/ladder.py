@@ -41,8 +41,9 @@ prevent. ``TpuSession`` configures it from the conf at construction.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import List
+
+from ..utils import lockdep
 
 #: Lane width of the VPU — the minimum sensible capacity granularity.
 LANE = 128
@@ -193,7 +194,7 @@ class BucketLadder:
         return out
 
 
-_LOCK = threading.Lock()
+_LOCK = lockdep.lock("ladder._LOCK")
 _LADDER = BucketLadder()
 
 

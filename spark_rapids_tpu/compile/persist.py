@@ -28,10 +28,11 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-import threading
 from typing import Callable, Dict, List, Optional, Tuple
 
-_LOCK = threading.Lock()
+from ..utils import lockdep
+
+_LOCK = lockdep.lock("persist._LOCK", io_ok=True)
 _STATUS: Dict[str, object] = {"enabled": False, "reason": "not configured"}
 _MANIFEST: Optional["CompileManifest"] = None
 #: True while this process's jax config points at our cache dir — so a
@@ -183,7 +184,7 @@ class CompileManifest:
 
     def __init__(self, path: str):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("CompileManifest._lock", io_ok=True)
         self._plans: Dict[str, List[tuple]] = {}
         #: plan hash -> fusion split level (compile/budget.py): plans
         #: whose fused region historically blew the compile budget.

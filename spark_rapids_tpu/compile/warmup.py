@@ -42,13 +42,14 @@ from typing import List, Optional
 
 import jax
 
+from ..utils import lockdep
 from . import persist
 from .executables import FusedProgram, abstract_like
 from .ladder import get_ladder
 
 _LOG = logging.getLogger(__name__)
 
-_CV = threading.Condition()
+_CV = lockdep.condition("warmup._CV")
 _QUEUE: deque = deque()
 _WORKER: Optional[threading.Thread] = None
 _INFLIGHT = 0

@@ -427,6 +427,17 @@ QUERY_DEADLINE_SECS = conf_float(
     "retry). The per-tenant time-budget primitive of the multi-tenant "
     "serving roadmap. 0 (default) disables. See docs/fault-tolerance.md.")
 
+LOCKDEP_ENABLED = conf_bool(
+    "spark.rapids.tpu.lockdep.enabled", False,
+    "Instrument engine locks constructed AFTER session init with runtime "
+    "lockdep (utils/lockdep.py): named locks, an observed lock-order "
+    "graph, and recorded lock-order-inversion / self-deadlock / "
+    "hold-across-blocking violations. Module-level locks are built at "
+    "import time, so full coverage needs the TPU_LOCKDEP=1 environment "
+    "variable before the engine is imported (tier-1 CI sets it). "
+    "Near-zero cost when off: lock factories return raw threading "
+    "primitives. See docs/concurrency.md.")
+
 SHUFFLE_CHECKSUM_ENABLED = conf_bool(
     "spark.rapids.tpu.shuffle.checksum.enabled", True,
     "Compute and verify CRC32C checksums on every shuffle block "

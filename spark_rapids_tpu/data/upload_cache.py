@@ -23,15 +23,16 @@ manager caching instead.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
 from typing import Optional
 
 import pyarrow as pa
 
+from ..utils import lockdep
+
 #: byte budget for cached device columns (set from conf at session init)
 _budget_bytes = 1 << 30
-_lock = threading.Lock()
+_lock = lockdep.lock("upload_cache._lock")
 _entries: "OrderedDict[tuple, tuple]" = OrderedDict()  # key -> (col, src, nb)
 _bytes = 0
 stats = {"hits": 0, "misses": 0, "evictions": 0}

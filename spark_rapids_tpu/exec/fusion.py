@@ -53,6 +53,7 @@ from ..compile import budget as _budget
 from ..compile import persist as _persist
 from ..compile import warmup as _warmup
 from ..compile.executables import FusedProgram
+from ..utils import lockdep as _lockdep
 from ..compile.ladder import get_ladder
 from ..data.batch import ColumnarBatch, _grow_batch, _shrink_batch
 from ..data.column import bucket_capacity
@@ -329,7 +330,10 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
                                        ctx.join_growth, guess_rows,
                                        ctx.join_caps, ctx.dense_modes),
                           label=type(device_plan).__name__)
-        _FUSED_CACHE[sig] = fn
+        # Last-wins under concurrent sessions: a GIL-atomic dict store
+        # of an equivalent program (same sig); the loser only wasted a
+        # build. No lock on the dispatch path.
+        _FUSED_CACHE[sig] = fn  # concurrency: ignore
     # Boundary subtrees run eagerly (uploads, windows, shuffles, ...); their
     # materialized batches are the fused program's positional arguments.
     # Independent boundaries materialize CONCURRENTLY on the shared
@@ -351,7 +355,12 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     key_compiled_before = fn.jit_compiled(inputs)
     import time as _time
     t_dispatch = _time.perf_counter_ns()
-    head, full = fn(inputs)
+    # Lockdep blocking marker: the fused dispatch (and on first touch of
+    # a signature, its trace+compile) is THE device wait of the engine —
+    # holding any engine lock across it serializes every sibling thread
+    # behind the device (utils/lockdep.py, docs/concurrency.md).
+    with _lockdep.blocking("fusion.dispatch"):
+        head, full = fn(inputs)
     if budget_secs > 0 and not key_compiled_before \
             and fn.jit_compiled(inputs):
         # THIS key's dispatch paid trace+compile (per-key, so a

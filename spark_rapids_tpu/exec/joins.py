@@ -93,7 +93,9 @@ class TpuBroadcastExchangeExec(TpuExec):
                 # entry at query end or the session-lifetime catalog leaks
                 # one build table per broadcast query.
                 catalog.free(bid)
-                self._buffer_id = None
+                # Cleanups run on the query thread at query end, never
+                # on pipeline workers.
+                self._buffer_id = None  # concurrency: ignore
             ctx.add_cleanup(_release)
             return catalog.acquire_batch(bid)
         self._device_batch = merged

@@ -612,7 +612,7 @@ def mesh_capable(root, conf) -> bool:
             cached = True
         except NotMeshCapable:
             cached = False
-        _MESH_CACHE[sig] = cached
+        _MESH_CACHE[sig] = cached  # GIL-atomic last-wins probe cache; concurrency: ignore
     return cached
 
 
@@ -733,7 +733,7 @@ def _mesh_core_collect(device_plan, ctx: ExecContext,
         sources: List = []
         fn = _compile(device_plan, sources, n_parts, bucket_growth, ctx.conf)
         entry = {"fn": fn, "n_sources": len(sources), "jit": {}}
-        _MESH_CACHE[sig] = entry
+        _MESH_CACHE[sig] = entry  # GIL-atomic last-wins compile cache; concurrency: ignore
     # The CURRENT plan's source batches, in _compile's traversal order.
     cur_sources: List = []
     _collect_sources(device_plan, cur_sources)

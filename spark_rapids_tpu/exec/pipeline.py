@@ -59,6 +59,8 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Iterator, List, Optional, Sequence
 
+from ..utils import lockdep
+
 _STOP = object()
 
 
@@ -82,7 +84,7 @@ class PipelinePool:
     def __init__(self, name: str = "tpu-pipeline"):
         self._name = name
         self._tasks: "queue.Queue" = queue.Queue()
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("PipelinePool._lock")
         self._threads: List[threading.Thread] = []
         self._idle = 0
         self._seq = 0
@@ -169,7 +171,7 @@ class PipelinePool:
         return leaked
 
 
-_LOCK = threading.Lock()
+_LOCK = lockdep.lock("pipeline._LOCK")
 _POOL: Optional[PipelinePool] = None
 _DECODE_SLOTS: Optional[threading.BoundedSemaphore] = None
 #: Conf snapshot (TpuSession.configure); defaults match the conf defaults.
@@ -294,10 +296,12 @@ def _stalled_result(f: Future, ctx, node: Optional[str]):
     t0 = time.perf_counter_ns()
     try:
         if deadline is None:
-            return f.result()
+            with lockdep.blocking("pipeline.future_wait"):
+                return f.result()
         while True:
             try:
-                return f.result(timeout=max(deadline.remaining(), 0.0))
+                with lockdep.blocking("pipeline.future_wait"):
+                    return f.result(timeout=max(deadline.remaining(), 0.0))
             except _FutTimeout:
                 # On py3.11+ futures.TimeoutError IS the builtin
                 # TimeoutError, which a WORKER can legitimately raise
@@ -373,7 +377,7 @@ class _UnitScheduler:
         self._depth = prefetch_depth(getattr(ctx, "conf", None))
         self._pool = get_pool()
         self._futs: dict = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("_UnitScheduler._lock")
         # A LIMIT can abandon trailing partitions; drop their look-ahead
         # at query end (running decodes finish, unstarted never run).
         if hasattr(ctx, "add_cleanup"):
@@ -464,7 +468,8 @@ def materialize_boundaries(boundaries: Sequence, ctx,
         # parent so ctx.close() can run them.
         for f in futs:
             try:
-                results.append(f.result())
+                with lockdep.blocking("pipeline.boundary_wait"):
+                    results.append(f.result())
             # Collect-and-re-raise: the FIRST failure propagates verbatim
             # after every worker has stopped touching its fork (the
             # session's retry loop then classifies it).

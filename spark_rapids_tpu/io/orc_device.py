@@ -37,6 +37,8 @@ import jax.numpy as jnp
 import numpy as np
 import pyarrow as pa
 
+from ..utils import lockdep
+
 from .. import types as T
 from ..data.batch import ColumnarBatch
 from ..data.column import (DeviceColumn, bucket_byte_capacity,
@@ -54,8 +56,14 @@ _S_PRESENT, _S_DATA, _S_LENGTH, _S_DICT = 0, 1, 2, 3
 #: column encodings
 _E_DIRECT, _E_DICT, _E_DIRECT_V2, _E_DICT_V2 = 0, 1, 2, 3
 
-#: decode-path observability (tests assert rare encodings were exercised)
+#: decode-path observability (tests assert rare encodings were exercised).
+#: Incremented from DECODE WORKERS (the readers run stripes through
+#: ordered_map_iter, exec/pipeline.py), so the bump must hold the lock —
+#: an unlocked `+=` from concurrent workers loses updates (found by the
+#: unguarded-shared-write pass, analysis/concurrency.py; regression:
+#: tests/test_lockdep.py::TestOrcDecodeStats).
 decode_stats = {"patched_base_runs": 0}
+_STATS_LOCK = lockdep.lock("orc_device._STATS_LOCK")
 
 #: RLEv2 5-bit width-code table (ORC spec "Closest fixed bit sizes").
 _WIDTH_TABLE = [1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16,
@@ -331,7 +339,8 @@ def parse_rlev2(b: bytes, signed: bool, expected: int) -> _Runs:
                 runs.add_direct(vals)
             produced += count
         else:  # enc == 2, PATCHED_BASE — materialize host-side
-            decode_stats["patched_base_runs"] += 1
+            with _STATS_LOCK:
+                decode_stats["patched_base_runs"] += 1
             wcode = (hdr >> 1) & 0x1F
             width = _WIDTH_TABLE[wcode]
             count = ((hdr & 1) << 8 | b[i + 1]) + 1

@@ -19,12 +19,12 @@ host paths (oracle runs, planning, explain) alive regardless.
 from __future__ import annotations
 
 import logging
-import threading
 
 from ..config import (CONCURRENT_ACQUIRE_TIMEOUT, CONCURRENT_TPU_TASKS,
                       DEVICE_BACKEND, DEVICE_SPILL_BUDGET,
                       HBM_ALLOC_FRACTION, HOST_SPILL_STORAGE_SIZE,
                       MEMORY_DEBUG, SPILL_DIR, TpuConf)
+from ..utils import lockdep
 from .semaphore import TpuSemaphore
 
 #: Conservative HBM guess used when the backend can't report a size (CPU
@@ -41,7 +41,7 @@ _PROBE_ERRORS = (NotImplementedError, AttributeError, TypeError,
 
 class DeviceManager:
     _instances: dict = {}
-    _lock = threading.Lock()
+    _lock = lockdep.lock("DeviceManager._lock")
 
     def __init__(self, conf: TpuConf):
         self._backend = conf.get(DEVICE_BACKEND)
@@ -53,7 +53,7 @@ class DeviceManager:
         self._devices = None
         self._hbm_budget = None
         self._peak_in_use = 0
-        self._init_lock = threading.Lock()
+        self._init_lock = lockdep.lock("DeviceManager._init_lock", io_ok=True)
         self._warned_probes: set = set()
         # Spill catalog: the GpuShuffleEnv.initStorage chain
         # (device -> host -> disk, GpuShuffleEnv.scala:52-69). The device

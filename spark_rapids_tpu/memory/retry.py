@@ -42,10 +42,11 @@ from __future__ import annotations
 
 import dataclasses
 import logging
-import threading
 import time
 import zlib
 from typing import Callable, List, Optional
+
+from ..utils import lockdep
 
 _LOG = logging.getLogger(__name__)
 
@@ -56,7 +57,7 @@ _LOG = logging.getLogger(__name__)
 #: sibling's retry is about to re-pin. Device ALLOCATION concurrency is
 #: already bounded by the admission semaphore the workers hold; this lock
 #: only orders the recovery sequences among themselves.
-_OOM_RECOVERY_LOCK = threading.RLock()
+_OOM_RECOVERY_LOCK = lockdep.rlock("retry._OOM_RECOVERY_LOCK", io_ok=True)
 
 #: Hard ceiling on attempts one ``with_retry`` call may make across all
 #: split fragments — a runaway-injection backstop, far above any real
@@ -227,7 +228,8 @@ def backoff_sleep(policy: RetryPolicy, site: str, attempt: int,
     if delay <= 0:
         return
     t0 = time.perf_counter_ns()
-    time.sleep(delay)
+    with lockdep.blocking("retry.backoff_sleep"):
+        time.sleep(delay)
     if ctx is not None and node is not None:
         ctx.metric(node, "retryBlockTimeNs", time.perf_counter_ns() - t0)
 

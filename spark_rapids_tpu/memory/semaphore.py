@@ -20,6 +20,8 @@ import threading
 import time
 from typing import Dict
 
+from ..utils import lockdep
+
 
 class SemaphoreTimeoutError(RuntimeError):
     """Task-admission acquire timed out — almost always a stuck or leaked
@@ -34,7 +36,7 @@ class TpuSemaphore:
         self.acquire_timeout_s = acquire_timeout_s
         self._sem = threading.Semaphore(max_concurrent)
         self._held: Dict[int, int] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("TpuSemaphore._lock")
         #: Lifetime nanoseconds threads spent blocked on acquire — the
         #: semaphoreWaitNs metric source; the query profile takes deltas
         #: (metrics/profile.py, GpuSemaphore's SEMAPHORE_WAIT analog).

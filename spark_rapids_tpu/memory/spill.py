@@ -24,13 +24,13 @@ import heapq
 import io
 import os
 import tempfile
-import threading
 from typing import Dict, Optional, Tuple
 
 import pyarrow as pa
 
 from .. import types as T
 from ..data.batch import ColumnarBatch
+from ..utils import lockdep
 from ..utils.tracing import trace_range
 
 
@@ -111,7 +111,7 @@ class SpillFile:
         #: catalog threads spark.rapids.tpu.shuffle.checksum.enabled here
         #: so the kill switch covers its disk tier too)
         self.verify = verify
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("SpillFile._lock", io_ok=True)
 
     def close(self):
         import shutil
@@ -267,7 +267,7 @@ class BufferCatalog:
         self.device_bytes = 0
         self.host_bytes = 0
         self._next_id = 0
-        self._lock = threading.RLock()
+        self._lock = lockdep.rlock("BufferCatalog._lock")
         self._spill_dir = spill_dir
         self._spill_file: Optional[SpillFile] = None  # lazy: first disk spill
         self._pinned: set = set()

@@ -13,8 +13,9 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 from typing import List, Optional
+
+from ..utils import lockdep
 
 FILENAME = "query_profiles.jsonl"
 
@@ -25,7 +26,7 @@ class EventLog:
     def __init__(self, directory: str):
         self.dir = directory
         self.path = os.path.join(directory, FILENAME)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("EventLog._lock", io_ok=True)
 
     def append(self, profile) -> bool:
         """Append one profile (QueryProfile or plain dict); returns False

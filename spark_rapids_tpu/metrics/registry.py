@@ -22,8 +22,9 @@ XProf/TraceAnnotation range (the NvtxWithMetrics coupling).
 
 from __future__ import annotations
 
-import threading
 from typing import Dict, Optional
+
+from ..utils import lockdep
 
 # ---------------------------------------------------------------------------
 # Levels (GpuMetric.scala: ESSENTIAL/MODERATE/DEBUG) and kinds.
@@ -269,7 +270,7 @@ class MetricsRegistry:
     def __init__(self, level: int = MODERATE, device_timing: bool = False):
         self.level = level
         self.device_timing = device_timing and level > NONE
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("MetricsRegistry._lock")
         self._nodes: Dict[str, Dict[str, TpuMetric]] = {}
 
     @classmethod

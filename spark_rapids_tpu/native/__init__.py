@@ -13,14 +13,15 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
+
+from ..utils import lockdep
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
 _SOURCES = ["hostkern.cpp", "arena.cpp"]
 _SO = os.path.join(_DIR, "_build", "libsrtpu_host.so")
 
-_lock = threading.Lock()
+_lock = lockdep.lock("native._lock", io_ok=True)
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 

@@ -45,8 +45,9 @@ surface with no dispatch effect.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Dict, Optional, Tuple
+
+from ....utils import lockdep
 
 #: every kernel family name, in the order docs list them
 KERNEL_FAMILIES = ("hash", "joinProbe", "segmented", "sortStep", "strings")
@@ -84,7 +85,7 @@ class PallasConf:
 DISABLED = PallasConf()
 
 _PROCESS_DEFAULT = DISABLED
-_LOCK = threading.Lock()
+_LOCK = lockdep.lock("pallas._LOCK")
 
 # Per-kernel attribution (ISSUE 8): staged counts (times a kernel wrapper
 # actually emitted a pallas_call into a trace — each staging is one

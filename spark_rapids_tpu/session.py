@@ -76,6 +76,14 @@ class TpuSession:
         self._last_profile = None
         self._query_seq = 0
         self._event_log = None
+        # Concurrency analysis layer (utils/lockdep.py,
+        # docs/concurrency.md): the conf covers locks constructed from
+        # here on (session-scoped catalogs, deadlines, registries); the
+        # TPU_LOCKDEP env var is the full-coverage import-time switch.
+        from .config import LOCKDEP_ENABLED
+        if self.conf.get(LOCKDEP_ENABLED):
+            from .utils import lockdep
+            lockdep.enable(True)
         # OOM-resilience layer (memory/retry.py, docs/fault-tolerance.md):
         # the fault injector is SESSION-scoped so its deterministic visit
         # counters survive per-dispatch context rebuilds.
@@ -100,6 +108,10 @@ class TpuSession:
         s._last_profile = None
         s._query_seq = 0
         s._event_log = None
+        from .config import LOCKDEP_ENABLED
+        if s.conf.get(LOCKDEP_ENABLED):
+            from .utils import lockdep
+            lockdep.enable(True)
         from .utils.fault_injection import FaultInjector
         s._fault_injector = FaultInjector.maybe(s.conf)
         from .shuffle.exchange import MapOutputTracker
@@ -421,7 +433,9 @@ class TpuSession:
         final = {}
 
         def run(ctx, mode):
-            final["ctx"] = ctx   # the profiled attempt = the last one run
+            # run() executes on the query thread (the retry loop calls it
+            # inline); worker-reachability here is generous-taint noise.
+            final["ctx"] = ctx  # concurrency: ignore
             if mode == "deferred" and self.conf.sql_enabled \
                     and self.conf.mesh_enabled \
                     and _mesh().mesh_capable(physical, self.conf):

@@ -27,7 +27,6 @@ from __future__ import annotations
 
 import os
 import tempfile
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -37,6 +36,7 @@ from .. import types as T
 from ..config import SHUFFLE_COMPRESSION_CODEC
 from ..data.batch import ColumnarBatch, HostBatch
 from ..plan.physical import ExecContext, PhysicalPlan, _arrow_schema
+from ..utils import lockdep
 from ..utils.kernel_cache import cached_kernel, kernel_key
 from .codec import get_codec
 from .serializer import deserialize_batch, serialize_batch
@@ -64,7 +64,7 @@ class ShuffleBufferCatalog:
         self._blocks: Dict[Tuple[int, int, int], object] = {}
         self._crcs: Dict[Tuple[int, int, int], int] = {}
         self._host_bytes = 0
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("ShuffleBufferCatalog._lock")
         self._spill_dir = spill_dir
         self._spill_file = None
         # Host tier storage: serialized blocks go into ONE native arena
@@ -314,7 +314,7 @@ class MapOutputTracker:
         self._peer_failures: Dict[Tuple[str, int], int] = {}
         self._blacklist: set = set()
         self._recomputes: Dict[Tuple[int, int], int] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("MapOutputTracker._lock")
         self.metrics = {"map_tasks_recomputed": 0, "recomputes": 0,
                         "peers_blacklisted": 0}
 
@@ -512,11 +512,53 @@ def fetch_with_recovery(peer, shuffle_id: int, reduce_id: int,
 
 
 _next_shuffle_id = [0]
+#: Guards the id counter: exchanges in SIBLING fusion boundaries execute
+#: concurrently on pipeline workers (exec/pipeline.py), and the previous
+#: unsynchronized `+= 1; return [0]` could hand two exchanges the SAME
+#: shuffle id (increment and read are separate bytecodes — another
+#: worker's increment between them makes both reads return its value),
+#: silently mixing two exchanges' blocks in the catalog. Found by the
+#: unguarded-shared-write pass (analysis/concurrency.py); regression:
+#: tests/test_lockdep.py::TestShuffleIdAllocation.
+_SHUFFLE_ID_LOCK = lockdep.lock("exchange._SHUFFLE_ID_LOCK")
 
 
 def _new_shuffle_id() -> int:
-    _next_shuffle_id[0] += 1
-    return _next_shuffle_id[0]
+    with _SHUFFLE_ID_LOCK:
+        _next_shuffle_id[0] += 1
+        return _next_shuffle_id[0]
+
+
+class _DrainLatch:
+    """Runs ``action`` exactly once after ``arrive()`` has been called
+    ``n`` times — the read side's early block release (every reduce
+    partition drained -> unregister the shuffle before query end).
+
+    Replaces an unsynchronized ``drained["n"] += 1`` closure counter:
+    with reduce-side prefetch on, the drain bookkeeping runs on pipeline
+    WORKER threads, and concurrent unlocked ``+=`` loses updates — the
+    count then never reaches ``n`` and the shuffle's blocks stay pinned
+    in host memory until query-end cleanup. Found by the
+    unguarded-shared-write pass (analysis/concurrency.py); regression:
+    tests/test_lockdep.py::TestDrainLatch."""
+
+    def __init__(self, n: int, action):
+        self._lock = lockdep.lock("exchange._DrainLatch._lock")
+        self._n = n
+        self._count = 0
+        self._fired = False
+        self._action = action
+
+    def arrive(self) -> None:
+        with self._lock:
+            self._count += 1
+            fire = not self._fired and self._count >= self._n
+            if fire:
+                self._fired = True
+        if fire:
+            # Outside the latch lock: the action takes the catalog lock,
+            # and lock-order discipline wants no nesting here.
+            self._action()
 
 
 class CpuShuffleExchangeExec(PhysicalPlan):
@@ -781,7 +823,8 @@ class TpuShuffleExchangeExec(PhysicalPlan):
             ctx.metric(name, "aqeOutputPartitions", len(specs))
         else:
             specs = [aqe.CoalescedSpec(p, p + 1) for p in range(n_parts)]
-        drained = {"n": 0}
+        drained = _DrainLatch(
+            len(specs), lambda: catalog.unregister_shuffle(shuffle_id))
 
         def recovered_payloads(p, map_range):
             """One reduce partition's verified payloads, in map order,
@@ -851,9 +894,7 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                         ctx.metric(name, "numOutputBatches", 1)
                         yield ColumnarBatch.from_arrow(rb)
             finally:
-                drained["n"] += 1
-                if drained["n"] == len(specs):
-                    catalog.unregister_shuffle(shuffle_id)
+                drained.arrive()
         if not overlap:
             return [read_spec(s) for s in specs]
         # Reduce-side overlap: a prefetch worker deserializes + re-uploads

@@ -56,6 +56,8 @@ import socketserver
 import struct
 import threading
 import time
+
+from ..utils import lockdep
 from typing import Callable, Iterator, List, Optional, Tuple
 
 from ..utils import checksum as CK
@@ -213,7 +215,7 @@ class NetTransport(Transport):
         if greeting[:len(MAGIC)] != MAGIC or greeting[-1] != VERSION:
             self._sock.close()
             raise ConnectionError(f"bad handshake from {peer}: {greeting!r}")
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("NetTransport._lock", io_ok=True)
 
     def close(self):
         try:
@@ -372,7 +374,8 @@ class RetryingBlockIterator:
                     if desc.tag[1] in prev_attempted:
                         self._metric("shuffleBlocksRefetched", 1)
                     attempted.add(desc.tag[1])
-                    payload = client.fetch_one(desc)
+                    with lockdep.blocking("shuffle.fetch_wait"):
+                        payload = client.fetch_one(desc)
                     yielded.add(desc.tag[1])
                     self.delivered_crcs[desc.tag[1]] = desc.crc
                     yield (desc.tag[1], payload) if self.with_map_ids \
@@ -394,7 +397,8 @@ class RetryingBlockIterator:
                         f"shuffle.fetch {self.peer[0]}:{self.peer[1]}",
                         self.ctx, self.node)
                     delay = deadline.bound(delay)
-                time.sleep(delay)
+                with lockdep.blocking("shuffle.fetch_backoff"):
+                    time.sleep(delay)
         raise ShuffleFetchFailedError(self.peer, self.shuffle_id,
                                       self.reduce_id, last_error,
                                       yielded_map_ids=yielded)

@@ -17,8 +17,9 @@ unit tests drive the state machines with scripted transactions exactly like
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Callable, Dict, List, Optional, Tuple
+
+from ..utils import lockdep
 
 from .serializer import ShuffleTableMeta
 
@@ -87,7 +88,7 @@ class BounceBufferPool:
         self.buffer_size = buffer_size
         self._free: List[bytearray] = [bytearray(buffer_size)
                                        for _ in range(count)]
-        self._cv = threading.Condition()
+        self._cv = lockdep.condition("BounceBufferPool._cv")
 
     def acquire(self) -> bytearray:
         with self._cv:
@@ -112,7 +113,7 @@ class Throttle:
     def __init__(self, max_inflight_bytes: int):
         self.max_inflight = max_inflight_bytes
         self._inflight = 0
-        self._cv = threading.Condition()
+        self._cv = lockdep.condition("Throttle._cv")
 
     def acquire(self, nbytes: int):
         with self._cv:

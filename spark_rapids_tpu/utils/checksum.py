@@ -23,8 +23,9 @@ section (metrics/profile.py) — a clean run reports zero failures.
 from __future__ import annotations
 
 import logging
-import threading
 from typing import Optional
+
+from . import lockdep
 
 _LOG = logging.getLogger(__name__)
 
@@ -72,7 +73,7 @@ except ImportError:  # pragma: no cover - depends on installed packages
             "correct, but slow on large shuffle/spill payloads")
 
 
-_STATS_LOCK = threading.Lock()
+_STATS_LOCK = lockdep.lock("checksum._STATS_LOCK")
 _STATS = {"computed": 0, "verified": 0, "failures": 0}
 
 

@@ -21,9 +21,10 @@ by the remaining budget so a site never oversleeps the deadline.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Optional
+
+from . import lockdep
 
 
 class QueryDeadlineExceeded(RuntimeError):
@@ -67,7 +68,7 @@ class Deadline:
         self._deadline = self._t0 + self.limit_s
         self._last = self._t0
         self._elapsed: dict = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("Deadline._lock")
         self._cancelled = False
 
     @classmethod

@@ -34,11 +34,12 @@ Site names are dotted, ``<node>.<boundary>`` (e.g.
 
 from __future__ import annotations
 
-import threading
 import zlib
 from typing import Dict, Optional
 
-_SITES_LOCK = threading.Lock()
+from . import lockdep
+
+_SITES_LOCK = lockdep.lock("fault_injection._SITES_LOCK")
 _KNOWN_SITES: set = set()
 
 
@@ -99,7 +100,7 @@ class FaultInjector:
             if f in NET_FAULT_CLASSES) or NET_FAULT_CLASSES
         self.net_stall_secs = float(net_stall_secs)
         self._counters: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("FaultInjector._lock")
         #: injected-fault tallies by flavor (test assertions read these)
         self.injected = {"oom": 0, "transient": 0, "disk": 0}
         self.injected.update({f"net.{c}": 0 for c in NET_FAULT_CLASSES})

@@ -16,13 +16,14 @@ by :func:`kernel_key` from their bound expressions instead.
 from __future__ import annotations
 
 import dataclasses
-import threading
 from typing import Callable, Dict, Optional, Tuple
 
 import jax
 
+from . import lockdep
+
 _CACHE: Dict[tuple, Callable] = {}
-_LOCK = threading.Lock()
+_LOCK = lockdep.lock("kernel_cache._LOCK")
 #: build_ns: host time spent constructing kernels on cache misses — the
 #: compileNs source for query profiles (XLA backend compilation itself is
 #: async and lands in first-dispatch deviceTime).

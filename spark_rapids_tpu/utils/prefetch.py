@@ -22,6 +22,8 @@ import threading
 import time
 from typing import Iterable, Iterator, Optional
 
+from . import lockdep
+
 _STOP = object()
 _PENDING = object()
 
@@ -88,7 +90,8 @@ def prefetch_iter(src: Iterable, depth: int = 2, ctx=None,
                 deadline = getattr(ctx, "deadline", None)
                 while item is _PENDING:
                     try:
-                        item = q.get(timeout=0.5)
+                        with lockdep.blocking("prefetch.consumer_wait"):
+                            item = q.get(timeout=0.5)
                     except queue.Empty:
                         if deadline is not None:
                             # Cooperative deadline cancellation: stop

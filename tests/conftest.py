@@ -35,6 +35,18 @@ def pytest_addoption(parser):
 
 
 def pytest_configure(config):
+    # Runtime lockdep (utils/lockdep.py, docs/concurrency.md): instrument
+    # every engine lock so the WHOLE suite runs as a lockdep-supervised
+    # schedule corpus. Must be exported before any test module imports
+    # the engine — module-level locks are constructed at import time.
+    # The session gate below fails the run on any recorded violation.
+    # An explicit falsey export (0/false/no/off) opts a local debug run
+    # out (tests/test_lockdep.py then SKIPS its corpus-contract test
+    # rather than failing); anything else — unset, empty, or a value
+    # lockdep would not recognize — arms the gate. CI never sets it.
+    if os.environ.get("TPU_LOCKDEP", "").strip().lower() \
+            not in ("0", "false", "no", "off"):
+        os.environ["TPU_LOCKDEP"] = "1"
     if config.getoption("--tpu"):
         # Signal the harness to compare floats with tolerance.
         os.environ["SRTPU_TEST_TPU"] = "1"
@@ -98,6 +110,18 @@ def pytest_sessionfinish(session, exitstatus):
         print("ERROR: pipeline worker threads survived shutdown "
               f"(TpuSession.close leak): {[t.name for t in leaked]}",
               file=sys.stderr)
+    # Lockdep gate (docs/concurrency.md): the suite doubles as a schedule
+    # corpus — any lock-order inversion, self-deadlock, or
+    # hold-across-blocking recorded by ANY test fails the run. Tests that
+    # provoke violations on purpose drain them (lockdep.drain_violations).
+    ld = sys.modules.get("spark_rapids_tpu.utils.lockdep")
+    if ld is not None and ld.violations():
+        session.exitstatus = 1
+        print("ERROR: lockdep recorded lock-discipline violation(s) "
+              "during the suite (utils/lockdep.py, docs/concurrency.md):",
+              file=sys.stderr)
+        for v in ld.violations():
+            print(f"  {v}", file=sys.stderr)
 
 
 #: Test modules that need the 8-device virtual mesh (single real chip

@@ -1,0 +1,563 @@
+"""Static concurrency analysis tests (analysis/concurrency.py): the repo
+must pass its own ratcheted gate, and each rule must catch its seeded
+pattern in synthetic modules — plus the false-positive guards (reentrant
+RLock self-cycles, lock released before dispatch, inline closures,
+threading.local). Mirrors tests/test_tpu_lint.py; see docs/concurrency.md.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+import tools.tpu_lint as TL
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CONC = TL.load_concurrency()
+
+
+def _write(root, relpath, source):
+    full = os.path.join(root, relpath)
+    os.makedirs(os.path.dirname(full), exist_ok=True)
+    with open(full, "w") as f:
+        f.write(textwrap.dedent(source))
+
+
+def _findings(root, rule=None):
+    model = CONC.analyze_tree(root)
+    if rule is None:
+        return model.findings
+    return [f for f in model.findings if f.rule == rule]
+
+
+@pytest.fixture
+def pkg(tmp_path):
+    return str(tmp_path / "pkg")
+
+
+class TestLockDiscovery:
+    def test_factories_and_raw_constructions_discovered(self, pkg):
+        _write(pkg, "mod.py", """
+            import threading
+            A = lockdep.lock("mod.A")
+            B = lockdep.rlock("mod.B", io_ok=True)
+            C = threading.Lock()
+
+            class Cat:
+                D = threading.RLock()
+
+                def __init__(self):
+                    self._lock = lockdep.lock("Cat._lock")
+            """)
+        m = CONC.analyze_tree(pkg)
+        assert set(m.locks) == {"mod.py::A", "mod.py::B", "mod.py::C",
+                                "mod.py::Cat.D", "mod.py::Cat._lock"}
+        assert m.locks["mod.py::B"].kind == "rlock"
+        assert m.locks["mod.py::B"].io_ok
+        assert not m.locks["mod.py::A"].io_ok
+        assert m.locks["mod.py::A"].declared == "mod.A"
+
+    def test_nested_with_records_order_edge(self, pkg):
+        _write(pkg, "mod.py", """
+            A = lockdep.lock("mod.A")
+            B = lockdep.lock("mod.B")
+
+            def f():
+                with A:
+                    with B:
+                        pass
+            """)
+        m = CONC.analyze_tree(pkg)
+        assert "mod.py::B" in m.edges["mod.py::A"]
+
+
+class TestCycleDetection:
+    def test_ab_versus_ba_cycle_flagged(self, pkg):
+        _write(pkg, "mod.py", """
+            A = lockdep.lock("mod.A")
+            B = lockdep.lock("mod.B")
+
+            def fa():
+                with A:
+                    with B:
+                        pass
+
+            def fb():
+                with B:
+                    with A:
+                        pass
+            """)
+        fs = _findings(pkg, "lock-cycle")
+        assert len(fs) == 1
+        assert "mod.py::A" in fs[0].message and "mod.py::B" in fs[0].message
+
+    def test_consistent_order_is_clean(self, pkg):
+        _write(pkg, "mod.py", """
+            A = lockdep.lock("mod.A")
+            B = lockdep.lock("mod.B")
+
+            def fa():
+                with A:
+                    with B:
+                        pass
+
+            def fb():
+                with A:
+                    with B:
+                        pass
+            """)
+        assert _findings(pkg, "lock-cycle") == []
+
+    def test_cycle_through_call_chain_flagged(self, pkg):
+        _write(pkg, "mod.py", """
+            A = lockdep.lock("mod.A")
+            B = lockdep.lock("mod.B")
+
+            def fa():
+                with A:
+                    take_b()
+
+            def take_b():
+                with B:
+                    pass
+
+            def fb():
+                with B:
+                    take_a()
+
+            def take_a():
+                with A:
+                    pass
+            """)
+        assert len(_findings(pkg, "lock-cycle")) == 1
+
+    def test_reentrant_rlock_self_cycle_suppressed(self, pkg):
+        # The false-positive guard the RLock exists for.
+        _write(pkg, "mod.py", """
+            R = lockdep.rlock("mod.R")
+
+            def f():
+                with R:
+                    g()
+
+            def g():
+                with R:
+                    pass
+            """)
+        assert _findings(pkg, "lock-cycle") == []
+
+    def test_plain_lock_self_nesting_flagged(self, pkg):
+        _write(pkg, "mod.py", """
+            L = lockdep.lock("mod.L")
+
+            def f():
+                with L:
+                    with L:
+                        pass
+            """)
+        assert len(_findings(pkg, "lock-cycle")) == 1
+
+
+class TestHoldAcrossBlocking:
+    def test_sleep_under_lock_flagged(self, pkg):
+        _write(pkg, "mod.py", """
+            import time
+            L = lockdep.lock("mod.L")
+
+            def f():
+                with L:
+                    time.sleep(1)
+            """)
+        fs = _findings(pkg, "hold-across-blocking")
+        assert len(fs) == 1 and "mod.py::L" in fs[0].message
+
+    def test_lock_released_before_blocking_is_clean(self, pkg):
+        # FP guard: the engine discipline — drop the lock, then block.
+        _write(pkg, "mod.py", """
+            import time
+            L = lockdep.lock("mod.L")
+
+            def f():
+                with L:
+                    pass
+                time.sleep(1)
+            """)
+        assert _findings(pkg, "hold-across-blocking") == []
+
+    def test_io_ok_lock_exempt(self, pkg):
+        _write(pkg, "mod.py", """
+            import time
+            L = lockdep.lock("mod.L", io_ok=True)
+
+            def f():
+                with L:
+                    time.sleep(1)
+            """)
+        assert _findings(pkg, "hold-across-blocking") == []
+
+    def test_transitive_blocking_through_call_flagged(self, pkg):
+        _write(pkg, "mod.py", """
+            import time
+            L = lockdep.lock("mod.L")
+
+            def f():
+                with L:
+                    helper()
+
+            def helper():
+                time.sleep(1)
+            """)
+        assert len(_findings(pkg, "hold-across-blocking")) == 1
+
+    def test_lockdep_blocking_region_counts(self, pkg):
+        _write(pkg, "mod.py", """
+            L = lockdep.lock("mod.L")
+
+            def f():
+                with L:
+                    with lockdep.blocking("device.dispatch"):
+                        pass
+            """)
+        fs = _findings(pkg, "hold-across-blocking")
+        assert len(fs) == 1 and "device.dispatch" in fs[0].message
+
+    def test_with_open_under_lock_flagged(self, pkg):
+        # `with lock: with open(p):` is the idiomatic file-I/O shape;
+        # the with-item context expression must be visited (review fix).
+        _write(pkg, "mod.py", """
+            L = lockdep.lock("mod.L")
+
+            def f(p):
+                with L:
+                    with open(p) as fh:
+                        return fh
+            """)
+        fs = _findings(pkg, "hold-across-blocking")
+        assert len(fs) == 1 and "file open" in fs[0].message
+
+    def test_call_in_with_context_reaches_callee(self, pkg):
+        # `with helper():` must record the call edge so transitive
+        # blocking through a context-manager factory is seen.
+        _write(pkg, "mod.py", """
+            import time
+            L = lockdep.lock("mod.L")
+
+            def f():
+                with L:
+                    with helper():
+                        pass
+
+            def helper():
+                time.sleep(1)
+            """)
+        assert len(_findings(pkg, "hold-across-blocking")) == 1
+
+    def test_str_and_path_join_under_lock_not_flagged(self, pkg):
+        # FP guard (review fix): only the zero-arg thread-join shape
+        # blocks; str.join / os.path.join always take arguments.
+        _write(pkg, "mod.py", """
+            import os
+            L = lockdep.lock("mod.L")
+
+            def f(names, d):
+                with L:
+                    msg = ", ".join(names)
+                    p = os.path.join(d, msg)
+                return p
+            """)
+        assert _findings(pkg, "hold-across-blocking") == []
+
+    def test_bare_thread_join_under_lock_flagged(self, pkg):
+        _write(pkg, "mod.py", """
+            L = lockdep.lock("mod.L")
+
+            def f(t):
+                with L:
+                    t.join()
+            """)
+        fs = _findings(pkg, "hold-across-blocking")
+        assert len(fs) == 1 and "thread join" in fs[0].message
+
+    def test_ignore_marker_suppresses(self, pkg):
+        _write(pkg, "mod.py", """
+            import time
+            L = lockdep.lock("mod.L")
+
+            def f():
+                with L:
+                    time.sleep(1)  # concurrency: ignore
+            """)
+        assert _findings(pkg, "hold-across-blocking") == []
+
+
+class TestWorkerReachability:
+    def test_submitted_function_writing_global_flagged(self, pkg):
+        _write(pkg, "mod.py", """
+            STATS = {"n": 0}
+
+            def work():
+                STATS["n"] += 1
+
+            def go(pool):
+                pool.submit(work)
+            """)
+        fs = _findings(pkg, "unguarded-shared-write")
+        assert len(fs) == 1 and "STATS" in fs[0].message
+
+    def test_guarded_global_write_is_clean(self, pkg):
+        _write(pkg, "mod.py", """
+            STATS = {"n": 0}
+            L = lockdep.lock("mod.L")
+
+            def work():
+                with L:
+                    STATS["n"] += 1
+
+            def go(pool):
+                pool.submit(work)
+            """)
+        assert _findings(pkg, "unguarded-shared-write") == []
+
+    def test_non_worker_global_write_is_clean(self, pkg):
+        _write(pkg, "mod.py", """
+            STATS = {"n": 0}
+
+            def main_thread_only():
+                STATS["n"] += 1
+            """)
+        assert _findings(pkg, "unguarded-shared-write") == []
+
+    def test_decode_callback_of_ordered_map_iter_flagged(self, pkg):
+        _write(pkg, "mod.py", """
+            STATS = {"rows": 0}
+
+            def decode(unit):
+                STATS["rows"] += 1
+                return unit
+
+            def scan(items, ctx):
+                return ordered_map_iter(decode, items, ctx)
+            """)
+        assert len(_findings(pkg, "unguarded-shared-write")) == 1
+
+    def test_escaping_generator_closure_write_flagged(self, pkg):
+        # The drained-counter bug class (shuffle/exchange.py, PR 9 fix):
+        # a generator closure handed to prefetch workers, mutating a
+        # captured dict with no lock.
+        _write(pkg, "mod.py", """
+            def outer(specs, ctx):
+                drained = {"n": 0}
+
+                def read_spec(s):
+                    drained["n"] += 1
+                    yield s
+                return [prefetch_iter(read_spec(s), ctx=ctx)
+                        for s in specs]
+            """)
+        fs = _findings(pkg, "unguarded-shared-write")
+        assert len(fs) == 1 and "drained" in fs[0].message
+
+    def test_inline_helper_closure_is_clean(self, pkg):
+        # FP guard: a nested function only ever called inline (no yield,
+        # never passed as a value) runs on its creator's thread.
+        _write(pkg, "mod.py", """
+            def work(items):
+                acc = {"n": 0}
+
+                def bump(x):
+                    acc["n"] += 1
+                    return x
+                return [bump(i) for i in items]
+
+            def go(pool, items):
+                pool.submit(work, items)
+            """)
+        assert _findings(pkg, "unguarded-shared-write") == []
+
+    def test_plain_global_rebind_flagged(self, pkg):
+        # `global X; X = v` is a module-state write too (review fix:
+        # _note_local used to re-add the name to locals and hide it).
+        _write(pkg, "mod.py", """
+            _CACHE = None
+            _COUNT = 0
+
+            def work(x):
+                global _CACHE, _COUNT
+                _CACHE = x
+                _COUNT += 1
+
+            def go(pool):
+                pool.submit(work, 1)
+            """)
+        fs = _findings(pkg, "unguarded-shared-write")
+        assert len(fs) == 2
+        assert any("_CACHE" in f.message for f in fs)
+        assert any("_COUNT" in f.message for f in fs)
+
+    def test_threading_local_attribute_writes_exempt(self, pkg):
+        _write(pkg, "mod.py", """
+            import threading
+            TLS = threading.local()
+
+            def work():
+                TLS.stack = []
+
+            def go(pool):
+                pool.submit(work)
+            """)
+        assert _findings(pkg, "unguarded-shared-write") == []
+
+    def test_unlocked_self_write_of_lock_owning_class_flagged(self, pkg):
+        _write(pkg, "mod.py", """
+            class Catalog:
+                def __init__(self):
+                    self._lock = lockdep.lock("Catalog._lock")
+                    self.n = 0
+
+                def good(self):
+                    with self._lock:
+                        self.n += 1
+
+                def bad(self):
+                    self.n += 1
+
+            def go(pool, c):
+                pool.submit(c.bad)
+                pool.submit(c.good)
+            """)
+        fs = _findings(pkg, "unguarded-shared-write")
+        assert len(fs) == 1 and ".<locals>" not in fs[0].message
+        assert "bad" in fs[0].message
+
+    def test_helper_always_called_under_lock_is_clean(self, pkg):
+        # FP guard (always_held fixpoint): a private helper only ever
+        # invoked from under the class lock inherits the guard.
+        _write(pkg, "mod.py", """
+            class Catalog:
+                def __init__(self):
+                    self._lock = lockdep.lock("Catalog._lock")
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._bump_locked()
+
+                def _bump_locked(self):
+                    self.n += 1
+
+            def go(pool, c):
+                pool.submit(c.bump)
+            """)
+        assert _findings(pkg, "unguarded-shared-write") == []
+
+
+class TestRepoGate:
+    def test_repo_passes_concurrency_gate(self):
+        assert TL.main(["--concurrency"]) == 0
+
+    def test_module_invocation(self):
+        # The exact CI incantation.
+        r = subprocess.run(
+            [sys.executable, "-m", "tools.tpu_lint", "--concurrency"],
+            cwd=REPO, capture_output=True, text=True)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+    def test_baseline_counts_match_reality_exactly(self):
+        # A stale (too-loose) baseline would let new debt in silently.
+        model = CONC.analyze_tree(os.path.join(REPO, "spark_rapids_tpu"))
+        baseline = CONC.load_baseline(
+            os.path.join(REPO, "tools", "lock_order_baseline.json"))
+        assert CONC.counts_of(model.findings) == baseline
+
+    def test_engine_lock_graph_is_acyclic(self):
+        model = CONC.analyze_tree(os.path.join(REPO, "spark_rapids_tpu"))
+        assert [f for f in model.findings if f.rule == "lock-cycle"] == []
+
+    def test_known_engine_locks_discovered(self):
+        model = CONC.analyze_tree(os.path.join(REPO, "spark_rapids_tpu"))
+        for lid in ("memory/spill.py::SpillFile._lock",
+                    "memory/spill.py::BufferCatalog._lock",
+                    "exec/pipeline.py::PipelinePool._lock",
+                    "shuffle/exchange.py::ShuffleBufferCatalog._lock",
+                    "memory/retry.py::_OOM_RECOVERY_LOCK",
+                    "utils/deadline.py::Deadline._lock"):
+            assert lid in model.locks, lid
+
+    def test_real_nesting_edges_observed(self):
+        # The OOM recovery ladder really nests recovery -> catalog; the
+        # unit scheduler really submits under its own lock.
+        model = CONC.analyze_tree(os.path.join(REPO, "spark_rapids_tpu"))
+        assert "memory/spill.py::BufferCatalog._lock" \
+            in model.edges["memory/retry.py::_OOM_RECOVERY_LOCK"]
+        assert "exec/pipeline.py::PipelinePool._lock" \
+            in model.edges["exec/pipeline.py::_UnitScheduler._lock"]
+
+    def test_inventory_markdown_lists_locks_and_edges(self):
+        model = CONC.analyze_tree(os.path.join(REPO, "spark_rapids_tpu"))
+        md = CONC.inventory_markdown(model)
+        assert "SpillFile._lock" in md
+        assert "io_ok" in md or "yes" in md
+        assert "→" in md
+
+
+class TestRatchet:
+    def _seed(self, pkg, n):
+        body = "\n".join(
+            f"def f{i}():\n    with L:\n        time.sleep(1)\n"
+            for i in range(n))
+        _write(pkg, "mod.py",
+               "import time\nL = lockdep.lock(\"mod.L\")\n\n" + body)
+
+    def test_baselined_debt_passes(self, pkg):
+        self._seed(pkg, 2)
+        fs = _findings(pkg)
+        baseline = CONC.counts_of(fs)
+        new, improved = CONC.compare_to_baseline(fs, baseline)
+        assert new == [] and improved == []
+
+    def test_new_debt_fails(self, pkg):
+        self._seed(pkg, 2)
+        baseline = CONC.counts_of(_findings(pkg))
+        self._seed(pkg, 3)
+        new, _ = CONC.compare_to_baseline(_findings(pkg), baseline)
+        assert len(new) == 1 and new[0].rule == "hold-across-blocking"
+
+    def test_paying_down_debt_reports_improvement(self, pkg):
+        self._seed(pkg, 3)
+        baseline = CONC.counts_of(_findings(pkg))
+        self._seed(pkg, 1)
+        new, improved = CONC.compare_to_baseline(_findings(pkg), baseline)
+        assert new == []
+        assert improved == ["mod.py::hold-across-blocking"]
+
+    def test_update_baseline_roundtrip(self, pkg, tmp_path):
+        self._seed(pkg, 2)
+        fs = _findings(pkg)
+        path = str(tmp_path / "baseline.json")
+        CONC.write_baseline(path, fs)
+        assert CONC.load_baseline(path) == CONC.counts_of(fs)
+
+    def test_run_gate_update_and_check(self, pkg, tmp_path):
+        self._seed(pkg, 2)
+        path = str(tmp_path / "baseline.json")
+        assert CONC.run(pkg, path, update=True) == 0
+        assert CONC.run(pkg, path) == 0
+        self._seed(pkg, 3)
+        assert CONC.run(pkg, path) == 1
+
+    def test_cli_custom_root_analyzes_that_tree(self, pkg, tmp_path):
+        # --root selects the tree to ANALYZE; the analyzer itself always
+        # loads from this repo (review fix: a custom --root used to make
+        # load_concurrency look for analysis/concurrency.py under it).
+        self._seed(pkg, 1)
+        baseline = str(tmp_path / "baseline.json")
+        assert TL.main(["--concurrency", "--root", pkg,
+                        "--concurrency-baseline", baseline,
+                        "--update-baseline"]) == 0
+        assert TL.main(["--concurrency", "--root", pkg,
+                        "--concurrency-baseline", baseline]) == 0
+        self._seed(pkg, 2)
+        assert TL.main(["--concurrency", "--root", pkg,
+                        "--concurrency-baseline", baseline]) == 1

@@ -12,3 +12,22 @@ def test_configs_md_is_current():
         "docs/configs.md is stale; regenerate with " \
         "python -c \"from spark_rapids_tpu.config import TpuConf; " \
         "open('docs/configs.md','w').write(TpuConf.help_markdown())\""
+
+
+def test_concurrency_md_lock_inventory_is_current():
+    """docs/concurrency.md's generated section tracks the engine's real
+    lock inventory + statically observed acquisition order (the
+    analysis/concurrency.py model) — regeneration recipe is in the doc."""
+    from tools.tpu_lint import load_concurrency
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    conc = load_concurrency()
+    model = conc.analyze_tree(os.path.join(repo, "spark_rapids_tpu"))
+    generated = conc.inventory_markdown(model)
+    text = open(os.path.join(repo, "docs", "concurrency.md")).read()
+    begin = "<!-- BEGIN GENERATED: lock inventory -->\n"
+    end = "<!-- END GENERATED: lock inventory -->"
+    assert begin in text and end in text
+    block = text.split(begin, 1)[1].split(end, 1)[0]
+    assert block == generated, \
+        "docs/concurrency.md lock inventory is stale; regenerate with " \
+        "the snippet in that doc's 'Lock inventory' section"

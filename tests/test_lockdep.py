@@ -1,0 +1,565 @@
+"""Runtime lockdep (utils/lockdep.py) + the concurrency fixes it guards.
+
+The conftest exports TPU_LOCKDEP=1 before the engine imports, so every
+engine lock in this process is instrumented and the whole suite doubles
+as a schedule corpus (the sessionfinish gate fails on any recorded
+violation). Tests here that provoke violations ON PURPOSE drain them.
+
+The fix-regression classes reproduce their schedules through the lockdep
+hooks: ``set_acquire_hook`` injects context switches at lock
+acquisitions, and ``sys.setswitchinterval`` forces bytecode-level
+preemption — the interleavings that made the original bugs bite.
+See docs/concurrency.md.
+"""
+
+import contextlib
+import itertools
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.utils import lockdep
+
+#: snapshot BEFORE any fixture runs: the corpus contract is about what
+#: conftest armed for the whole suite, not what this module's autouse
+#: fixture flips for its own lock constructions.
+_ENABLED_AT_IMPORT = lockdep.enabled()
+
+_uniq = itertools.count()
+
+
+def _name(tag: str) -> str:
+    """Process-unique lock name: the order graph is global, so reused
+    names across tests would alias edges."""
+    return f"t_{tag}_{next(_uniq)}"
+
+
+def _is_test_violation(v):
+    """Provoked-by-this-file violations: every lock this module creates
+    is named t_*, and its blocking kinds are test.* — draining ONLY
+    those keeps a real engine violation recorded earlier in the session
+    alive for the conftest gate."""
+    return any(n.startswith(("t_", "test.")) for n in v.locks)
+
+
+@contextlib.contextmanager
+def expecting_violations():
+    """Scope for tests that provoke violations on purpose: yields a list
+    that receives the drained violations afterward (selective — see
+    _is_test_violation — so the conftest sessionfinish gate stays
+    meaningful for every other test)."""
+    out = []
+    try:
+        yield out
+    finally:
+        out.extend(lockdep.drain_violations(_is_test_violation))
+
+
+@contextlib.contextmanager
+def forced_preemption(interval: float = 1e-6):
+    prev = sys.getswitchinterval()
+    sys.setswitchinterval(interval)
+    try:
+        yield
+    finally:
+        sys.setswitchinterval(prev)
+
+
+@contextlib.contextmanager
+def acquire_hook(fn):
+    lockdep.set_acquire_hook(fn)
+    try:
+        yield
+    finally:
+        lockdep.set_acquire_hook(None)
+
+
+def _run_threads(n, fn):
+    threads = [threading.Thread(target=fn, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+#: conftest only setdefaults TPU_LOCKDEP — an explicit 0 export is a
+#: deliberate local opt-out; the rest of this module re-enables the gate
+#: for its own lock constructions so it still tests the machinery.
+_ENV_OPTED_OUT = (os.environ.get("TPU_LOCKDEP", "").strip().lower()
+                  in ("0", "false", "no", "off"))
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _instrumented_for_this_module():
+    prev = lockdep.enabled()
+    lockdep.enable(True)
+    yield
+    lockdep.enable(prev)
+
+
+class TestFactories:
+    def test_suite_runs_instrumented(self):
+        # The conftest contract: tier-1 IS the lockdep schedule corpus.
+        # Checked against the state AT MODULE IMPORT — the autouse
+        # fixture has already forced enable(True) by the time this body
+        # runs, so asserting lockdep.enabled() here would be vacuous.
+        if _ENV_OPTED_OUT:
+            pytest.skip("TPU_LOCKDEP explicitly disabled in the "
+                        "environment — schedule-corpus coverage is off "
+                        "for this local run (conftest honors the "
+                        "opt-out)")
+        assert _ENABLED_AT_IMPORT
+
+    def test_disabled_factories_return_raw_primitives(self):
+        lockdep.enable(False)
+        try:
+            raw = lockdep.lock(_name("raw"))
+            assert isinstance(raw, type(threading.Lock()))
+            assert isinstance(lockdep.rlock(_name("rawr")),
+                              type(threading.RLock()))
+            assert isinstance(lockdep.condition(_name("rawc")),
+                              threading.Condition)
+        finally:
+            lockdep.enable(True)
+
+    def test_enabled_locks_are_named_and_registered(self):
+        n = _name("reg")
+        lk = lockdep.lock(n)
+        assert lk.name == n
+        assert lockdep.known_locks()[n] == "lock"
+        with lk:
+            assert n in lockdep.held_names()
+        assert n not in lockdep.held_names()
+
+    def test_session_conf_flips_the_gate(self):
+        from spark_rapids_tpu.config import LOCKDEP_ENABLED
+        from spark_rapids_tpu.session import TpuSession
+        lockdep.enable(False)
+        try:
+            s = TpuSession({LOCKDEP_ENABLED.key: True})
+            assert lockdep.enabled()
+            s.close()
+        finally:
+            lockdep.enable(True)
+
+
+class TestOrderGraph:
+    def test_nested_acquisition_records_edge(self):
+        a, b = _name("edge_a"), _name("edge_b")
+        la, lb = lockdep.lock(a), lockdep.lock(b)
+        with la:
+            with lb:
+                pass
+        assert b in lockdep.edges()[a]
+        assert not lockdep.violations()
+
+    def test_ab_ba_inversion_detected(self):
+        a, b = _name("inv_a"), _name("inv_b")
+        la, lb = lockdep.lock(a), lockdep.lock(b)
+        with expecting_violations() as vs:
+            with la:
+                with lb:
+                    pass
+            with lb:
+                with la:
+                    pass
+        kinds = [v.kind for v in vs]
+        assert kinds == ["lock-order-inversion"]
+        assert a in vs[0].locks and b in vs[0].locks
+
+    def test_three_lock_cycle_detected_via_path(self):
+        a, b, c = _name("cyc_a"), _name("cyc_b"), _name("cyc_c")
+        la, lb, lc = lockdep.lock(a), lockdep.lock(b), lockdep.lock(c)
+        with expecting_violations() as vs:
+            with la, lb:
+                pass
+            with lb, lc:
+                pass
+            with lc, la:       # completes a -> b -> c -> a
+                pass
+        assert [v.kind for v in vs] == ["lock-order-inversion"]
+        assert set(vs[0].locks) >= {a, b, c}
+
+    def test_rlock_reentry_is_not_a_violation(self):
+        r = lockdep.rlock(_name("re"))
+        with r:
+            with r:
+                pass
+        assert not lockdep.violations()
+
+    def test_same_name_two_instances_flagged(self):
+        # Two instances of one lock class cannot be ordered by the name
+        # graph — the runtime analog of the static same-name cycle.
+        n = _name("twins")
+        l1 = lockdep._DepLock(n)
+        l2 = lockdep._DepLock(n)
+        with expecting_violations() as vs:
+            with l1:
+                with l2:
+                    pass
+        assert [v.kind for v in vs] == ["lock-order-inversion"]
+
+    def test_trylock_does_not_poison_the_graph(self):
+        a, b = _name("try_a"), _name("try_b")
+        la, lb = lockdep.lock(a), lockdep.lock(b)
+        with la:
+            assert lb.acquire(False)
+            lb.release()
+        with lb:
+            assert la.acquire(False)
+            la.release()
+        assert not lockdep.violations()
+
+    def test_condition_reentry_matches_raw_semantics(self):
+        # A bare threading.Condition() is RLock-backed, so condition
+        # re-entry is legal; the instrumented variant must not raise a
+        # false self-deadlock on it (review fix: condition() wraps
+        # _DepRLock, not _DepLock).
+        cv = lockdep.condition(_name("cv_re"))
+        with cv:
+            with cv:
+                pass
+        assert not lockdep.violations()
+
+    def test_condition_wait_releases_the_held_stack(self):
+        cv = lockdep.condition(_name("cv"))
+        entered = threading.Event()
+        release = threading.Event()
+        seen = {}
+
+        def waiter():
+            with cv:
+                entered.set()
+                cv.wait_for(release.is_set, timeout=5.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        entered.wait(5.0)
+        # While the waiter sleeps in wait(), this thread can take the
+        # condition lock — proof the instrumented lock really released.
+        with cv:
+            seen["acquired"] = True
+            release.set()
+            cv.notify_all()
+        t.join(5.0)
+        assert seen["acquired"] and not t.is_alive()
+        assert not lockdep.violations()
+
+
+class TestSelfDeadlock:
+    def test_blocking_reacquire_raises_instead_of_hanging(self):
+        lk = lockdep.lock(_name("self"))
+        with expecting_violations() as vs:
+            with lk:
+                with pytest.raises(RuntimeError, match="self-deadlock"):
+                    lk.acquire()
+        assert [v.kind for v in vs] == ["self-deadlock"]
+
+    def test_nonblocking_probe_of_own_lock_is_legitimate(self):
+        # threading.Condition._is_owned probes with acquire(False); that
+        # must answer False quietly, never flag.
+        lk = lockdep.lock(_name("probe"))
+        with lk:
+            assert lk.acquire(False) is False
+        assert not lockdep.violations()
+
+
+class TestBlockingRegions:
+    def test_hold_across_blocking_recorded(self):
+        n = _name("hold")
+        lk = lockdep.lock(n)
+        with expecting_violations() as vs:
+            with lk:
+                with lockdep.blocking("test.dispatch"):
+                    pass
+        assert [v.kind for v in vs] == ["hold-across-blocking"]
+        assert n in vs[0].locks and "test.dispatch" in vs[0].locks
+
+    def test_io_ok_lock_is_exempt(self):
+        lk = lockdep.lock(_name("io"), io_ok=True)
+        with lk:
+            with lockdep.blocking("test.io"):
+                pass
+        assert not lockdep.violations()
+
+    def test_lock_released_before_blocking_is_clean(self):
+        # The false-positive guard: the discipline the engine follows —
+        # drop the lock, THEN dispatch.
+        lk = lockdep.lock(_name("drop"))
+        with lk:
+            pass
+        with lockdep.blocking("test.dispatch"):
+            pass
+        assert not lockdep.violations()
+
+
+class TestDeadlineHammer:
+    """Satellite fix: Deadline's per-site interval attribution is updated
+    from pipeline workers; its dict now lives behind a lockdep lock. The
+    invariant a data race would break: every elapsed interval is
+    attributed to EXACTLY ONE site, so the attributed total can never
+    exceed wall time (the unlocked version double-counted intervals when
+    two workers read the same ``_last``)."""
+
+    def test_concurrent_checks_attribute_each_interval_once(self):
+        from spark_rapids_tpu.utils.deadline import Deadline
+        dl = Deadline(3600.0)
+        t0 = time.monotonic()
+        n_threads, n_iter = 8, 400
+
+        def hammer(i):
+            for k in range(n_iter):
+                dl.check(f"site{i}")
+
+        with forced_preemption():
+            with acquire_hook(lambda name: time.sleep(0)
+                              if name == "Deadline._lock" else None):
+                _run_threads(n_threads, hammer)
+        wall = time.monotonic() - t0
+        times = dl.site_times()
+        assert len(times) == n_threads
+        total = sum(times.values())
+        # One-sided: attribution only counts time BETWEEN checks, so the
+        # total is <= wall; double counting would push it past wall.
+        assert total <= wall * 1.05 + 1e-3
+        assert not lockdep.violations()
+
+    def test_expiry_still_names_slowest_site_under_concurrency(self):
+        from spark_rapids_tpu.utils.deadline import (Deadline,
+                                                     QueryDeadlineExceeded)
+        dl = Deadline(0.05)
+        dl.check("warm")
+        time.sleep(0.08)
+        errors = []
+
+        def check(i):
+            try:
+                dl.check(f"late{i}")
+            except QueryDeadlineExceeded as e:
+                errors.append(e)
+
+        _run_threads(4, check)
+        assert len(errors) == 4
+        assert all(e.slowest_site for e in errors)
+
+
+class TestShuffleIdAllocation:
+    """Regression for the duplicate-shuffle-id race: exchanges in sibling
+    fusion boundaries run concurrently on pipeline workers, and the old
+    unsynchronized ``_next_shuffle_id[0] += 1; return _next_shuffle_id[0]``
+    could return one id to two exchanges (another thread's increment can
+    land between the ``+=`` and the read) — two exchanges' blocks then
+    silently mix in the ShuffleBufferCatalog under one shuffle id."""
+
+    def test_old_pattern_window_demonstrated(self):
+        # Deterministic schedule reproduction: hold both threads in the
+        # window between the increment and the read — both observe the
+        # SECOND increment and return the same id.
+        counter = [0]
+        barrier = threading.Barrier(2)
+        got = []
+
+        def old_new_id(i):
+            counter[0] += 1
+            barrier.wait(timeout=5.0)     # the unsynchronized window
+            got.append(counter[0])
+
+        _run_threads(2, old_new_id)
+        assert got == [2, 2], "both allocations observed the same id"
+
+    def test_new_allocator_is_unique_under_forced_schedules(self):
+        from spark_rapids_tpu.shuffle import exchange as EX
+        ids = []
+        lk = threading.Lock()
+        n_threads, n_iter = 8, 300
+
+        def alloc(i):
+            mine = [EX._new_shuffle_id() for _ in range(n_iter)]
+            with lk:
+                ids.extend(mine)
+
+        with forced_preemption():
+            # Hook a sleep(0) yield onto the id-lock acquisition: every
+            # allocation offers the scheduler the exact preemption point
+            # the old code lost the race on.
+            with acquire_hook(lambda name: time.sleep(0)
+                              if name == "exchange._SHUFFLE_ID_LOCK"
+                              else None):
+                _run_threads(n_threads, alloc)
+        assert len(ids) == n_threads * n_iter
+        assert len(set(ids)) == len(ids), "duplicate shuffle ids handed out"
+        assert not lockdep.violations()
+
+
+class TestDrainLatch:
+    """Regression for the lost-update drain counter: the read side's
+    drain bookkeeping runs on prefetch WORKERS, and the old unlocked
+    ``drained["n"] += 1`` could lose updates — the count then never
+    reached len(specs) and the shuffle's blocks stayed pinned until
+    query-end cleanup."""
+
+    def test_old_pattern_loses_updates_demonstrated(self):
+        drained = {"n": 0}
+        barrier = threading.Barrier(2)
+
+        def old_arrive(i):
+            n = drained["n"]                 # read
+            barrier.wait(timeout=5.0)        # both read the same value
+            drained["n"] = n + 1             # write: one update lost
+
+        _run_threads(2, old_arrive)
+        assert drained["n"] == 1, "one of two arrivals was lost"
+
+    def test_latch_fires_exactly_once_at_exact_count(self):
+        from spark_rapids_tpu.shuffle.exchange import _DrainLatch
+        n = 64
+        fired = []
+        latch = _DrainLatch(n, lambda: fired.append(True))
+
+        def arrive(i):
+            latch.arrive()
+
+        with forced_preemption():
+            with acquire_hook(lambda name: time.sleep(0)
+                              if name == "exchange._DrainLatch._lock"
+                              else None):
+                _run_threads(n, arrive)
+        assert fired == [True]
+        assert latch._count == n
+        assert not lockdep.violations()
+
+    def test_latch_does_not_fire_early(self):
+        from spark_rapids_tpu.shuffle.exchange import _DrainLatch
+        fired = []
+        latch = _DrainLatch(3, lambda: fired.append(True))
+        latch.arrive()
+        latch.arrive()
+        assert fired == []
+        latch.arrive()
+        assert fired == [True]
+
+    def test_shuffle_query_still_completes_and_is_clean(self):
+        # End-to-end: a pipelined multi-partition shuffle query (drain
+        # latch on prefetch workers) completes, matches the CPU oracle,
+        # and records no lockdep violations.
+        import sys
+        sys.path.insert(0, __file__.rsplit("/", 1)[0])
+        from harness import assert_tpu_and_cpu_are_equal
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+        import pyarrow as pa
+        t = pa.table({"k": list(range(50)) * 4,
+                      "v": list(range(200))})
+        assert_tpu_and_cpu_are_equal(
+            lambda s: s.create_dataframe(t).group_by(col("k")).agg(
+                AGG.AggregateExpression(AGG.Sum(col("v")), "sum_v")),
+            conf={"spark.sql.shuffle.partitions": 4})
+        assert not lockdep.violations()
+
+
+class TestOrcDecodeStats:
+    """Regression for the decode-stats race: ORC stripes decode on
+    pipeline workers (ordered_map_iter), and the patched-base counter was
+    a bare module-dict ``+=``. It now holds orc_device._STATS_LOCK; the
+    static pass keeps it honest (an unlocked reintroduction reappears as
+    an unguarded-shared-write finding and fails the ratchet)."""
+
+    def test_concurrent_bumps_are_exact(self):
+        from spark_rapids_tpu.io import orc_device as OD
+        before = OD.decode_stats["patched_base_runs"]
+        n_threads, n_iter = 8, 200
+
+        def bump(i):
+            for _ in range(n_iter):
+                with OD._STATS_LOCK:
+                    OD.decode_stats["patched_base_runs"] += 1
+
+        with forced_preemption():
+            _run_threads(n_threads, bump)
+        got = OD.decode_stats["patched_base_runs"] - before
+        assert got == n_threads * n_iter
+        with OD._STATS_LOCK:
+            OD.decode_stats["patched_base_runs"] = before
+
+    def test_static_pass_confirms_the_site_is_guarded(self):
+        import os
+        from tools.tpu_lint import load_concurrency
+        conc = load_concurrency()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        model = conc.analyze_tree(os.path.join(repo, "spark_rapids_tpu"))
+        assert not [f for f in model.findings
+                    if f.path == "io/orc_device.py"
+                    and f.rule == "unguarded-shared-write"]
+
+
+class TestReviewHardening:
+    def test_blocking_violation_names_the_engine_site(self):
+        # _call_site must skip contextlib/threading wrapper frames: the
+        # report names THIS file, not contextlib.py (review fix).
+        lk = lockdep.lock(_name("site"))
+        with expecting_violations() as vs:
+            with lk:
+                with lockdep.blocking("test.site"):
+                    pass
+        assert len(vs) == 1
+        assert "test_lockdep.py" in vs[0].message
+        assert "contextlib" not in vs[0].message
+
+    def test_condition_inversion_names_the_engine_site(self):
+        a, cvn = _name("cv_site_a"), _name("cv_site")
+        la = lockdep.lock(a)
+        cv = lockdep.condition(cvn)
+        with expecting_violations() as vs:
+            with la:
+                with cv:
+                    pass
+            with cv:
+                with la:
+                    pass
+        assert len(vs) == 1
+        assert "test_lockdep.py" in vs[0].message
+        assert "threading.py" not in vs[0].message
+
+    def test_selective_drain_preserves_other_violations(self):
+        # A provoke-test's drain must not scrub violations from OTHER
+        # locks (the conftest gate would go green over a real hazard).
+        engine_ish = lockdep._DepLock("fake_engine_lock_draincheck")
+        with engine_ish:
+            with lockdep.blocking("fusion.dispatch"):
+                pass
+        with expecting_violations() as vs:
+            lk = lockdep.lock(_name("mine"))
+            with lk:
+                with lockdep.blocking("test.mine"):
+                    pass
+        assert len(vs) == 1  # only the t_* violation drained
+        remaining = lockdep.violations()
+        assert any("fake_engine_lock_draincheck" in v.locks
+                   for v in remaining)
+        # scrub the synthetic "engine" violation explicitly
+        lockdep.drain_violations(
+            lambda v: "fake_engine_lock_draincheck" in v.locks)
+        assert not lockdep.violations()
+
+
+class TestReporting:
+    def test_report_shape(self):
+        r = lockdep.report()
+        assert r["enabled"] is True
+        assert isinstance(r["locks"], dict)
+        assert isinstance(r["edges"], dict)
+        assert isinstance(r["violations"], list)
+
+    def test_assert_clean_raises_with_details(self):
+        lk = lockdep.lock(_name("dirty"))
+        with expecting_violations():
+            with lk:
+                with lockdep.blocking("test.assert_clean"):
+                    pass
+            with pytest.raises(AssertionError, match="hold-across"):
+                lockdep.assert_clean()
+        assert not [v for v in lockdep.violations()
+                    if _is_test_violation(v)]  # drained -> clean again

@@ -301,6 +301,53 @@ class TestSeededAntiPatterns:
         assert [v for v in TL.lint_tree(fake_pkg)
                 if v.rule == "raw-thread"] == []
 
+    def test_raw_lock_constructions_flagged_engine_wide(self, fake_pkg):
+        # Unlike raw-thread, raw-lock has no scope carve-out: every raw
+        # lock anywhere in the engine is invisible to the concurrency
+        # layer (utils/lockdep.py, docs/concurrency.md).
+        _write(fake_pkg, "compile/locky.py", """
+            import threading
+            from threading import Condition, Lock, RLock
+
+            A = threading.Lock()
+            B = threading.RLock()
+            C = threading.Condition()
+            D = Lock()
+            E = RLock()
+            F = Condition()
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg) if v.rule == "raw-lock"]
+        assert len(vs) == 6
+
+    def test_lockdep_factories_not_flagged(self, fake_pkg):
+        _write(fake_pkg, "memory/routed.py", """
+            from ..utils import lockdep
+
+            A = lockdep.lock("routed.A")
+            B = lockdep.rlock("routed.B", io_ok=True)
+            C = lockdep.condition("routed.C")
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "raw-lock"] == []
+
+    def test_raw_lock_suppressible_inline(self, fake_pkg):
+        _write(fake_pkg, "utils/lockdeppish.py", """
+            import threading
+
+            _GUARD = threading.Lock()  # tpu-lint: ignore
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "raw-lock"] == []
+
+    def test_repo_raw_lock_debt_is_only_lockdep_itself(self):
+        # The engine-wide conversion is complete: the ONLY raw lock
+        # constructions left are lockdep.py's own (the factories must
+        # build the primitives they wrap) — baselined, per ISSUE 9.
+        vs = [v for v in TL.lint_tree(os.path.join(REPO,
+                                                   "spark_rapids_tpu"))
+              if v.rule == "raw-lock"]
+        assert vs and {v.path for v in vs} == {"utils/lockdep.py"}
+
     def test_pallas_call_without_oracle_flagged(self, fake_pkg):
         _write(fake_pkg, "ops/kernels/pallas/orphan.py", """
             from jax.experimental import pallas as pl

@@ -40,6 +40,14 @@ corrupt TPU performance or correctness silently:
   concurrency. Route through ``exec.pipeline.get_pool().submit`` or
   ``utils.prefetch.prefetch_iter`` instead; the pool's own spawn site
   carries the ignore marker.
+* ``raw-lock`` (engine-wide): a direct ``threading.Lock()`` /
+  ``RLock()`` / ``Condition()`` construction — raw locks are invisible
+  to the concurrency layer (no name, no order tracking, no
+  hold-across-blocking detection, absent from the docs/concurrency.md
+  inventory). Route through ``utils/lockdep.py``'s ``lock()`` /
+  ``rlock()`` / ``condition()`` factories, which return the raw
+  primitive when ``TPU_LOCKDEP`` is off; lockdep.py's own construction
+  sites are the baselined exception.
 * ``pallas-no-oracle`` (kernel modules, ``ops/kernels/``): a
   ``pallas_call`` site whose enclosing function's docstring does not
   name its jnp oracle twin (the word "oracle"). Every hand-written
@@ -56,11 +64,17 @@ baseline prints a reminder to tighten with ``--update-baseline``.
 Suppress a finding by putting ``# tpu-lint: ignore`` on the offending
 line (counts as a whitelisted sync point for ``host-sync``).
 
+The static concurrency pass (``analysis/concurrency.py`` — lock-order
+cycles, hold-across-blocking, unguarded shared writes) runs under the
+same ratchet discipline against ``tools/lock_order_baseline.json`` via
+``--concurrency``; see docs/concurrency.md.
+
 CLI::
 
     python -m tools.tpu_lint            # check against the baseline
     python -m tools.tpu_lint --list     # print every finding
     python -m tools.tpu_lint --update-baseline
+    python -m tools.tpu_lint --concurrency [--list | --update-baseline]
 """
 
 from __future__ import annotations
@@ -231,6 +245,7 @@ class _FileLinter(ast.NodeVisitor):
             self._check_nondet(node, func, root)
         if self.in_raw_thread:
             self._check_raw_thread(node, func, root)
+        self._check_raw_lock(node, func, root)
         if self._funcs and (
                 (root == "jax" and isinstance(func, ast.Attribute)
                  and func.attr == "jit")
@@ -299,6 +314,28 @@ class _FileLinter(ast.NodeVisitor):
                        "concurrency, session-close leak check); route "
                        "through exec.pipeline.get_pool().submit or "
                        "utils.prefetch.prefetch_iter")
+
+    def _check_raw_lock(self, node: ast.Call, func, root):
+        """raw-lock (engine-wide): threading.Lock/RLock/Condition must
+        route through the utils/lockdep.py factories so every engine lock
+        is named, order-tracked, and listed in the docs/concurrency.md
+        inventory; lockdep.py's own sites are baselined."""
+        names = ("Lock", "RLock", "Condition")
+        is_raw = (isinstance(func, ast.Attribute) and func.attr in names
+                  and root == "threading") \
+            or (isinstance(func, ast.Name) and func.id in names)
+        if is_raw:
+            kind = func.attr if isinstance(func, ast.Attribute) \
+                else func.id
+            factory = {"Lock": "lock", "RLock": "rlock",
+                       "Condition": "condition"}[kind]
+            self._flag(node, "raw-lock",
+                       f"threading.{kind}() constructed outside "
+                       "utils/lockdep.py is invisible to the concurrency "
+                       "layer (no lock-order tracking, no "
+                       "hold-across-blocking detection, missing from the "
+                       "docs/concurrency.md inventory); use "
+                       f"lockdep.{factory}(\"<module>.<name>\")")
 
     def _check_nondet(self, node: ast.Call, func, root):
         if not isinstance(func, ast.Attribute):
@@ -449,6 +486,24 @@ def write_baseline(path: str, violations: List[Violation]):
         f.write("\n")
 
 
+def load_concurrency():
+    """Load THIS repo's analysis/concurrency.py by FILE PATH (it is
+    standalone by design): importing it as a package submodule would pull
+    in spark_rapids_tpu/__init__ and therefore jax, which the lint CLI
+    must not need. Always resolved relative to tpu_lint itself — the
+    --root flag selects the tree to ANALYZE, never where the analyzer
+    lives."""
+    import importlib.util
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo_root, "spark_rapids_tpu", "analysis",
+                        "concurrency.py")
+    spec = importlib.util.spec_from_file_location("_tpu_concurrency", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["_tpu_concurrency"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     ap = argparse.ArgumentParser(
@@ -464,7 +519,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="rewrite the baseline from the current findings")
     ap.add_argument("--list", action="store_true",
                     help="print every finding, baselined or not")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the static concurrency pass "
+                         "(analysis/concurrency.py) against its own "
+                         "ratchet, tools/lock_order_baseline.json")
+    ap.add_argument("--concurrency-baseline",
+                    default=os.path.join(repo_root, "tools",
+                                         "lock_order_baseline.json"))
     args = ap.parse_args(argv)
+
+    if args.concurrency:
+        conc = load_concurrency()
+        return conc.run(args.root, args.concurrency_baseline,
+                        update=args.update_baseline, list_all=args.list)
 
     violations = lint_tree(args.root)
     if args.update_baseline:
