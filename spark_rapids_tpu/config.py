@@ -274,6 +274,25 @@ DEVICE_SPILL_BUDGET = conf_int(
     "Explicit device-store byte budget for spillable buffers; 0 derives it "
     "from allocFraction of detected HBM (test hook for forcing spills).")
 
+SPILL_IO_THREADS = conf_int(
+    "spark.rapids.tpu.spill.ioThreads", 2,
+    "Concurrency of the dedicated spill-IO lane on the shared pipeline "
+    "pool: device<->host copies, spill-file appends/reads, and disk-tier "
+    "shuffle-block I/O run OFF the catalog lock with up to this many "
+    "units in flight, so concurrent spills overlap and no thread ever "
+    "waits on a catalog lock held across I/O. 0 runs spill I/O inline on "
+    "the requesting thread (still off-lock, just without overlap). See "
+    "docs/fault-tolerance.md#async-spill and docs/tuning-guide.md.")
+
+TENANT_ID = conf_str(
+    "spark.rapids.tpu.tenantId", "",
+    "Session/tenant identity for memory QoS: spill victim selection "
+    "prefers the requesting query's own buffers, then same-tenant "
+    "buffers, then other tenants ordered by query-deadline slack — so "
+    "one tenant's OOM-retry ladder stops evicting a neighbor's hot "
+    "build tables (docs/fault-tolerance.md#async-spill). Empty = the "
+    "default shared tenant.")
+
 AUTO_BROADCAST_JOIN_ROWS = conf_int(
     "spark.rapids.sql.autoBroadcastJoinRows", 100_000,
     "Equi joins whose build side is estimated at or below this many rows "

@@ -118,7 +118,8 @@ class TpuCoalesceBatchesExec(TpuExec):
                     continue
                 if catalog is not None and not ctx.in_fusion:
                     pending.append(catalog.register_batch(
-                        db, SP.ACTIVE_BATCHING_PRIORITY))
+                        db, SP.ACTIVE_BATCHING_PRIORITY,
+                        owner=getattr(ctx, "qos", None)))
                 else:
                     direct.append(db)
                 pending_cap += db.capacity

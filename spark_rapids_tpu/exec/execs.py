@@ -706,7 +706,8 @@ class TpuSortExec(TpuExec):
                 for part in self.children[0].execute(ctx):
                     for db in part:
                         ids.append(catalog.register_batch(
-                            db, SP_MOD.ACTIVE_BATCHING_PRIORITY))
+                            db, SP_MOD.ACTIVE_BATCHING_PRIORITY,
+                            owner=getattr(ctx, "qos", None)))
                         total += db.device_size_bytes
                 if not ids:
                     return
@@ -855,7 +856,8 @@ def _accumulate_spillable(child: PhysicalPlan, ctx, label: str,
         for part in child.execute(ctx):
             for db in part:
                 ids.append(catalog.register_batch(
-                    db, SP.ACTIVE_BATCHING_PRIORITY))
+                    db, SP.ACTIVE_BATCHING_PRIORITY,
+                    owner=getattr(ctx, "qos", None)))
         if not ids:
             return None
 

@@ -200,12 +200,15 @@ class _TrackingCatalog:
     can free every outstanding registration instead of leaking them into
     the session-lifetime spill budget."""
 
-    def __init__(self, catalog):
+    def __init__(self, catalog, owner=None):
         self._c = catalog
+        #: QoS identity stamped on every chunk registration (ISSUE 11):
+        #: the spill victim order drains this query's own chunks first.
+        self._owner = owner
         self.live = set()
 
     def register_batch(self, batch, priority):
-        bid = self._c.register_batch(batch, priority)
+        bid = self._c.register_batch(batch, priority, owner=self._owner)
         self.live.add(bid)
         return bid
 
@@ -229,7 +232,8 @@ class ExternalSorter:
                  key_exprs=None, ctx=None):
         self.orders = orders
         self.schema = schema
-        self.catalog = _TrackingCatalog(catalog)
+        self.catalog = _TrackingCatalog(catalog,
+                                        owner=getattr(ctx, "qos", None))
         self.key_exprs = key_exprs or [o.child.bind(schema) for o in orders]
         self.asc = [o.ascending for o in orders]
         self.nf = [o.effective_nulls_first for o in orders]

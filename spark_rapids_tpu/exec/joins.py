@@ -85,7 +85,8 @@ class TpuBroadcastExchangeExec(TpuExec):
         ctx.metric(self.node_name(), "dataSize", self._payload_bytes)
         if catalog is not None and not ctx.in_fusion:
             from ..memory import spill as SP
-            bid = catalog.register_batch(merged, SP.ACTIVE_ON_DECK_PRIORITY)
+            bid = catalog.register_batch(merged, SP.ACTIVE_ON_DECK_PRIORITY,
+                                         owner=getattr(ctx, "qos", None))
             self._buffer_id = bid
 
             def _release():

@@ -125,19 +125,33 @@ class PipelinePool:
             if item is _STOP:
                 return
             f, fn, args = item
-            if f.set_running_or_notify_cancel():
+            ran = f.set_running_or_notify_cancel()
+            result = exc = None
+            if ran:
                 try:
-                    f.set_result(fn(*args))
+                    result = fn(*args)
                 # Forwarded verbatim to the future: the CONSUMER's
                 # result() re-raises it where the retry taxonomy (or the
                 # exchange/reader handlers) classify it — the pool must
                 # stay classification-neutral.
                 except BaseException as e:  # tpu-lint: ignore
-                    f.set_exception(e)
+                    exc = e
+            # Return to the idle pool BEFORE publishing the result: a
+            # consumer that wakes on result() and immediately submits its
+            # next task must see this worker as reusable — publishing
+            # first left a window where sequential submit/result loops
+            # spawned one fresh thread per task.
             with self._lock:
-                if self._closed:
-                    return
-                self._idle += 1
+                closed = self._closed
+                if not closed:
+                    self._idle += 1
+            if ran:
+                if exc is not None:
+                    f.set_exception(exc)
+                else:
+                    f.set_result(result)
+            if closed:
+                return
 
     def alive_threads(self) -> List[threading.Thread]:
         with self._lock:
@@ -219,6 +233,21 @@ def shutdown(timeout: float = 10.0) -> List[threading.Thread]:
 
 def _auto_threads() -> int:
     return max(2, min(4, os.cpu_count() or 2))
+
+
+def submit_spill_io(fn, *args) -> Optional[Future]:
+    """Spill-IO lane entry (memory/spill.py): hand one spill/restore copy
+    or disk append/read to the shared pool. Concurrency is bounded by the
+    CALLER's lane slots (``spark.rapids.tpu.spill.ioThreads`` — each
+    catalog holds its own slot semaphore inside the submitted unit, the
+    decode-limiter pattern), never by pool size. Returns None when the
+    pool refuses the task (shutdown race) — the caller runs the unit
+    inline, because spilling must survive pool teardown: a query draining
+    memory during session close still has to land its bytes."""
+    try:
+        return get_pool().submit(fn, *args)
+    except RuntimeError:
+        return None
 
 
 def _conf_int(conf, prop: str, fallback_key: str) -> int:

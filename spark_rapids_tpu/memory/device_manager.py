@@ -23,7 +23,7 @@ import logging
 from ..config import (CONCURRENT_ACQUIRE_TIMEOUT, CONCURRENT_TPU_TASKS,
                       DEVICE_BACKEND, DEVICE_SPILL_BUDGET,
                       HBM_ALLOC_FRACTION, HOST_SPILL_STORAGE_SIZE,
-                      MEMORY_DEBUG, SPILL_DIR, TpuConf)
+                      MEMORY_DEBUG, SPILL_DIR, SPILL_IO_THREADS, TpuConf)
 from ..utils import lockdep
 from .semaphore import TpuSemaphore
 
@@ -64,7 +64,8 @@ class DeviceManager:
         self.catalog = BufferCatalog(
             explicit if explicit > 0 else (lambda: self.hbm_budget_bytes),
             conf.get(HOST_SPILL_STORAGE_SIZE),
-            conf.get(SPILL_DIR))
+            conf.get(SPILL_DIR),
+            io_threads=conf.get(SPILL_IO_THREADS))
 
     @property
     def devices(self):
@@ -118,6 +119,7 @@ class DeviceManager:
         key = (conf.get(DEVICE_BACKEND), conf.get(HBM_ALLOC_FRACTION),
                conf.get(DEVICE_SPILL_BUDGET),
                conf.get(HOST_SPILL_STORAGE_SIZE), conf.get(SPILL_DIR),
+               conf.get(SPILL_IO_THREADS),
                conf.get(CONCURRENT_TPU_TASKS),
                conf.get(CONCURRENT_ACQUIRE_TIMEOUT))
         with cls._lock:

@@ -50,13 +50,15 @@ from ..utils import lockdep
 
 _LOG = logging.getLogger(__name__)
 
-#: Serializes concurrent OOM recoveries: pipeline boundary workers
-#: (exec/pipeline.py) can hit OOM simultaneously, and the sync+spill
-#: sequence must run atomically — two interleaved spill-downs would each
-#: observe the other's half-freed state and could spill buffers the
-#: sibling's retry is about to re-pin. Device ALLOCATION concurrency is
-#: already bounded by the admission semaphore the workers hold; this lock
-#: only orders the recovery sequences among themselves.
+#: Serializes concurrent DEVICE SYNCS between OOM recoveries (ISSUE 11 —
+#: narrowed from the whole sync+spill sequence): overlapping
+#: effects_barriers would each re-drain the other's freshly dispatched
+#: work for no benefit. The SPILL step no longer needs this lock at all:
+#: the spill catalog's state machine (memory/spill.py) reserves each
+#: victim exactly once under the catalog lock, respects pins, and never
+#: selects an in-flight buffer — so concurrent spill-downs divide the
+#: victims instead of corrupting each other, and one query's sync->spill
+#: no longer serializes behind another query's disk write.
 _OOM_RECOVERY_LOCK = lockdep.rlock("retry._OOM_RECOVERY_LOCK", io_ok=True)
 
 #: Hard ceiling on attempts one ``with_retry`` call may make across all
@@ -198,17 +200,23 @@ def synchronize_device() -> None:
 
 
 def spill_device_below(ctx, priority_ceiling: Optional[int] = None) -> int:
-    """Synchronously push every spillable device buffer below
-    ``priority_ceiling`` (default: everything under on-deck priority) off
-    the device, and drop the upload memo entirely — the forced device
-    drain between OOM retries. Returns device bytes moved."""
+    """Push every spillable device buffer below ``priority_ceiling``
+    (default: everything under on-deck priority) off the device, and drop
+    the upload memo entirely — the forced device drain between OOM
+    retries. The catalog drains victims in QoS order keyed by this
+    query's :class:`~.spill.QosTag` (its OWN buffers first, then by
+    tenant and deadline slack — an OOM ladder must not evict its
+    neighbors' hot tables while its own spillable state suffices), with
+    the copies overlapped off-lock on the spill-IO lane. Returns device
+    bytes moved."""
     from . import spill as SP
     if priority_ceiling is None:
         priority_ceiling = SP.ACTIVE_ON_DECK_PRIORITY
     moved = 0
     catalog = getattr(ctx, "catalog", None)
     if catalog is not None:
-        moved = catalog.spill_below(priority_ceiling)
+        moved = catalog.spill_below(priority_ceiling,
+                                    requester=getattr(ctx, "qos", None))
     from ..data import upload_cache
     moved += upload_cache.shrink_by(upload_cache.cache_bytes())
     return moved
@@ -347,9 +355,14 @@ def with_retry(ctx, site: str, inputs, attempt: Callable,
                 ctx.metric(node, "retryWastedComputeNs",
                            time.perf_counter_ns() - t0)
                 if cls == Classification.OOM:
+                    # The lock covers ONLY the device sync (ISSUE 11);
+                    # the spill-down runs off-lock — the catalog's state
+                    # machine makes concurrent drains safe, so one
+                    # query's recovery never queues behind a neighbor's
+                    # disk write.
                     with _OOM_RECOVERY_LOCK:
                         synchronize_device()
-                        spill_device_below(ctx)
+                    spill_device_below(ctx)
                     if retries >= policy.max_retries:
                         if split is None:
                             raise SplitAndRetryOOM(site) from e

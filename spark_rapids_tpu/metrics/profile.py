@@ -220,6 +220,19 @@ class QueryProfiler:
             # Live size of the shared disk spill file (compaction keeps it
             # from leaking freed ranges — memory/spill.py).
             "diskSpillFileBytes": int(spill.get("disk_spill_file_bytes", 0)),
+            # Async spill engine (ISSUE 11, docs/monitoring.md):
+            # bytes-per-second through the off-lock spill-IO lane this
+            # query (copies + restores; 0 when nothing spilled), the
+            # process watermark of queued-not-finished lane units, and ns
+            # this query's threads spent WAITING for the catalog lock —
+            # the convoy detector that the old synchronous design kept
+            # pegged during any spill.
+            "spillThroughputBytesPerSec": _rate_per_sec(
+                _delta(spill, self._spill0, "spill_io_bytes"),
+                _delta(spill, self._spill0, "spill_io_ns")),
+            "spillQueueDepth": int(spill.get("spill_queue_peak", 0)),
+            "spillLockWaitNs": _delta(spill, self._spill0,
+                                      "spill_lock_wait_ns"),
             "deviceStoreBytes": dm.catalog.device_bytes,
             **dm.hbm_watermarks(),
             "compile": {
@@ -288,6 +301,11 @@ class QueryProfiler:
 
 def _delta(now: dict, base: dict, key: str) -> int:
     return int(now.get(key, 0)) - int(base.get(key, 0))
+
+
+def _rate_per_sec(amount: int, ns: int) -> int:
+    """amount / (ns as seconds), 0 when nothing was measured."""
+    return int(amount * 1e9 / ns) if ns > 0 else 0
 
 
 def _pallas_section(session, base: dict, now: dict,

@@ -216,9 +216,28 @@ def probe_device_times(base_keys: Dict[str, frozenset],
     return out
 
 
+#: Tri-state override of the backend-derived interpret default:
+#: ``tools/kernel_bench.py --no-interpret`` forces COMPILED pallas_call
+#: so hardware rounds measure the kernels, not the interpreter (ISSUE
+#: 11 / VERDICT round-5 ask 3). None = derive from the backend.
+_INTERPRET_OVERRIDE = None
+
+
+def set_interpret_override(value) -> None:
+    """Force interpret mode on (True), off (False — hardware mode), or
+    back to the backend-derived default (None). Process-wide: a flipped
+    mode changes traced programs, so callers (the kernel bench) must set
+    it BEFORE any kernel stages."""
+    global _INTERPRET_OVERRIDE
+    _INTERPRET_OVERRIDE = value
+
+
 def interpret_mode() -> bool:
     """Interpreter mode off-TPU: kernels are testable on the CPU backend
-    (the same trick the ORC/parquet device decoders use)."""
+    (the same trick the ORC/parquet device decoders use).
+    :func:`set_interpret_override` forces either mode for benchmarking."""
+    if _INTERPRET_OVERRIDE is not None:
+        return bool(_INTERPRET_OVERRIDE)
     import jax
     return jax.default_backend() != "tpu"
 

@@ -103,6 +103,13 @@ class ExecContext:
     #: different gates cannot poison each other's cached kernels (the
     #: PR-5 pipeline-sizing fix applied to the Pallas layer).
     pallas: object = None
+    #: QoS identity of this query for spill victim selection
+    #: (memory/spill.py QosTag): the session's tenant id
+    #: (spark.rapids.tpu.tenantId) plus this query's deadline. Built by
+    #: __post_init__; boundary forks SHARE it (dataclasses.replace keeps
+    #: the reference), so "own buffer" in the victim order means "same
+    #: query" across every worker of one execution.
+    qos: object = None
     _join_site: int = 0
     #: Base offset for next_join_site ordinals: pipeline boundary forks
     #: get disjoint deterministic namespaces so concurrent materialization
@@ -120,6 +127,14 @@ class ExecContext:
         if self.pallas is None:
             from ..ops.kernels import pallas as PAL
             self.pallas = PAL.from_conf(self.conf)
+        if self.qos is None:
+            from ..config import TENANT_ID
+            from ..memory.spill import QosTag
+            try:
+                tenant = self.conf.get(TENANT_ID) or ""
+            except (AttributeError, TypeError):
+                tenant = ""  # bare test doubles without a TpuConf
+            self.qos = QosTag(tenant=tenant, deadline=self.deadline)
 
     def next_join_site(self) -> int:
         """Deterministic per-execution ordinal for a join probe batch

@@ -347,9 +347,12 @@ class TpuSession:
                         raise
                     _harvest_durability(ctx)
                     if cls == R.Classification.OOM:
+                        # Sync-only under the lock (ISSUE 11): the spill
+                        # catalog's state machine makes concurrent
+                        # spill-downs safe off-lock.
                         with R._OOM_RECOVERY_LOCK:
                             R.synchronize_device()
-                            R.spill_device_below(ctx)
+                        R.spill_device_below(ctx)
                     dispatch_retries += 1
                     t0 = time.perf_counter_ns()
                     R.backoff_sleep(policy, "session.dispatch",

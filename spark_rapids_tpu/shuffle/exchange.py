@@ -35,6 +35,7 @@ import pyarrow as pa
 from .. import types as T
 from ..config import SHUFFLE_COMPRESSION_CODEC
 from ..data.batch import ColumnarBatch, HostBatch
+from ..memory.spill import SpillFileClosedError
 from ..plan.physical import ExecContext, PhysicalPlan, _arrow_schema
 from ..utils import lockdep
 from ..utils.kernel_cache import cached_kernel, kernel_key
@@ -54,19 +55,48 @@ class ShuffleBufferCatalog:
     rides protocol-v3 META/FETCH). Verification failures raise the typed
     :class:`~.transport.ShuffleBlockCorruptError`, which the read path
     recovers from via lineage recompute (:class:`MapOutputTracker`) —
-    corrupt bytes never deserialize into an answer."""
+    corrupt bytes never deserialize into an answer.
+
+    Async-spill discipline (ISSUE 11, mirroring ``BufferCatalog``): the
+    catalog lock brackets only bookkeeping — disk-tier appends, reads,
+    and compaction rewrites all run OFF the lock (bounded by the
+    ``spark.rapids.tpu.spill.ioThreads`` lane slots), so one reduce
+    task's disk read never stalls every writer and reader of the
+    catalog. Disk reads snapshot the block's range under the lock, read
+    atomically under the SpillFile's own io_ok lock, and re-validate the
+    range afterward; while a compaction is claimed, disk readers stand
+    aside on the catalog's state condition."""
 
     def __init__(self, host_budget_bytes: int = 1 << 30,
                  spill_dir: Optional[str] = None,
-                 verify_checksums: bool = True):
+                 verify_checksums: bool = True,
+                 io_threads: int = 2):
         self.host_budget = host_budget_bytes
         self.verify_checksums = verify_checksums
         self._blocks: Dict[Tuple[int, int, int], object] = {}
         self._crcs: Dict[Tuple[int, int, int], int] = {}
         self._host_bytes = 0
-        self._lock = lockdep.lock("ShuffleBufferCatalog._lock")
+        # Reentrant: the off-lock disk protocol double-checks the lazy
+        # SpillFile init from paths that may already hold the lock.
+        self._lock = lockdep.rlock("ShuffleBufferCatalog._lock")
+        #: compaction exclusion channel (shares the catalog lock — waits
+        #: release it, exactly like BufferCatalog's per-buffer conds)
+        self._state_cond = lockdep.condition_on(self._lock)
+        self._compacting = False
+        #: set by close(): late off-lock disk appends/reads stand down
+        #: instead of lazily resurrecting a fresh SpillFile (stray temp
+        #: dir leak) or re-installing blocks into the cleared catalog
+        #: (mirrors BufferCatalog._closed)
+        self._closed = False
+        #: disk appends in flight (range not yet published): a compaction
+        #: snapshot would miss those bytes and the rewrite would drop them
+        #: — _claim_compact refuses while > 0 (mirrors BufferCatalog)
+        self._disk_appends = 0
         self._spill_dir = spill_dir
         self._spill_file = None
+        import threading
+        self._io_slots = threading.BoundedSemaphore(max(1, int(io_threads))) \
+            if int(io_threads) > 0 else None
         # Host tier storage: serialized blocks go into ONE native arena
         # region (native/arena.cpp, the AddressSpaceAllocator analog)
         # instead of per-block Python bytes; arena-full or no-native falls
@@ -77,60 +107,138 @@ class ShuffleBufferCatalog:
                         "checksum_failures": 0}
 
     def _disk(self):
-        if self._spill_file is None:
-            from ..memory.spill import SpillFile
-            self._spill_file = SpillFile(self._spill_dir,
-                                         verify=self.verify_checksums)
-        return self._spill_file
+        # Double-checked under the (reentrant) catalog lock so off-lock
+        # readers/writers can resolve it without racing the lazy init.
+        f = self._spill_file
+        if f is None:
+            with self._lock:
+                if self._closed:
+                    # Backstop: never lazily recreate a SpillFile after
+                    # close() removed it (mirrors BufferCatalog._disk).
+                    raise SpillFileClosedError("shuffle catalog is closed")
+                if self._spill_file is None:
+                    from ..memory.spill import SpillFile
+                    self._spill_file = SpillFile(
+                        self._spill_dir, verify=self.verify_checksums)
+                f = self._spill_file
+        return f
+
+    def _io_lane(self):
+        """Bounds concurrent disk-tier I/O to the spill-IO lane width."""
+        import contextlib
+        return self._io_slots if self._io_slots is not None \
+            else contextlib.nullcontext()
 
     def add_block(self, shuffle_id: int, map_id: int, reduce_id: int,
                   payload: bytes):
         from ..utils import checksum as CK
-        crc = CK.crc32c(payload)
+        crc = CK.crc32c(payload)  # checksummed OFF the catalog lock
+        key = (shuffle_id, map_id, reduce_id)
         with self._lock:
-            key = (shuffle_id, map_id, reduce_id)
+            if self._closed:
+                # Same silent-drop contract as the disk-tier close-race
+                # interleavings below: a post-close add must not
+                # resurrect blocks (or byte accounting) into the
+                # cleared catalog — its consumers are gone.
+                return
+            to_disk = self._host_bytes + len(payload) > self.host_budget
+            if not to_disk:
+                self._crcs[key] = crc
+                self.metrics["blocks"] += 1
+                self.metrics["bytes_written"] += len(payload)
+                if self._arena.available:
+                    off = self._arena.put(payload)
+                    if off is not None:
+                        self._blocks[key] = ("arena", off, len(payload))
+                        self._host_bytes += len(payload)
+                        return
+                self._blocks[key] = payload
+                self._host_bytes += len(payload)
+                return
+        # Disk tier: the append (file open + write) runs off-lock on the
+        # IO lane; the block publishes under the lock afterward — a
+        # reader never sees a half-written range, and writers of OTHER
+        # blocks never queue behind this one's disk write. Appends
+        # exclude compaction both ways (mirrors BufferCatalog's
+        # _spill_host_job): stand aside while a claimed rewrite runs,
+        # and hold _disk_appends so no claim's live snapshot can miss
+        # this appended-but-unpublished range (the rewrite would drop
+        # the bytes and this publish would install a stale offset).
+        with self._lock:
+            while self._compacting and not self._closed:
+                self._state_cond.wait(timeout=1.0)
+            if self._closed:
+                # close() already removed the spill file: drop the block
+                # (the catalog's consumers are gone) rather than
+                # resurrect a fresh file for it.
+                return
+            self._disk_appends += 1
+        try:
+            with self._io_lane():
+                offset, length = self._disk().append(payload)
+        except SpillFileClosedError:
+            # close() landed between the pre-gate and the append (the
+            # typed error covers both the _disk() backstop and the
+            # closed-aware SpillFile refusing open('ab') re-creation):
+            # settle as the same silent drop every neighboring
+            # interleaving of this race gets, instead of failing the
+            # writer task during an otherwise-clean shutdown.
+            with self._lock:
+                self._disk_appends -= 1
+                self._state_cond.notify_all()
+            return
+        except BaseException:  # tpu-lint: ignore — undo the append hold
+            with self._lock:
+                self._disk_appends -= 1
+            raise
+        compact_ready = False
+        with self._lock:
+            self._disk_appends -= 1
+            if self._closed:
+                # close() raced the off-lock append — the range died
+                # with the closed spill file; do not re-install the
+                # block into the cleared catalog.
+                self._state_cond.notify_all()
+                return
             self._crcs[key] = crc
+            self._blocks[key] = ("disk", offset, length)
             self.metrics["blocks"] += 1
             self.metrics["bytes_written"] += len(payload)
-            if self._host_bytes + len(payload) > self.host_budget:
-                offset, length = self._disk().append(payload)
-                self._blocks[key] = ("disk", offset, length)
-                self.metrics["spilled_blocks"] += 1
-                return
-            if self._arena.available:
-                off = self._arena.put(payload)
-                if off is not None:
-                    self._blocks[key] = ("arena", off, len(payload))
-                    self._host_bytes += len(payload)
-                    return
-            self._blocks[key] = payload
-            self._host_bytes += len(payload)
+            self.metrics["spilled_blocks"] += 1
+            # Pick up a compaction our in-flight append deferred.
+            compact_ready = self._claim_compact()
+        if compact_ready:
+            self._compact_now()
 
     def _read_block(self, v) -> bytes:
+        """Host-tier payload copy (caller holds _lock); disk tiers go
+        through :meth:`_snapshot_block`'s off-lock protocol instead."""
         if isinstance(v, tuple):
-            kind, offset, length = v
-            if kind == "arena":
-                return self._arena.get(offset, length)
-            return self._disk().read(offset, length)
+            return self._arena.get(v[1], v[2])
         return v
 
-    def _read_for_verify(self, key: Tuple[int, int, int]
-                         ) -> Tuple[bytes, Optional[int]]:
-        """(payload, crc-to-verify-or-None) for one block; caller holds
-        _lock. NO verification happens here — every tier's CRC pass runs
-        in :meth:`_verify_payload` outside the catalog lock (the disk
-        tier reads unverified via SpillFile.read_with_crc; its recorded
-        crc equals this catalog's registration crc). None = skip: kill
-        switch off or no recorded checksum."""
-        v = self._blocks[key]
-        if isinstance(v, tuple) and v[0] == "disk":
-            payload, crc = self._disk().read_with_crc(v[1], v[2])
-        else:
-            payload = self._read_block(v)
-            crc = self._crcs.get(key)
-        if not self.verify_checksums:
-            crc = None
-        return payload, crc
+    def _snapshot_block(self, key: Tuple[int, int, int]
+                        ) -> Tuple[bytes, Optional[int]]:
+        """(payload, crc-to-verify-or-None) for one block. Host tiers
+        (arena, bytes) copy under the lock — host memcpy, no I/O. The
+        disk tier reads OFF the lock: snapshot the range under the lock,
+        read it atomically under the SpillFile's own io_ok lock, then
+        re-validate that no compaction moved it (retrying with the
+        installed range if one did). NO verification happens here — the
+        CRC pass runs in :meth:`_verify_payload` outside the lock."""
+        while True:
+            with self._lock:
+                while self._compacting:
+                    self._state_cond.wait(timeout=1.0)
+                v = self._blocks[key]
+                crc = self._crcs.get(key) if self.verify_checksums else None
+                if not (isinstance(v, tuple) and v[0] == "disk"):
+                    return self._read_block(v), crc
+            with self._io_lane():
+                payload = self._disk().read_with_crc(v[1], v[2])[0]
+            with self._lock:
+                if not self._compacting and self._blocks.get(key) == v:
+                    return payload, crc
 
     def _verify_payload(self, key: Tuple[int, int, int], payload: bytes,
                         crc: Optional[int]) -> bytes:
@@ -174,14 +282,13 @@ class ShuffleBufferCatalog:
         partition, verified, in map order — the streaming read the
         recovery path needs (it must know WHICH map outputs were already
         delivered before a corruption surfaced). Keys snapshot under the
-        lock; each payload reads under the lock at yield time
-        (position-independent keying makes that safe against concurrent
-        registration) and verifies outside it."""
+        lock; each payload snapshots at yield time (position-independent
+        keying makes that safe against concurrent registration; disk
+        payloads read off-lock) and verifies outside the lock."""
         with self._lock:
             keys = self._keys_for_reduce(shuffle_id, reduce_id, map_range)
         for k in keys:
-            with self._lock:
-                payload, crc = self._read_for_verify(k)
+            payload, crc = self._snapshot_block(k)
             yield k[1], self._verify_payload(k, payload, crc)
 
     def block_metas_for_reduce(self, shuffle_id: int, reduce_id: int,
@@ -205,8 +312,7 @@ class ShuffleBufferCatalog:
         reference's tag scheme. Position-independent, so blocks added
         between a client's META and FETCH can't shift addressing."""
         key = (shuffle_id, map_id, reduce_id)
-        with self._lock:
-            payload, crc = self._read_for_verify(key)
+        payload, crc = self._snapshot_block(key)
         return self._verify_payload(key, payload, crc)
 
     def read_block_with_crc(self, shuffle_id: int, map_id: int,
@@ -215,8 +321,8 @@ class ShuffleBufferCatalog:
         at rest before serving, and the registration checksum travels
         with it so the peer verifies end-to-end."""
         key = (shuffle_id, map_id, reduce_id)
+        payload, crc = self._snapshot_block(key)
         with self._lock:
-            payload, crc = self._read_for_verify(key)
             stored = self._crcs.get(key, 0)
         self._verify_payload(key, payload, crc)
         return payload, stored
@@ -239,34 +345,90 @@ class ShuffleBufferCatalog:
                     if v[0] == "arena":
                         self._arena.free(v[1])
                         self._host_bytes -= v[2]
-                    elif v[0] == "disk" and self._spill_file is not None:
+                    elif v[0] == "disk" and self._spill_file is not None \
+                            and not self._compacting:
+                        # While a claimed rewrite runs, the offsets are
+                        # about to be remapped — the install loop frees
+                        # the relocated bytes of popped keys instead.
                         self._spill_file.free_range(v[1], v[2])
                 else:
                     self._host_bytes -= len(v)
-            self._maybe_compact_disk()
+            compact_ready = self._claim_compact()
+        if compact_ready:
+            self._compact_now()
 
-    def _maybe_compact_disk(self):
-        """Reclaim freed spill-file space (caller holds _lock): rewrite
-        the surviving disk blocks contiguously once half the file is dead
-        — mirrors BufferCatalog's compaction (memory/spill.py)."""
+    def _claim_compact(self) -> bool:
+        """True when half the spill file is dead AND this caller claimed
+        the single compaction slot (caller holds _lock; must then call
+        :meth:`_compact_now` after releasing it)."""
         from ..memory.spill import DISK_COMPACT_FRACTION
         f = self._spill_file
-        if f is None or f.freed_bytes == 0 \
+        if f is None or self._compacting or self._disk_appends > 0 \
+                or f.freed_bytes == 0 \
                 or f.freed_fraction() < DISK_COMPACT_FRACTION:
+            # _disk_appends > 0: an unpublished append would be invisible
+            # to the live snapshot; the appender's publish re-claims.
+            return False
+        self._compacting = True
+        return True
+
+    def _compact_now(self):
+        """Rewrite the surviving disk blocks contiguously — OFF the
+        catalog lock (mirrors BufferCatalog._compact_now): snapshot and
+        install bracket the rewrite under the lock, the rewrite holds
+        only the SpillFile's own io_ok lock, and disk readers stand
+        aside on the claimed ``_compacting`` flag."""
+        f = self._spill_file
+        with self._lock:
+            if self._closed or f is None:
+                # close() raced the claimed rewrite: the file and every
+                # range died with it — release the claim and stand down
+                # instead of dereferencing the nulled file (mirrors
+                # BufferCatalog._compact_now).
+                self._compacting = False
+                self._state_cond.notify_all()
+                return
+            live = {k: (v[1], v[2]) for k, v in self._blocks.items()
+                    if isinstance(v, tuple) and v[0] == "disk"}
+        try:
+            new_ranges = f.compact(live)
+        except SpillFileClosedError:
+            # close() landed between the snapshot and the rewrite (the
+            # closed-aware SpillFile refused): same stand-down.
+            with self._lock:
+                self._compacting = False
+                self._state_cond.notify_all()
             return
-        live = {k: (v[1], v[2]) for k, v in self._blocks.items()
-                if isinstance(v, tuple) and v[0] == "disk"}
-        for k, (off, length) in f.compact(live).items():
-            self._blocks[k] = ("disk", off, length)
+        # Release the claim and re-raise: classification-neutral.
+        except BaseException:  # tpu-lint: ignore
+            with self._lock:
+                self._compacting = False
+                self._state_cond.notify_all()
+            raise
+        with self._lock:
+            for k, (off, length) in new_ranges.items():
+                if k in self._blocks:
+                    self._blocks[k] = ("disk", off, length)
+                else:
+                    # unregistered while the rewrite ran: release the
+                    # relocated bytes instead of resurrecting them
+                    f.free_range(off, length)
+            self._compacting = False
+            self._state_cond.notify_all()
 
     def close(self):
         with self._lock:
+            # Flag first: any off-lock disk append/read still in flight
+            # stands down at its next lock bracket instead of touching
+            # the cleared catalog or recreating the spill file.
+            self._closed = True
             self._blocks.clear()
             self._crcs.clear()
             self._arena.close()
             if self._spill_file is not None:
                 self._spill_file.close()
                 self._spill_file = None
+            self._state_cond.notify_all()
 
 
 class MapOutputTracker:
@@ -910,11 +1072,13 @@ def _shuffle_env(ctx: ExecContext) -> ShuffleBufferCatalog:
     env = getattr(ctx, "_shuffle_catalog", None)
     if env is None:
         from ..config import (HOST_SPILL_STORAGE_SIZE,
-                              SHUFFLE_CHECKSUM_ENABLED, SPILL_DIR)
+                              SHUFFLE_CHECKSUM_ENABLED, SPILL_DIR,
+                              SPILL_IO_THREADS)
         env = ShuffleBufferCatalog(
             ctx.conf.get(HOST_SPILL_STORAGE_SIZE),
             ctx.conf.get(SPILL_DIR),
-            verify_checksums=ctx.conf.get(SHUFFLE_CHECKSUM_ENABLED))
+            verify_checksums=ctx.conf.get(SHUFFLE_CHECKSUM_ENABLED),
+            io_threads=ctx.conf.get(SPILL_IO_THREADS))
         ctx._shuffle_catalog = env
         # Query-end teardown: free any still-pinned blocks and delete the
         # spill file so long sessions don't accumulate host memory/disk.

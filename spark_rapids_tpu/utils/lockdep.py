@@ -388,6 +388,21 @@ def condition(name: str, *, io_ok: bool = False):
     return threading.Condition(_DepRLock(name, io_ok))
 
 
+def condition_on(lock):
+    """A condition variable over an EXISTING lockdep lock — the per-buffer
+    wait channel of the async spill engine (memory/spill.py): waiters of
+    an in-flight buffer transition wait on the buffer's condition, which
+    RELEASES the owning catalog's lock for the duration of the wait (the
+    whole point — a reader waiting out one buffer's copy must not hold up
+    the catalog), and transition publishers notify under the same lock.
+    No new lock is constructed, so the order graph and the concurrency.md
+    inventory are unchanged; ``lock`` must be a (reentrant) lockdep rlock
+    or raw RLock — :class:`_DepRLock` implements Condition's
+    release/restore protocol so the held-stack stays truthful across the
+    wait."""
+    return threading.Condition(lock)  # tpu-lint: ignore
+
+
 # ---------------------------------------------------------------------------
 # Blocking-site markers
 # ---------------------------------------------------------------------------

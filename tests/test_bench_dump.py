@@ -1,0 +1,82 @@
+"""bench.py resilience contract: the SIGTERM/SIGINT kill path must (a)
+leave a parseable cumulative JSON line behind and (b) run the cleanups
+atexit would have run — ``os._exit`` skips atexit, so the parquet
+staging dir registered only there would leak on every external
+timeout kill (the exact rc=124 class the kill-dump exists for)."""
+import json
+import os
+import signal
+
+import pytest
+
+import bench
+
+
+@pytest.fixture
+def _bench_state():
+    """Snapshot/restore the module-global kill-dump state so the test
+    can fire the handler without polluting later tests or leaving a
+    chatty atexit dumper behind."""
+    old_term = signal.getsignal(signal.SIGTERM)
+    old_int = signal.getsignal(signal.SIGINT)
+    old_ckpt = dict(bench._CHECKPOINT)
+    old_cleanups = list(bench._KILL_CLEANUPS)
+    yield
+    signal.signal(signal.SIGTERM, old_term)
+    signal.signal(signal.SIGINT, old_int)
+    bench._CHECKPOINT.update(old_ckpt)
+    bench._CHECKPOINT["done"] = True  # silence the registered atexit dump
+    bench._KILL_CLEANUPS[:] = old_cleanups
+
+
+class TestKillDump:
+    def test_signal_path_runs_cleanups_and_dumps_json(
+            self, _bench_state, monkeypatch, capsys, tmp_path):
+        exits = []
+        monkeypatch.setattr(os, "_exit", exits.append)
+        pq_dir = tmp_path / "pq"
+        pq_dir.mkdir()
+        (pq_dir / "t.parquet").write_bytes(b"x")
+        import shutil
+        bench._KILL_CLEANUPS.append(
+            lambda: shutil.rmtree(str(pq_dir), ignore_errors=True))
+        bench._CHECKPOINT["payload"] = {"metric": "m", "value": 1.0,
+                                        "unit": "ms", "vs_baseline": 2.0,
+                                        "partial": True}
+        bench._CHECKPOINT["done"] = False
+        bench.install_kill_dump()
+        handler = signal.getsignal(signal.SIGTERM)
+        handler(signal.SIGTERM, None)
+        assert exits == [0]  # exit-0 contract
+        # The staging dir was removed DESPITE os._exit skipping atexit.
+        assert not pq_dir.exists()
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        payload = json.loads(line)
+        assert payload["value"] == 1.0
+        assert "killed by signal" in payload["error"]
+
+    def test_signal_before_first_checkpoint_still_emits_json(
+            self, _bench_state, monkeypatch, capsys):
+        monkeypatch.setattr(os, "_exit", lambda code: None)
+        bench._CHECKPOINT["payload"] = None
+        bench._CHECKPOINT["done"] = False
+        bench.install_kill_dump()
+        handler = signal.getsignal(signal.SIGINT)
+        handler(signal.SIGINT, None)
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        payload = json.loads(line)  # minimal zeroed payload, not no-line
+        assert payload["partial"] is True and payload["value"] == 0.0
+
+    def test_cleanup_errors_do_not_block_exit(self, _bench_state,
+                                              monkeypatch, capsys):
+        exits = []
+        monkeypatch.setattr(os, "_exit", exits.append)
+        ran = []
+        bench._KILL_CLEANUPS.append(
+            lambda: (_ for _ in ()).throw(OSError("boom")))
+        bench._KILL_CLEANUPS.append(lambda: ran.append(True))
+        bench._CHECKPOINT["done"] = False
+        bench.install_kill_dump()
+        signal.getsignal(signal.SIGTERM)(signal.SIGTERM, None)
+        capsys.readouterr()
+        assert exits == [0] and ran == [True]

@@ -118,6 +118,133 @@ class TestCatalog:
         assert cat.blocks_for_reduce(1, 0) == [b"x" * 10, b"y" * 10]
         cat.close()
 
+    def test_close_racing_disk_append_stands_down(self, monkeypatch,
+                                                  tmp_path):
+        """A disk append whose off-lock write loses the race to close()
+        must drop the block — not re-install it into the cleared catalog
+        or lazily resurrect a fresh SpillFile (stray temp dir); mirrors
+        BufferCatalog's straggler-publish guard."""
+        import threading
+        from spark_rapids_tpu.memory import spill as SP
+        cat = ShuffleBufferCatalog(host_budget_bytes=0,
+                                   spill_dir=str(tmp_path))
+        gate_in, gate_out = threading.Event(), threading.Event()
+
+        def blocking_append(self, payload):
+            gate_in.set()
+            assert gate_out.wait(10)
+            return (0, len(payload))  # file is closed by now: fake range
+
+        monkeypatch.setattr(SP.SpillFile, "append", blocking_append)
+        t = threading.Thread(
+            target=lambda: cat.add_block(1, 0, 0, b"x" * 10))
+        t.start()
+        assert gate_in.wait(10)  # mid-append, off-lock
+        cat.close()
+        gate_out.set()
+        t.join(30)
+        assert not t.is_alive()
+        assert cat._spill_file is None       # never resurrected
+        assert cat.blocks_for_reduce(1, 0) == []
+        assert cat.metrics["blocks"] == 0
+        # And a to-disk add AFTER close is dropped before the append.
+        cat.add_block(1, 0, 1, b"y" * 10)
+        assert cat._spill_file is None
+        assert cat.blocks_for_reduce(1, 0) == []
+
+    def test_closed_spill_file_append_drops_silently(self, monkeypatch,
+                                                     tmp_path):
+        """The REAL closed-SpillFile race (no faked append): the append
+        that loses to close() hits the typed SpillFileClosedError —
+        either from the closed-aware SpillFile refusing the open('ab')
+        re-creation of its removed path, or from the _disk() backstop
+        when the lazy file never existed — and add_block settles as the
+        same silent drop every neighboring interleaving gets, leaving
+        no stray .bin behind."""
+        import contextlib
+        import threading
+        from spark_rapids_tpu.memory import spill as SP
+
+        def racing_add(cat, key, gate_in, gate_out):
+            errs = []
+
+            def add():
+                try:
+                    cat.add_block(*key, b"y" * 10)
+                except BaseException as exc:  # noqa: BLE001 - capture
+                    errs.append(exc)
+
+            t = threading.Thread(target=add)
+            t.start()
+            assert gate_in.wait(10)  # off-lock, past the closed pre-gate
+            cat.close()
+            gate_out.set()
+            t.join(10)
+            assert not t.is_alive()
+            return errs
+
+        # Case 1: the spill file exists on disk; the gated append runs
+        # its REAL body only after close() removed the path.
+        cat = ShuffleBufferCatalog(host_budget_bytes=0,
+                                   spill_dir=str(tmp_path))
+        cat.add_block(1, 0, 0, b"x" * 10)  # creates the real file
+        assert list(tmp_path.glob("spill_*.bin"))
+        gate_in, gate_out = threading.Event(), threading.Event()
+        real_append = SP.SpillFile.append
+
+        def gated_append(self, payload):
+            gate_in.set()
+            assert gate_out.wait(10)
+            return real_append(self, payload)
+
+        monkeypatch.setattr(SP.SpillFile, "append", gated_append)
+        assert racing_add(cat, (1, 0, 1), gate_in, gate_out) == []
+        monkeypatch.undo()
+        assert not list(tmp_path.glob("spill_*.bin"))  # no 'ab' revival
+        assert cat._disk_appends == 0
+
+        # Case 2: close() lands BEFORE the lazy SpillFile ever exists —
+        # the _disk() backstop raises the same typed error; same drop.
+        cat2 = ShuffleBufferCatalog(host_budget_bytes=0,
+                                    spill_dir=str(tmp_path))
+        gate_in2, gate_out2 = threading.Event(), threading.Event()
+
+        @contextlib.contextmanager
+        def gated_lane():
+            gate_in2.set()
+            assert gate_out2.wait(10)
+            yield
+
+        cat2._io_lane = gated_lane
+        assert racing_add(cat2, (2, 0, 0), gate_in2, gate_out2) == []
+        assert cat2._spill_file is None
+        assert not list(tmp_path.glob("spill_*.bin"))
+        assert cat2._disk_appends == 0
+
+    def test_post_close_host_add_drops_silently(self):
+        """The HOST-tier path of add_block honors the same post-close
+        silent-drop contract as the disk tier: no block, no byte
+        accounting, no metrics resurrected into the cleared catalog."""
+        cat = ShuffleBufferCatalog(host_budget_bytes=1 << 20)
+        cat.close()
+        cat.add_block(1, 0, 0, b"x" * 10)
+        assert cat.blocks_for_reduce(1, 0) == []
+        assert cat._host_bytes == 0
+        assert cat.metrics["blocks"] == 0
+
+    def test_claimed_compaction_racing_close_stands_down(self, tmp_path):
+        """A compaction claimed pre-close but executed post-close must
+        release the claim and stand down — not dereference the nulled
+        spill file (mirrors BufferCatalog)."""
+        cat = ShuffleBufferCatalog(host_budget_bytes=0,
+                                   spill_dir=str(tmp_path))
+        cat.add_block(1, 0, 0, b"x" * 32)
+        with cat._lock:
+            cat._compacting = True  # the claim, as if taken pre-close
+        cat.close()
+        cat._compact_now()
+        assert not cat._compacting
+
 
 class TestExchange:
     @pytest.mark.parametrize("call", [

@@ -253,6 +253,307 @@ class TestLifecycle:
         cat.close()
 
 
+class TestFailureSettlement:
+    """A failed spill/restore unit must SETTLE every reserved victim
+    (publish or revert) before the error propagates — an aborted list
+    would leave entries SPILLING forever with the in-flight byte
+    reservations inflated, turning a recoverable I/O error into a
+    permanent hang of any later acquire (REVIEW findings, PR 11)."""
+
+    def test_cascade_failure_settles_all_victims(self, monkeypatch):
+        """One disk-full append inside the host-budget cascade must not
+        wedge the remaining cascade victims."""
+        cat = SP.BufferCatalog(1 << 30, 1 << 30, io_threads=2)
+        for i in range(3):
+            cat.register_batch(_batch(seed=i))
+        cat.synchronous_spill(0)  # all three on HOST
+        calls = {"n": 0}
+        real = SP.SpillFile.append
+
+        def flaky_append(self, payload):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("injected disk-full")
+            return real(self, payload)
+
+        monkeypatch.setattr(SP.SpillFile, "append", flaky_append)
+        cat.host_budget = 0
+        cat.device_budget = 0
+        # Registration spills the new batch to host, whose publish
+        # cascades every host buffer toward disk; the first append dies.
+        with pytest.raises(OSError, match="injected"):
+            cat.register_batch(_batch(seed=3))
+        # Every victim settled: nothing left mid-transition, no inflated
+        # in-flight reservation to starve later budget loops.
+        assert cat._spilling_host_bytes == 0
+        assert cat._spilling_device_bytes == 0
+        # Every victim settled to a REAL tier (the failed one reverted
+        # to HOST; a concurrent publish may then have legitimately
+        # re-reserved and cascaded it, so only settlement is asserted).
+        tiers = {bid: cat.tier_of(bid) for bid in sorted(cat._entries)}
+        assert not set(tiers.values()) & set(SP.TRANSITIONAL_TIERS)
+        # Every buffer stays acquirable (the old bug hung forever here).
+        cat.device_budget = 1 << 30
+        cat.host_budget = 1 << 30
+        for i, bid in enumerate(sorted(tiers)):
+            _assert_same(cat.acquire_batch(bid), _batch(seed=i))
+        cat.close()
+
+    def test_inline_failure_settles_all_jobs(self, monkeypatch):
+        """ioThreads=0: a failing job mid-list must not abort the loop
+        and leak the remaining reservations (collect-and-re-raise, same
+        contract as the submitted-futures path)."""
+        cat = SP.BufferCatalog(1 << 30, 1 << 30, io_threads=0)
+        bids = [cat.register_batch(_batch(seed=i)) for i in range(3)]
+        calls = {"n": 0}
+        real = ColumnarBatch.to_arrow
+
+        def flaky_to_arrow(self):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError("injected copy failure")
+            return real(self)
+
+        monkeypatch.setattr(ColumnarBatch, "to_arrow", flaky_to_arrow)
+        with pytest.raises(OSError, match="injected"):
+            cat.synchronous_spill(0)
+        assert cat._spilling_device_bytes == 0
+        tiers = [cat.tier_of(b) for b in bids]
+        assert tiers.count(SP.StorageTier.DEVICE) == 1  # reverted victim
+        assert tiers.count(SP.StorageTier.HOST) == 2    # settled anyway
+        for i, bid in enumerate(bids):
+            _assert_same(cat.acquire_batch(bid), _batch(seed=i))
+
+    def test_free_during_failed_disk_restore_releases_range(self):
+        """free() racing a disk restore that then FAILS must still honor
+        the deferred free_range — otherwise the dead bytes are invisible
+        to freed_fraction and the spill file never compacts them."""
+        import threading
+        b = _batch()
+        size = b.device_size_bytes
+        cat = SP.BufferCatalog(int(size * 1.5), 1)  # cascades to disk
+        bid = cat.register_batch(b)
+        cat.register_batch(_batch(seed=1))
+        assert cat.tier_of(bid) == SP.StorageTier.DISK
+        assert cat._spill_file.live_bytes > 0
+        started, freed = threading.Event(), threading.Event()
+
+        def failing_read(entry):
+            started.set()
+            assert freed.wait(10)
+            raise OSError("injected disk failure")
+
+        cat._read_disk_payload = failing_read
+        errs = []
+
+        def run():
+            try:
+                cat.acquire_batch(bid)
+            except OSError as exc:
+                errs.append(exc)
+
+        t = threading.Thread(target=run)
+        t.start()
+        assert started.wait(10)
+        cat.free(bid)  # races the in-flight (about-to-fail) restore
+        freed.set()
+        t.join(30)
+        assert not t.is_alive() and errs
+        # The revert path released the range; the now-100%-dead file
+        # compacted to empty instead of leaking until close().
+        assert cat._spill_file.live_bytes == 0
+        cat.close()
+
+    def test_close_with_inflight_spill_does_not_recreate_file(
+            self, monkeypatch):
+        """A straggler host->disk unit publishing after close() must
+        stand down — not lazily resurrect a fresh SpillFile (stray temp
+        dir) or account into the cleared catalog."""
+        import threading
+        cat = SP.BufferCatalog(1 << 30, 1 << 30, io_threads=2)
+        for i in range(2):
+            cat.register_batch(_batch(seed=i))
+        cat.synchronous_spill(0)  # both on HOST
+        gate_in, gate_out = threading.Event(), threading.Event()
+        real = SP._ipc_serialize
+
+        def blocking_serialize(rb):
+            gate_in.set()
+            assert gate_out.wait(10)
+            return real(rb)
+
+        monkeypatch.setattr(SP, "_ipc_serialize", blocking_serialize)
+        # Shorten close()'s IO-drain give-up so the straggler path runs
+        # without the test sleeping through the production deadline.
+        monkeypatch.setattr(SP, "_CLOSE_DRAIN_DEADLINE_S", 0.2)
+        cat.host_budget = 0
+        errs = []
+
+        def drain():
+            try:
+                cat.device_budget = 0
+                cat.register_batch(_batch(seed=9))
+            except BaseException as exc:  # noqa: BLE001 - test capture
+                errs.append(exc)
+
+        t = threading.Thread(target=drain)
+        t.start()
+        assert gate_in.wait(10)  # worker is mid-serialize, off-lock
+        cat.close()
+        gate_out.set()
+        t.join(30)
+        assert not t.is_alive()
+        assert cat._spill_file is None       # never resurrected
+        assert cat._spilling_host_bytes == 0  # every victim settled
+
+    def test_restore_racing_close_serves_batch_without_resurrecting(
+            self, monkeypatch):
+        """Restores run on the acquiring thread, OUTSIDE close()'s IO
+        drain — a restore publish that loses the race to close() must
+        hand the batch to the acquirer without resurrecting byte
+        accounting or tier state into the cleared catalog."""
+        import threading
+        cat = SP.BufferCatalog(1 << 30, 1 << 30, io_threads=2)
+        bid = cat.register_batch(_batch())
+        cat.synchronous_spill(0)
+        assert cat.tier_of(bid) == SP.StorageTier.HOST
+        gate_in, gate_out = threading.Event(), threading.Event()
+        real = ColumnarBatch.from_arrow
+
+        def blocking_from_arrow(*a, **kw):
+            gate_in.set()
+            assert gate_out.wait(10)
+            return real(*a, **kw)
+
+        monkeypatch.setattr(ColumnarBatch, "from_arrow",
+                            staticmethod(blocking_from_arrow))
+        out = []
+        t = threading.Thread(target=lambda: out.append(
+            cat.acquire_batch(bid)))
+        t.start()
+        assert gate_in.wait(10)  # mid-restore, off-lock
+        cat.close()
+        gate_out.set()
+        t.join(30)
+        assert not t.is_alive()
+        _assert_same(out[0], _batch())
+        # The late publish stood down: nothing resurrected, no budget
+        # pass ran against the closed catalog.
+        assert cat.device_bytes == 0
+        assert cat.metrics["reloaded_from_host"] == 0
+        assert cat._spill_file is None
+
+    def test_waiter_on_transitional_buffer_unblocks_on_close(
+            self, monkeypatch):
+        """A SECOND thread parked on a SPILLING buffer's condition must
+        wake when close() races the transition: the stand-down publish
+        never settles the tier, so without acquire_batch's closed check
+        the waiter would tick against SPILLING forever (it then raises
+        KeyError on the cleared catalog, like any post-close acquire)."""
+        import threading
+        import time as _time
+        cat = SP.BufferCatalog(1 << 30, 1 << 30, io_threads=2)
+        bid = cat.register_batch(_batch())
+        gate_in, gate_out = threading.Event(), threading.Event()
+        real = ColumnarBatch.to_arrow
+
+        def blocking_to_arrow(self):
+            gate_in.set()
+            assert gate_out.wait(10)
+            return real(self)
+
+        monkeypatch.setattr(ColumnarBatch, "to_arrow", blocking_to_arrow)
+        monkeypatch.setattr(SP, "_CLOSE_DRAIN_DEADLINE_S", 0.2)
+        spiller = threading.Thread(target=lambda: cat.synchronous_spill(0))
+        spiller.start()
+        assert gate_in.wait(10)  # device->host copy in flight, off-lock
+        errs = []
+
+        def wait_acquire():
+            try:
+                cat.acquire_batch(bid)
+            except KeyError as exc:
+                errs.append(exc)
+
+        waiter = threading.Thread(target=wait_acquire)
+        waiter.start()
+        _time.sleep(0.2)  # let the waiter park on the buffer's cond
+        cat.close()
+        waiter.join(10)
+        assert not waiter.is_alive() and errs  # woke, no permanent hang
+        gate_out.set()
+        spiller.join(10)
+        assert not spiller.is_alive()
+        assert cat._spilling_device_bytes == 0  # stand-down settled
+
+    def test_claimed_compaction_racing_close_stands_down(self):
+        """A compaction claimed before close() but executed after it
+        must release the claim and stand down — not dereference the
+        nulled spill file (AttributeError to the spilling caller)."""
+        b = _batch()
+        cat = SP.BufferCatalog(int(b.device_size_bytes * 1.5), 1)
+        cat.register_batch(b)
+        cat.register_batch(_batch(seed=1))  # cascades one to disk
+        with cat._lock:
+            cat._compacting = True  # the claim, as if taken pre-close
+        cat.close()
+        cat._compact_now()  # post-close execution of the claimed rewrite
+        assert not cat._compacting  # claim released, no AttributeError
+
+    def test_spill_file_compact_is_closed_aware_and_keeps_dir_clean(
+            self, tmp_path):
+        """SpillFile.compact refuses after close() (typed error, like
+        append/read), and a FAILED rewrite unlinks its mkstemp temp —
+        the stray spill_compact_*.bin class."""
+        import glob
+        import os
+        f = SP.SpillFile(str(tmp_path))
+        rng = f.append(b"x" * 64)
+        # Corrupt the recorded crc so verify-while-relocating fails.
+        off = rng[0]
+        f._crcs[off] = (f._crcs[off][0], f._crcs[off][1] ^ 1)
+        from spark_rapids_tpu.utils.checksum import ChecksumError
+        with pytest.raises(ChecksumError):
+            f.compact({0: rng})
+        assert not glob.glob(os.path.join(str(tmp_path),
+                                          "spill_compact_*.bin"))
+        f.close()
+        with pytest.raises(SP.SpillFileClosedError):
+            f.compact({})
+
+    def test_device_budget_lazy_callable_is_race_safe(self):
+        """Two first readers racing the lazy-callable resolve must never
+        interleave check-then-call with the other's just-assigned int
+        (TypeError: 'int' object is not callable)."""
+        import threading
+        import time as _time
+        for _ in range(10):
+            cat = SP.BufferCatalog(1 << 20, 1 << 20)
+
+            def slow_budget():
+                _time.sleep(0.001)  # widen the resolve window
+                return 1 << 20
+
+            cat.device_budget = slow_budget
+            barrier = threading.Barrier(8)
+            errs = []
+
+            def read():
+                barrier.wait()
+                try:
+                    assert cat.device_budget == 1 << 20
+                except BaseException as exc:  # noqa: BLE001 - capture
+                    errs.append(exc)
+
+            threads = [threading.Thread(target=read) for _ in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(10)
+            assert not errs
+            assert cat._device_budget == 1 << 20  # settled to the int
+
+
 class TestLeakTracking:
     def test_leak_report_and_close_warning(self, caplog):
         import logging

@@ -24,6 +24,15 @@ CLI::
     python -m tools.kernel_bench                       # default tiers
     python -m tools.kernel_bench --tiers 1024,16384
     python -m tools.kernel_bench --reps 5 --out BENCH_kernels.json
+    python -m tools.kernel_bench --no-interpret        # hardware mode
+
+``--no-interpret`` forces COMPILED ``pallas_call`` (``interpret=False``)
+regardless of backend — the hardware mode for TPU rounds, so the Pallas
+family numbers in BENCH_kernels.json measure the kernels instead of the
+interpreter (ISSUE 11; the JSON's ``backend``/``interpret`` fields
+record which mode produced it). Off-TPU this requires a backend that can
+actually compile Pallas — expect failures there; they are recorded as
+results, not aborts.
 """
 
 from __future__ import annotations
@@ -220,7 +229,7 @@ BENCHES = {
 }
 
 
-def run(tiers, kernels, reps: int) -> dict:
+def run(tiers, kernels, reps: int, forced: bool = False) -> dict:
     import jax
     from spark_rapids_tpu.ops.kernels import pallas as PAL
     conf = PAL.PallasConf(enabled=True, vmem_budget=64 << 20)
@@ -245,6 +254,7 @@ def run(tiers, kernels, reps: int) -> dict:
         "metric": "pallas_kernel_ab",
         "backend": jax.default_backend(),
         "interpret": interpret,
+        "interpret_forced": forced,
         "note": ("interpreter-mode timings measure the Pallas interpreter,"
                  " not hardware; bit-identity (match) is still meaningful")
                 if interpret else "compiled-kernel timings",
@@ -269,6 +279,12 @@ def parse_args(argv=None):
                     help="comma-separated kernel families (default all): "
                          + ",".join(BENCHES))
     ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--no-interpret", action="store_true",
+                    help="force compiled pallas_call (interpret=False) "
+                         "even off-TPU — the hardware mode for the win "
+                         "curve; the interpreter-mode default only "
+                         "proves bit-identity, its timings measure the "
+                         "interpreter")
     ap.add_argument("--out", default=None,
                     help="output path (default BENCH_kernels.json next to "
                          "the repo root)")
@@ -278,6 +294,10 @@ def parse_args(argv=None):
 def main(argv=None) -> int:
     args = parse_args(argv)
     from spark_rapids_tpu.ops.kernels import pallas as PAL
+    if args.no_interpret:
+        # Must flip BEFORE any kernel stages: interpret rides the traced
+        # program, so a late flip would mix modes in one artifact.
+        PAL.set_interpret_override(False)
     if args.tiers:
         tiers = [int(t) for t in args.tiers.split(",") if t.strip()]
     else:
@@ -291,7 +311,7 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     try:
-        out = run(tiers, kernels, args.reps)
+        out = run(tiers, kernels, args.reps, forced=args.no_interpret)
     except Exception as e:  # noqa: BLE001 — the JSON must always land
         import traceback
         traceback.print_exc()
