@@ -261,6 +261,28 @@ def _work() -> None:
                 _CV.notify_all()
 
 
+def quiesce(timeout: float = 10.0) -> bool:
+    """Drop every queued warm-up and wait out the in-flight compile —
+    the ``TpuSession.close`` step. Unlike :func:`_stop_at_exit` this
+    does NOT set the permanent shutdown flag (a session used after
+    close keeps working, and later sessions re-arm the worker), and it
+    is safe for CONCURRENT closers: each just clears the queue and
+    waits under the condition — no join of a thread another closer may
+    already have observed dying (the one-closer assumption the serving
+    pool reaper violates; docs/serving.md). True when quiesced, False
+    on timeout."""
+    deadline = time.monotonic() + timeout
+    with _CV:
+        _QUEUE.clear()
+        _CV.notify_all()
+        while _INFLIGHT:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return False
+            _CV.wait(left)
+    return True
+
+
 def drain(timeout: float = 60.0) -> bool:
     """Block until the warm-up queue is empty and no compile is in flight
     (tests/diagnostics). True when drained, False on timeout."""

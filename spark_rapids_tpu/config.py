@@ -18,7 +18,13 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Callable, Dict, List, Optional
 
+from .utils import lockdep as _lockdep
+
 _REGISTRY: Dict[str, "ConfEntry"] = {}
+#: registrations normally happen at module import, but extension points
+#: (and the serving layer's worker-reachable call graph) make the write
+#: path formally concurrent — the registry mutates under a lock.
+_REGISTRY_LOCK = _lockdep.lock("config._REGISTRY_LOCK")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -41,10 +47,11 @@ def _to_bool(s: str) -> bool:
 
 
 def _register(key, default, doc, conv, internal=False) -> ConfEntry:
-    if key in _REGISTRY:
-        raise ValueError(f"duplicate conf key {key}")
-    e = ConfEntry(key, default, doc, conv, internal)
-    _REGISTRY[key] = e
+    with _REGISTRY_LOCK:
+        if key in _REGISTRY:
+            raise ValueError(f"duplicate conf key {key}")
+        e = ConfEntry(key, default, doc, conv, internal)
+        _REGISTRY[key] = e
     return e
 
 
@@ -734,6 +741,104 @@ PLAN_LINT_FAIL_ON_WARN = conf_bool(
     "Promote warn-severity plan-lint violations (which normally log and "
     "fall back to the CPU plan) to hard PlanLintError failures. Intended "
     "for CI and tests. See docs/plan-lint.md.")
+
+# ---------------------------------------------------------------------------
+# Multi-tenant query service (serve/, docs/serving.md)
+# ---------------------------------------------------------------------------
+
+SERVE_SESSIONS = conf_int(
+    "spark.rapids.tpu.serve.sessions", 2,
+    "Warm TpuSessions the query service (serve/) pools. Each pooled "
+    "session loads the registered tables once and serves one query at a "
+    "time; a session that dies mid-query is torn down and replaced "
+    "without disturbing its neighbors. See docs/serving.md.")
+
+SERVE_MAX_CONCURRENT = conf_int(
+    "spark.rapids.tpu.serve.maxConcurrentQueries", 0,
+    "Queries the service admits concurrently (the fair-share gate's slot "
+    "count, layered in FRONT of spark.rapids.sql.concurrentTpuTasks). "
+    "0 = one per pooled session. See docs/serving.md.")
+
+SERVE_MAX_QUEUE_DEPTH = conf_int(
+    "spark.rapids.tpu.serve.maxQueueDepth", 16,
+    "Bound on each tenant's admission queue: a submit arriving when the "
+    "tenant already has this many queries waiting is SHED with a typed "
+    "ServiceOverloadedError carrying a retry-after hint — overload "
+    "answers as fast typed backpressure, never as unbounded queueing. "
+    "See docs/serving.md.")
+
+SERVE_TENANT_WEIGHTS = conf_str(
+    "spark.rapids.tpu.serve.tenantWeights", "",
+    "Comma-separated 'tenant:weight' fair-share weights for the "
+    "admission gate (stride scheduling: a weight-2 tenant is admitted "
+    "twice as often under contention). Unlisted tenants weigh 1. "
+    "See docs/serving.md.")
+
+SERVE_TENANT_TIME_BUDGET = conf_str(
+    "spark.rapids.tpu.serve.tenantTimeBudgetSecs", "",
+    "Comma-separated 'tenant:seconds' per-query wall-clock budgets, "
+    "enforced through the PR-7 cooperative Deadline spanning queue wait "
+    "AND execution (including the retry ladder). 'default:N' applies to "
+    "unlisted tenants; 0/absent = unbounded. Exceeding the budget "
+    "raises the typed QueryDeadlineExceeded. See docs/serving.md.")
+
+SERVE_TENANT_MEMORY_BUDGET = conf_str(
+    "spark.rapids.tpu.serve.tenantMemoryBudgetBytes", "",
+    "Comma-separated 'tenant:bytes' device-memory budgets: before each "
+    "of a tenant's queries runs, its device-resident spillable bytes "
+    "above budget are spilled via the QoS victim order (its OWN buffers "
+    "— an over-budget tenant pays with its own residency, never a "
+    "neighbor's). 'default:N' applies to unlisted tenants; 0/absent = "
+    "unbounded. See docs/serving.md.")
+
+SERVE_QUARANTINE_FAILURES = conf_int(
+    "spark.rapids.tpu.serve.quarantine.maxFailures", 2,
+    "Retry-ladder exhaustions (OOM-classified failures that escaped the "
+    "whole memory/retry.py ladder, or repeated session crashes) of one "
+    "plan hash before the circuit breaker quarantines it: further "
+    "submits of that plan are rejected with the typed "
+    "QueryQuarantinedError instead of re-admitted to burn the pool. "
+    "0 disables the breaker. See docs/serving.md.")
+
+SERVE_QUARANTINE_SECS = conf_float(
+    "spark.rapids.tpu.serve.quarantine.secs", 300.0,
+    "How long a quarantined plan hash stays rejected before one probe "
+    "execution is allowed again (half-open breaker).")
+
+SERVE_RESULT_CACHE_ENTRIES = conf_int(
+    "spark.rapids.tpu.serve.resultCache.maxEntries", 64,
+    "LRU capacity of the serving result cache, keyed by (tenant, PR-2 "
+    "plan hash). Entries store the CRC32C-verified serialized result, so "
+    "a poisoned entry is detected on hit and recomputed, never served. "
+    "Invalidation is tenant-scoped (QueryService.invalidate). 0 "
+    "disables. See docs/serving.md.")
+
+SERVE_SHED_RETRY_AFTER_SECS = conf_float(
+    "spark.rapids.tpu.serve.shedRetryAfterSecs", 0.25,
+    "Base of the retry-after hint a shed (ServiceOverloadedError) "
+    "carries; scaled by how loaded the admission gate is when the shed "
+    "happens.")
+
+FAULT_INJECTION_SERVE_EVERY_N = conf_int(
+    "spark.rapids.tpu.test.faultInjection.serveEveryN", 0,
+    "Apply a deterministic SERVING-SEAM fault at every Nth visit of the "
+    "matched serve.* site (serve.admission / serve.execute / "
+    "serve.cache; the 'sites' patterns gate it). Negative N faults the "
+    "first |N| visits then heals. The fault class per visit is chosen "
+    "deterministically from the seed among faultInjection.serveFaults "
+    "(restricted to the classes valid at that seam). 0 disables.")
+
+FAULT_INJECTION_SERVE_FAULTS = conf_str(
+    "spark.rapids.tpu.test.faultInjection.serveFaults",
+    "tenantKill,sessionCrash,cachePoison,admissionStall",
+    "Comma-separated serving fault classes the injector may apply: "
+    "tenantKill (the victim query is cancelled mid-flight — typed "
+    "QueryCancelledError, neighbors unaffected), sessionCrash (the "
+    "pooled session dies — torn down, replaced, read-only query re-run "
+    "once), cachePoison (the stored result-cache entry is corrupted — "
+    "CRC32C catches it on hit and the query recomputes), admissionStall "
+    "(a delay inside the admission queue — drives shed paths). A single "
+    "name pins every injected fault to that class.")
 
 DEVICE_BACKEND = conf_str(
     "spark.rapids.tpu.backend", None,

@@ -52,6 +52,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import math
 import os
 import queue
 import threading
@@ -62,6 +63,14 @@ from typing import Callable, Iterator, List, Optional, Sequence
 from ..utils import lockdep
 
 _STOP = object()
+
+
+class PoolShutdownError(RuntimeError):
+    """The shared pool was shut down under this caller (a concurrent
+    ``TpuSession.close`` — e.g. the serving layer's session reaper
+    retiring a crashed neighbor). Classified TRANSIENT by the retry
+    taxonomy (memory/retry.py): the pool is lazily recreated, so a
+    retry in place lands on fresh workers and the query survives."""
 
 
 # ---------------------------------------------------------------------------
@@ -103,7 +112,7 @@ class PipelinePool:
         # worker misses both.
         with self._lock:
             if self._closed:
-                raise RuntimeError("pipeline pool is shut down")
+                raise PoolShutdownError("pipeline pool is shut down")
             spawn = self._idle == 0
             if not spawn:
                 self._idle -= 1
@@ -311,6 +320,23 @@ def parallel_active(ctx) -> bool:
 # ---------------------------------------------------------------------------
 
 
+def _result_or_shutdown(f: Future, timeout: Optional[float] = None):
+    """``f.result(timeout)`` with pool-teardown cancellation translated
+    to the typed (transient) :class:`PoolShutdownError` — raw
+    CancelledError derives from BaseException on modern Pythons and
+    would sail past every ``except Exception`` retry arm. The futures
+    TimeoutError passes through untouched for the caller's deadline
+    loop."""
+    from concurrent.futures import CancelledError
+    try:
+        return f.result(timeout=timeout)
+    except CancelledError:
+        raise PoolShutdownError(
+            "pipeline pool shut down while this future was awaited "
+            "(concurrent TpuSession.close); the unit was cancelled "
+            "unrun") from None
+
+
 def _stalled_result(f: Future, ctx, node: Optional[str]):
     """future.result() with the blocked time accounted to the consumer
     stall counter — the signal that the producer side is the bottleneck.
@@ -321,16 +347,24 @@ def _stalled_result(f: Future, ctx, node: Optional[str]):
     from concurrent.futures import TimeoutError as _FutTimeout
     deadline = getattr(ctx, "deadline", None)
     if f.done():
-        return f.result()
+        return _result_or_shutdown(f)
     t0 = time.perf_counter_ns()
     try:
         if deadline is None:
             with lockdep.blocking("pipeline.future_wait"):
-                return f.result()
+                return _result_or_shutdown(f)
         while True:
             try:
                 with lockdep.blocking("pipeline.future_wait"):
-                    return f.result(timeout=max(deadline.remaining(), 0.0))
+                    # An INFINITE deadline (the serving layer's
+                    # cancel-only Deadline(math.inf)) polls bounded:
+                    # result(timeout=inf) is an OverflowError in
+                    # CPython, and a cancel() could never wake an
+                    # unbounded wait.
+                    rem = deadline.remaining()
+                    return _result_or_shutdown(
+                        f, timeout=max(rem, 0.0)
+                        if math.isfinite(rem) else 0.1)
             except _FutTimeout:
                 # On py3.11+ futures.TimeoutError IS the builtin
                 # TimeoutError, which a WORKER can legitimately raise
@@ -338,7 +372,7 @@ def _stalled_result(f: Future, ctx, node: Optional[str]):
                 # the exception came from the work — re-raise it instead
                 # of misreading it as a wait-timeout and spinning.
                 if f.done():
-                    return f.result()
+                    return _result_or_shutdown(f)
                 # Raises once expired; a spurious early wake just re-arms.
                 deadline.check(f"pipeline.wait:{node or 'prefetch'}",
                                ctx, node)

@@ -107,10 +107,16 @@ _OOM_MARKERS = ("RESOURCE_EXHAUSTED", "Resource exhausted",
                 "resource exhausted", "out of memory", "Out of memory",
                 "OUT_OF_MEMORY", "HBM space exhausted")
 
-#: Substrings identifying transient infrastructure races (the axon remote
-#: compile helper's known failure modes, previously substring-matched ad
-#: hoc in session._run_with_retries).
-_TRANSIENT_MARKERS = ("remote_compile", "tpu_compile_helper")
+#: Substrings identifying transient infrastructure races: the axon remote
+#: compile helper's known failure modes (previously substring-matched ad
+#: hoc in session._run_with_retries), plus the pipeline pool's teardown
+#: signals — a query racing a concurrent ``TpuSession.close()`` sees the
+#: shared pool shut down under it, and the pool is lazily recreated, so
+#: retrying in place succeeds (the serving layer's session-reaper relies
+#: on this: retiring a crashed session must be a non-event for its
+#: neighbors' in-flight queries; docs/serving.md).
+_TRANSIENT_MARKERS = ("remote_compile", "tpu_compile_helper",
+                      "pool is shut down", "pool shut down while")
 
 #: OSError shapes that are DETERMINISTIC user errors (missing input path,
 #: permissions, write target already exists), not I/O flakiness —
@@ -129,6 +135,15 @@ def classify(exc: BaseException) -> str:
         return Classification.FATAL
     if isinstance(exc, RetryOOM):
         return Classification.OOM
+    from concurrent.futures import CancelledError
+    if isinstance(exc, CancelledError):
+        # The only canceller of pipeline futures is pool shutdown (a
+        # concurrent TpuSession.close); the pool lazily recreates, so a
+        # retry in place lands on fresh workers. CancelledError derives
+        # from BaseException on modern Pythons — wait sites translate it
+        # (exec/pipeline.PoolShutdownError), this arm covers any that
+        # escapes raw.
+        return Classification.TRANSIENT
     msg = str(exc)
     if any(m in msg for m in _OOM_MARKERS):
         return Classification.OOM

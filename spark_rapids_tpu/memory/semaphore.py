@@ -16,9 +16,11 @@ held counts — an actionable diagnostic instead of a hang
 
 from __future__ import annotations
 
+import collections
+import math
 import threading
 import time
-from typing import Dict
+from typing import Deque, Dict, Optional
 
 from ..utils import lockdep
 
@@ -106,6 +108,210 @@ class TpuSemaphore:
     def __exit__(self, *exc):
         self.release_if_necessary()
         return False
+
+
+class AdmissionQueueFull(RuntimeError):
+    """A tenant's bounded admission queue was full at submit — the typed
+    SHED signal (docs/serving.md): the caller should answer the client
+    with retry-after backpressure, never queue unboundedly. Carries the
+    tenant, the observed depth, and the retry-after hint."""
+
+    def __init__(self, tenant: str, depth: int, retry_after_s: float):
+        super().__init__(
+            f"admission queue for tenant '{tenant or '<default>'}' is "
+            f"full ({depth} waiting); retry after ~{retry_after_s:.2f}s")
+        self.tenant = tenant
+        self.depth = depth
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionCancelled(RuntimeError):
+    """The waiter was cancelled while queued (client disconnect or an
+    injected tenant-kill): its queue entry is already removed and no
+    slot was consumed."""
+
+
+class _Waiter:
+    __slots__ = ("tenant", "granted", "cancelled")
+
+    def __init__(self, tenant: str):
+        self.tenant = tenant
+        self.granted = False
+        self.cancelled = False
+
+
+class FairShareGate:
+    """Weighted fair-share admission LAYERED IN FRONT of the task
+    semaphore (the serving layer's front door, docs/serving.md): each
+    tenant gets a bounded FIFO queue, and free slots are granted by
+    stride scheduling — the nonempty tenant with the smallest virtual
+    pass runs next, and a grant advances its pass by ``1/weight``, so a
+    weight-2 tenant is admitted twice as often under contention while an
+    idle tenant's first query never waits behind a burst from another.
+    The gate bounds how many queries hold pooled sessions at once; the
+    semaphore below it (``spark.rapids.sql.concurrentTpuTasks``) still
+    bounds device admission exactly as for non-served queries.
+
+    Overload is answered typed: a submit past ``max_depth`` raises
+    :class:`AdmissionQueueFull` immediately (shed with retry-after), a
+    cancelled waiter raises :class:`AdmissionCancelled` with its entry
+    removed, and an expired query deadline raises through
+    ``deadline.check`` — queue wait spends the tenant's time budget."""
+
+    def __init__(self, slots: int, max_depth: int,
+                 weights: Optional[Dict[str, float]] = None,
+                 retry_after_base_s: float = 0.25):
+        self.slots = max(1, int(slots))
+        self.max_depth = max(1, int(max_depth))
+        self.weights = {t: max(float(w), 1e-9)
+                        for t, w in (weights or {}).items()}
+        self.retry_after_base_s = float(retry_after_base_s)
+        self._cond = lockdep.condition("FairShareGate._cond")
+        self._free = self.slots
+        self._queues: Dict[str, Deque[_Waiter]] = {}
+        self._passes: Dict[str, float] = {}
+        self.stats = {"admitted": 0, "shed": 0, "cancelled": 0,
+                      "peak_depth": 0, "peak_concurrent": 0}
+
+    # -- scheduling (caller holds self._cond) -------------------------------
+    def _weight(self, tenant: str) -> float:
+        return self.weights.get(tenant, 1.0)
+
+    def _gc_tenant_locked(self, tenant: str) -> None:
+        """Drop an emptied tenant's queue AND pass entry. Tenant ids
+        arrive off the wire, so per-tenant state must not grow with
+        every distinct id ever seen; a returning tenant re-joins at the
+        current pass floor, which is the documented idle-tenant
+        semantics anyway."""
+        q = self._queues.get(tenant)
+        if q is not None and not q:
+            del self._queues[tenant]
+            self._passes.pop(tenant, None)
+
+    def _dispatch_locked(self) -> None:
+        while self._free > 0:
+            ready = [(self._passes.get(t, 0.0), t)
+                     for t, q in self._queues.items() if q]
+            if not ready:
+                return
+            _, tenant = min(ready)
+            q = self._queues[tenant]
+            w = q.popleft()
+            if w.cancelled:
+                self._gc_tenant_locked(tenant)
+                continue
+            w.granted = True
+            self._free -= 1
+            # The pass floor is applied at ENQUEUE time (acquire):
+            # clamping here against a min that includes the granted
+            # tenant's own stale pass let a returning burst (pass far
+            # below the field) monopolize the gate until it caught up.
+            self._passes[tenant] = self._passes.get(tenant, 0.0) \
+                + 1.0 / self._weight(tenant)
+            self.stats["admitted"] += 1
+            used = self.slots - self._free
+            if used > self.stats["peak_concurrent"]:
+                self.stats["peak_concurrent"] = used
+            self._gc_tenant_locked(tenant)
+
+    def _depth_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values())
+
+    def _retry_after_locked(self) -> float:
+        return self.retry_after_base_s * (1.0 + self._depth_locked()
+                                          / float(self.slots))
+
+    # -- public API ---------------------------------------------------------
+    def acquire(self, tenant: str, deadline=None,
+                waiter_out: Optional[list] = None) -> None:
+        """Block until admitted. ``waiter_out`` (a one-slot list) receives
+        the queue entry so a canceller can target it via :meth:`cancel`.
+        Raises :class:`AdmissionQueueFull` on a full tenant queue,
+        :class:`AdmissionCancelled` after a cancel, and whatever
+        ``deadline.check`` raises once the time budget is spent (the
+        entry is removed in every raising path — a shed or cancelled
+        query never leaks queue depth or a slot)."""
+        with self._cond:
+            q = self._queues.setdefault(tenant, collections.deque())
+            if len(q) >= self.max_depth:
+                self.stats["shed"] += 1
+                raise AdmissionQueueFull(tenant, len(q),
+                                         self._retry_after_locked())
+            if tenant not in self._passes:
+                # A NEW or returning tenant joins at the current pass
+                # floor of the queued field: it cannot claim credit for
+                # time it was not queued, and (unlike clamping at grant
+                # time against a min that includes its own stale pass) a
+                # returning BURST cannot monopolize the gate either.
+                self._passes[tenant] = min(
+                    (p for t, p in self._passes.items()
+                     if self._queues.get(t)), default=0.0)
+            w = _Waiter(tenant)
+            if waiter_out is not None:
+                waiter_out.append(w)
+            q.append(w)
+            depth = self._depth_locked()
+            if depth > self.stats["peak_depth"]:
+                self.stats["peak_depth"] = depth
+            self._dispatch_locked()
+            try:
+                while not w.granted:
+                    if w.cancelled:
+                        self.stats["cancelled"] += 1
+                        raise AdmissionCancelled(
+                            f"tenant '{tenant}' cancelled while queued")
+                    timeout = None
+                    if deadline is not None:
+                        deadline.check("serve.admission")
+                        rem = deadline.remaining()
+                        if math.isfinite(rem):
+                            timeout = max(min(rem, 0.05), 0.005)
+                    self._cond.wait(timeout)
+            except BaseException:  # tpu-lint: ignore - cleanup-only
+                # handler: re-raises verbatim (classification is the
+                # OUTER layer's job — serve/service.py maps these), it
+                # only unwinds this waiter's queue entry / slot.
+                if w.granted:
+                    # Granted in the same race window the raise came
+                    # from: give the slot back or it leaks forever.
+                    self._free += 1
+                    self._dispatch_locked()
+                    self._cond.notify_all()
+                else:
+                    w.cancelled = True
+                    try:
+                        q.remove(w)
+                    except ValueError:
+                        pass
+                    self._gc_tenant_locked(tenant)
+                raise
+
+    def release(self) -> None:
+        with self._cond:
+            self._free += 1
+            self._dispatch_locked()
+            self._cond.notify_all()
+
+    def cancel(self, waiter: _Waiter) -> None:
+        """Cancel a queued waiter (client disconnect / tenant kill). A
+        waiter already granted is untouched — its query is cancelled
+        cooperatively through the deadline instead."""
+        with self._cond:
+            waiter.cancelled = True
+            q = self._queues.get(waiter.tenant)
+            if q is not None:
+                try:
+                    q.remove(waiter)
+                except ValueError:
+                    pass
+                self._gc_tenant_locked(waiter.tenant)
+            self._cond.notify_all()
+
+    def depth(self, tenant: Optional[str] = None) -> int:
+        with self._cond:
+            if tenant is None:
+                return self._depth_locked()
+            return len(self._queues.get(tenant, ()))
 
 
 class _Released:

@@ -845,6 +845,41 @@ class BufferCatalog:
         self._run_spill_jobs(jobs, requester)
         return moved
 
+    def _tenant_device_bytes_locked(self, tenant: str) -> int:
+        """Settled DEVICE bytes owned by ``tenant`` (caller holds the
+        lock) — the ONE tenant-residency meter shared by the public
+        accessor and the budget victim reservation."""
+        return sum(e.meta.size_bytes for e in self._entries.values()
+                   if e.tier == StorageTier.DEVICE and not e.freed
+                   and e.owner is not None
+                   and e.owner.tenant == tenant)
+
+    def tenant_device_bytes(self, tenant: str) -> int:
+        """Settled DEVICE bytes owned by ``tenant``'s queries (QosTag
+        owners stamped at registration) — the usage the serving layer's
+        per-tenant memory budget meters (docs/serving.md)."""
+        with self._lock:
+            return self._tenant_device_bytes_locked(tenant)
+
+    def spill_tenant_over_budget(self, tenant: str, budget: int,
+                                 requester: Optional[QosTag] = None) -> int:
+        """Spill ``tenant``'s own device buffers (QoS victim order among
+        them) until its device residency fits ``budget`` — the serving
+        layer's budget enforcement (docs/serving.md): an over-budget
+        tenant pays with its OWN spillable residency before its next
+        query runs; neighbors' buffers are never candidates, so
+        enforcement can neither crash nor starve them. Returns device
+        bytes moved."""
+        t0 = time.perf_counter_ns()
+        with self._lock:
+            self._note_lock_wait(t0)
+            jobs = self._reserve_device_victims(target=int(budget),
+                                                requester=requester,
+                                                tenant=tenant)
+        moved = sum(e.meta.size_bytes for e in jobs)
+        self._run_spill_jobs(jobs, requester)
+        return moved
+
     def _reserve_for_target(self, target: int,
                             requester: Optional[QosTag]) -> List[_Entry]:
         t0 = time.perf_counter_ns()
@@ -900,27 +935,38 @@ class BufferCatalog:
     def _reserve_device_victims(self, target: int,
                                 requester: Optional[QosTag],
                                 exclude: Optional[int] = None,
-                                ceiling: Optional[int] = None
+                                ceiling: Optional[int] = None,
+                                tenant: Optional[str] = None
                                 ) -> List[_Entry]:
         """Reserve DEVICE->SPILLING transitions (caller holds the lock)
         until settled-plus-inflight device usage fits ``target``.
-        ``ceiling`` bounds eligible priorities (spill_below)."""
-        if self.device_bytes - self._spilling_device_bytes <= target:
+        ``ceiling`` bounds eligible priorities (spill_below); ``tenant``
+        restricts BOTH the usage meter and the candidates to buffers
+        owned by that tenant (the serving layer's per-tenant memory
+        budget — neighbors' buffers are never candidates)."""
+        if tenant is None:
+            usage = self.device_bytes - self._spilling_device_bytes
+        else:
+            usage = self._tenant_device_bytes_locked(tenant)
+        if usage <= target:
             return []
         cands = [e for e in self._entries.values()
                  if e.tier == StorageTier.DEVICE and not e.freed
                  and e.buffer_id != exclude
                  and e.buffer_id not in self._pinned
-                 and (ceiling is None or e.priority < ceiling)]
+                 and (ceiling is None or e.priority < ceiling)
+                 and (tenant is None or (e.owner is not None
+                                         and e.owner.tenant == tenant))]
         cands.sort(key=lambda e: self._victim_key(e, requester))
         jobs: List[_Entry] = []
         for e in cands:
-            if self.device_bytes - self._spilling_device_bytes <= target:
+            if usage <= target:
                 break
             e.tier = StorageTier.SPILLING
             e.moving_from = StorageTier.DEVICE
             self._entry_cond(e)
             self._spilling_device_bytes += e.meta.size_bytes
+            usage -= e.meta.size_bytes
             jobs.append(e)
         return jobs
 

@@ -72,6 +72,11 @@ class QueryProfile:
     engine: dict
     timestamp: str = ""
     version: int = VERSION
+    #: the executing session's ``spark.rapids.tpu.tenantId`` (ISSUE 12):
+    #: stamped into the header AND therefore into every event-log record,
+    #: so per-tenant attribution (tools/serve_bench.py) groups profiles
+    #: directly instead of joining against a side channel.
+    tenant: str = ""
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -86,8 +91,10 @@ class QueryProfile:
         """The metric-annotated EXPLAIN tree."""
         counts: Dict[str, int] = {}
         _count_names(self.tree, counts)
+        tenant = f", tenant={self.tenant}" if self.tenant else ""
         lines = [f"== Query Profile #{self.query_id} "
-                 f"(level={self.level}, wall={_fmt_ns(self.wall_ns)}) =="]
+                 f"(level={self.level}, wall={_fmt_ns(self.wall_ns)}"
+                 f"{tenant}) =="]
         _render_node(self.tree, 0, counts, lines)
         shared = sorted(n for n, c in counts.items() if c > 1)
         if shared:
@@ -286,6 +293,11 @@ class QueryProfiler:
                    for name in DURABILITY_COUNTERS},
             },
         }
+        from ..config import TENANT_ID
+        try:
+            tenant = str(self._session.conf.get(TENANT_ID) or "")
+        except Exception:  # noqa: BLE001 - attribution is an aid
+            tenant = ""
         return QueryProfile(
             query_id=query_id,
             plan_hash=plan_profile_hash(plan_sig),
@@ -296,6 +308,7 @@ class QueryProfiler:
             engine=engine,
             timestamp=datetime.datetime.now(datetime.timezone.utc)
             .isoformat(timespec="seconds"),
+            tenant=tenant,
         )
 
 

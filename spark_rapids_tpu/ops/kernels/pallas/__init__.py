@@ -291,7 +291,8 @@ def configure(enabled: bool) -> None:
     read exclusively from the per-session conf (ExecContext.pallas),
     so concurrent sessions cannot override each other."""
     global _PROCESS_DEFAULT
-    _PROCESS_DEFAULT = PallasConf(enabled=bool(enabled))
+    with _LOCK:
+        _PROCESS_DEFAULT = PallasConf(enabled=bool(enabled))
 
 
 def enabled() -> bool:

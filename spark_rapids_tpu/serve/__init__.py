@@ -1,0 +1,30 @@
+"""Multi-tenant query service (ISSUE 12, docs/serving.md).
+
+The millions-of-users front door: a long-lived in-process
+:class:`~.service.QueryService` pools warm :class:`~..session.TpuSession`
+instances, admits queries through a per-tenant weighted fair-share gate
+layered on the task semaphore, enforces per-tenant time/memory budgets
+through the PR-7 cooperative Deadline and the PR-11 QoS spill order,
+quarantines poisoned plans behind a circuit breaker, contains pooled
+session crashes (tear down, replace, re-run once if read-only), and
+serves repeated plans from a CRC-verified result cache — overload and
+neighbor failure answer as TYPED errors (shed with retry-after,
+quarantine, cancellation), never as crashes, hangs, or cross-tenant
+wrong answers. :class:`~.frontend.ServeFrontend` exposes it over a
+loopback TCP/JSON wire in the style of ``shuffle/net.py``.
+"""
+
+from .breaker import CircuitBreaker
+from .cache import ResultCache
+from .errors import (QueryCancelledError, QueryQuarantinedError, ServeError,
+                     ServiceClosedError, ServiceOverloadedError,
+                     SessionCrashError)
+from .frontend import ServeClient, ServeFrontend
+from .service import QueryService, QueryTicket, ServeResult
+
+__all__ = [
+    "CircuitBreaker", "QueryCancelledError", "QueryQuarantinedError",
+    "QueryService", "QueryTicket", "ResultCache", "ServeClient",
+    "ServeError", "ServeFrontend", "ServeResult", "ServiceClosedError",
+    "ServiceOverloadedError", "SessionCrashError",
+]

@@ -1,0 +1,122 @@
+"""Per-plan circuit breaker (docs/serving.md).
+
+A query whose retry ladder EXHAUSTS (an OOM that survived every
+spill/split escalation of memory/retry.py, or a plan that keeps killing
+pooled sessions) is not a fault to keep re-admitting: each re-run burns
+the pool — device time, spill bandwidth, admission slots — for every
+tenant. The breaker counts ladder exhaustions per PR-2 plan hash; past
+``spark.rapids.tpu.serve.quarantine.maxFailures`` the hash is
+QUARANTINED: submits are rejected with the typed
+:class:`~.errors.QueryQuarantinedError` until ``quarantine.secs``
+elapses, after which ONE probe execution is allowed (half-open) — a
+probe success closes the circuit, a probe failure re-arms the full
+quarantine window.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+from ..utils import lockdep
+from .errors import QueryQuarantinedError
+
+
+class _PlanHealth:
+    __slots__ = ("failures", "quarantined_until", "probing")
+
+    def __init__(self):
+        self.failures = 0
+        self.quarantined_until = 0.0
+        self.probing = False
+
+
+class CircuitBreaker:
+    """Quarantine poisoned plan hashes (see module doc)."""
+
+    def __init__(self, max_failures: int, quarantine_secs: float):
+        self.max_failures = int(max_failures)
+        self.quarantine_secs = float(quarantine_secs)
+        self._lock = lockdep.lock("CircuitBreaker._lock")
+        self._plans: Dict[str, _PlanHealth] = {}
+        self.stats = {"quarantined": 0, "rejected": 0, "probes": 0,
+                      "probes_released": 0, "recovered": 0}
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_failures > 0
+
+    def check(self, plan_hash: str) -> bool:
+        """Raise :class:`QueryQuarantinedError` when ``plan_hash`` is
+        quarantined; past the window, admit ONE caller as the half-open
+        probe and keep rejecting the rest until it reports back. Returns
+        True when THIS caller became the probe — it then owes the
+        breaker exactly one terminal call (:meth:`note_success` /
+        :meth:`note_failure`, or :meth:`release_probe` when the plan
+        never actually ran), else the circuit wedges open-pending
+        forever."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            h = self._plans.get(plan_hash)
+            if h is None or h.quarantined_until == 0.0:
+                return False
+            now = time.monotonic()
+            if now < h.quarantined_until:
+                self.stats["rejected"] += 1
+                raise QueryQuarantinedError(plan_hash, h.failures,
+                                            h.quarantined_until - now)
+            if h.probing:
+                self.stats["rejected"] += 1
+                raise QueryQuarantinedError(plan_hash, h.failures,
+                                            self.quarantine_secs)
+            h.probing = True
+            self.stats["probes"] += 1
+            return True
+
+    def release_probe(self, plan_hash: str) -> None:
+        """Hand back an UNCONSUMED half-open probe: the caller that won
+        it never ran the plan (cache hit, admission shed, deadline spent
+        in queue, client disconnect). The circuit stays quarantined but
+        the NEXT submit may probe — without this the plan would be
+        rejected forever."""
+        if not self.enabled:
+            return
+        with self._lock:
+            h = self._plans.get(plan_hash)
+            if h is not None and h.probing:
+                h.probing = False
+                self.stats["probes_released"] += 1
+
+    def note_failure(self, plan_hash: str) -> bool:
+        """One retry-ladder exhaustion of ``plan_hash``; returns True
+        when this failure tripped (or re-armed) the quarantine."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            h = self._plans.setdefault(plan_hash, _PlanHealth())
+            h.failures += 1
+            h.probing = False
+            if h.failures >= self.max_failures:
+                first = h.quarantined_until == 0.0
+                h.quarantined_until = time.monotonic() + self.quarantine_secs
+                if first:
+                    self.stats["quarantined"] += 1
+                return True
+        return False
+
+    def note_success(self, plan_hash: str) -> None:
+        """A completed run (normal or probe) closes the circuit."""
+        if not self.enabled:
+            return
+        with self._lock:
+            h = self._plans.pop(plan_hash, None)
+            if h is not None and h.quarantined_until:
+                self.stats["recovered"] += 1
+
+    def quarantined(self) -> list:
+        """Plan hashes currently quarantined (diagnostics)."""
+        now = time.monotonic()
+        with self._lock:
+            return sorted(p for p, h in self._plans.items()
+                          if h.quarantined_until > now or h.probing)

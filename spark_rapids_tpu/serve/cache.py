@@ -1,0 +1,129 @@
+"""CRC-verified serving result cache (docs/serving.md).
+
+Keyed by ``(tenant, plan hash)`` — the PR-2 plan hash is stable across
+sessions and processes (the compile manifest already relies on it), so a
+repeated dashboard query is answered without touching the device.
+Tenant-scoped keys double as the isolation boundary: one tenant's entry
+(poisoned or not) can never be served to another, and invalidation is
+per tenant.
+
+Entries store the Arrow-IPC serialized result plus its CRC32C
+(utils/checksum.py): every hit re-verifies before deserializing, so a
+corrupted entry (the ``cachePoison`` serving fault, or real rot) is
+detected, dropped, and RECOMPUTED — a poisoned cache degrades to a
+cache miss, never to a wrong answer.
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Dict, Optional, Tuple
+
+import pyarrow as pa
+
+from ..utils import checksum as CK
+from ..utils import lockdep
+
+
+def _serialize(table: pa.Table) -> bytes:
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+    return sink.getvalue()
+
+
+def _deserialize(payload: bytes) -> pa.Table:
+    with pa.ipc.open_stream(io.BytesIO(payload)) as r:
+        return r.read_all()
+
+
+class ResultCache:
+    """Bounded LRU of serialized query results (see module doc)."""
+
+    def __init__(self, max_entries: int):
+        self.max_entries = int(max_entries)
+        self._lock = lockdep.lock("ResultCache._lock")
+        #: (tenant, plan_hash) -> (payload, crc32c); dict preserves
+        #: insertion order — re-inserting on hit keeps it LRU.
+        self._entries: Dict[Tuple[str, str], Tuple[bytes, int]] = {}
+        self.stats = {"hits": 0, "misses": 0, "puts": 0, "evicted": 0,
+                      "corrupt_dropped": 0, "invalidated": 0}
+
+    @property
+    def enabled(self) -> bool:
+        return self.max_entries > 0
+
+    def get(self, tenant: str, plan_hash: str) -> Optional[pa.Table]:
+        """The cached result, or None. A CRC mismatch drops the entry
+        and reports a miss (the caller recomputes — corruption is never
+        served)."""
+        hit = self.get_with_crc(tenant, plan_hash)
+        return hit[0] if hit is not None else None
+
+    def get_with_crc(self, tenant: str, plan_hash: str
+                     ) -> Optional[Tuple[pa.Table, int]]:
+        """Like :meth:`get`, also returning the VERIFIED CRC32C of the
+        stored Arrow-IPC payload — the serving layer hands it to the
+        wire so a cache hit never pays a re-serialize just to recompute
+        a checksum it already has."""
+        if not self.enabled:
+            return None
+        key = (tenant, plan_hash)
+        with self._lock:
+            hit = self._entries.pop(key, None)
+            if hit is not None and CK.crc32c(hit[0]) == hit[1]:
+                self._entries[key] = hit  # re-insert: LRU touch
+                self.stats["hits"] += 1
+            elif hit is not None:
+                self.stats["corrupt_dropped"] += 1
+                self.stats["misses"] += 1
+                hit = None
+            else:
+                self.stats["misses"] += 1
+        return (_deserialize(hit[0]), hit[1]) if hit is not None else None
+
+    def put(self, tenant: str, plan_hash: str,
+            table: pa.Table) -> Optional[int]:
+        """Store ``table``; returns the CRC32C of its serialized form
+        (None when the cache is disabled) so the caller can forward it
+        without serializing again."""
+        if not self.enabled:
+            return None
+        payload = _serialize(table)
+        crc = CK.crc32c(payload)
+        with self._lock:
+            self._entries.pop((tenant, plan_hash), None)
+            self._entries[(tenant, plan_hash)] = (payload, crc)
+            self.stats["puts"] += 1
+            while len(self._entries) > self.max_entries:
+                self._entries.pop(next(iter(self._entries)))
+                self.stats["evicted"] += 1
+        return crc
+
+    def invalidate(self, tenant: str) -> int:
+        """Drop every entry of one tenant (its data changed); returns
+        how many were dropped. Other tenants' entries are untouched —
+        the tenant-scoped invalidation contract."""
+        with self._lock:
+            victims = [k for k in self._entries if k[0] == tenant]
+            for k in victims:
+                del self._entries[k]
+            self.stats["invalidated"] += len(victims)
+        return len(victims)
+
+    def poison(self, tenant: str, plan_hash: str) -> bool:
+        """TEST SEAM (the ``cachePoison`` serving fault): flip one byte
+        of the stored payload WITHOUT updating the recorded CRC, exactly
+        what rot would do. Returns whether an entry was poisoned."""
+        with self._lock:
+            hit = self._entries.get((tenant, plan_hash))
+            if hit is None or not hit[0]:
+                return False
+            payload = bytearray(hit[0])
+            payload[len(payload) // 2] ^= 0x40
+            self._entries[(tenant, plan_hash)] = (bytes(payload), hit[1])
+            return True
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)

@@ -1,0 +1,102 @@
+"""Typed serving-layer errors (docs/serving.md).
+
+Every way the service refuses or loses a query has its OWN exception
+type with machine-readable fields and a stable wire encoding
+(:meth:`ServeError.to_wire` — the frontend serializes these verbatim),
+so clients distinguish "back off and retry" (overload), "stop sending
+this query" (quarantine), "your budget ran out" (the PR-7
+``QueryDeadlineExceeded`` passes through untyped-wrapped), and "you went
+away" (cancellation) without parsing message strings. None of these are
+retryable faults to the retry taxonomy: ``memory/retry.classify``
+buckets them FATAL, which is correct — the SERVICE is the retry policy
+here, not the operator ladder.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ServeError(RuntimeError):
+    """Base of every typed serving refusal/loss signal."""
+
+    #: stable wire name (overridden where the class name is not it)
+    wire_fields = ()
+
+    def to_wire(self) -> dict:
+        d = {"error": type(self).__name__, "message": str(self)}
+        for f in self.wire_fields:
+            d[f] = getattr(self, f, None)
+        return d
+
+
+class ServiceOverloadedError(ServeError):
+    """Admission shed: the tenant's bounded queue was full. Carries the
+    retry-after hint — the client contract is 'back off, then retry',
+    never 'the service is broken'."""
+
+    wire_fields = ("tenant", "retry_after_s", "queue_depth")
+
+    def __init__(self, tenant: str, queue_depth: int, retry_after_s: float):
+        super().__init__(
+            f"service overloaded for tenant '{tenant or '<default>'}': "
+            f"{queue_depth} queries already queued; retry after "
+            f"~{retry_after_s:.2f}s")
+        self.tenant = tenant
+        self.queue_depth = queue_depth
+        self.retry_after_s = retry_after_s
+
+
+class QueryQuarantinedError(ServeError):
+    """Circuit breaker rejection: this plan hash exhausted its retry
+    ladder too often and is quarantined — re-admitting it would burn the
+    pool for every tenant. Carries when the next probe is allowed."""
+
+    wire_fields = ("plan_hash", "failures", "retry_after_s")
+
+    def __init__(self, plan_hash: str, failures: int, retry_after_s: float):
+        super().__init__(
+            f"plan {plan_hash} is quarantined after {failures} retry-ladder "
+            f"exhaustion(s); next probe allowed in ~{retry_after_s:.0f}s")
+        self.plan_hash = plan_hash
+        self.failures = failures
+        self.retry_after_s = retry_after_s
+
+
+class QueryCancelledError(ServeError):
+    """The query was cancelled mid-flight (client disconnect, tenant
+    kill): its admission entry, session slot, and semaphore holds were
+    released through the cooperative deadline teardown."""
+
+    wire_fields = ("tenant", "reason")
+
+    def __init__(self, tenant: str, reason: str = "cancelled"):
+        super().__init__(
+            f"query for tenant '{tenant or '<default>'}' was cancelled: "
+            f"{reason}")
+        self.tenant = tenant
+        self.reason = reason
+
+
+class SessionCrashError(ServeError):
+    """A pooled session died mid-query (injected via the sessionCrash
+    serving fault, or a real executor death). The service tears the
+    session down via ``close()``, replaces it in the pool, and re-runs
+    the query ONCE if it is read-only (PR-4 rule: side-effecting plans
+    never re-execute)."""
+
+    wire_fields = ("session_id",)
+
+    def __init__(self, session_id: int, detail: str = ""):
+        msg = f"pooled session #{session_id} died mid-query"
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+        self.session_id = session_id
+
+
+class ServiceClosedError(ServeError):
+    """Submit after :meth:`~..serve.service.QueryService.close`."""
+
+    def __init__(self, detail: Optional[str] = None):
+        super().__init__(detail or "the query service is closed")

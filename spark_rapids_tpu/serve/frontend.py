@@ -1,0 +1,259 @@
+"""Loopback TCP/JSON front end for the query service (docs/serving.md).
+
+The wire-plane sibling of ``shuffle/net.py``: one process exposes its
+:class:`~.service.QueryService` over TCP so N independent clients (the
+serving bench's tenants, a dashboard, a test harness) drive it through a
+real socket. Protocol v1, deliberately simple:
+
+* handshake: server greets ``b"SRTQS" + version`` on accept; a client
+  that sees anything else disconnects (the ``net.py`` management-port
+  validation role).
+* requests/responses: one JSON object per line (UTF-8,
+  newline-delimited). Ops: ``query`` (``tenant``, ``query`` name,
+  optional ``collect`` to inline the result columns), ``stats``,
+  ``invalidate`` (``tenant``), ``ping``.
+* every query response carries ``rows`` and the CRC32C of the
+  Arrow-IPC-serialized result, so a client can assert bit-identity with
+  an oracle without shipping the data; ``collect: true`` adds the
+  columns as JSON lists.
+* typed service errors answer as ``{"ok": false, "error": <type>,
+  ...fields}`` (:meth:`~.errors.ServeError.to_wire`) and the connection
+  stays usable — a shed or quarantine is a RESPONSE, not a disconnect.
+
+Client disconnect mid-query is the serving layer's cancellation seam:
+while a query runs, the handler watches the socket; EOF cancels the
+query's :class:`~.service.QueryTicket`, which unwinds the admission
+entry, session slot, and semaphore holds through the cooperative
+deadline (the satellite-4 contract, tested in tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+import json
+import select
+import socket
+import socketserver
+import threading
+from typing import Optional, Tuple
+
+from ..utils import checksum as CK
+from ..utils import lockdep
+from ..utils.deadline import QueryDeadlineExceeded
+from .cache import _serialize
+from .errors import ServeError
+from .service import QueryService, QueryTicket
+
+MAGIC = b"SRTQS"
+VERSION = 1
+
+#: how often the handler polls for client EOF while a query runs
+_EOF_POLL_SECS = 0.05
+
+
+def _client_gone(sock: socket.socket) -> bool:
+    """EOF probe: readable + empty peek means the peer closed. Pending
+    request bytes (pipelining) peek non-empty and are left in place."""
+    try:
+        r, _, _ = select.select([sock], [], [], 0)
+        if not r:
+            return False
+        return sock.recv(1, socket.MSG_PEEK) == b""
+    except OSError:
+        return True
+
+
+def _wire_error(exc: BaseException) -> dict:
+    if isinstance(exc, ServeError):
+        return {"ok": False, **exc.to_wire()}
+    if isinstance(exc, QueryDeadlineExceeded):
+        return {"ok": False, "error": "QueryDeadlineExceeded",
+                "message": str(exc)}
+    # Anything else reaching the wire is a bug the chaos matrix asserts
+    # against — name it loudly rather than masking it as a generic 500.
+    return {"ok": False, "error": type(exc).__name__, "message": str(exc),
+            "unexpected": True}
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self):
+        self.request.sendall(MAGIC + bytes([VERSION]))
+        service: QueryService = self.server.service  # type: ignore
+        while True:
+            try:
+                line = self.rfile.readline()
+            except (ConnectionError, OSError):
+                return
+            if not line:
+                return
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                req = json.loads(line)
+            except ValueError:
+                if not self._send({"ok": False, "error": "BadRequest",
+                                   "message": "request is not JSON"}):
+                    return
+                continue
+            if not self._handle_one(service, req):
+                return
+
+    def _send(self, payload: dict) -> bool:
+        try:
+            # default=str: collected columns can carry date/decimal/etc.
+            # values json has no native encoding for — stringify rather
+            # than crash the handler (a response, never a disconnect).
+            self.wfile.write(
+                json.dumps(payload, default=str).encode("utf-8") + b"\n")
+            self.wfile.flush()
+            return True
+        except (ConnectionError, OSError):
+            return False
+
+    def _handle_one(self, service: QueryService, req: dict) -> bool:
+        op = req.get("op", "query")
+        if op == "ping":
+            return self._send({"ok": True, "op": "ping"})
+        if op == "stats":
+            return self._send({"ok": True, "stats": service.stats()})
+        if op == "invalidate":
+            n = service.invalidate(str(req.get("tenant", "")))
+            return self._send({"ok": True, "invalidated": n})
+        if op != "query":
+            return self._send({"ok": False, "error": "BadRequest",
+                               "message": f"unknown op {op!r}"})
+        tenant = str(req.get("tenant", ""))
+        name = req.get("query")
+        if not isinstance(name, str) or name not in service._queries:
+            return self._send({"ok": False, "error": "UnknownQuery",
+                               "message": f"no registered query {name!r}"})
+        ticket = QueryTicket()
+        done = threading.Event()
+        box: dict = {}
+        # The worker thread writes, the handler reads after done.wait();
+        # the lock makes the handoff explicit (and analyzable) rather
+        # than leaning on the Event's happens-before alone.
+        box_lock = lockdep.lock("ServeFrontend._box_lock")
+
+        def run():
+            from ..memory.retry import classify
+            try:
+                result = service.execute(tenant, name, ticket=ticket)
+                with box_lock:
+                    box["result"] = result
+            except BaseException as e:  # noqa: BLE001 - forwarded to wire
+                with box_lock:
+                    box["error"] = e
+                    box["class"] = classify(e)
+            finally:
+                done.set()
+        worker = threading.Thread(target=run, daemon=True,
+                                  name="tpu-serve-query")
+        worker.start()
+        while not done.wait(_EOF_POLL_SECS):
+            if _client_gone(self.request):
+                # THE cancellation seam: the client went away mid-query.
+                ticket.cancel("client disconnected")
+                done.wait()  # let the unwind finish before dropping
+                return False
+        err = box.get("error")
+        if err is not None:
+            return self._send(_wire_error(err))
+        res = box["result"]
+        # The cache already computed/verified the payload CRC; only a
+        # cache-disabled run pays a serialize here.
+        crc = res.crc32c if res.crc32c is not None \
+            else CK.crc32c(_serialize(res.table))
+        resp = {"ok": True, "query": name, "tenant": tenant,
+                "rows": res.table.num_rows, "cached": res.cached,
+                "wall_ms": round(res.wall_ms, 3),
+                "plan_hash": res.plan_hash, "query_id": res.query_id,
+                "crc32c": crc}
+        if req.get("collect"):
+            resp["data"] = {c: res.table.column(c).to_pylist()
+                            for c in res.table.column_names}
+        return self._send(resp)
+
+
+class ServeFrontend:
+    """Serves one process's QueryService over TCP (the NetShuffleServer
+    idiom: ``port=0`` picks a free port; ``address`` is what clients
+    dial)."""
+
+    def __init__(self, service: QueryService, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.service = service
+        self._srv = socketserver.ThreadingTCPServer(
+            (host, port), _Handler, bind_and_activate=True)
+        self._srv.daemon_threads = True
+        self._srv.service = service  # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._srv.serve_forever,
+                                        name="tpu-serve-frontend",
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._srv.server_address[:2]
+
+    def close(self):
+        self._srv.shutdown()
+        self._srv.server_close()
+
+
+class ServeClient:
+    """Minimal blocking JSON-lines client (tests, tools/serve_bench.py).
+    One connection, request/response; raises ConnectionError on a bad
+    handshake."""
+
+    def __init__(self, address: Tuple[str, int],
+                 connect_timeout: float = 5.0,
+                 request_timeout: Optional[float] = 120.0):
+        self.address = address
+        self._sock = socket.create_connection(address,
+                                              timeout=connect_timeout)
+        self._sock.settimeout(request_timeout)
+        greeting = self._recv_exact(len(MAGIC) + 1)
+        if greeting[:len(MAGIC)] != MAGIC or greeting[-1] != VERSION:
+            self._sock.close()
+            raise ConnectionError(
+                f"bad serve handshake from {address}: {greeting!r}")
+        self._buf = b""
+
+    def _recv_exact(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n:
+            chunk = self._sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("server closed")
+            out.extend(chunk)
+        return bytes(out)
+
+    def _roundtrip(self, req: dict) -> dict:
+        self._sock.sendall(json.dumps(req).encode("utf-8") + b"\n")
+        while b"\n" not in self._buf:
+            chunk = self._sock.recv(1 << 16)
+            if not chunk:
+                raise ConnectionError("server closed mid-response")
+            self._buf += chunk
+        line, _, self._buf = self._buf.partition(b"\n")
+        return json.loads(line)
+
+    def query(self, tenant: str, name: str, collect: bool = False) -> dict:
+        return self._roundtrip({"op": "query", "tenant": tenant,
+                                "query": name, "collect": collect})
+
+    def stats(self) -> dict:
+        return self._roundtrip({"op": "stats"})
+
+    def invalidate(self, tenant: str) -> dict:
+        return self._roundtrip({"op": "invalidate", "tenant": tenant})
+
+    def ping(self) -> dict:
+        return self._roundtrip({"op": "ping"})
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

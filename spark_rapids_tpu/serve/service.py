@@ -1,0 +1,559 @@
+"""The multi-tenant query service (ISSUE 12, docs/serving.md).
+
+:class:`QueryService` is the long-lived in-process front door: it pools
+``spark.rapids.tpu.serve.sessions`` warm :class:`~..session.TpuSession`
+instances (each loads the registered tables once, device-resident), and
+runs named or ad-hoc queries for many tenants concurrently with
+robustness enforced end to end:
+
+* **Admission** — a per-tenant weighted fair-share gate
+  (:class:`~..memory.semaphore.FairShareGate`) layered in FRONT of the
+  task semaphore: bounded queues shed overload as the typed
+  :class:`~.errors.ServiceOverloadedError` with a retry-after hint,
+  never unbounded queueing; stride scheduling keeps one tenant's burst
+  from starving another.
+* **Budgets** — per-tenant TIME budgets become one PR-7 cooperative
+  :class:`~..utils.deadline.Deadline` spanning queue wait AND execution
+  (including the whole PR-4 retry ladder); per-tenant MEMORY budgets are
+  enforced before each query by spilling the tenant's OWN device
+  residency through the PR-11 QoS victim order
+  (``BufferCatalog.spill_tenant_over_budget``) — over-budget degrades
+  the offender, never crashes or starves the neighbor.
+* **Circuit breaker** — a plan hash whose retry ladder exhausts
+  repeatedly is quarantined (:class:`~.breaker.CircuitBreaker`) and
+  rejected typed instead of re-admitted to burn the pool.
+* **Crash containment** — a pooled session that dies mid-query is torn
+  down via ``close()`` (idempotent, concurrent-closer safe), REPLACED in
+  the pool, and the query re-run once if read-only (PR-4 rule); its
+  neighbors see at worst the typed-transient pool-recreate blip.
+* **Result cache** — repeated plans are answered from the CRC-verified
+  :class:`~.cache.ResultCache` keyed by (tenant, PR-2 plan hash), with
+  tenant-scoped invalidation; a poisoned entry is detected on hit and
+  recomputed.
+
+Every serving seam is a deterministic fault-injection site
+(``serve.admission`` / ``serve.execute`` / ``serve.cache``; classes
+tenantKill / sessionCrash / cachePoison / admissionStall — see
+``utils/fault_injection.py``), so the whole matrix runs in tier-1 CI
+under ``TPU_LOCKDEP=1``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import Callable, Dict, List, Optional, Union
+
+import pyarrow as pa
+
+from ..config import (SERVE_MAX_CONCURRENT, SERVE_MAX_QUEUE_DEPTH,
+                      SERVE_QUARANTINE_FAILURES, SERVE_QUARANTINE_SECS,
+                      SERVE_RESULT_CACHE_ENTRIES, SERVE_SESSIONS,
+                      SERVE_SHED_RETRY_AFTER_SECS, SERVE_TENANT_MEMORY_BUDGET,
+                      SERVE_TENANT_TIME_BUDGET, SERVE_TENANT_WEIGHTS,
+                      TENANT_ID, TpuConf)
+from ..memory.semaphore import (AdmissionCancelled, AdmissionQueueFull,
+                                FairShareGate)
+from ..utils import lockdep
+from ..utils.deadline import Deadline, QueryDeadlineExceeded
+from ..utils.fault_injection import FaultInjector
+from .breaker import CircuitBreaker
+from .cache import ResultCache
+from .errors import (QueryCancelledError, QueryQuarantinedError, ServeError,
+                     ServiceClosedError, ServiceOverloadedError,
+                     SessionCrashError)
+
+#: injected in-queue stall length (kept small; CI matrices must stay fast)
+_ADMISSION_STALL_SECS = 0.05
+
+
+def parse_tenant_map(raw: Optional[str]) -> Dict[str, float]:
+    """Parse a ``'tenant:value,tenant:value'`` conf string (the
+    tenantWeights / tenant*Budget shape). Malformed entries are skipped —
+    a typo in one tenant's entry must not take the service down."""
+    out: Dict[str, float] = {}
+    for part in (raw or "").split(","):
+        part = part.strip()
+        if not part or ":" not in part:
+            continue
+        tenant, _, value = part.rpartition(":")
+        try:
+            out[tenant.strip()] = float(value)
+        except ValueError:
+            continue
+    return out
+
+
+def _budget_for(budgets: Dict[str, float], tenant: str) -> float:
+    return budgets.get(tenant, budgets.get("default", 0.0))
+
+
+class QueryTicket:
+    """Cancellable handle on one submitted query (the client-disconnect
+    primitive, docs/serving.md): :meth:`cancel` removes a still-queued
+    entry from the admission gate and forces the cooperative deadline of
+    a running query, so the semaphore slot, session, and any spill-lane
+    work unwind through the normal teardown path — nothing is killed
+    non-cooperatively."""
+
+    def __init__(self):
+        self.tenant = ""
+        self.cancelled = False
+        self.cancel_reason = ""
+        self._deadline: Optional[Deadline] = None
+        self._gate: Optional[FairShareGate] = None
+        self._waiter_box: List = []
+
+    def cancel(self, reason: str = "cancelled by client") -> None:
+        self.cancelled = True
+        self.cancel_reason = reason
+        dl = self._deadline
+        if dl is not None:
+            dl.cancel()
+        gate = self._gate
+        if gate is not None and self._waiter_box:
+            gate.cancel(self._waiter_box[0])
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One served query's result + attribution."""
+
+    table: pa.Table
+    tenant: str
+    plan_hash: str
+    cached: bool
+    wall_ms: float
+    query_id: Optional[int] = None
+    profile: object = None
+    #: CRC32C of the Arrow-IPC serialized result when the result cache
+    #: computed/verified it (None when caching is disabled) — the
+    #: frontend forwards it instead of re-serializing the table.
+    crc32c: Optional[int] = None
+
+
+class _PooledSlot:
+    """One warm session slot: the base session, its loaded tables, and
+    lazily derived per-tenant sessions (``tenantId`` stamped so QoS spill
+    ownership and profile attribution are per tenant)."""
+
+    def __init__(self, sid: int, base_conf: dict, tables: Dict[str, object],
+                 tenant_conf: Dict[str, dict]):
+        from ..session import TpuSession
+        self.sid = sid
+        self.generation = 0
+        self._base_conf = dict(base_conf)
+        self._tables = tables
+        self._tenant_conf = tenant_conf
+        self.session = TpuSession(dict(base_conf))
+        self.dfs: Dict[str, object] = {}
+        self._tenant_sessions: Dict[str, object] = {}
+        self._load_tables()
+
+    def _load_tables(self) -> None:
+        self.dfs = {}
+        for name, tbl in self._tables.items():
+            self.dfs[name] = self.session.create_dataframe(tbl).cache()
+
+    #: derived-session LRU bound per slot: the tenant string arrives
+    #: straight off the wire, so the cache must not grow with every
+    #: distinct id a client invents (evicted views are just dropped —
+    #: they share the base session's engine state, nothing to close)
+    _MAX_TENANT_SESSIONS = 64
+
+    def session_for(self, tenant: str):
+        sess = self._tenant_sessions.pop(tenant, None)
+        if sess is None:
+            overrides = {TENANT_ID.key: tenant}
+            overrides.update(self._tenant_conf.get(tenant, {}))
+            sess = self.session.with_conf(**overrides)
+        self._tenant_sessions[tenant] = sess  # re-insert: LRU touch
+        while len(self._tenant_sessions) > self._MAX_TENANT_SESSIONS:
+            self._tenant_sessions.pop(next(iter(self._tenant_sessions)))
+        return sess
+
+    def replace(self) -> None:
+        """Tear down the (crashed) session via close() and build a fresh
+        one in its place — crash containment's replace step. The old
+        session's close is the idempotent concurrent-safe one (ISSUE 12
+        satellite), so a reaper racing anything is fine."""
+        from ..session import TpuSession
+        old = self.session
+        try:
+            old.close()
+        except Exception as e:  # noqa: BLE001 - a dying session's close
+            # may throw anything; classify-and-log, never mask the replace
+            from ..memory.retry import classify
+            import logging
+            logging.getLogger(__name__).warning(
+                "close() of crashed session #%d raised %s (%s): %s",
+                self.sid, type(e).__name__, classify(e), e)
+        self.generation += 1
+        self._tenant_sessions = {}
+        self.session = TpuSession(dict(self._base_conf))
+        self._load_tables()
+
+    def close(self) -> None:
+        self._tenant_sessions = {}
+        self.session.close()
+
+
+class QueryService:
+    """See the module docstring. ``tables`` maps name -> pyarrow data
+    (loaded once per pooled session, device-resident); ``queries`` maps
+    name -> builder taking the dict of loaded DataFrames (the
+    ``workloads.tpch.QUERIES`` shape); ``tenant_conf`` adds per-tenant
+    session conf overrides (e.g. a fault-injection schedule for one
+    tenant only)."""
+
+    def __init__(self, conf: Optional[dict] = None,
+                 tables: Optional[Dict[str, object]] = None,
+                 queries: Optional[Dict[str, Callable]] = None,
+                 tenant_conf: Optional[Dict[str, dict]] = None):
+        self._conf_dict = dict(conf or {})
+        self.conf = TpuConf(self._conf_dict)
+        self._queries = dict(queries or {})
+        self._tenant_conf = dict(tenant_conf or {})
+        self._weights = parse_tenant_map(self.conf.get(SERVE_TENANT_WEIGHTS))
+        self._time_budgets = parse_tenant_map(
+            self.conf.get(SERVE_TENANT_TIME_BUDGET))
+        self._memory_budgets = parse_tenant_map(
+            self.conf.get(SERVE_TENANT_MEMORY_BUDGET))
+        n_sessions = max(1, int(self.conf.get(SERVE_SESSIONS)))
+        slots = int(self.conf.get(SERVE_MAX_CONCURRENT)) or n_sessions
+        self.gate = FairShareGate(
+            slots=slots,
+            max_depth=int(self.conf.get(SERVE_MAX_QUEUE_DEPTH)),
+            weights=self._weights,
+            retry_after_base_s=float(
+                self.conf.get(SERVE_SHED_RETRY_AFTER_SECS)))
+        self.breaker = CircuitBreaker(
+            int(self.conf.get(SERVE_QUARANTINE_FAILURES)),
+            float(self.conf.get(SERVE_QUARANTINE_SECS)))
+        self.cache = ResultCache(int(self.conf.get(SERVE_RESULT_CACHE_ENTRIES)))
+        #: the SERVICE's injector (serving seams); pooled sessions build
+        #: their own from the same conf for the engine-site schedules.
+        self._injector = FaultInjector.maybe(self.conf)
+        self._closed = False
+        self._stats_lock = lockdep.lock("QueryService._stats_lock")
+        self._stats = {"sessions_replaced": 0, "sessions_lost": 0,
+                       "crash_reruns": 0, "quarantine_trips": 0}
+        self._tenant_stats: Dict[str, Dict[str, int]] = {}
+        #: learned query-name -> plan hash (pre-admission breaker/cache
+        #: fast path; plan hashes are stable per PR-2)
+        self._plan_hashes: Dict[str, str] = {}
+        self._slots_cond = lockdep.condition("QueryService._slots_cond")
+        self._free_slots: List[_PooledSlot] = [
+            _PooledSlot(i, self._conf_dict, dict(tables or {}),
+                        self._tenant_conf)
+            for i in range(n_sessions)]
+        self._all_slots = list(self._free_slots)
+
+    # -- registration / lifecycle ------------------------------------------
+    def register_query(self, name: str, builder: Callable) -> None:
+        self._queries[name] = builder
+
+    def invalidate(self, tenant: str) -> int:
+        """Tenant-scoped result-cache invalidation (its data changed)."""
+        return self.cache.invalidate(tenant)
+
+    def close(self) -> None:
+        with self._slots_cond:
+            self._closed = True
+            self._slots_cond.notify_all()
+        for slot in self._all_slots:
+            slot.close()
+
+    # -- stats --------------------------------------------------------------
+
+    #: distinct tenants retained in the stats map — tenant ids arrive
+    #: off the wire, so the map is bounded (oldest evicted) rather than
+    #: an unbounded-growth vector in a long-lived process
+    _MAX_TENANT_STATS = 1024
+
+    def _tstat(self, tenant: str, name: str, value: int = 1) -> None:
+        with self._stats_lock:
+            t = self._tenant_stats.setdefault(tenant, {})
+            t[name] = t.get(name, 0) + value
+            while len(self._tenant_stats) > self._MAX_TENANT_STATS:
+                self._tenant_stats.pop(next(iter(self._tenant_stats)))
+
+    def stats(self) -> dict:
+        """Machine-readable counters: global, per-tenant, gate, breaker,
+        cache, and injected-fault tallies (tools/serve_bench.py emits
+        these into BENCH_serving.json)."""
+        with self._stats_lock:
+            out = {
+                **dict(self._stats),
+                "tenants": {t: dict(s)
+                            for t, s in self._tenant_stats.items()},
+            }
+        out["gate"] = dict(self.gate.stats)
+        out["breaker"] = dict(self.breaker.stats)
+        out["cache"] = dict(self.cache.stats)
+        if self._injector is not None:
+            out["injected"] = {k: v for k, v in self._injector.injected.items()
+                               if v}
+        return out
+
+    # -- slot pool ----------------------------------------------------------
+    def _borrow_slot(self, deadline: Optional[Deadline]) -> _PooledSlot:
+        with self._slots_cond:
+            while True:
+                if self._closed:
+                    raise ServiceClosedError()
+                if self._free_slots:
+                    return self._free_slots.pop()
+                if deadline is not None:
+                    # Bounded poll, even with an infinite (cancel-only)
+                    # deadline: a ticket.cancel() forces expiry but has
+                    # no handle on this condition to notify.
+                    deadline.check("serve.slot_wait")
+                    rem = deadline.remaining()
+                    self._slots_cond.wait(
+                        max(min(rem, 0.05), 0.005)
+                        if math.isfinite(rem) else 0.1)
+                else:
+                    self._slots_cond.wait()
+
+    def _return_slot(self, slot: _PooledSlot) -> None:
+        with self._slots_cond:
+            self._free_slots.append(slot)
+            self._slots_cond.notify_all()
+
+    # -- execution ----------------------------------------------------------
+    def _build_logical(self, query: Union[str, Callable], slot: _PooledSlot):
+        builder = self._queries[query] if isinstance(query, str) else query
+        df = builder(slot.dfs)
+        return df._plan
+
+    def _seam(self, site: str, classes) -> Optional[str]:
+        if self._injector is None:
+            return None
+        return self._injector.check_serve(site, classes)
+
+    def execute(self, tenant: str, query: Union[str, Callable],
+                read_only: bool = True,
+                ticket: Optional[QueryTicket] = None) -> ServeResult:
+        """Run one query for ``tenant`` — a registered name or a builder
+        callable taking the dict of loaded DataFrames. Blocks the
+        calling thread (the frontend gives each connection its own);
+        raises only TYPED errors (:mod:`.errors`,
+        ``QueryDeadlineExceeded`` for a spent budget). ``read_only=False``
+        marks a side-effecting query: it is never re-run after a session
+        crash (PR-4 write rule)."""
+        if self._closed:
+            raise ServiceClosedError()
+        t0 = time.perf_counter_ns()
+        ticket = ticket or QueryTicket()
+        ticket.tenant = tenant
+        tbudget = _budget_for(self._time_budgets, tenant)
+        deadline = Deadline(tbudget if tbudget > 0 else math.inf)
+        ticket._deadline = deadline
+        ticket._gate = self.gate
+        if ticket.cancelled:
+            # cancel() fired BEFORE the ticket was wired to this
+            # deadline (a client that disconnected between submit and
+            # here): honor it now or the cancellation is silently lost
+            # and the query runs to completion for a dead client.
+            deadline.cancel()
+        self._tstat(tenant, "submitted")
+        name = query if isinstance(query, str) else None
+        with self._stats_lock:
+            known_hash = self._plan_hashes.get(name) if name else None
+        #: the half-open probe this request currently OWNS (plan hash,
+        #: or None). note_success/note_failure consume it inside
+        #: _execute_admitted; any other exit (cache hit, shed, deadline,
+        #: cancel, crash-replace failure) releases it in the finally so
+        #: a quarantined plan can always be probed again.
+        probe_box = {"hash": None}
+        try:
+            if known_hash:
+                if self.breaker.check(known_hash):
+                    probe_box["hash"] = known_hash
+                # Side-effecting queries are never cached OR answered
+                # from cache: a memoized write would report success
+                # while silently skipping its side effect.
+                hit = self.cache.get_with_crc(tenant, known_hash) \
+                    if read_only else None
+                if hit is not None:
+                    self._tstat(tenant, "cache_hits")
+                    self._tstat(tenant, "completed")
+                    return ServeResult(
+                        hit[0], tenant, known_hash, cached=True,
+                        wall_ms=(time.perf_counter_ns() - t0) / 1e6,
+                        crc32c=hit[1])
+            flavor = self._seam("serve.admission",
+                                ("admissionStall", "tenantKill"))
+            if flavor == "admissionStall":
+                time.sleep(_ADMISSION_STALL_SECS)
+            elif flavor == "tenantKill":
+                ticket.cancel("injected tenant kill (queued)")
+            self.gate.acquire(tenant, deadline=deadline,
+                              waiter_out=ticket._waiter_box)
+            try:
+                return self._execute_admitted(tenant, query, name, t0,
+                                              read_only, ticket, deadline,
+                                              known_hash, probe_box)
+            finally:
+                self.gate.release()
+        except AdmissionQueueFull as e:
+            self._tstat(tenant, "shed")
+            raise ServiceOverloadedError(tenant, e.depth,
+                                         e.retry_after_s) from e
+        except QueryQuarantinedError:
+            self._tstat(tenant, "quarantine_rejects")
+            raise
+        except AdmissionCancelled as e:
+            self._tstat(tenant, "cancelled")
+            raise QueryCancelledError(
+                tenant, ticket.cancel_reason or str(e)) from e
+        except QueryDeadlineExceeded as e:
+            if ticket.cancelled:
+                self._tstat(tenant, "cancelled")
+                raise QueryCancelledError(tenant,
+                                          ticket.cancel_reason) from e
+            self._tstat(tenant, "budget_exceeded")
+            raise
+        finally:
+            if probe_box["hash"] is not None:
+                self.breaker.release_probe(probe_box["hash"])
+
+    def _execute_admitted(self, tenant: str, query, name: Optional[str],
+                          t0: int, read_only: bool, ticket: QueryTicket,
+                          deadline: Deadline, checked_hash: Optional[str],
+                          probe_box: dict) -> ServeResult:
+        from ..memory.retry import Classification, classify
+        from ..memory.spill import QosTag
+        from ..metrics.profile import plan_profile_hash
+        from ..utils.kernel_cache import plan_signature
+        attempts = 0
+        plan_hash = None
+        while True:
+            attempts += 1
+            slot = self._borrow_slot(deadline)
+            try:
+                mbudget = _budget_for(self._memory_budgets, tenant)
+                if mbudget > 0:
+                    moved = slot.session.device_manager.catalog \
+                        .spill_tenant_over_budget(
+                            tenant, int(mbudget),
+                            requester=QosTag(tenant=tenant,
+                                             deadline=deadline))
+                    if moved:
+                        self._tstat(tenant, "budget_spill_bytes", moved)
+                sess = slot.session_for(tenant)
+                logical = self._build_logical(query, slot)
+                physical = sess.plan(logical)
+                plan_hash = plan_profile_hash(plan_signature(physical))
+                if name:
+                    with self._stats_lock:
+                        self._plan_hashes[name] = plan_hash
+                # One breaker check per request: execute() already
+                # checked (and may have won the half-open probe on) the
+                # learned hash — re-checking the same hash here would
+                # see OUR OWN probe reservation and self-reject, wedging
+                # the plan in quarantine forever.
+                if plan_hash != checked_hash \
+                        and probe_box["hash"] != plan_hash:
+                    if self.breaker.check(plan_hash):
+                        if probe_box["hash"] is not None:
+                            # Stale probe on a superseded hash (the plan
+                            # changed under its name): hand it back.
+                            self.breaker.release_probe(probe_box["hash"])
+                        probe_box["hash"] = plan_hash
+                    checked_hash = plan_hash
+                hit = self.cache.get_with_crc(tenant, plan_hash) \
+                    if read_only else None
+                if hit is not None:
+                    self._tstat(tenant, "cache_hits")
+                    self._tstat(tenant, "completed")
+                    return ServeResult(
+                        hit[0], tenant, plan_hash, cached=True,
+                        wall_ms=(time.perf_counter_ns() - t0) / 1e6,
+                        crc32c=hit[1])
+                flavor = self._seam("serve.execute",
+                                    ("sessionCrash", "tenantKill"))
+                if flavor == "sessionCrash":
+                    raise SessionCrashError(slot.sid, "injected crash")
+                if flavor == "tenantKill":
+                    # Cancel THROUGH the cooperative deadline so the kill
+                    # exercises the same unwind a client disconnect does.
+                    ticket.cancel("injected tenant kill (running)")
+                profiles: List = []
+                table = sess.execute(logical, deadline=deadline,
+                                     profile_sink=profiles.append)
+            except SessionCrashError:
+                # Swap the slot out of the finally's return path FIRST:
+                # if the replacement itself fails, the dead slot must
+                # never go back to the pool.
+                dead, slot = slot, None
+                self._replace_slot(dead)
+                if read_only and attempts == 1:
+                    with self._stats_lock:
+                        self._stats["crash_reruns"] += 1
+                    self._tstat(tenant, "crash_reruns")
+                    continue
+                if plan_hash:
+                    if self.breaker.note_failure(plan_hash):
+                        self._note_quarantine(tenant)
+                    if probe_box["hash"] == plan_hash:
+                        probe_box["hash"] = None  # consumed by the failure
+                self._tstat(tenant, "crashed")
+                raise
+            except Exception as e:  # noqa: BLE001 - routed through classify
+                if isinstance(e, (ServeError, QueryDeadlineExceeded)):
+                    raise
+                if classify(e) == Classification.OOM:
+                    # An OOM surfacing HERE escaped the entire operator
+                    # and session retry ladder — the breaker's signal.
+                    self._tstat(tenant, "ladder_exhausted")
+                    if plan_hash:
+                        if self.breaker.note_failure(plan_hash):
+                            self._note_quarantine(tenant)
+                        if probe_box["hash"] == plan_hash:
+                            probe_box["hash"] = None
+                raise
+            finally:
+                if slot is not None:
+                    self._return_slot(slot)
+            self.breaker.note_success(plan_hash)
+            if probe_box["hash"] == plan_hash:
+                probe_box["hash"] = None  # consumed by the success
+            crc = self.cache.put(tenant, plan_hash, table) \
+                if read_only else None
+            if self._seam("serve.cache", ("cachePoison",)) == "cachePoison":
+                self.cache.poison(tenant, plan_hash)
+            self._tstat(tenant, "completed")
+            prof = profiles[0] if profiles else None
+            return ServeResult(
+                table, tenant, plan_hash, cached=False,
+                wall_ms=(time.perf_counter_ns() - t0) / 1e6,
+                query_id=getattr(prof, "query_id", None), profile=prof,
+                crc32c=crc)
+
+    def _replace_slot(self, slot: _PooledSlot) -> None:
+        """Crash containment: tear down + replace, then hand the FRESH
+        slot back to the pool (the crashed one never returns). A failed
+        REBUILD (not the victim's close — ``replace()`` guards that)
+        loses the slot rather than returning it half-dead, and surfaces
+        typed: the pool runs degraded until a restart, which beats every
+        later borrower failing on a closed session."""
+        try:
+            slot.replace()
+        except Exception as e:  # noqa: BLE001 - surfaced typed below
+            from ..memory.retry import classify
+            with self._stats_lock:
+                self._stats["sessions_lost"] += 1
+            raise SessionCrashError(
+                slot.sid, f"session replacement failed "
+                f"({classify(e)}): {e}") from e
+        with self._stats_lock:
+            self._stats["sessions_replaced"] += 1
+        self._return_slot(slot)
+
+    def _note_quarantine(self, tenant: str) -> None:
+        with self._stats_lock:
+            self._stats["quarantine_trips"] += 1
+        self._tstat(tenant, "quarantined")

@@ -72,10 +72,19 @@ class TpuSession:
         # (exec/pipeline.py, docs/tuning-guide.md).
         from .exec import pipeline as pipeline_layer
         pipeline_layer.configure(self.conf)
-        # Query-profile layer (metrics/, docs/monitoring.md).
+        # Query-profile layer (metrics/, docs/monitoring.md). Profiles
+        # key by QUERY ID (ISSUE 12): concurrent queries on one session
+        # (the serving pool) no longer clobber a single slot —
+        # last_query_profile() stays as the last-slot shim.
         self._last_profile = None
         self._query_seq = 0
         self._event_log = None
+        self._profiles = {}
+        from .utils import lockdep as _lockdep
+        self._profiles_lock = _lockdep.lock("TpuSession._profiles_lock")
+        # close() is idempotent and safe under concurrent callers — the
+        # serving pool's reaper may race an in-flight query (ISSUE 12).
+        self._close_lock = _lockdep.lock("TpuSession._close_lock")
         # Concurrency analysis layer (utils/lockdep.py,
         # docs/concurrency.md): the conf covers locks constructed from
         # here on (session-scoped catalogs, deadlines, registries); the
@@ -108,6 +117,10 @@ class TpuSession:
         s._last_profile = None
         s._query_seq = 0
         s._event_log = None
+        s._profiles = {}
+        from .utils import lockdep as _lockdep
+        s._profiles_lock = _lockdep.lock("TpuSession._profiles_lock")
+        s._close_lock = _lockdep.lock("TpuSession._close_lock")
         from .config import LOCKDEP_ENABLED
         if s.conf.get(LOCKDEP_ENABLED):
             from .utils import lockdep
@@ -119,13 +132,25 @@ class TpuSession:
         return s
 
     def close(self) -> None:
-        """Quiesce session-owned background machinery: join every shared
-        pipeline worker thread (exec/pipeline.py — the conftest leak
-        check asserts none survive close). The pool is process-wide and
-        lazily recreated, so a session used after close keeps working;
-        close only guarantees no pipeline thread is left running NOW."""
-        from .exec import pipeline as pipeline_layer
-        leaked = pipeline_layer.shutdown()
+        """Quiesce session-owned background machinery: drop queued
+        warm-ups and wait out the in-flight warm-up compile
+        (compile/warmup.quiesce), then join every shared pipeline worker
+        thread (exec/pipeline.py — the conftest leak check asserts none
+        survive close). The pool is process-wide and lazily recreated,
+        so a session used after close keeps working; close only
+        guarantees no pipeline thread is left running NOW.
+
+        Idempotent and safe under CONCURRENT callers (ISSUE 12): a pool
+        reaper racing an in-flight query serializes closers through
+        ``_close_lock``, both quiesce steps tolerate multiple closers,
+        and a query that loses the race sees the typed TRANSIENT
+        ``PoolShutdownError`` and retries onto the lazily recreated
+        pool — a neighbor's teardown is a non-event, not a failure."""
+        with self._close_lock:
+            from .compile import warmup as warmup_layer
+            from .exec import pipeline as pipeline_layer
+            warmup_layer.quiesce()
+            leaked = pipeline_layer.shutdown()
         if leaked:
             import logging
             logging.getLogger(__name__).warning(
@@ -234,7 +259,8 @@ class TpuSession:
     _MAX_LEARN_ATTEMPTS = 6
 
     def _run_with_retries(self, fn, eager_only: bool = False,
-                          plan_sig: Optional[tuple] = None):
+                          plan_sig: Optional[tuple] = None,
+                          deadline=None):
         """Run ``fn(ctx, mode) -> (result, overflowed)``; on a deferred join
         overflow, learn the exact output capacities from the run's observed
         match totals and retry with them (cached per plan signature).
@@ -257,8 +283,11 @@ class TpuSession:
         policy = R.RetryPolicy.from_conf(self.conf)
         # One deadline spans the WHOLE query including its retry ladder
         # (spark.rapids.tpu.query.deadlineSecs): re-running after a fault
-        # does not reset the user's wall-clock contract.
-        deadline = Deadline.maybe(self.conf)
+        # does not reset the user's wall-clock contract. The serving
+        # layer passes its own (per-tenant budget / cancellable) Deadline
+        # instead (serve/service.py, docs/serving.md).
+        if deadline is None:
+            deadline = Deadline.maybe(self.conf)
         cached = self._JOIN_CAP_CACHE.get(plan_sig) \
             if plan_sig is not None else None
         caps, dense_modes = (dict(cached[0]), dict(cached[1])) \
@@ -421,14 +450,21 @@ class TpuSession:
             return HostToDeviceExec(physical, self.conf.batch_size_rows)
         return physical
 
-    def execute(self, logical: L.LogicalPlan) -> pa.Table:
+    def execute(self, logical: L.LogicalPlan, deadline=None,
+                profile_sink=None) -> pa.Table:
         """Plan + run. Joins size their output optimistically with a
         deferred device-side overflow flag (no per-batch host syncs); when a
         flag trips the query re-runs with the EXACT capacities learned from
         the observed match totals (cached per plan signature, so the same
         query shape never pays the retry twice). Fusable device plans run
         as ONE compiled program (exec/fusion.py); mesh-capable plans as one
-        SPMD program (exec/mesh.py)."""
+        SPMD program (exec/mesh.py).
+
+        ``deadline`` overrides the conf-derived query deadline (the
+        serving layer passes its per-tenant budget / cancellable one);
+        ``profile_sink`` receives THIS query's QueryProfile — the
+        race-free way for a concurrent caller to get its own profile
+        instead of reading the last-slot shim (docs/serving.md)."""
         from .exec import fusion
         from .metrics.profile import QueryProfiler
         physical = self.plan(logical)
@@ -459,9 +495,10 @@ class TpuSession:
         sig = plan_signature(physical)
         result = self._run_with_retries(run,
                                         eager_only=_contains_write(physical),
-                                        plan_sig=sig)
+                                        plan_sig=sig, deadline=deadline)
         if profiler is not None and final.get("ctx") is not None:
-            self._note_profile(profiler, physical, final["ctx"], sig)
+            self._note_profile(profiler, physical, final["ctx"], sig,
+                               profile_sink)
         return result
 
     def materialize(self, logical: L.LogicalPlan) -> "L.CachedRelation":
@@ -522,29 +559,63 @@ class TpuSession:
         return physical.tree_string()
 
     # -- query-profile layer (metrics/, docs/monitoring.md) -----------------
-    def _note_profile(self, profiler, physical, ctx, plan_sig) -> None:
-        """Snapshot the finished query into the session's last profile and
-        the structured event log (best-effort: observability must never
-        fail a query)."""
+
+    #: profiles kept per session before the oldest query ids are evicted
+    _MAX_PROFILES = 256
+
+    def _note_profile(self, profiler, physical, ctx, plan_sig,
+                      profile_sink=None) -> None:
+        """Snapshot the finished query into the session's per-query-id
+        profile map, the last-slot shim, and the structured event log
+        (best-effort: observability must never fail a query). Query ids
+        are assigned under the profile lock — concurrent queries on one
+        session (the serving pool) each get their own id and slot
+        instead of clobbering a single field (ISSUE 12)."""
         try:
-            self._query_seq += 1
-            prof = profiler.finish(physical, ctx, plan_sig, self._query_seq)
+            with self._profiles_lock:
+                self._query_seq += 1
+                qid = self._query_seq
+            prof = profiler.finish(physical, ctx, plan_sig, qid)
         except Exception:  # noqa: BLE001 - profile is an aid, not a gate
             return
-        self._last_profile = prof
-        log_dir = self.conf.metrics_event_log_dir
-        if log_dir:
-            if self._event_log is None or self._event_log.dir != log_dir:
-                from .metrics.eventlog import EventLog
-                self._event_log = EventLog(log_dir)
-            self._event_log.append(prof)
+        with self._profiles_lock:
+            self._profiles[qid] = prof
+            while len(self._profiles) > self._MAX_PROFILES:
+                self._profiles.pop(next(iter(self._profiles)))
+            self._last_profile = prof
+            log_dir = self.conf.metrics_event_log_dir
+            log = None
+            if log_dir:
+                if self._event_log is None or self._event_log.dir != log_dir:
+                    from .metrics.eventlog import EventLog
+                    self._event_log = EventLog(log_dir)
+                log = self._event_log
+        if profile_sink is not None:
+            try:
+                profile_sink(prof)
+            except Exception:  # noqa: BLE001 - caller's sink, not a gate
+                pass
+        if log is not None:
+            log.append(prof)
+
+    def query_profile(self, query_id: int):
+        """The :class:`~spark_rapids_tpu.metrics.profile.QueryProfile`
+        recorded for ``query_id`` on this session, or None (evicted past
+        the retention window, metrics level NONE, or never run). The
+        race-free accessor for concurrent queries — each profile's
+        ``query_id`` field is the key."""
+        with self._profiles_lock:
+            return self._profiles.get(query_id)
 
     def last_query_profile(self):
         """The :class:`~spark_rapids_tpu.metrics.profile.QueryProfile` of
         the most recent query this session executed, or None (metrics level
         NONE, or nothing run yet). Render with ``.render()``; serialize
-        with ``.to_dict()``."""
-        return self._last_profile
+        with ``.to_dict()``. Under CONCURRENT queries this last-slot shim
+        is whichever finished most recently — use :meth:`query_profile`
+        (or ``execute``'s ``profile_sink``) for race-free attribution."""
+        with self._profiles_lock:
+            return self._last_profile
 
     def explain_metrics(self, logical: L.LogicalPlan) -> str:
         """The metric-annotated EXPLAIN tree (df.explain(metrics=True)):

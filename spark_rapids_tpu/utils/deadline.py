@@ -95,6 +95,18 @@ class Deadline:
         cooperative site oversleeps the deadline."""
         return max(0.0, min(float(seconds), self.remaining()))
 
+    def cancel(self) -> None:
+        """Force expiry NOW: every cooperative site's next :meth:`check`
+        raises. This is the client-disconnect / tenant-kill primitive of
+        the serving layer (serve/, docs/serving.md): a query whose
+        consumer went away is cancelled at the same cooperative points a
+        real deadline uses, so its semaphore slot, admission entry, and
+        spill-lane work unwind through the normal teardown path. A
+        serving deadline built with ``Deadline(math.inf)`` exists ONLY
+        for this — it never expires on its own."""
+        with self._lock:
+            self._deadline = min(self._deadline, time.monotonic() - 1e-9)
+
     def check(self, site: str, ctx=None, node: Optional[str] = None) -> None:
         """Attribute elapsed time to ``site``; raise
         :class:`QueryDeadlineExceeded` once expired. ``ctx``/``node``

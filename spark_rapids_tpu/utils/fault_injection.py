@@ -83,13 +83,21 @@ class InjectedDiskFault(InjectedFault, OSError):
 #: (shuffle/transport.py applies the returned flavor to its stream).
 NET_FAULT_CLASSES = ("peerDeath", "torn", "bitFlip", "stall")
 
+#: Serving-seam fault classes (ISSUE 12): what each does is implemented
+#: by the query service (serve/service.py applies the returned flavor at
+#: its seam — cancel the victim query, crash its pooled session, poison
+#: the just-stored cache entry, stall inside the admission queue).
+SERVE_FAULT_CLASSES = ("tenantKill", "sessionCrash", "cachePoison",
+                       "admissionStall")
+
 
 class FaultInjector:
     """Deterministic per-site fault schedule (see module doc)."""
 
     def __init__(self, seed: int, sites: str, oom_every_n: int,
                  transient_every_n: int, net_every_n: int = 0,
-                 net_faults: str = "", net_stall_secs: float = 0.05):
+                 net_faults: str = "", net_stall_secs: float = 0.05,
+                 serve_every_n: int = 0, serve_faults: str = ""):
         self.seed = int(seed)
         self.patterns = [s.strip() for s in sites.split(",") if s.strip()]
         self.oom_every_n = int(oom_every_n)
@@ -99,11 +107,16 @@ class FaultInjector:
             f for f in (s.strip() for s in (net_faults or "").split(","))
             if f in NET_FAULT_CLASSES) or NET_FAULT_CLASSES
         self.net_stall_secs = float(net_stall_secs)
+        self.serve_every_n = int(serve_every_n)
+        self.serve_faults = tuple(
+            f for f in (s.strip() for s in (serve_faults or "").split(","))
+            if f in SERVE_FAULT_CLASSES) or SERVE_FAULT_CLASSES
         self._counters: Dict[str, int] = {}
         self._lock = lockdep.lock("FaultInjector._lock")
         #: injected-fault tallies by flavor (test assertions read these)
         self.injected = {"oom": 0, "transient": 0, "disk": 0}
         self.injected.update({f"net.{c}": 0 for c in NET_FAULT_CLASSES})
+        self.injected.update({f"serve.{c}": 0 for c in SERVE_FAULT_CLASSES})
 
     @classmethod
     def maybe(cls, conf) -> Optional["FaultInjector"]:
@@ -114,7 +127,10 @@ class FaultInjector:
                               FAULT_INJECTION_NET_FAULTS,
                               FAULT_INJECTION_NET_STALL_SECS,
                               FAULT_INJECTION_OOM_EVERY_N,
-                              FAULT_INJECTION_SEED, FAULT_INJECTION_SITES,
+                              FAULT_INJECTION_SEED,
+                              FAULT_INJECTION_SERVE_EVERY_N,
+                              FAULT_INJECTION_SERVE_FAULTS,
+                              FAULT_INJECTION_SITES,
                               FAULT_INJECTION_TRANSIENT_EVERY_N)
         if not hasattr(conf, "get"):
             return None
@@ -126,13 +142,16 @@ class FaultInjector:
             net_n = int(conf.get(FAULT_INJECTION_NET_EVERY_N))
             net_faults = conf.get(FAULT_INJECTION_NET_FAULTS) or ""
             net_stall = float(conf.get(FAULT_INJECTION_NET_STALL_SECS))
+            serve_n = int(conf.get(FAULT_INJECTION_SERVE_EVERY_N))
+            serve_faults = conf.get(FAULT_INJECTION_SERVE_FAULTS) or ""
         except (AttributeError, TypeError):
             return None
         if not sites.strip() \
-                or (oom_n == 0 and transient_n == 0 and net_n == 0):
+                or (oom_n == 0 and transient_n == 0 and net_n == 0
+                    and serve_n == 0):
             return None
         return cls(seed, sites, oom_n, transient_n, net_n, net_faults,
-                   net_stall)
+                   net_stall, serve_n, serve_faults)
 
     def matches(self, site: str) -> bool:
         for p in self.patterns:
@@ -181,6 +200,35 @@ class FaultInjector:
                 f"injected spill-disk I/O failure at {site} (visit {n})")
         raise InjectedTransient(
             f"injected remote_compile helper race at {site} (visit {n})")
+
+    def check_serve(self, site: str, classes=SERVE_FAULT_CLASSES
+                    ) -> Optional[str]:
+        """Count one visit of a SERVING seam; return the fault class
+        scheduled for this visit, or None. ``classes`` restricts the
+        flavors valid at this seam (admissionStall only makes sense in
+        the admission path, cachePoison only at a cache store, ...) — a
+        seam where no configured flavor applies never faults, and the
+        deterministic schedule depends only on (site, visit, seed). Like
+        :meth:`check_net` this does not raise: the query service applies
+        the class at its own seam (cancel the victim, crash the pooled
+        session, corrupt the stored entry, stall in the queue) so the
+        failure arrives through the exact path the real event would
+        take (serve/service.py, docs/serving.md)."""
+        if self.serve_every_n == 0 or not self.matches(site):
+            return None
+        eligible = tuple(f for f in self.serve_faults if f in classes)
+        if not eligible:
+            return None
+        with self._lock:
+            n = self._counters.get(site, 0) + 1
+            self._counters[site] = n
+            if not self._scheduled(n, self.serve_every_n):
+                return None
+            flavor = eligible[
+                zlib.crc32(f"serve:{site}:{n}:{self.seed}".encode())
+                % len(eligible)]
+            self.injected[f"serve.{flavor}"] += 1
+            return flavor
 
     def check_net(self, site: str) -> Optional[str]:
         """Count one visit of a TRANSPORT site; return the network fault

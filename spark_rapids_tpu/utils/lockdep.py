@@ -72,9 +72,12 @@ def enabled() -> bool:
 def enable(on: bool = True) -> None:
     """Flip the construction-time gate (session conf / tests). Locks
     already constructed keep whatever they are; the env var is the only
-    switch that covers module-level locks."""
+    switch that covers module-level locks. Callable from concurrent
+    session constructors (the serving pool): the write goes through
+    ``_GUARD`` like the rest of the global instrumentation state."""
     global _ENABLED
-    _ENABLED = bool(on)
+    with _GUARD:
+        _ENABLED = bool(on)
 
 
 # ---------------------------------------------------------------------------

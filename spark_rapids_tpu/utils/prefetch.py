@@ -113,8 +113,10 @@ def prefetch_iter(src: Iterable, depth: int = 2, ctx=None,
                             # shut down under a live iteration (a
                             # concurrent TpuSession.close). Truncating
                             # silently would return wrong results — fail
-                            # loudly instead.
-                            raise RuntimeError(
+                            # loudly with the typed TRANSIENT signal so
+                            # the retry ladder re-runs onto the lazily
+                            # recreated pool.
+                            raise _pipeline.PoolShutdownError(
                                 "pipeline pool shut down while this "
                                 "prefetch stream was still being "
                                 "consumed (TpuSession.close() during a "

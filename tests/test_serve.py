@@ -1,0 +1,1334 @@
+"""Multi-tenant query service tests (ISSUE 12, docs/serving.md).
+
+The serving matrix runs — like all of tier-1 — under ``TPU_LOCKDEP=1``
+(tests/conftest.py), so every schedule these tests drive is also a
+lockdep-supervised proof of the serving layer's locking discipline:
+any inversion, self-deadlock, or hold-across-blocking recorded while a
+pool reaper races an in-flight query fails the suite.
+
+Layers:
+
+* **Unit** — FairShareGate (weighted stride admission, bounded-depth
+  shed, cancel, deadline-spent queue wait), CircuitBreaker (trip,
+  half-open probe, recovery), ResultCache (CRC-verified hits, LRU,
+  tenant-scoped invalidation, poison-degrades-to-miss), per-tenant
+  budget spill on the BufferCatalog (own buffers only).
+* **Serving smoke (the tier-1 gate)** — 2 tenants x q1/q6 concurrent on
+  a pooled service, every result bit-identical to the serial oracle.
+* **Chaos matrix** — serving-seam fault injection (tenantKill,
+  sessionCrash, cachePoison, admissionStall) plus engine OOM ladders:
+  survivors bit-identical, overload/quarantine/cancel answered TYPED
+  (never a crash, hang, or cross-tenant error), replace/shed/quarantine
+  counters observable.
+* **Satellites** — per-query-id profiles, concurrent-close safety,
+  tenant-stamped profiles/event log, client disconnect mid-query,
+  except-too-broad lint over serve/ with zero grandfathered sites.
+"""
+
+import json
+import math
+import threading
+import time
+
+import pytest
+
+from spark_rapids_tpu.serve import (QueryCancelledError,
+                                    QueryQuarantinedError, QueryService,
+                                    QueryTicket, ResultCache,
+                                    ServeClient, ServeFrontend,
+                                    ServiceClosedError,
+                                    ServiceOverloadedError,
+                                    SessionCrashError)
+from spark_rapids_tpu.serve.breaker import CircuitBreaker
+from spark_rapids_tpu.serve.service import parse_tenant_map
+from spark_rapids_tpu.memory.semaphore import (AdmissionCancelled,
+                                               AdmissionQueueFull,
+                                               FairShareGate)
+from spark_rapids_tpu.utils import lockdep
+from spark_rapids_tpu.utils.deadline import Deadline, QueryDeadlineExceeded
+
+ROWS = 1024
+SMOKE_QUERIES = ("q1", "q6")
+
+
+def _wait_until(pred, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.005)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+@pytest.fixture(scope="module")
+def tpch_tables():
+    from spark_rapids_tpu.workloads import tpch
+    return tpch.gen_tables(ROWS, seed=7)
+
+
+@pytest.fixture(scope="module")
+def oracle(tpch_tables):
+    """Serial oracle: each query run alone on a plain session — the
+    bit-identity reference for every served result."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.workloads import tpch
+    s = TpuSession({"spark.rapids.sql.enabled": True})
+    dfs = tpch.load(s, tpch_tables)
+    out = {q: tpch.QUERIES[q](dfs).collect() for q in SMOKE_QUERIES}
+    s.close()
+    return out
+
+
+def _service(tpch_tables, conf=None, queries=SMOKE_QUERIES, **kw):
+    from spark_rapids_tpu.workloads import tpch
+    base = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.serve.sessions": 2}
+    base.update(conf or {})
+    return QueryService(conf=base, tables=tpch_tables,
+                        queries={q: tpch.QUERIES[q] for q in queries}, **kw)
+
+
+# ---------------------------------------------------------------------------
+# FairShareGate
+# ---------------------------------------------------------------------------
+
+
+class TestFairShareGate:
+    def test_acquire_release_counts_slots(self):
+        g = FairShareGate(slots=2, max_depth=4)
+        g.acquire("a")
+        g.acquire("b")
+        assert g.stats["admitted"] == 2
+        assert g.stats["peak_concurrent"] == 2
+        g.release()
+        g.release()
+        g.acquire("a")
+        g.release()
+        assert g.stats["admitted"] == 3
+
+    def test_full_tenant_queue_sheds_typed_with_retry_after(self):
+        g = FairShareGate(slots=1, max_depth=1, retry_after_base_s=0.2)
+        g.acquire("hold")
+        queued = threading.Thread(target=g.acquire, args=("a",), daemon=True)
+        queued.start()
+        _wait_until(lambda: g.depth("a") == 1, msg="waiter queued")
+        with pytest.raises(AdmissionQueueFull) as ei:
+            g.acquire("a")
+        assert ei.value.retry_after_s > 0
+        assert ei.value.tenant == "a"
+        assert g.stats["shed"] == 1
+        # The shed never consumed depth or a slot: the queued waiter is
+        # still first in line and gets the released slot.
+        g.release()
+        queued.join(5)
+        assert not queued.is_alive()
+        assert g.depth() == 0
+
+    def test_weighted_stride_admission_order(self):
+        """Weight-2 tenant 'a' is granted twice as often as weight-1 'b'
+        under contention (deterministic stride schedule)."""
+        g = FairShareGate(slots=1, max_depth=8, weights={"a": 2.0})
+        order = []
+
+        def waiter(tenant):
+            g.acquire(tenant)
+            order.append(tenant)
+            g.release()
+
+        g.acquire("hold")
+        threads = []
+        for tenant, n in (("a", 4), ("b", 4)):
+            for i in range(n):
+                t = threading.Thread(target=waiter, args=(tenant,),
+                                     daemon=True)
+                t.start()
+                threads.append(t)
+                _wait_until(lambda t=tenant, i=i: g.depth(t) == i + 1,
+                            msg=f"{tenant} waiter {i} queued")
+        g.release()
+        for t in threads:
+            t.join(10)
+            assert not t.is_alive(), "gate admission deadlocked"
+        assert len(order) == 8
+        # Stride: a pays 1/2 per grant, b pays 1 — among the first six
+        # grants a lands four (a,b,a,a,b,a), then b drains.
+        assert order[:6].count("a") == 4
+        assert sorted(order[6:]) == ["b", "b"]
+
+    def test_returning_tenant_burst_joins_at_floor_not_zero(self):
+        """Regression: a returning tenant (pass gc'd to zero) whose
+        BURST kept its queue nonempty used to drag the grant-time floor
+        down to its own stale pass and monopolize the gate until it
+        caught up. The floor is applied at enqueue now: the burst joins
+        at the queued field's pass level and interleaves."""
+        g = FairShareGate(slots=1, max_depth=8)
+        order = []
+        evs = {}
+
+        def waiter(tenant, tag):
+            g.acquire(tenant)
+            order.append(tag)
+            evs[tag].wait(10)
+            g.release()
+
+        g.acquire("hold")
+        threads = []
+
+        def spawn(tenant, tag):
+            evs[tag] = threading.Event()
+            t = threading.Thread(target=waiter, args=(tenant, tag),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+
+        for i in range(5):
+            spawn("a", f"a{i}")
+            _wait_until(lambda i=i: g.depth("a") == i + 1,
+                        msg=f"a{i} queued")
+        g.release()  # a0 granted, holds
+        for i in range(3):
+            _wait_until(lambda i=i: len(order) == i + 1,
+                        msg=f"a{i} granted")
+            evs[f"a{i}"].set()  # next a grant; a's pass advances
+        _wait_until(lambda: len(order) == 4, msg="a3 granted")
+        # a's pass is now 4.0 with a4 still queued; tenant b RETURNS
+        # with a burst of 3 — it must join at the floor (4.0), not 0.
+        for i in range(3):
+            spawn("b", f"b{i}")
+            _wait_until(lambda i=i: g.depth("b") == i + 1,
+                        msg=f"b{i} queued")
+        evs["a3"].set()
+        _wait_until(lambda: len(order) == 5, msg="post-burst grant")
+        # The old bug granted b0 here (b's pass 0 < a's 4): b's burst
+        # starved the steadily-queued tenant. Now the tie at 4.0 goes
+        # to a4 and the burst interleaves behind it.
+        assert order[4] == "a4", \
+            f"returning burst monopolized the gate: {order}"
+        for tag, ev in evs.items():
+            ev.set()
+        for t in threads:
+            t.join(10)
+            assert not t.is_alive()
+
+    def test_cancel_queued_waiter_releases_entry(self):
+        g = FairShareGate(slots=1, max_depth=4)
+        g.acquire("hold")
+        box, err = [], []
+
+        def waiter():
+            try:
+                g.acquire("a", waiter_out=box)
+            except AdmissionCancelled as e:
+                err.append(e)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        _wait_until(lambda: box and g.depth("a") == 1, msg="waiter queued")
+        g.cancel(box[0])
+        t.join(5)
+        assert not t.is_alive()
+        assert len(err) == 1
+        assert g.depth() == 0
+        assert g.stats["cancelled"] == 1
+        # The slot was never consumed by the cancelled waiter.
+        g.release()
+        g.acquire("b")
+        g.release()
+
+    def test_deadline_spent_in_queue_raises_and_unwinds(self):
+        g = FairShareGate(slots=1, max_depth=4)
+        g.acquire("hold")
+        with pytest.raises(QueryDeadlineExceeded):
+            g.acquire("a", deadline=Deadline(0.05))
+        assert g.depth() == 0
+        g.release()
+        g.acquire("a")  # slot accounting intact
+        g.release()
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class TestCircuitBreaker:
+    def test_trips_after_max_failures_and_rejects_typed(self):
+        b = CircuitBreaker(max_failures=2, quarantine_secs=300.0)
+        b.check("p1")
+        assert not b.note_failure("p1")
+        assert b.note_failure("p1")  # second failure trips
+        with pytest.raises(QueryQuarantinedError) as ei:
+            b.check("p1")
+        assert ei.value.plan_hash == "p1"
+        assert ei.value.failures == 2
+        assert 0 < ei.value.retry_after_s <= 300.0
+        assert b.stats["quarantined"] == 1
+        assert b.stats["rejected"] == 1
+        assert b.quarantined() == ["p1"]
+        # Other plans are unaffected.
+        b.check("p2")
+
+    def test_half_open_probe_success_closes_circuit(self):
+        b = CircuitBreaker(max_failures=1, quarantine_secs=0.05)
+        b.note_failure("p")
+        with pytest.raises(QueryQuarantinedError):
+            b.check("p")
+        time.sleep(0.06)
+        b.check("p")  # the ONE half-open probe
+        with pytest.raises(QueryQuarantinedError):
+            b.check("p")  # second caller keeps rejecting until it reports
+        b.note_success("p")
+        b.check("p")  # circuit closed
+        assert b.stats["probes"] == 1
+        assert b.stats["recovered"] == 1
+
+    def test_probe_failure_rearms_the_window(self):
+        b = CircuitBreaker(max_failures=1, quarantine_secs=0.05)
+        b.note_failure("p")
+        time.sleep(0.06)
+        b.check("p")  # probe admitted
+        b.note_failure("p")  # probe failed -> full window re-arms
+        with pytest.raises(QueryQuarantinedError):
+            b.check("p")
+
+    def test_disabled_breaker_never_rejects(self):
+        b = CircuitBreaker(max_failures=0, quarantine_secs=1.0)
+        for _ in range(5):
+            assert not b.note_failure("p")
+        b.check("p")
+
+    def test_check_returns_probe_ownership(self):
+        b = CircuitBreaker(max_failures=1, quarantine_secs=0.05)
+        assert b.check("p") is False  # healthy plan: nobody is a probe
+        b.note_failure("p")
+        time.sleep(0.06)
+        assert b.check("p") is True  # this caller IS the half-open probe
+
+    def test_release_probe_hands_it_to_the_next_caller(self):
+        """A probe winner that never ran the plan (cache hit, shed,
+        disconnect) hands the probe back — without release_probe the
+        plan would be rejected forever."""
+        b = CircuitBreaker(max_failures=1, quarantine_secs=0.05)
+        b.note_failure("p")
+        time.sleep(0.06)
+        assert b.check("p") is True
+        with pytest.raises(QueryQuarantinedError):
+            b.check("p")  # reserved: others still rejected
+        b.release_probe("p")
+        assert b.stats["probes_released"] == 1
+        assert b.check("p") is True  # the NEXT caller can probe
+        b.note_success("p")
+        assert b.check("p") is False
+
+
+# ---------------------------------------------------------------------------
+# ResultCache
+# ---------------------------------------------------------------------------
+
+
+def _table(seed=0):
+    import pyarrow as pa
+    return pa.table({"k": list(range(seed, seed + 50)),
+                     "v": [float(i) * 1.5 for i in range(50)]})
+
+
+class TestResultCache:
+    def test_roundtrip_bit_identical(self):
+        c = ResultCache(4)
+        t = _table()
+        c.put("a", "p1", t)
+        got = c.get("a", "p1")
+        assert got is not None and got.equals(t)
+        assert c.stats["hits"] == 1
+
+    def test_tenant_scoped_keys_and_invalidation(self):
+        c = ResultCache(8)
+        c.put("a", "p1", _table(1))
+        c.put("b", "p1", _table(2))
+        assert c.get("b", "p1").equals(_table(2))  # never a's entry
+        assert c.invalidate("a") == 1
+        assert c.get("a", "p1") is None
+        assert c.get("b", "p1") is not None  # untouched
+
+    def test_lru_eviction(self):
+        c = ResultCache(2)
+        c.put("a", "p1", _table(1))
+        c.put("a", "p2", _table(2))
+        assert c.get("a", "p1") is not None  # touch p1 -> p2 is LRU
+        c.put("a", "p3", _table(3))
+        assert c.stats["evicted"] == 1
+        assert c.get("a", "p2") is None
+        assert c.get("a", "p1") is not None
+
+    def test_poisoned_entry_degrades_to_miss_never_wrong_answer(self):
+        c = ResultCache(4)
+        c.put("a", "p1", _table())
+        assert c.poison("a", "p1")
+        assert c.get("a", "p1") is None  # CRC catches the flip
+        assert c.stats["corrupt_dropped"] == 1
+        assert len(c) == 0  # dropped, so the caller's recompute re-fills
+
+    def test_disabled_cache(self):
+        c = ResultCache(0)
+        c.put("a", "p1", _table())
+        assert c.get("a", "p1") is None
+        assert len(c) == 0
+
+
+class TestTenantMap:
+    def test_parse_shapes(self):
+        assert parse_tenant_map("a:2,b:0.5") == {"a": 2.0, "b": 0.5}
+        assert parse_tenant_map(" default:30 , x:1 ") == {"default": 30.0,
+                                                          "x": 1.0}
+        assert parse_tenant_map("") == {}
+        assert parse_tenant_map(None) == {}
+
+    def test_malformed_entries_are_skipped_not_fatal(self):
+        assert parse_tenant_map("a:2,junk,b:notanumber,c:3") == {"a": 2.0,
+                                                                 "c": 3.0}
+
+
+# ---------------------------------------------------------------------------
+# Per-tenant memory budget spill (BufferCatalog)
+# ---------------------------------------------------------------------------
+
+
+class TestTenantBudgetSpill:
+    def _batch(self, n=200, seed=0):
+        import numpy as np
+        from spark_rapids_tpu.data.batch import HostBatch
+        rng = np.random.default_rng(seed)
+        return HostBatch.from_pydict({
+            "a": rng.integers(-1000, 1000, n).tolist(),
+            "b": rng.random(n).tolist(),
+        }).to_device()
+
+    def test_over_budget_spills_own_buffers_only(self):
+        from spark_rapids_tpu.memory import spill as SP
+        b = self._batch(seed=1)
+        size = b.device_size_bytes
+        cat = SP.BufferCatalog(1 << 30, 1 << 30, io_threads=0)
+        a_tag = SP.QosTag(tenant="a")
+        b_tag = SP.QosTag(tenant="b")
+        own1 = cat.register_batch(b, owner=a_tag)
+        own2 = cat.register_batch(self._batch(seed=2), owner=a_tag)
+        neighbor = cat.register_batch(self._batch(seed=3), owner=b_tag)
+        assert cat.tenant_device_bytes("a") == 2 * size
+        moved = cat.spill_tenant_over_budget("a", int(size * 1.5),
+                                             requester=a_tag)
+        assert moved == size
+        assert cat.tenant_device_bytes("a") <= int(size * 1.5)
+        # The neighbor's residency was never a candidate.
+        assert cat.tenant_device_bytes("b") == size
+        # Spilled data restores bit-identically.
+        for bid, seed in ((own1, 1), (own2, 2), (neighbor, 3)):
+            got = cat.acquire_batch(bid)
+            assert got.to_arrow().equals(self._batch(seed=seed).to_arrow())
+        cat.close()
+
+    def test_under_budget_is_a_no_op(self):
+        from spark_rapids_tpu.memory import spill as SP
+        b = self._batch(seed=1)
+        cat = SP.BufferCatalog(1 << 30, 1 << 30, io_threads=0)
+        cat.register_batch(b, owner=SP.QosTag(tenant="a"))
+        assert cat.spill_tenant_over_budget("a", 1 << 30) == 0
+        assert cat.spill_tenant_over_budget("never-seen", 0) == 0
+        cat.close()
+
+
+# ---------------------------------------------------------------------------
+# The tier-1 serving smoke: 2 tenants x q1/q6 concurrent == serial oracle
+# ---------------------------------------------------------------------------
+
+
+class TestServingSmoke:
+    def test_two_tenants_concurrent_bit_identical_to_serial_oracle(
+            self, tpch_tables, oracle):
+        svc = _service(tpch_tables)
+        results, errs = {}, []
+
+        def run(tenant, q, key):
+            try:
+                results[key] = svc.execute(tenant, q)
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append((key, e))
+
+        try:
+            threads = [
+                threading.Thread(target=run, args=(t, q, (t, q)),
+                                 daemon=True)
+                for t in ("tenantA", "tenantB") for q in SMOKE_QUERIES]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+                assert not t.is_alive(), "serving smoke hung"
+            assert errs == []
+            for (tenant, q), res in results.items():
+                assert res.table.equals(oracle[q]), \
+                    f"{tenant}/{q} diverged from the serial oracle"
+                assert res.tenant == tenant
+                assert res.plan_hash
+            stats = svc.stats()
+            assert stats["gate"]["admitted"] == 4
+            for tenant in ("tenantA", "tenantB"):
+                assert stats["tenants"][tenant]["completed"] == 2
+        finally:
+            svc.close()
+
+    def test_repeat_plan_served_from_cache_and_invalidated(
+            self, tpch_tables, oracle):
+        svc = _service(tpch_tables,
+                       conf={"spark.rapids.tpu.serve.sessions": 1})
+        try:
+            first = svc.execute("a", "q6")
+            hit = svc.execute("a", "q6")
+            assert not first.cached and hit.cached
+            assert hit.table.equals(oracle["q6"])
+            # Cache keys are tenant-scoped: b's first run is a miss.
+            other = svc.execute("b", "q6")
+            assert not other.cached
+            assert svc.invalidate("a") >= 1
+            again = svc.execute("a", "q6")
+            assert not again.cached
+            assert again.table.equals(oracle["q6"])
+        finally:
+            svc.close()
+
+    def test_profile_attribution_per_tenant(self, tpch_tables):
+        svc = _service(tpch_tables)
+        try:
+            res = svc.execute("tenant-42", "q6")
+            assert res.profile is not None
+            assert res.profile.tenant == "tenant-42"
+            assert res.query_id == res.profile.query_id
+        finally:
+            svc.close()
+
+    def test_side_effecting_queries_never_touch_the_result_cache(
+            self, tpch_tables, oracle):
+        """A memoized WRITE would report success while silently skipping
+        its side effect — read_only=False skips both cache store and
+        cache serve (the cache twin of the PR-4 never-re-run rule)."""
+        svc = _service(tpch_tables,
+                       conf={"spark.rapids.tpu.serve.sessions": 1})
+        try:
+            first = svc.execute("a", "q6", read_only=False)
+            assert not first.cached
+            assert svc.cache.stats["puts"] == 0  # never stored
+            again = svc.execute("a", "q6", read_only=False)
+            assert not again.cached  # re-EXECUTED, not memoized
+            assert again.table.equals(oracle["q6"])
+            # A read-only run of the same plan caches normally.
+            ro = svc.execute("a", "q6")
+            assert not ro.cached and svc.cache.stats["puts"] == 1
+            assert svc.execute("a", "q6").cached
+        finally:
+            svc.close()
+
+    def test_submit_after_close_is_typed(self, tpch_tables):
+        svc = _service(tpch_tables,
+                       conf={"spark.rapids.tpu.serve.sessions": 1})
+        svc.close()
+        with pytest.raises(ServiceClosedError):
+            svc.execute("a", "q6")
+
+
+# ---------------------------------------------------------------------------
+# Budgets + overload
+# ---------------------------------------------------------------------------
+
+
+class TestBudgetsAndOverload:
+    def test_time_budget_exceeded_is_typed_and_neighbor_survives(
+            self, tpch_tables, oracle):
+        svc = _service(tpch_tables, conf={
+            "spark.rapids.tpu.serve.tenantTimeBudgetSecs":
+                "broke:0.000001,default:0",
+        })
+        try:
+            with pytest.raises(QueryDeadlineExceeded):
+                svc.execute("broke", "q6")
+            assert svc.stats()["tenants"]["broke"]["budget_exceeded"] == 1
+            # The neighbor (unbudgeted) is untouched by broke's failure.
+            res = svc.execute("rich", "q6")
+            assert res.table.equals(oracle["q6"])
+        finally:
+            svc.close()
+
+    def test_overload_sheds_typed_with_retry_after(self, tpch_tables,
+                                                   oracle):
+        svc = _service(tpch_tables, conf={
+            "spark.rapids.tpu.serve.sessions": 1,
+            "spark.rapids.tpu.serve.maxQueueDepth": 1,
+        })
+        release = threading.Event()
+
+        def slow_builder(dfs):
+            release.wait(10)
+            from spark_rapids_tpu.workloads import tpch
+            return tpch.QUERIES["q6"](dfs)
+
+        out, errs = [], []
+
+        def submit(query, sink):
+            try:
+                sink.append(svc.execute("a", query))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        try:
+            holder = threading.Thread(target=submit,
+                                      args=(slow_builder, out), daemon=True)
+            holder.start()
+            _wait_until(lambda: svc.gate.stats["admitted"] == 1,
+                        msg="holder admitted")
+            queued = threading.Thread(target=submit, args=("q6", out),
+                                      daemon=True)
+            queued.start()
+            _wait_until(lambda: svc.gate.depth("a") == 1,
+                        msg="second query queued")
+            # Queue full -> the third submit sheds TYPED, immediately.
+            with pytest.raises(ServiceOverloadedError) as ei:
+                svc.execute("a", "q6")
+            assert ei.value.retry_after_s > 0
+            assert svc.stats()["tenants"]["a"]["shed"] == 1
+            release.set()
+            holder.join(60)
+            queued.join(60)
+            assert not holder.is_alive() and not queued.is_alive()
+            assert errs == []
+            assert all(r.table.equals(oracle["q6"]) for r in out)
+        finally:
+            release.set()
+            svc.close()
+
+    def test_memory_budget_spills_tenant_residency(self, tpch_tables,
+                                                   oracle):
+        """An over-budget tenant's settled device bytes are spilled via
+        the QoS order before its query runs — enforcement degrades the
+        offender and the answer stays correct."""
+        svc = _service(tpch_tables, conf={
+            "spark.rapids.tpu.serve.sessions": 1,
+            # Absurdly small: anything the tenant left resident spills.
+            "spark.rapids.tpu.serve.tenantMemoryBudgetBytes": "piggy:1",
+        })
+        try:
+            import numpy as np
+            from spark_rapids_tpu.data.batch import HostBatch
+            from spark_rapids_tpu.memory.spill import QosTag
+            slot = svc._all_slots[0]
+            cat = slot.session.device_manager.catalog
+            rng = np.random.default_rng(3)
+            batch = HostBatch.from_pydict(
+                {"x": rng.random(4096).tolist()}).to_device()
+            cat.register_batch(batch, owner=QosTag(tenant="piggy"))
+            assert cat.tenant_device_bytes("piggy") > 1
+            res = svc.execute("piggy", "q6")
+            assert res.table.equals(oracle["q6"])
+            assert cat.tenant_device_bytes("piggy") <= 1
+            assert svc.stats()["tenants"]["piggy"]["budget_spill_bytes"] > 0
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Chaos: serving-seam fault injection under lockdep
+# ---------------------------------------------------------------------------
+
+
+def _chaos_conf(every_n, faults, extra=None):
+    conf = {
+        "spark.rapids.tpu.test.faultInjection.sites": "serve.",
+        "spark.rapids.tpu.test.faultInjection.serveEveryN": every_n,
+        "spark.rapids.tpu.test.faultInjection.serveFaults": faults,
+    }
+    conf.update(extra or {})
+    return conf
+
+
+class TestChaosMatrix:
+    def test_session_crash_contained_and_rerun_read_only(
+            self, tpch_tables, oracle):
+        """First visit of serve.execute crashes the pooled session: it
+        is torn down, REPLACED, and the read-only query re-runs once —
+        the caller sees the oracle answer, not the crash."""
+        svc = _service(tpch_tables, conf=_chaos_conf(
+            -1, "sessionCrash",
+            {"spark.rapids.tpu.serve.sessions": 1}))
+        try:
+            gen0 = svc._all_slots[0].generation
+            res = svc.execute("a", "q1")
+            assert res.table.equals(oracle["q1"])
+            stats = svc.stats()
+            assert stats["sessions_replaced"] == 1
+            assert stats["crash_reruns"] == 1
+            assert stats["injected"]["serve.sessionCrash"] == 1
+            assert svc._all_slots[0].generation == gen0 + 1
+        finally:
+            svc.close()
+
+    def test_side_effecting_query_never_reruns_after_crash(
+            self, tpch_tables):
+        svc = _service(tpch_tables, conf=_chaos_conf(
+            -1, "sessionCrash",
+            {"spark.rapids.tpu.serve.sessions": 1}))
+        try:
+            with pytest.raises(SessionCrashError):
+                svc.execute("a", "q1", read_only=False)
+            stats = svc.stats()
+            assert stats["sessions_replaced"] == 1
+            assert stats["crash_reruns"] == 0
+        finally:
+            svc.close()
+
+    def test_repeated_crashes_quarantine_the_plan(self, tpch_tables,
+                                                  oracle):
+        """Crash, replace, re-run, crash again: the plan hash trips the
+        breaker — the NEXT submit is rejected typed without burning a
+        pooled session, and the neighbor plan still runs."""
+        svc = _service(tpch_tables, conf=_chaos_conf(
+            -2, "sessionCrash", {
+                "spark.rapids.tpu.serve.sessions": 1,
+                "spark.rapids.tpu.serve.quarantine.maxFailures": 1,
+            }))
+        try:
+            with pytest.raises(SessionCrashError):
+                svc.execute("a", "q1")
+            with pytest.raises(QueryQuarantinedError):
+                svc.execute("a", "q1")
+            stats = svc.stats()
+            assert stats["quarantine_trips"] == 1
+            assert stats["tenants"]["a"]["quarantine_rejects"] == 1
+            assert stats["sessions_replaced"] == 2
+            # A DIFFERENT plan is not quarantined (per-plan breaker) —
+            # and the injection schedule has healed, so it just runs.
+            res = svc.execute("a", "q6")
+            assert res.table.equals(oracle["q6"])
+        finally:
+            svc.close()
+
+    def test_quarantined_named_query_recovers_via_half_open_probe(
+            self, tpch_tables, oracle):
+        """The half-open path END TO END through QueryService with a
+        LEARNED name hash — regression for the double-breaker-check bug
+        where execute()'s pre-admission check won the probe and
+        _execute_admitted's second check then saw that very reservation
+        and self-rejected, wedging the plan in quarantine forever."""
+        svc = _service(tpch_tables, conf=_chaos_conf(
+            -2, "sessionCrash", {
+                "spark.rapids.tpu.serve.sessions": 1,
+                "spark.rapids.tpu.serve.quarantine.maxFailures": 1,
+                "spark.rapids.tpu.serve.quarantine.secs": 0.1,
+            }))
+        try:
+            with pytest.raises(SessionCrashError):
+                svc.execute("a", "q1")  # crash, rerun, crash -> tripped
+            with pytest.raises(QueryQuarantinedError):
+                svc.execute("a", "q1")  # inside the window
+            time.sleep(0.12)  # window elapses; injection has healed
+            res = svc.execute("a", "q1")  # the ONE half-open probe runs
+            assert res.table.equals(oracle["q1"])
+            assert svc.breaker.stats["probes"] == 1
+            assert svc.breaker.stats["recovered"] == 1
+            res = svc.execute("a", "q1")  # circuit closed, cache now hot
+            assert res.table.equals(oracle["q1"])
+        finally:
+            svc.close()
+
+    def test_probe_won_by_cache_hit_is_released_not_leaked(
+            self, tpch_tables, oracle):
+        """A probe winner answered from the result cache never ran the
+        plan: the reservation is handed back so later submits can still
+        probe — regression for the probing=True leak."""
+        svc = _service(tpch_tables, conf={
+            "spark.rapids.tpu.serve.sessions": 1,
+            "spark.rapids.tpu.serve.quarantine.maxFailures": 1,
+            "spark.rapids.tpu.serve.quarantine.secs": 0.05,
+        })
+        try:
+            first = svc.execute("a", "q6")  # learns the hash, fills cache
+            svc.breaker.note_failure(first.plan_hash)  # trips (max=1)
+            time.sleep(0.06)
+            for i in range(2):
+                res = svc.execute("a", "q6")  # probe -> cache hit
+                assert res.cached and res.table.equals(oracle["q6"])
+            # Each winner released its unconsumed probe; nothing wedged.
+            assert svc.breaker.stats["probes_released"] == 2
+            assert svc.breaker.stats["probes"] == 2
+        finally:
+            svc.close()
+
+    def test_failed_replacement_loses_slot_never_returns_it_dead(
+            self, tpch_tables, monkeypatch):
+        """If the crash-containment REBUILD itself fails, the dead slot
+        must not go back to the pool (every later borrower would fail on
+        a closed session) — the query fails typed and the slot is lost."""
+        from spark_rapids_tpu.serve.service import _PooledSlot
+        svc = _service(tpch_tables, conf=_chaos_conf(
+            -1, "sessionCrash",
+            {"spark.rapids.tpu.serve.sessions": 1}))
+
+        def broken_replace(self):
+            raise RuntimeError("device init failed after crash")
+
+        try:
+            monkeypatch.setattr(_PooledSlot, "replace", broken_replace)
+            with pytest.raises(SessionCrashError) as ei:
+                svc.execute("a", "q1")
+            assert "replacement failed" in str(ei.value)
+            stats = svc.stats()
+            assert stats["sessions_lost"] == 1
+            assert stats["sessions_replaced"] == 0
+            assert svc._free_slots == []  # the dead slot never came back
+        finally:
+            svc.close()
+
+    def test_cache_poison_detected_and_recomputed(self, tpch_tables,
+                                                  oracle):
+        """cachePoison corrupts the entry just stored; the next hit's
+        CRC check drops it and the query RECOMPUTES — degraded to a
+        miss, never served wrong."""
+        svc = _service(tpch_tables, conf=_chaos_conf(
+            -1, "cachePoison",
+            {"spark.rapids.tpu.serve.sessions": 1}))
+        try:
+            first = svc.execute("a", "q6")
+            assert svc.stats()["injected"]["serve.cachePoison"] == 1
+            again = svc.execute("a", "q6")
+            assert not again.cached  # poisoned entry was dropped, not used
+            assert again.table.equals(oracle["q6"])
+            assert first.table.equals(oracle["q6"])
+            assert svc.cache.stats["corrupt_dropped"] == 1
+            third = svc.execute("a", "q6")  # recompute re-filled the cache
+            assert third.cached
+        finally:
+            svc.close()
+
+    def test_tenant_kill_cancels_typed_and_heals(self, tpch_tables,
+                                                 oracle):
+        svc = _service(tpch_tables, conf=_chaos_conf(
+            -1, "tenantKill",
+            {"spark.rapids.tpu.serve.sessions": 1}))
+        try:
+            with pytest.raises(QueryCancelledError):
+                svc.execute("victim", "q6")
+            assert svc.stats()["tenants"]["victim"]["cancelled"] == 1
+            # No slot or queue entry leaked; the next query just runs.
+            assert svc.gate.depth() == 0
+            res = svc.execute("victim", "q6")
+            assert res.table.equals(oracle["q6"])
+        finally:
+            svc.close()
+
+    def test_admission_stall_delays_but_completes(self, tpch_tables,
+                                                  oracle):
+        svc = _service(tpch_tables, conf=_chaos_conf(
+            -1, "admissionStall",
+            {"spark.rapids.tpu.serve.sessions": 1}))
+        try:
+            res = svc.execute("a", "q6")
+            assert res.table.equals(oracle["q6"])
+            assert svc.stats()["injected"]["serve.admissionStall"] == 1
+        finally:
+            svc.close()
+
+    def test_mixed_chaos_matrix_survivors_bit_identical(
+            self, tpch_tables, oracle):
+        """The acceptance matrix: 3 tenants x q1/q6 against a 2-session
+        pool with every serving fault class scheduled AND engine OOM
+        ladders forced in the pooled sessions — every response is either
+        the bit-identical oracle answer or a TYPED serving error; no
+        crash, hang, or cross-tenant bleed, and the injected classes
+        were actually exercised. Runs under TPU_LOCKDEP=1 like all of
+        tier-1: zero recorded violations is part of the assertion
+        (conftest fails the suite otherwise)."""
+        svc = _service(tpch_tables, conf={
+            "spark.rapids.tpu.serve.sessions": 2,
+            "spark.rapids.tpu.serve.maxQueueDepth": 2,
+            "spark.rapids.tpu.serve.quarantine.maxFailures": 8,
+            # Serving seams: every 3rd visit, all four classes eligible.
+            "spark.rapids.tpu.test.faultInjection.sites": "*",
+            "spark.rapids.tpu.test.faultInjection.serveEveryN": 3,
+            # Engine seams: forced OOM retry ladders inside the pooled
+            # sessions (the PR-4 machinery the budgets lean on).
+            "spark.rapids.tpu.test.faultInjection.oomEveryN": 5,
+            "spark.rapids.tpu.retry.backoffBaseMs": 0.0,
+        })
+        typed = (ServiceOverloadedError, QueryCancelledError,
+                 QueryQuarantinedError, SessionCrashError,
+                 QueryDeadlineExceeded)
+        outcomes, bad = [], []
+
+        def client(tenant, n):
+            for i in range(n):
+                q = SMOKE_QUERIES[i % len(SMOKE_QUERIES)]
+                try:
+                    res = svc.execute(tenant, q)
+                    if not res.table.equals(oracle[q]):
+                        bad.append((tenant, q, "diverged"))
+                    outcomes.append("ok")
+                except typed as e:
+                    outcomes.append(type(e).__name__)
+                except Exception as e:  # noqa: BLE001 - the assertion
+                    bad.append((tenant, q, repr(e)))
+
+        try:
+            threads = [threading.Thread(target=client, args=(f"t{i}", 6),
+                                        daemon=True) for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(300)
+                assert not t.is_alive(), "chaos matrix hung"
+            assert bad == [], f"untyped or wrong outcomes: {bad}"
+            assert outcomes.count("ok") > 0
+            stats = svc.stats()
+            injected = stats.get("injected", {})
+            assert sum(injected.values()) > 0, "no faults were injected"
+            # Crash containment demonstrably ran inside the matrix.
+            assert stats["sessions_replaced"] > 0
+        finally:
+            svc.close()
+
+
+# ---------------------------------------------------------------------------
+# Client disconnect mid-query (satellite 4) + the TCP frontend
+# ---------------------------------------------------------------------------
+
+
+class TestFrontendAndDisconnect:
+    def test_protocol_ops_and_bad_requests(self, tpch_tables, oracle):
+        svc = _service(tpch_tables,
+                       conf={"spark.rapids.tpu.serve.sessions": 1})
+        fe = ServeFrontend(svc)
+        try:
+            cl = ServeClient(fe.address)
+            assert cl.ping()["ok"]
+            resp = cl.query("a", "q6", collect=True)
+            assert resp["ok"] and resp["rows"] == oracle["q6"].num_rows
+            assert resp["data"] == oracle["q6"].to_pydict()
+            assert resp["plan_hash"]
+            # CRC lets a client assert bit-identity without the data.
+            from spark_rapids_tpu.serve.cache import _serialize
+            from spark_rapids_tpu.utils import checksum as CK
+            assert resp["crc32c"] == CK.crc32c(_serialize(oracle["q6"]))
+            assert cl.query("a", "nope")["error"] == "UnknownQuery"
+            # A non-JSON line answers typed and the connection SURVIVES.
+            cl._sock.sendall(b"this is not json\n")
+            bad = cl._roundtrip({"op": "ping"})  # reads the BadRequest
+            assert bad["error"] == "BadRequest"
+            # Resync: drain the ping's own pending response.
+            while b"\n" not in cl._buf:
+                cl._buf += cl._sock.recv(1 << 16)
+            line, _, cl._buf = cl._buf.partition(b"\n")
+            assert json.loads(line)["ok"]
+            assert cl.stats()["ok"]
+            assert cl.invalidate("a")["invalidated"] >= 1
+            cl.close()
+        finally:
+            fe.close()
+            svc.close()
+
+    def test_collect_with_date_columns_answers_not_disconnects(
+            self, tpch_tables):
+        """q3's output carries a date32 column; json has no native date
+        encoding, and the handler used to crash (and drop the
+        connection) serializing it — values stringify instead."""
+        svc = _service(tpch_tables,
+                       conf={"spark.rapids.tpu.serve.sessions": 1},
+                       queries=("q3",))
+        fe = ServeFrontend(svc)
+        try:
+            cl = ServeClient(fe.address)
+            r = cl.query("a", "q3", collect=True)
+            assert r["ok"], r
+            assert r["rows"] == len(r["data"]["o_orderdate"])
+            assert all(isinstance(v, str)
+                       for v in r["data"]["o_orderdate"])
+            assert cl.ping()["ok"]  # the connection SURVIVED
+            cl.close()
+        finally:
+            fe.close()
+            svc.close()
+
+    def test_client_disconnect_mid_queue_releases_everything(
+            self, tpch_tables, oracle):
+        """The satellite-4 contract: a client that goes away while its
+        query is QUEUED has its admission entry cancelled cooperatively
+        — the deadline fires, the queue entry and (never-acquired) slot
+        are released, and the neighbor holding the pool finishes
+        unharmed."""
+        svc = _service(tpch_tables, conf={
+            "spark.rapids.tpu.serve.sessions": 1,
+            "spark.rapids.tpu.serve.maxQueueDepth": 4,
+        })
+        fe = ServeFrontend(svc)
+        release = threading.Event()
+
+        def slow_builder(dfs):
+            release.wait(10)
+            from spark_rapids_tpu.workloads import tpch
+            return tpch.QUERIES["q6"](dfs)
+
+        out, errs = [], []
+
+        def holder():
+            try:
+                out.append(svc.execute("a", slow_builder))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        try:
+            ht = threading.Thread(target=holder, daemon=True)
+            ht.start()
+            _wait_until(lambda: svc.gate.stats["admitted"] == 1,
+                        msg="holder admitted")
+            victim = ServeClient(fe.address)
+            victim._sock.sendall(json.dumps(
+                {"op": "query", "tenant": "b", "query": "q6"}
+            ).encode() + b"\n")
+            _wait_until(lambda: svc.gate.depth("b") == 1,
+                        msg="victim queued")
+            victim.close()  # the disconnect — no response ever read
+            _wait_until(
+                lambda: svc.stats()["tenants"].get("b", {})
+                .get("cancelled", 0) == 1,
+                msg="victim cancelled after disconnect")
+            assert svc.gate.depth() == 0
+            release.set()
+            ht.join(60)
+            assert not ht.is_alive() and errs == []
+            assert out[0].table.equals(oracle["q6"])
+            # The pool is fully healthy: a fresh client round-trips.
+            cl = ServeClient(fe.address)
+            assert cl.query("c", "q6")["ok"]
+            cl.close()
+        finally:
+            release.set()
+            fe.close()
+            svc.close()
+
+    def test_cancel_running_query_unwinds_cooperatively(
+            self, tpch_tables, oracle):
+        """Cancelling a RUNNING query forces its deadline; the next
+        cooperative check site unwinds it as the typed cancellation, the
+        gate slot is returned, and the service keeps serving."""
+        svc = _service(tpch_tables,
+                       conf={"spark.rapids.tpu.serve.sessions": 1})
+        ticket = QueryTicket()
+
+        def self_cancelling_builder(dfs):
+            ticket.cancel("client vanished mid-build")
+            from spark_rapids_tpu.workloads import tpch
+            return tpch.QUERIES["q6"](dfs)
+
+        try:
+            with pytest.raises(QueryCancelledError) as ei:
+                svc.execute("a", self_cancelling_builder, ticket=ticket)
+            assert "vanished" in ei.value.reason
+            assert svc.gate.depth() == 0
+            res = svc.execute("a", "q6")  # slot came back
+            assert res.table.equals(oracle["q6"])
+        finally:
+            svc.close()
+
+    def test_deadline_cancel_forces_expiry(self):
+        d = Deadline(math.inf)
+        d.check("serve.test")  # infinite: never expires on its own
+        d.cancel()
+        with pytest.raises(QueryDeadlineExceeded):
+            d.check("serve.test")
+
+    def test_cancel_before_ticket_wiring_is_not_lost(self, tpch_tables):
+        """A disconnect can fire cancel() BEFORE execute() wires the
+        ticket to its deadline (the frontend's worker thread may not
+        have been scheduled yet) — the flag must still cancel the query
+        instead of running it to completion for a dead client."""
+        svc = _service(tpch_tables,
+                       conf={"spark.rapids.tpu.serve.sessions": 1})
+        try:
+            ticket = QueryTicket()
+            ticket.cancel("client vanished before submit ran")
+            with pytest.raises(QueryCancelledError):
+                svc.execute("a", "q6", ticket=ticket)
+            assert svc.gate.depth() == 0
+        finally:
+            svc.close()
+
+    def test_infinite_deadline_pipeline_wait_does_not_overflow(self):
+        """The serving layer's cancel-only Deadline(math.inf) rides
+        ctx.deadline into pipeline future waits; result(timeout=inf) is
+        an OverflowError in CPython, so the wait must poll bounded —
+        and a cancel() must actually wake it."""
+        import types
+        from spark_rapids_tpu.exec import pipeline as PL
+        pool = PL.PipelinePool()
+        ctx = types.SimpleNamespace(deadline=Deadline(math.inf))
+        f = pool.submit(lambda: (time.sleep(0.3), 42)[1])
+        assert PL._stalled_result(f, ctx, None) == 42  # was OverflowError
+        # cancel() wakes a parked waiter instead of sleeping forever
+        release = threading.Event()
+        slow = pool.submit(lambda: release.wait(30))
+        threading.Timer(0.2, ctx.deadline.cancel).start()
+        with pytest.raises(QueryDeadlineExceeded):
+            PL._stalled_result(slow, ctx, None)
+        release.set()
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 1: profiles keyed by query id
+# ---------------------------------------------------------------------------
+
+
+class TestProfilesByQueryId:
+    def test_concurrent_queries_get_their_own_profiles(self, tpch_tables):
+        from spark_rapids_tpu.session import TpuSession
+        from spark_rapids_tpu.workloads import tpch
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        dfs = tpch.load(s, tpch_tables)
+        sinks = {q: [] for q in SMOKE_QUERIES}
+
+        def run(q):
+            s.execute(tpch.QUERIES[q](dfs)._plan,
+                      profile_sink=sinks[q].append)
+
+        threads = [threading.Thread(target=run, args=(q,), daemon=True)
+                   for q in SMOKE_QUERIES for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+            assert not t.is_alive()
+        seen_ids = set()
+        for q in SMOKE_QUERIES:
+            assert len(sinks[q]) == 2
+            for prof in sinks[q]:
+                # Each concurrent query kept its OWN profile (the sink
+                # and the id-keyed map agree), no last-slot clobbering.
+                assert prof.query_id not in seen_ids
+                seen_ids.add(prof.query_id)
+                assert s.query_profile(prof.query_id) is prof
+        # The shim still answers with the most recent profile.
+        assert s.last_query_profile() in [p for ps in sinks.values()
+                                          for p in ps]
+        s.close()
+
+    def test_profile_retention_evicts_oldest(self, tpch_tables,
+                                             monkeypatch):
+        from spark_rapids_tpu.session import TpuSession
+        from spark_rapids_tpu.workloads import tpch
+        monkeypatch.setattr(TpuSession, "_MAX_PROFILES", 2)
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        dfs = tpch.load(s, tpch_tables)
+        ids = []
+        for _ in range(3):
+            sink = []
+            s.execute(tpch.QUERIES["q6"](dfs)._plan,
+                      profile_sink=sink.append)
+            ids.append(sink[0].query_id)
+        assert s.query_profile(ids[0]) is None  # evicted
+        assert s.query_profile(ids[1]) is not None
+        assert s.query_profile(ids[2]) is not None
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 2: close() idempotent + concurrent-closer safe
+# ---------------------------------------------------------------------------
+
+
+class TestConcurrentClose:
+    def test_close_is_idempotent(self, tpch_tables):
+        from spark_rapids_tpu.session import TpuSession
+        from spark_rapids_tpu.workloads import tpch
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        dfs = tpch.load(s, tpch_tables)
+        s.close()
+        s.close()  # second closer: no-op, no raise
+        # A session used after close keeps working (lazy pool recreate).
+        assert tpch.QUERIES["q6"](dfs).collect().num_rows >= 0
+        s.close()
+
+    def test_pool_reaper_racing_inflight_query(self, tpch_tables, oracle):
+        """The schedule the serving pool's reaper produces: concurrent
+        close() calls racing a live query. Closers serialize on
+        _close_lock (the lockdep acquire hook widens the race window on
+        exactly that lock); the query either completes or retries onto
+        the recreated pool — never a hang, never a wrong answer."""
+        from spark_rapids_tpu.session import TpuSession
+        from spark_rapids_tpu.workloads import tpch
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        dfs = tpch.load(s, tpch_tables)
+        plan = tpch.QUERIES["q6"](dfs)._plan
+        results, errs = [], []
+        stop = threading.Event()
+
+        def query_loop():
+            try:
+                while not stop.is_set():
+                    results.append(s.execute(plan))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        def hook(name):
+            if name == "TpuSession._close_lock":
+                time.sleep(0.002)
+
+        lockdep.set_acquire_hook(hook)
+        try:
+            qt = threading.Thread(target=query_loop, daemon=True)
+            qt.start()
+            _wait_until(lambda: len(results) >= 1, timeout=60,
+                        msg="first query done")
+            closers = [threading.Thread(target=s.close, daemon=True)
+                       for _ in range(3)]
+            for c in closers:
+                c.start()
+            for c in closers:
+                c.join(60)
+                assert not c.is_alive(), "concurrent close deadlocked"
+            stop.set()
+            qt.join(60)
+            assert not qt.is_alive(), "query hung across concurrent close"
+        finally:
+            lockdep.set_acquire_hook(None)
+            stop.set()
+            s.close()
+        assert errs == [], f"query failed across concurrent close: {errs}"
+        for r in results:
+            assert r.equals(oracle["q6"])
+
+    def test_pool_shutdown_error_is_transient(self):
+        from concurrent.futures import CancelledError
+        from spark_rapids_tpu.exec.pipeline import PoolShutdownError
+        from spark_rapids_tpu.memory.retry import Classification, classify
+        assert classify(PoolShutdownError("pipeline pool is shut down")) \
+            == Classification.TRANSIENT
+        assert classify(CancelledError()) == Classification.TRANSIENT
+
+
+# ---------------------------------------------------------------------------
+# Satellite 3: tenant stamped into profiles + event log
+# ---------------------------------------------------------------------------
+
+
+class TestTenantStamp:
+    def test_profile_and_event_log_carry_tenant(self, tpch_tables,
+                                                tmp_path):
+        from spark_rapids_tpu.metrics import eventlog
+        from spark_rapids_tpu.session import TpuSession
+        from spark_rapids_tpu.workloads import tpch
+        s = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.tenantId": "acme",
+            "spark.rapids.tpu.metrics.eventLog.dir": str(tmp_path),
+        })
+        dfs = tpch.load(s, tpch_tables)
+        tpch.QUERIES["q6"](dfs).collect()
+        prof = s.last_query_profile()
+        assert prof.tenant == "acme"
+        assert "tenant=acme" in prof.render()
+        assert prof.to_dict()["tenant"] == "acme"
+        records = eventlog.read(eventlog.log_path(str(tmp_path)))
+        assert records and all(r["tenant"] == "acme" for r in records)
+        s.close()
+
+    def test_untenanted_session_stamps_empty(self, tpch_tables):
+        from spark_rapids_tpu.session import TpuSession
+        from spark_rapids_tpu.workloads import tpch
+        s = TpuSession({"spark.rapids.sql.enabled": True})
+        dfs = tpch.load(s, tpch_tables)
+        tpch.QUERIES["q6"](dfs).collect()
+        prof = s.last_query_profile()
+        assert prof.tenant == ""
+        assert "tenant=" not in prof.render()
+        s.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite 6: except-too-broad ratchet covers serve/ (zero grandfathered)
+# ---------------------------------------------------------------------------
+
+
+class TestServeLintScope:
+    def _write(self, root, relpath, source):
+        import os
+        import textwrap
+        path = root / relpath
+        os.makedirs(path.parent, exist_ok=True)
+        path.write_text(textwrap.dedent(source))
+        return str(root)
+
+    def test_swallowing_handler_in_serve_is_flagged(self, tmp_path):
+        import tools.tpu_lint as TL
+        pkg = self._write(tmp_path, "serve/swallow.py", """
+            def admit(q):
+                try:
+                    return q.run()
+                except Exception:
+                    return None
+            """)
+        vs = [v for v in TL.lint_tree(pkg)
+              if v.rule == "except-too-broad"]
+        assert len(vs) == 1 and "serve/swallow.py" in vs[0].path
+
+    def test_taxonomy_routed_handler_in_serve_passes(self, tmp_path):
+        import tools.tpu_lint as TL
+        pkg = self._write(tmp_path, "serve/routed.py", """
+            from ..memory.retry import Classification, classify
+
+            def admit(q):
+                try:
+                    return q.run()
+                except Exception as e:
+                    if classify(e) == Classification.FATAL:
+                        raise
+                    return None
+            """)
+        assert [v for v in TL.lint_tree(pkg)
+                if v.rule == "except-too-broad"] == []
+
+    def test_repo_serve_layer_has_zero_grandfathered_sites(self):
+        import os
+        import tools.tpu_lint as TL
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        vs = [v for v in TL.lint_tree(os.path.join(repo, "spark_rapids_tpu"))
+              if v.rule == "except-too-broad"
+              and v.path.startswith("serve/")]
+        assert vs == [], \
+            "serve/ must stay at ZERO broad-except debt (ISSUE 12): " \
+            + "; ".join(f"{v.path}:{v.lineno}" for v in vs)
+
+
+# ---------------------------------------------------------------------------
+# tools/serve_bench.py emits a parseable BENCH_serving.json
+# ---------------------------------------------------------------------------
+
+
+class TestServeBench:
+    def test_bench_emits_parseable_json_with_attribution(self, tmp_path):
+        import tools.serve_bench as SB
+        out = tmp_path / "BENCH_serving.json"
+        rc = SB.main(["--rows", "512", "--clients", "2", "--tenants", "2",
+                      "--requests", "2", "--sessions", "1",
+                      "--queries", "q6",
+                      "--event-log-dir", str(tmp_path / "events"),
+                      "--out", str(out)])
+        assert rc == 0
+        payload = json.loads(out.read_text())
+        assert payload["bench"] == "serving"
+        assert payload["completed"] == 4
+        assert payload["p50_ms"] > 0 and payload["p99_ms"] > 0
+        assert payload["throughput_qps"] > 0
+        assert set(payload["counters"]) >= {"shed", "admitted",
+                                            "quarantine_trips",
+                                            "sessions_replaced",
+                                            "cache_hits"}
+        # Per-tenant attribution straight from tenant-stamped profiles.
+        for tenant in ("tenant0", "tenant1"):
+            pt = payload["per_tenant"][tenant]
+            assert pt["requests"] == 2
+            assert pt["attribution"]["queries"] >= 1
+            assert pt["attribution"]["wall_ns"] > 0

@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Serving-layer concurrency bench (ISSUE 12) -> BENCH_serving.json.
+
+N concurrent clients x a TPC-H query mix against one
+:class:`~spark_rapids_tpu.serve.QueryService` behind the loopback
+TCP/JSON front end — the real wire path, not an in-process shortcut.
+Emits p50/p99 latency, throughput, and the robustness counters
+(shed / cancelled / quarantine / crash-replace / cache) plus per-tenant
+attribution read straight from the PR-3 event log: every QueryProfile
+carries its ``tenant`` stamp (ISSUE 12 satellite), so attribution is a
+group-by over ``query_profiles.jsonl``, no side-channel join.
+
+The JSON is written on EVERY exit path (the bench.py kill-dump stance):
+even a crashed run leaves a parseable artifact.
+
+Usage:
+    python tools/serve_bench.py --rows 16384 --clients 4 --tenants 2 \
+        --requests 8 --queries q1,q6,q3 --out BENCH_serving.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(sorted_vals, q: float):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[idx]
+
+
+def _client_loop(client, tenant: str, mix, requests: int, out: list,
+                 barrier: threading.Barrier):
+    barrier.wait()
+    for i in range(requests):
+        name = mix[i % len(mix)]
+        t0 = time.perf_counter()
+        try:
+            resp = client.query(tenant, name)
+        except (ConnectionError, OSError) as e:
+            out.append({"tenant": tenant, "query": name, "ok": False,
+                        "error": type(e).__name__,
+                        "latency_ms": (time.perf_counter() - t0) * 1e3})
+            return
+        out.append({"tenant": tenant, "query": name,
+                    "ok": bool(resp.get("ok")),
+                    "error": resp.get("error"),
+                    "cached": bool(resp.get("cached")),
+                    "retry_after_s": resp.get("retry_after_s"),
+                    "latency_ms": (time.perf_counter() - t0) * 1e3})
+        # Honor shed backpressure the way a well-behaved client would.
+        if resp.get("error") == "ServiceOverloadedError":
+            time.sleep(min(float(resp.get("retry_after_s") or 0.05), 0.5))
+
+
+def run(args) -> dict:
+    from spark_rapids_tpu.serve import (QueryService, ServeClient,
+                                        ServeFrontend)
+    from spark_rapids_tpu.metrics import eventlog
+    from spark_rapids_tpu.workloads import tpch
+
+    mix = [q.strip() for q in args.queries.split(",") if q.strip()]
+    tenants = [f"tenant{i}" for i in range(args.tenants)]
+    log_dir = args.event_log_dir or tempfile.mkdtemp(prefix="serve_bench_")
+    conf = {
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.tpu.serve.sessions": args.sessions,
+        "spark.rapids.tpu.serve.maxQueueDepth": args.max_queue_depth,
+        "spark.rapids.tpu.metrics.eventLog.dir": log_dir,
+    }
+    if args.time_budget_secs > 0:
+        conf["spark.rapids.tpu.serve.tenantTimeBudgetSecs"] = \
+            f"default:{args.time_budget_secs}"
+    t_gen0 = time.perf_counter()
+    tables = tpch.gen_tables(args.rows, seed=7)
+    service = QueryService(
+        conf=conf, tables=tables,
+        queries={n: tpch.QUERIES[n] for n in mix})
+    warm_secs = time.perf_counter() - t_gen0
+    frontend = ServeFrontend(service)
+    results: list = []
+    barrier = threading.Barrier(args.clients + 1)
+    clients, threads = [], []
+    t0 = time.perf_counter()
+    try:
+        for i in range(args.clients):
+            cl = ServeClient(frontend.address)
+            clients.append(cl)
+            t = threading.Thread(
+                target=_client_loop,
+                args=(cl, tenants[i % len(tenants)], mix, args.requests,
+                      results, barrier),
+                name=f"serve-bench-client-{i}", daemon=True)
+            threads.append(t)
+            t.start()
+        barrier.wait()
+        wall0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - wall0
+    finally:
+        for cl in clients:
+            cl.close()
+        frontend.close()
+        stats = service.stats()
+        service.close()
+
+    ok_lat = sorted(r["latency_ms"] for r in results if r["ok"])
+    completed = len(ok_lat)
+    by_tenant: dict = {}
+    for r in results:
+        t = by_tenant.setdefault(r["tenant"], {"requests": 0, "ok": 0,
+                                               "shed": 0, "lat": []})
+        t["requests"] += 1
+        if r["ok"]:
+            t["ok"] += 1
+            t["lat"].append(r["latency_ms"])
+        elif r.get("error") == "ServiceOverloadedError":
+            t["shed"] += 1
+    # Per-tenant attribution from the event log: group the tenant-stamped
+    # profiles (ISSUE 12 satellite) — no join against any side channel.
+    profile_attr: dict = {}
+    for rec in eventlog.read(eventlog.log_path(log_dir) or ""):
+        ten = rec.get("tenant", "")
+        a = profile_attr.setdefault(ten, {"queries": 0, "wall_ns": 0,
+                                          "spill_bytes": 0})
+        a["queries"] += 1
+        a["wall_ns"] += int(rec.get("wall_ns", 0))
+        a["spill_bytes"] += int(rec.get("engine", {}).get("spillBytes", 0))
+    per_tenant = {}
+    for ten, t in sorted(by_tenant.items()):
+        lat = sorted(t["lat"])
+        per_tenant[ten] = {
+            "requests": t["requests"], "completed": t["ok"],
+            "shed": t["shed"],
+            "p50_ms": round(_percentile(lat, 0.50) or 0, 3),
+            "p99_ms": round(_percentile(lat, 0.99) or 0, 3),
+            "attribution": profile_attr.get(ten, {}),
+            **({"stats": stats["tenants"].get(ten, {})}),
+        }
+    return {
+        "bench": "serving", "version": 1,
+        "backend": _backend(),
+        "rows": args.rows, "clients": args.clients,
+        "tenants": args.tenants, "requests_per_client": args.requests,
+        "queries": mix,
+        "warm_start_secs": round(warm_secs, 3),
+        "wall_secs": round(wall, 3),
+        "completed": completed,
+        "failed_typed": sum(1 for r in results
+                            if not r["ok"] and r.get("error")),
+        "throughput_qps": round(completed / wall, 3) if wall > 0 else 0.0,
+        "p50_ms": round(_percentile(ok_lat, 0.50) or 0, 3),
+        "p99_ms": round(_percentile(ok_lat, 0.99) or 0, 3),
+        "counters": {
+            "shed": stats["gate"]["shed"],
+            "admitted": stats["gate"]["admitted"],
+            "peak_concurrent": stats["gate"]["peak_concurrent"],
+            "quarantine_trips": stats["quarantine_trips"],
+            "sessions_replaced": stats["sessions_replaced"],
+            "crash_reruns": stats["crash_reruns"],
+            "cache_hits": stats["cache"]["hits"],
+            "cache_corrupt_dropped": stats["cache"]["corrupt_dropped"],
+        },
+        "service_stats": stats,
+        "per_tenant": per_tenant,
+        "event_log_dir": log_dir,
+    }
+
+
+def _backend() -> str:
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:  # noqa: BLE001 - diagnostics only
+        return "unknown"
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--rows", type=int, default=1 << 14,
+                   help="lineitem rows for the generated TPC-H tables")
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--tenants", type=int, default=2)
+    p.add_argument("--requests", type=int, default=8,
+                   help="requests per client")
+    p.add_argument("--sessions", type=int, default=2,
+                   help="pooled warm sessions")
+    p.add_argument("--queries", default="q1,q6,q3")
+    p.add_argument("--max-queue-depth", type=int, default=16)
+    p.add_argument("--time-budget-secs", type=float, default=0.0,
+                   help="per-tenant default time budget (0 = none)")
+    p.add_argument("--event-log-dir", default=None)
+    p.add_argument("--out", default="BENCH_serving.json")
+    args = p.parse_args(argv)
+    payload = {"bench": "serving", "version": 1, "error": "did not finish"}
+    rc = 1
+    try:
+        payload = run(args)
+        rc = 0
+    finally:
+        # The kill-dump stance (bench.py, ISSUE 11): ANY exit leaves a
+        # parseable artifact.
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    if rc == 0:
+        print(json.dumps({k: payload[k] for k in
+                          ("completed", "throughput_qps", "p50_ms",
+                           "p99_ms", "counters")}, indent=1))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,8 +26,9 @@ corrupt TPU performance or correctness silently:
   blind spot. Static approximation: the linter checks that SOME metric
   registration exists, not its level.
 * ``except-too-broad`` (device-path modules: ``exec/``, ``memory/``,
-  ``shuffle/``, ``io/``): a bare ``except Exception`` (or untyped
-  ``except:``) handler that never consults the retry taxonomy
+  ``shuffle/``, ``io/``, plus the serving layer ``serve/`` with ZERO
+  grandfathered sites — ISSUE 12): a bare ``except Exception`` (or
+  untyped ``except:``) handler that never consults the retry taxonomy
   (memory/retry.py ``classify`` / ``RetryOOM`` / ``SplitAndRetryOOM``) —
   such handlers swallow device OOMs and transient faults the
   OOM-resilience layer exists to classify (docs/fault-tolerance.md).
@@ -92,6 +93,10 @@ KERNEL_SCOPE = ("ops/kernels/",)
 PLAN_SCOPE = ("plan/",)
 EXEC_SCOPE = ("exec/",)
 DEVICE_SCOPE = ("exec/", "memory/", "shuffle/", "io/")
+#: except-too-broad also covers the serving layer (ISSUE 12, ZERO
+#: grandfathered sites): a handler there that swallows classified faults
+#: breaks the typed-error contract every client depends on.
+BROAD_EXCEPT_SCOPE = DEVICE_SCOPE + ("serve/",)
 #: raw-thread also covers the batch/upload and shared-utility layers —
 #: everywhere a stray Thread could carry device work past the pool.
 RAW_THREAD_SCOPE = DEVICE_SCOPE + ("data/", "utils/")
@@ -161,6 +166,7 @@ class _FileLinter(ast.NodeVisitor):
         self.in_plan = relpath.startswith(PLAN_SCOPE)
         self.in_exec = relpath.startswith(EXEC_SCOPE)
         self.in_device = relpath.startswith(DEVICE_SCOPE)
+        self.in_broad_except = relpath.startswith(BROAD_EXCEPT_SCOPE)
         self.in_raw_thread = relpath.startswith(RAW_THREAD_SCOPE)
         self.violations: List[Violation] = []
         #: stack of (is_jit, frozenset(param names)) for enclosing functions
@@ -357,7 +363,7 @@ class _FileLinter(ast.NodeVisitor):
                            "plan code")
 
     def visit_ExceptHandler(self, node: ast.ExceptHandler):
-        if self.in_device:
+        if self.in_broad_except:
             self._check_broad_except(node)
         self.generic_visit(node)
 
