@@ -538,7 +538,44 @@ def run_suite(budget_s=DEFAULT_BUDGET_S,
                 out.update(measure_pipeline_overlap(tpch, ab_tables, timed))
         except Exception as e:  # noqa: BLE001 — incl. QueryBudgetExceeded
             print(f"[bench] pipeline A/B skipped: {e}", file=sys.stderr)
+    # Critical-path attribution (ISSUE 13): ONE traced q3 rerun OUTSIDE
+    # every timed region (tracing adds spans, so it must never touch the
+    # headline numbers), summarized by tools/trace_report.py into the
+    # BENCH JSON — the "where did the time go" artifact the hardware win
+    # curve round needs (ROADMAP item 1: per-kernel/per-stage
+    # device-time attribution populated).
+    if not budget_s or time.perf_counter() - suite_t0 < budget_s:
+        try:
+            with query_budget(query_budget_s):
+                out["trace_report"] = _traced_query_report(
+                    tpu, tpu_t, tpch.QUERIES["q3"])
+        except Exception as e:  # noqa: BLE001 — best-effort attribution
+            print(f"[bench] trace report skipped: {e}", file=sys.stderr)
     return out
+
+
+def _traced_query_report(tpu, frames, q) -> dict:
+    """Re-run one query with tracing on and summarize its critical path
+    (tools/trace_report.py). The traced session shares the warm engine
+    state, so the trace shows the STEADY-STATE timeline."""
+    import functools
+    import shutil
+
+    import tools.trace_report as trace_report
+    trace_dir = tempfile.mkdtemp(prefix="bench_trace_")
+    # Same accumulation guard as the parquet staging dir above: repeated
+    # runs must not pile temp dirs up in /tmp (atexit + kill path).
+    cleanup = functools.partial(shutil.rmtree, trace_dir,
+                                ignore_errors=True)
+    atexit.register(cleanup)
+    _KILL_CLEANUPS.append(cleanup)
+    traced = tpu.with_conf(**{
+        "spark.rapids.tpu.trace.enabled": True,
+        "spark.rapids.tpu.trace.dir": trace_dir,
+    })
+    traced.execute(q(frames)._plan)
+    rep = trace_report.summarize_dir(trace_dir)
+    return rep["worst"] if rep else {}
 
 
 def _fault_section(profiles) -> dict:

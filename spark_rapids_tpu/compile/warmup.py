@@ -247,11 +247,22 @@ def _work() -> None:
             program, template, vec = _QUEUE.popleft()
             _INFLIGHT += 1
         try:
+            t0 = time.monotonic()
             abstract = _rebucket(template, vec)
             result = program.compile_abstract((abstract,))
             with _CV:
                 _STATS["compiled" if result == "compiled"
                        else "already_cached"] += 1
+            if result == "compiled":
+                # Flight-recorder breadcrumb (metrics/trace.py, ISSUE
+                # 13): warm-up compiles run outside any query's trace,
+                # but a post-mortem dump must still show the compile
+                # thread's activity (Flare's amortized-compilation
+                # thesis: these vanish from warm timelines).
+                from ..metrics import trace as _trace
+                _trace.record_event(
+                    "compile.warmup", label=program.label,
+                    secs=round(time.monotonic() - t0, 3))
         except Exception:  # noqa: BLE001 - warm-up must never fail a query
             with _CV:
                 _STATS["errors"] += 1

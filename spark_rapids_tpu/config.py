@@ -726,6 +726,60 @@ METRICS_EVENT_LOG_DIR = conf_str(
     "the compile manifest). Unset disables the log. See "
     "docs/monitoring.md for the record schema.")
 
+METRICS_EVENT_LOG_MAX_BYTES = conf_int(
+    "spark.rapids.tpu.metrics.eventLog.maxBytes", 64 << 20,
+    "Size-capped rotation for the event log in a long-lived serving "
+    "process: when an append would push query_profiles.jsonl past this "
+    "many bytes, the file atomically rotates to query_profiles.jsonl.1 "
+    "(one prior generation kept) and the append starts a fresh file — "
+    "crash-safe (os.replace) and torn-line tolerant like the append "
+    "itself. 0 disables rotation (unbounded growth). See "
+    "docs/monitoring.md.")
+
+TRACE_ENABLED = conf_bool(
+    "spark.rapids.tpu.trace.enabled", False,
+    "Per-query distributed tracing (metrics/trace.py): a span tree "
+    "spanning serve admission/queue wait, session dispatch, the retry "
+    "ladder, pipeline workers, the spill-IO lane, compile/warmup "
+    "events, and shuffle map/fetch/recompute — with trace context "
+    "propagated over both wire protocols (the SRTQS serve field and the "
+    "shuffle net protocol-v4 header) so multi-peer fetches stitch into "
+    "one trace. Each query exports Chrome trace-event JSON "
+    "(Perfetto-loadable) beside the event log; tools/trace_report.py "
+    "computes the critical path. Off by default: the disabled path is "
+    "no-op spans, no fences, bit-identical results (asserted by tests). "
+    "Read per session. See docs/monitoring.md#distributed-tracing.")
+
+TRACE_DIR = conf_str(
+    "spark.rapids.tpu.trace.dir", None,
+    "Directory for exported per-query trace files "
+    "(trace_<trace_id>.json). Unset: traces land beside the event log "
+    "(spark.rapids.tpu.metrics.eventLog.dir); with neither set, spans "
+    "still feed the in-memory flight recorder but no per-query file is "
+    "written.")
+
+TRACE_MAX_FILES = conf_int(
+    "spark.rapids.tpu.trace.maxFiles", 256,
+    "Retention bound on exported trace files: after each export the "
+    "oldest trace_*.json beyond this count are pruned from the trace "
+    "directory, so a long-lived traced serving process cannot fill the "
+    "disk (the eventLog.maxBytes stance applied to traces). 0 disables "
+    "pruning.")
+
+TRACE_FLIGHT_SPANS = conf_int(
+    "spark.rapids.tpu.trace.flightRecorder.spans", 4096,
+    "Bound on the in-memory flight recorder: the ring buffer keeps this "
+    "many recent finished spans + engine events across all queries, "
+    "dumped to JSON on QueryDeadlineExceeded, circuit-breaker "
+    "quarantine trips, SessionCrashError, and SIGTERM. See "
+    "docs/monitoring.md#flight-recorder.")
+
+TRACE_FLIGHT_DIR = conf_str(
+    "spark.rapids.tpu.trace.flightRecorder.dir", "artifacts",
+    "Directory flight-recorder dumps are written to "
+    "(flight_<reason>_<pid>_<n>.json; bounded per reason so a crash "
+    "loop cannot flood it).")
+
 PLAN_LINT_ENABLED = conf_bool(
     "spark.rapids.tpu.planLint.enabled", True,
     "Statically verify every physical plan after planning and again after "

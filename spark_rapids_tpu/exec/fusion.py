@@ -341,7 +341,11 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     # merge order stay deterministic; serial when the pipeline is off or
     # a fault injector is active.
     from . import pipeline as _pipeline
-    inputs = _pipeline.materialize_boundaries(boundaries, ctx)
+    from ..metrics import trace as _trace
+    tr = ctx.trace
+    with _trace.span(tr, "fusion.boundaries", cat="dispatch",
+                     n=len(boundaries)):
+        inputs = _pipeline.materialize_boundaries(boundaries, ctx)
     reg = ctx.registry
     # Shape polymorphism (spark.rapids.tpu.polymorphic.enabled): pad the
     # boundary inputs onto coarse capacity tiers so one executable serves
@@ -359,8 +363,12 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
     # a signature, its trace+compile) is THE device wait of the engine —
     # holding any engine lock across it serializes every sibling thread
     # behind the device (utils/lockdep.py, docs/concurrency.md).
-    with _lockdep.blocking("fusion.dispatch"):
+    with _trace.span(tr, "fusion.dispatch", cat="dispatch") as _sp, \
+            _lockdep.blocking("fusion.dispatch"):
         head, full = fn(inputs)
+        if _sp is not None and not key_compiled_before \
+                and fn.jit_compiled(inputs):
+            _sp.annotate(compiled=True)
     if budget_secs > 0 and not key_compiled_before \
             and fn.jit_compiled(inputs):
         # THIS key's dispatch paid trace+compile (per-key, so a
@@ -372,10 +380,16 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
         # report at the ceiling so the level cannot escalate uselessly.
         if base_hash is None:
             base_hash = _persist.plan_hash(_plan_sig(device_plan))
-        _budget.note_compile(base_hash,
-                             (_time.perf_counter_ns() - t_dispatch) / 1e9,
+        compile_secs = (_time.perf_counter_ns() - t_dispatch) / 1e9
+        _budget.note_compile(base_hash, compile_secs,
                              level if _has_inline_join(fused_plan)
                              else _budget.MAX_SPLIT_LEVEL)
+        # Flight-recorder breadcrumb (ISSUE 13): fused compiles are the
+        # single largest cold-path cost — a post-mortem dump must show
+        # which plan paid one and when (Flare's amortized-compile thesis
+        # verified on the warm timeline: these events vanish).
+        _trace.record_event("compile.fused", plan=base_hash,
+                            secs=round(compile_secs, 3))
     # Between dispatch and download: record this run's capacity rungs in
     # the compile manifest and schedule neighbor-rung AOT warm-ups, so the
     # scheduling work overlaps the device->host transfer below.
@@ -388,7 +402,8 @@ def fused_collect(root: DeviceToHostExec, ctx: ExecContext
         jax.block_until_ready(head)
         reg.add("WholeStageFusion", "deviceTime",
                 _time.perf_counter_ns() - t_dispatch)
-    head_np = jax.device_get(head)  # ONE round trip
+    with _trace.span(tr, "fusion.download", cat="download"):
+        head_np = jax.device_get(head)  # ONE round trip
     n_rows_np, flags_np, totals_np, dfails_np, shrunk_np = head_np
     if reg.enabled:
         reg.add("WholeStageFusion", "opTime",

@@ -60,6 +60,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Iterator, List, Optional, Sequence
 
+from ..metrics import trace as _trace
 from ..utils import lockdep
 
 _STOP = object()
@@ -349,33 +350,39 @@ def _stalled_result(f: Future, ctx, node: Optional[str]):
     if f.done():
         return _result_or_shutdown(f)
     t0 = time.perf_counter_ns()
+    # ONE span for the whole wait (opened only once we know we block):
+    # the deadline branch polls in 0.1s ticks, and a span per tick would
+    # flood the tracer and flight ring during a long producer stall.
     try:
-        if deadline is None:
-            with lockdep.blocking("pipeline.future_wait"):
-                return _result_or_shutdown(f)
-        while True:
-            try:
+        with _trace.span(getattr(ctx, "trace", None), "pipeline.wait",
+                         cat="pipeline", node=node or "prefetch"):
+            if deadline is None:
                 with lockdep.blocking("pipeline.future_wait"):
-                    # An INFINITE deadline (the serving layer's
-                    # cancel-only Deadline(math.inf)) polls bounded:
-                    # result(timeout=inf) is an OverflowError in
-                    # CPython, and a cancel() could never wake an
-                    # unbounded wait.
-                    rem = deadline.remaining()
-                    return _result_or_shutdown(
-                        f, timeout=max(rem, 0.0)
-                        if math.isfinite(rem) else 0.1)
-            except _FutTimeout:
-                # On py3.11+ futures.TimeoutError IS the builtin
-                # TimeoutError, which a WORKER can legitimately raise
-                # (requestTimeout, injected stall). A done future means
-                # the exception came from the work — re-raise it instead
-                # of misreading it as a wait-timeout and spinning.
-                if f.done():
                     return _result_or_shutdown(f)
-                # Raises once expired; a spurious early wake just re-arms.
-                deadline.check(f"pipeline.wait:{node or 'prefetch'}",
-                               ctx, node)
+            while True:
+                try:
+                    with lockdep.blocking("pipeline.future_wait"):
+                        # An INFINITE deadline (the serving layer's
+                        # cancel-only Deadline(math.inf)) polls bounded:
+                        # result(timeout=inf) is an OverflowError in
+                        # CPython, and a cancel() could never wake an
+                        # unbounded wait.
+                        rem = deadline.remaining()
+                        return _result_or_shutdown(
+                            f, timeout=max(rem, 0.0)
+                            if math.isfinite(rem) else 0.1)
+                except _FutTimeout:
+                    # On py3.11+ futures.TimeoutError IS the builtin
+                    # TimeoutError, which a WORKER can legitimately raise
+                    # (requestTimeout, injected stall). A done future
+                    # means the exception came from the work — re-raise
+                    # it instead of misreading it as a wait-timeout and
+                    # spinning.
+                    if f.done():
+                        return _result_or_shutdown(f)
+                    # Raises once expired; a spurious early wake re-arms.
+                    deadline.check(f"pipeline.wait:{node or 'prefetch'}",
+                                   ctx, node)
     finally:
         if ctx is not None and node:
             ctx.metric(node, "prefetchConsumerStallNs",
@@ -384,11 +391,16 @@ def _stalled_result(f: Future, ctx, node: Optional[str]):
 
 def _decode_task(fn: Callable, item, ctx, node: Optional[str]):
     """One decode unit on the shared pool: bounded by the global decode
-    slots, busy time accounted to decodeThreadBusyNs."""
+    slots, busy time accounted to decodeThreadBusyNs. Runs on a worker
+    thread — its span parents under the trace root (the fork fallback),
+    which is exactly where concurrent decode lanes belong on the
+    timeline."""
     with _decode_limiter(getattr(ctx, "conf", None)):
         t0 = time.perf_counter_ns()
         try:
-            return fn(item)
+            with _trace.span(getattr(ctx, "trace", None), "pipeline.decode",
+                             cat="decode", node=node or "scan"):
+                return fn(item)
         finally:
             if ctx is not None and node:
                 ctx.metric(node, "decodeThreadBusyNs",
@@ -484,6 +496,15 @@ def unit_partitions(fn: Callable, units: Sequence, ctx,
 # ---------------------------------------------------------------------------
 
 
+def _serial_boundary(b, index: int, ctx, tr) -> tuple:
+    """One boundary materialized on the calling thread (single boundary,
+    pipeline off, or injector active) — same span as the worker path so
+    traces always show the boundary stage, overlapped or not."""
+    with _trace.span(tr, "pipeline.boundary", cat="pipeline", index=index,
+                     node=type(b).__name__):
+        return tuple(tuple(p) for p in b.execute(ctx))
+
+
 def materialize_boundaries(boundaries: Sequence, ctx,
                            node: str = "WholeStageFusion") -> tuple:
     """Materialize every fusion-boundary subtree's partitions, preserving
@@ -502,24 +523,33 @@ def materialize_boundaries(boundaries: Sequence, ctx,
     parallelism = boundary_parallelism(getattr(ctx, "conf", None))
     if len(boundaries) <= 1 or not parallel_active(ctx) \
             or parallelism <= 1:
-        return tuple(tuple(tuple(p) for p in b.execute(ctx))
-                     for b in boundaries)
+        tr = getattr(ctx, "trace", None)
+        return tuple(
+            _serial_boundary(b, i, ctx, tr)
+            for i, b in enumerate(boundaries))
     subs = [ctx.fork_for_boundary(i) for i in range(len(boundaries))]
     pool = get_pool()
     slots = threading.BoundedSemaphore(parallelism)
     sem = getattr(ctx, "semaphore", None)
+    # Span context forked ONCE on the dispatching thread: every worker's
+    # boundary span parents under the span open HERE (fusion.boundaries),
+    # not wherever the worker's own stack happens to be.
+    span_fork = _trace.fork(getattr(ctx, "trace", None))
 
-    def run_one(b, sub):
+    def run_one(b, sub, index):
         with slots:
             admission = sem if sem is not None else contextlib.nullcontext()
             with admission:
                 t0 = time.perf_counter_ns()
-                out = tuple(tuple(p) for p in b.execute(sub))
+                with _trace.span(span_fork, "pipeline.boundary",
+                                 cat="pipeline", index=index,
+                                 node=type(b).__name__):
+                    out = tuple(tuple(p) for p in b.execute(sub))
                 return out, time.perf_counter_ns() - t0
 
     t_wall = time.perf_counter_ns()
-    futs = [pool.submit(run_one, b, sub)
-            for b, sub in zip(boundaries, subs)]
+    futs = [pool.submit(run_one, b, sub, i)
+            for i, (b, sub) in enumerate(zip(boundaries, subs))]
     release = sem.released() if sem is not None \
         else contextlib.nullcontext()
     results: List = []
@@ -531,7 +561,9 @@ def materialize_boundaries(boundaries: Sequence, ctx,
         # parent so ctx.close() can run them.
         for f in futs:
             try:
-                with lockdep.blocking("pipeline.boundary_wait"):
+                with _trace.span(getattr(ctx, "trace", None),
+                                 "pipeline.boundary_wait", cat="pipeline"), \
+                        lockdep.blocking("pipeline.boundary_wait"):
                     results.append(f.result())
             # Collect-and-re-raise: the FIRST failure propagates verbatim
             # after every worker has stopped touching its fork (the

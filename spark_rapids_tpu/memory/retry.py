@@ -250,8 +250,11 @@ def backoff_sleep(policy: RetryPolicy, site: str, attempt: int,
         delay = deadline.bound(delay)
     if delay <= 0:
         return
+    from ..metrics import trace as TR
     t0 = time.perf_counter_ns()
-    with lockdep.blocking("retry.backoff_sleep"):
+    with TR.span(getattr(ctx, "trace", None), "retry.backoff", cat="retry",
+                 site=site, attempt=attempt), \
+            lockdep.blocking("retry.backoff_sleep"):
         time.sleep(delay)
     if ctx is not None and node is not None:
         ctx.metric(node, "retryBlockTimeNs", time.perf_counter_ns() - t0)
@@ -375,9 +378,13 @@ def with_retry(ctx, site: str, inputs, attempt: Callable,
                     # machine makes concurrent drains safe, so one
                     # query's recovery never queues behind a neighbor's
                     # disk write.
-                    with _OOM_RECOVERY_LOCK:
-                        synchronize_device()
-                    spill_device_below(ctx)
+                    from ..metrics import trace as TR
+                    with TR.span(getattr(ctx, "trace", None),
+                                 "retry.oom_recovery", cat="retry",
+                                 site=site):
+                        with _OOM_RECOVERY_LOCK:
+                            synchronize_device()
+                        spill_device_below(ctx)
                     if retries >= policy.max_retries:
                         if split is None:
                             raise SplitAndRetryOOM(site) from e

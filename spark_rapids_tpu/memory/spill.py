@@ -103,6 +103,11 @@ class QosTag:
 
     tenant: str = ""
     deadline: object = None
+    #: the requesting query's span tracer (metrics/trace.py, ISSUE 13):
+    #: spill-IO lane units opened on this query's behalf record their
+    #: device<->host/disk transitions as spans in ITS trace — None (the
+    #: default) records nothing
+    trace: object = None
 
     def slack(self) -> float:
         """Seconds of deadline headroom; +inf without a deadline. A
@@ -1030,10 +1035,14 @@ class BufferCatalog:
                 self._spill_job(e, requester)
             else:
                 submitted.append((f, e))
+        from ..metrics import trace as _tracing
         err: Optional[BaseException] = None
         for f, e in submitted:
             try:
-                with lockdep.blocking("spill.io_wait"):
+                with _tracing.span(
+                        requester.trace if requester is not None else None,
+                        "spill.io_wait", cat="spill"), \
+                        lockdep.blocking("spill.io_wait"):
                     f.result()
             except BaseException as exc:  # tpu-lint: ignore - collect-
                 # re-raise: every job must settle (publish or revert)
@@ -1076,11 +1085,21 @@ class BufferCatalog:
             self._io_running += 1
             if self._io_running > self.metrics["spill_concurrent_peak"]:
                 self.metrics["spill_concurrent_peak"] = self._io_running
+        # Lane-transition span (ISSUE 13): runs on the IO-lane worker, so
+        # it parents under the requesting query's trace root — concurrent
+        # lane units show as overlapping spans, the proof the PR-11
+        # off-lock engine actually overlaps.
+        from ..metrics import trace as _tracing
         try:
-            if entry.moving_from == StorageTier.DEVICE:
-                self._spill_device_job(entry, requester)
-            else:
-                self._spill_host_job(entry)
+            with _tracing.span(
+                    requester.trace if requester is not None else None,
+                    "spill.io", cat="spill",
+                    tier=entry.moving_from or entry.tier,
+                    bytes=entry.meta.size_bytes):
+                if entry.moving_from == StorageTier.DEVICE:
+                    self._spill_device_job(entry, requester)
+                else:
+                    self._spill_host_job(entry)
         finally:
             with self._lock:
                 self._io_running -= 1

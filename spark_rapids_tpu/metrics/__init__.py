@@ -12,7 +12,14 @@ Three pieces (docs/monitoring.md):
   ``df.explain(metrics=True)`` and diffed by
   ``tools/profile_bench.py --compare``.
 * :mod:`.eventlog` — crash-safe JSON-lines event log
-  (``spark.rapids.tpu.metrics.eventLog.dir``), one line per query.
+  (``spark.rapids.tpu.metrics.eventLog.dir``), one line per query, with
+  size-capped rotation for long-lived serving processes.
+* :mod:`.trace` — per-query distributed tracing (ISSUE 13,
+  ``spark.rapids.tpu.trace.enabled``): the span-tree engine, Chrome
+  trace-event export, wire-propagated trace context, and the
+  flight-recorder ring. Not re-exported here (call sites import the
+  module directly — its disabled path is one None check);
+  ``tools/trace_report.py`` is the analyzer.
 """
 
 from .eventlog import EventLog

@@ -21,12 +21,34 @@ FILENAME = "query_profiles.jsonl"
 
 
 class EventLog:
-    """Append-only JSON-lines writer for query profiles."""
+    """Append-only JSON-lines writer for query profiles.
 
-    def __init__(self, directory: str):
+    ``max_bytes`` (``spark.rapids.tpu.metrics.eventLog.maxBytes``) caps
+    growth in a long-lived serving process: an append that would push
+    the file past the cap first rotates it to ``<name>.1`` via
+    ``os.replace`` — atomic, so a crash mid-rotation leaves either the
+    old or the new generation intact, never a torn hybrid — and keeps
+    exactly one prior generation. Torn-line tolerance is unchanged: both
+    generations are read with the same skip-corrupt-lines reader."""
+
+    def __init__(self, directory: str, max_bytes: int = 0):
         self.dir = directory
         self.path = os.path.join(directory, FILENAME)
+        self.max_bytes = int(max_bytes)
         self._lock = lockdep.lock("EventLog._lock", io_ok=True)
+
+    def _rotate_if_needed(self, incoming: int) -> None:
+        """Rotate under the lock when the NEXT append would cross the
+        cap (a single record larger than the cap still appends — the
+        cap bounds the file, not the record)."""
+        if self.max_bytes <= 0:
+            return
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return
+        if size > 0 and size + incoming > self.max_bytes:
+            os.replace(self.path, self.path + ".1")
 
     def append(self, profile) -> bool:
         """Append one profile (QueryProfile or plain dict); returns False
@@ -34,13 +56,14 @@ class EventLog:
         observability aid, never a correctness dependency."""
         record = profile if isinstance(profile, dict) else profile.to_dict()
         try:
-            line = json.dumps(record, separators=(",", ":"),
-                              default=_jsonable) + "\n"
+            payload = (json.dumps(record, separators=(",", ":"),
+                                  default=_jsonable) + "\n").encode("utf-8")
         except (TypeError, ValueError):
             return False
         with self._lock:
             try:
                 os.makedirs(self.dir, exist_ok=True)
+                self._rotate_if_needed(len(payload))
                 # A previous writer may have crashed mid-append, leaving a
                 # torn line with no trailing newline; start this record on
                 # a fresh line so the torn one stays isolated (and skipped
@@ -55,7 +78,7 @@ class EventLog:
                     pass
                 with open(self.path, "ab") as f:
                     f.write((b"\n" if needs_nl else b"")
-                            + line.encode("utf-8"))  # one write per record
+                            + payload)  # one write per record
                     f.flush()
             except OSError:
                 return False
@@ -89,6 +112,13 @@ def read(path: str) -> List[dict]:
     except OSError:
         return []
     return out
+
+
+def read_all(directory: str) -> List[dict]:
+    """Every intact profile across the rotated generation (``.1``, older)
+    and the current file, in append order."""
+    path = os.path.join(directory, FILENAME)
+    return read(path + ".1") + read(path)
 
 
 def log_path(directory: Optional[str]) -> Optional[str]:

@@ -110,6 +110,11 @@ class ExecContext:
     #: the reference), so "own buffer" in the victim order means "same
     #: query" across every worker of one execution.
     qos: object = None
+    #: Per-query span tracer (metrics/trace.py), or None (the default —
+    #: every span site pays one None check and records nothing). Shared
+    #: by boundary forks like the registry; worker threads parent their
+    #: spans through trace.fork()/SpanCtx or the trace root fallback.
+    trace: object = None
     _join_site: int = 0
     #: Base offset for next_join_site ordinals: pipeline boundary forks
     #: get disjoint deterministic namespaces so concurrent materialization
@@ -134,7 +139,8 @@ class ExecContext:
                 tenant = self.conf.get(TENANT_ID) or ""
             except (AttributeError, TypeError):
                 tenant = ""  # bare test doubles without a TpuConf
-            self.qos = QosTag(tenant=tenant, deadline=self.deadline)
+            self.qos = QosTag(tenant=tenant, deadline=self.deadline,
+                              trace=self.trace)
 
     def next_join_site(self) -> int:
         """Deterministic per-execution ordinal for a join probe batch

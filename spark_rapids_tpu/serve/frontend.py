@@ -10,8 +10,12 @@ real socket. Protocol v1, deliberately simple:
   validation role).
 * requests/responses: one JSON object per line (UTF-8,
   newline-delimited). Ops: ``query`` (``tenant``, ``query`` name,
-  optional ``collect`` to inline the result columns), ``stats``,
-  ``invalidate`` (``tenant``), ``ping``.
+  optional ``collect`` to inline the result columns, optional ``trace``
+  — a ``"<trace_id>/<parent_span>"`` context that stitches this query
+  into the CLIENT's distributed trace, ISSUE 13), ``stats`` (counters
+  plus the live ``health`` view), ``health`` (the health/inflight view
+  alone: running queries with tenant/elapsed/current span, queue
+  depths, HBM watermark), ``invalidate`` (``tenant``), ``ping``.
 * every query response carries ``rows`` and the CRC32C of the
   Arrow-IPC-serialized result, so a client can assert bit-identity with
   an oracle without shipping the data; ``collect: true`` adds the
@@ -115,7 +119,13 @@ class _Handler(socketserver.StreamRequestHandler):
         if op == "ping":
             return self._send({"ok": True, "op": "ping"})
         if op == "stats":
-            return self._send({"ok": True, "stats": service.stats()})
+            # The live health/inflight view rides the stats op (ISSUE 13
+            # satellite): one round trip answers both "what happened"
+            # (counters) and "what is happening" (inflight).
+            return self._send({"ok": True, "stats": service.stats(),
+                               "health": service.health()})
+        if op == "health":
+            return self._send({"ok": True, "health": service.health()})
         if op == "invalidate":
             n = service.invalidate(str(req.get("tenant", "")))
             return self._send({"ok": True, "invalidated": n})
@@ -127,6 +137,9 @@ class _Handler(socketserver.StreamRequestHandler):
         if not isinstance(name, str) or name not in service._queries:
             return self._send({"ok": False, "error": "UnknownQuery",
                                "message": f"no registered query {name!r}"})
+        wire_trace = req.get("trace")
+        if wire_trace is not None and not isinstance(wire_trace, str):
+            wire_trace = None
         ticket = QueryTicket()
         done = threading.Event()
         box: dict = {}
@@ -138,7 +151,8 @@ class _Handler(socketserver.StreamRequestHandler):
         def run():
             from ..memory.retry import classify
             try:
-                result = service.execute(tenant, name, ticket=ticket)
+                result = service.execute(tenant, name, ticket=ticket,
+                                         trace=wire_trace)
                 with box_lock:
                     box["result"] = result
             except BaseException as e:  # noqa: BLE001 - forwarded to wire
@@ -239,12 +253,19 @@ class ServeClient:
         line, _, self._buf = self._buf.partition(b"\n")
         return json.loads(line)
 
-    def query(self, tenant: str, name: str, collect: bool = False) -> dict:
-        return self._roundtrip({"op": "query", "tenant": tenant,
-                                "query": name, "collect": collect})
+    def query(self, tenant: str, name: str, collect: bool = False,
+              trace: Optional[str] = None) -> dict:
+        req = {"op": "query", "tenant": tenant, "query": name,
+               "collect": collect}
+        if trace:
+            req["trace"] = trace  # "<trace_id>/<parent_span>" (ISSUE 13)
+        return self._roundtrip(req)
 
     def stats(self) -> dict:
         return self._roundtrip({"op": "stats"})
+
+    def health(self) -> dict:
+        return self._roundtrip({"op": "health"})
 
     def invalidate(self, tenant: str) -> dict:
         return self._roundtrip({"op": "invalidate", "tenant": tenant})

@@ -55,6 +55,7 @@ from ..config import (SERVE_MAX_CONCURRENT, SERVE_MAX_QUEUE_DEPTH,
                       TENANT_ID, TpuConf)
 from ..memory.semaphore import (AdmissionCancelled, AdmissionQueueFull,
                                 FairShareGate)
+from ..metrics import trace as TR
 from ..utils import lockdep
 from ..utils.deadline import Deadline, QueryDeadlineExceeded
 from ..utils.fault_injection import FaultInjector
@@ -235,8 +236,16 @@ class QueryService:
         #: the SERVICE's injector (serving seams); pooled sessions build
         #: their own from the same conf for the engine-site schedules.
         self._injector = FaultInjector.maybe(self.conf)
+        # Distributed tracing (metrics/trace.py, ISSUE 13): the serving
+        # layer owns the per-query tracer so the exported trace spans the
+        # FULL journey — admission queue wait included, which session-
+        # created tracers can never see.
+        TR.configure(self.conf)
         self._closed = False
         self._stats_lock = lockdep.lock("QueryService._stats_lock")
+        #: live queries for the health/inflight view (ISSUE 13 satellite)
+        self._inflight: Dict[int, dict] = {}
+        self._inflight_seq = 0
         self._stats = {"sessions_replaced": 0, "sessions_lost": 0,
                        "crash_reruns": 0, "quarantine_trips": 0}
         self._tenant_stats: Dict[str, Dict[str, int]] = {}
@@ -297,6 +306,54 @@ class QueryService:
                                if v}
         return out
 
+    # -- health / inflight view (ISSUE 13 satellite) -------------------------
+    def _inflight_register(self, tenant: str, name: Optional[str],
+                           tracer) -> int:
+        with self._stats_lock:
+            self._inflight_seq += 1
+            key = self._inflight_seq
+            self._inflight[key] = {"tenant": tenant,
+                                   "query": name or "<adhoc>",
+                                   "t0": time.monotonic(),
+                                   "tracer": tracer}
+        return key
+
+    def _inflight_done(self, key: int) -> None:
+        with self._stats_lock:
+            self._inflight.pop(key, None)
+
+    def health(self) -> dict:
+        """Live introspection — the trace export's in-the-moment twin:
+        currently-running queries (tenant, query, elapsed, the span each
+        is inside RIGHT NOW when tracing is on), admission queue depths,
+        and the HBM watermark. Served by the frontend's ``stats`` /
+        ``health`` ops (docs/serving.md)."""
+        now = time.monotonic()
+        with self._stats_lock:
+            entries = [(k, dict(v)) for k, v in self._inflight.items()]
+        inflight = []
+        for _k, e in sorted(entries):
+            tracer = TR.tracer_of(e.pop("tracer", None))
+            span = None
+            if tracer is not None:
+                # Outside the stats lock: the tracer has its own lock and
+                # the order edge must stay one-way.
+                span = tracer.current_span_name()
+            inflight.append({"tenant": e["tenant"], "query": e["query"],
+                             "elapsed_ms": round((now - e["t0"]) * 1e3, 3),
+                             "span": span})
+        hbm = {}
+        if self._all_slots:
+            try:
+                hbm = self._all_slots[0].session.device_manager \
+                    .hbm_watermarks()
+            except (AttributeError, RuntimeError, OSError):
+                hbm = {}  # introspection aid only — never fail stats
+        return {"inflight": inflight,
+                "queue_depth": self.gate.depth(),
+                "gate": dict(self.gate.stats),
+                "hbm": hbm}
+
     # -- slot pool ----------------------------------------------------------
     def _borrow_slot(self, deadline: Optional[Deadline]) -> _PooledSlot:
         with self._slots_cond:
@@ -335,14 +392,24 @@ class QueryService:
 
     def execute(self, tenant: str, query: Union[str, Callable],
                 read_only: bool = True,
-                ticket: Optional[QueryTicket] = None) -> ServeResult:
+                ticket: Optional[QueryTicket] = None,
+                trace=None) -> ServeResult:
         """Run one query for ``tenant`` — a registered name or a builder
         callable taking the dict of loaded DataFrames. Blocks the
         calling thread (the frontend gives each connection its own);
         raises only TYPED errors (:mod:`.errors`,
         ``QueryDeadlineExceeded`` for a spent budget). ``read_only=False``
         marks a side-effecting query: it is never re-run after a session
-        crash (PR-4 write rule)."""
+        crash (PR-4 write rule).
+
+        ``trace`` (ISSUE 13) threads in the caller's trace context: a
+        :class:`~..metrics.trace.Tracer` (tests — the caller exports), a
+        wire string ``"<trace_id>/<parent_span>"`` (the frontend's SRTQS
+        ``trace`` field — joins the client's trace), or None (a tracer
+        is created here when ``spark.rapids.tpu.trace.enabled`` is on).
+        The serving layer owns the root span, so the exported trace
+        covers admission queue wait THROUGH shuffle fetches — the whole
+        journey a session-created tracer cannot see."""
         if self._closed:
             raise ServiceClosedError()
         t0 = time.perf_counter_ns()
@@ -360,6 +427,45 @@ class QueryService:
             deadline.cancel()
         self._tstat(tenant, "submitted")
         name = query if isinstance(query, str) else None
+        tracer, owns_trace = self._trace_for(tenant, trace)
+        inflight_key = self._inflight_register(tenant, name, tracer)
+        try:
+            with TR.span(tracer, "serve.query", cat="serve", tenant=tenant,
+                         query=name or "<adhoc>"):
+                return self._execute_guarded(tenant, query, name, t0,
+                                             read_only, ticket, deadline,
+                                             tracer)
+        finally:
+            self._inflight_done(inflight_key)
+            if owns_trace:
+                TR.export_chrome(tracer, TR.export_dir(self.conf))
+
+    def _trace_for(self, tenant: str, trace):
+        """Resolve the ``trace`` argument to ``(tracer, owns_export)``:
+        whoever CREATES a tracer exports it — a caller-passed Tracer is
+        theirs; a wire context that resolves to a live in-process tracer
+        is its creator's; an adopted cross-process sibling (same trace
+        id, new tracer) and a conf-created tracer are ours."""
+        if trace is not None and not isinstance(trace, str):
+            return trace, False
+        if isinstance(trace, str):
+            tid, parent = TR.parse_wire(trace)
+            if tid is not None:
+                live = TR.live_tracer(tid)
+                if live is not None:
+                    # In-process client: join its tracer AND keep its
+                    # wire parent — serve.query must be a CHILD of the
+                    # client's RPC span, not a sibling root.
+                    return TR.SpanCtx(live, parent or live._root_id), \
+                        False
+                tracer = TR.adopt(tid, parent, tenant)
+                return tracer, tracer is not None
+        tracer = TR.maybe_tracer(self.conf, tenant)
+        return tracer, tracer is not None
+
+    def _execute_guarded(self, tenant: str, query, name: Optional[str],
+                         t0: int, read_only: bool, ticket: QueryTicket,
+                         deadline: Deadline, tracer) -> ServeResult:
         with self._stats_lock:
             known_hash = self._plan_hashes.get(name) if name else None
         #: the half-open probe this request currently OWNS (plan hash,
@@ -390,12 +496,15 @@ class QueryService:
                 time.sleep(_ADMISSION_STALL_SECS)
             elif flavor == "tenantKill":
                 ticket.cancel("injected tenant kill (queued)")
-            self.gate.acquire(tenant, deadline=deadline,
-                              waiter_out=ticket._waiter_box)
+            with TR.span(tracer, "serve.admission", cat="serve",
+                         tenant=tenant):
+                self.gate.acquire(tenant, deadline=deadline,
+                                  waiter_out=ticket._waiter_box)
             try:
                 return self._execute_admitted(tenant, query, name, t0,
                                               read_only, ticket, deadline,
-                                              known_hash, probe_box)
+                                              known_hash, probe_box,
+                                              tracer)
             finally:
                 self.gate.release()
         except AdmissionQueueFull as e:
@@ -423,7 +532,7 @@ class QueryService:
     def _execute_admitted(self, tenant: str, query, name: Optional[str],
                           t0: int, read_only: bool, ticket: QueryTicket,
                           deadline: Deadline, checked_hash: Optional[str],
-                          probe_box: dict) -> ServeResult:
+                          probe_box: dict, tracer=None) -> ServeResult:
         from ..memory.retry import Classification, classify
         from ..memory.spill import QosTag
         from ..metrics.profile import plan_profile_hash
@@ -432,20 +541,25 @@ class QueryService:
         plan_hash = None
         while True:
             attempts += 1
-            slot = self._borrow_slot(deadline)
+            with TR.span(tracer, "serve.slot_wait", cat="serve"):
+                slot = self._borrow_slot(deadline)
             try:
                 mbudget = _budget_for(self._memory_budgets, tenant)
                 if mbudget > 0:
-                    moved = slot.session.device_manager.catalog \
-                        .spill_tenant_over_budget(
-                            tenant, int(mbudget),
-                            requester=QosTag(tenant=tenant,
-                                             deadline=deadline))
+                    with TR.span(tracer, "serve.budget_spill", cat="serve",
+                                 tenant=tenant):
+                        moved = slot.session.device_manager.catalog \
+                            .spill_tenant_over_budget(
+                                tenant, int(mbudget),
+                                requester=QosTag(tenant=tenant,
+                                                 deadline=deadline,
+                                                 trace=tracer))
                     if moved:
                         self._tstat(tenant, "budget_spill_bytes", moved)
                 sess = slot.session_for(tenant)
-                logical = self._build_logical(query, slot)
-                physical = sess.plan(logical)
+                with TR.span(tracer, "serve.plan", cat="serve"):
+                    logical = self._build_logical(query, slot)
+                    physical = sess.plan(logical)
                 plan_hash = plan_profile_hash(plan_signature(physical))
                 if name:
                     with self._stats_lock:
@@ -482,9 +596,18 @@ class QueryService:
                     # exercises the same unwind a client disconnect does.
                     ticket.cancel("injected tenant kill (running)")
                 profiles: List = []
-                table = sess.execute(logical, deadline=deadline,
-                                     profile_sink=profiles.append)
-            except SessionCrashError:
+                with TR.span(tracer, "serve.execute", cat="serve",
+                             attempt=attempts):
+                    table = sess.execute(logical, deadline=deadline,
+                                         profile_sink=profiles.append,
+                                         trace=tracer)
+            except SessionCrashError as crash:
+                # Flight-recorder dump (ISSUE 13): the crashed session's
+                # recent spans/events are the post-mortem — snapshot
+                # before the replace churns the ring (bounded per
+                # reason; no-op with tracing off).
+                TR.flight_dump("session_crash", tenant=tenant,
+                               sid=getattr(crash, "session_id", None))
                 # Swap the slot out of the finally's return path FIRST:
                 # if the replacement itself fails, the dead slot must
                 # never go back to the pool.
@@ -557,3 +680,7 @@ class QueryService:
         with self._stats_lock:
             self._stats["quarantine_trips"] += 1
         self._tstat(tenant, "quarantined")
+        # Quarantine means a plan burned its whole retry ladder
+        # repeatedly — dump what the engine was doing (ISSUE 13;
+        # bounded per reason, no-op with tracing off).
+        TR.flight_dump("quarantine", tenant=tenant)

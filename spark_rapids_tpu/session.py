@@ -80,6 +80,12 @@ class TpuSession:
         self._query_seq = 0
         self._event_log = None
         self._profiles = {}
+        # Distributed-tracing layer (metrics/trace.py, ISSUE 13): snapshot
+        # the trace confs; per-query tracers are created lazily in
+        # execute() only when spark.rapids.tpu.trace.enabled is on.
+        self._last_tracer = None
+        from .metrics import trace as _trace
+        _trace.configure(self.conf)
         from .utils import lockdep as _lockdep
         self._profiles_lock = _lockdep.lock("TpuSession._profiles_lock")
         # close() is idempotent and safe under concurrent callers — the
@@ -118,6 +124,9 @@ class TpuSession:
         s._query_seq = 0
         s._event_log = None
         s._profiles = {}
+        s._last_tracer = None
+        from .metrics import trace as _trace
+        _trace.configure(s.conf)
         from .utils import lockdep as _lockdep
         s._profiles_lock = _lockdep.lock("TpuSession._profiles_lock")
         s._close_lock = _lockdep.lock("TpuSession._close_lock")
@@ -260,7 +269,7 @@ class TpuSession:
 
     def _run_with_retries(self, fn, eager_only: bool = False,
                           plan_sig: Optional[tuple] = None,
-                          deadline=None):
+                          deadline=None, trace=None):
         """Run ``fn(ctx, mode) -> (result, overflowed)``; on a deferred join
         overflow, learn the exact output capacities from the run's observed
         match totals and retry with them (cached per plan signature).
@@ -278,6 +287,7 @@ class TpuSession:
         import jax
         from .data.column import bucket_capacity
         from .memory import retry as R
+        from .metrics import trace as TR
         from .utils.deadline import Deadline
         from .utils.fault_injection import maybe_inject
         policy = R.RetryPolicy.from_conf(self.conf)
@@ -337,7 +347,8 @@ class TpuSession:
                                     fault_injector=self._fault_injector,
                                     semaphore=self.device_manager.semaphore,
                                     deadline=deadline,
-                                    shuffle_tracker=self._shuffle_tracker)
+                                    shuffle_tracker=self._shuffle_tracker,
+                                    trace=trace)
                 ctx.join_caps = caps
                 ctx.dense_modes = dict(dense_modes)
                 ctx.join_growth = growth
@@ -352,7 +363,9 @@ class TpuSession:
                     # spark.rapids.sql.concurrentTpuTasks). Wait time is
                     # accumulated by the semaphore itself (wait_ns); the
                     # query profile reports the per-query delta.
-                    with self.device_manager.semaphore:
+                    with TR.span(trace, "session.dispatch", cat="session",
+                                 attempt=attempt, retry=dispatch_try), \
+                            self.device_manager.semaphore:
                         result, overflowed = fn(
                             ctx, "eager" if eager else "deferred")
                     if dispatch_retries:
@@ -384,8 +397,10 @@ class TpuSession:
                         R.spill_device_below(ctx)
                     dispatch_retries += 1
                     t0 = time.perf_counter_ns()
-                    R.backoff_sleep(policy, "session.dispatch",
-                                    dispatch_try)
+                    with TR.span(trace, "retry.backoff", cat="retry",
+                                 site="session.dispatch"):
+                        R.backoff_sleep(policy, "session.dispatch",
+                                        dispatch_try)
                     dispatch_block_ns += time.perf_counter_ns() - t0
                     dispatch_try += 1
                 finally:
@@ -451,7 +466,7 @@ class TpuSession:
         return physical
 
     def execute(self, logical: L.LogicalPlan, deadline=None,
-                profile_sink=None) -> pa.Table:
+                profile_sink=None, trace=None) -> pa.Table:
         """Plan + run. Joins size their output optimistically with a
         deferred device-side overflow flag (no per-batch host syncs); when a
         flag trips the query re-runs with the EXACT capacities learned from
@@ -464,10 +479,38 @@ class TpuSession:
         serving layer passes its per-tenant budget / cancellable one);
         ``profile_sink`` receives THIS query's QueryProfile — the
         race-free way for a concurrent caller to get its own profile
-        instead of reading the last-slot shim (docs/serving.md)."""
+        instead of reading the last-slot shim (docs/serving.md);
+        ``trace`` threads in a caller-owned span tracer (the serving
+        layer's — it exports the stitched trace itself), else one is
+        created here when spark.rapids.tpu.trace.enabled is on and
+        exported beside the event log at query end (ISSUE 13,
+        docs/monitoring.md#distributed-tracing)."""
         from .exec import fusion
+        from .metrics import trace as TR
         from .metrics.profile import QueryProfiler
-        physical = self.plan(logical)
+        import contextlib
+        tracer = trace
+        created_trace = False
+        if tracer is None:
+            from .config import TENANT_ID
+            tracer = TR.maybe_tracer(
+                self.conf, str(self.conf.get(TENANT_ID) or ""))
+            created_trace = tracer is not None
+        # A session-created tracer gets an explicit root span covering
+        # the whole query, so plan/dispatch/export are SIBLINGS under it
+        # (a serving-owned tracer already has serve.query as the root).
+        _root = contextlib.ExitStack()
+        if created_trace:
+            _root.enter_context(TR.span(tracer, "session.query",
+                                        cat="session"))
+        try:
+            with TR.span(tracer, "session.plan", cat="session"):
+                physical = self.plan(logical)
+        except BaseException:
+            if created_trace:
+                _root.close()
+                self._export_trace(tracer)
+            raise
         profiler = QueryProfiler.maybe(self)
         final = {}
 
@@ -486,20 +529,44 @@ class TpuSession:
                 # Boundary subtrees (windows, broadcasts, ...) executed
                 # eagerly with THIS ctx: their deferred flags gate too.
                 return table, overflowed or fusion.any_overflow(ctx)
-            table = P.collect_partitions(physical, ctx)
+            # Streaming (non-fused) path: one span covering the whole
+            # operator-at-a-time collect, so partially-offloaded plans
+            # still show where execution time went (ISSUE 13).
+            with TR.span(tracer, "session.stream_collect", cat="dispatch"):
+                table = P.collect_partitions(physical, ctx)
             return table, fusion.any_overflow(ctx)
         # Write plans are side-effecting: a discard-and-retry would commit
         # truncated files first, so they always use the eager exact-resize
         # join path (writes are IO-bound anyway).
         from .utils.kernel_cache import plan_signature
         sig = plan_signature(physical)
-        result = self._run_with_retries(run,
-                                        eager_only=_contains_write(physical),
-                                        plan_sig=sig, deadline=deadline)
+        try:
+            result = self._run_with_retries(
+                run, eager_only=_contains_write(physical),
+                plan_sig=sig, deadline=deadline, trace=tracer)
+        except BaseException:
+            if created_trace:
+                _root.close()
+                self._export_trace(tracer)
+            raise
         if profiler is not None and final.get("ctx") is not None:
             self._note_profile(profiler, physical, final["ctx"], sig,
-                               profile_sink)
+                               profile_sink, tracer=tracer)
+        if created_trace:
+            _root.close()
+            self._export_trace(tracer)
         return result
+
+    def _export_trace(self, tracer) -> None:
+        """Finish and export a session-created tracer (best-effort; a
+        failed export never fails the query). The last tracer is kept
+        for diagnostics/tests like the last-profile shim."""
+        from .metrics import trace as TR
+        self._last_tracer = tracer
+        try:
+            TR.export_chrome(tracer, TR.export_dir(self.conf))
+        except Exception:  # noqa: BLE001 - observability aid, not a gate
+            pass
 
     def materialize(self, logical: L.LogicalPlan) -> "L.CachedRelation":
         """Execute now and pin the result (eager df.cache()). Under a
@@ -564,7 +631,7 @@ class TpuSession:
     _MAX_PROFILES = 256
 
     def _note_profile(self, profiler, physical, ctx, plan_sig,
-                      profile_sink=None) -> None:
+                      profile_sink=None, tracer=None) -> None:
         """Snapshot the finished query into the session's per-query-id
         profile map, the last-slot shim, and the structured event log
         (best-effort: observability must never fail a query). Query ids
@@ -575,6 +642,10 @@ class TpuSession:
             with self._profiles_lock:
                 self._query_seq += 1
                 qid = self._query_seq
+            if tracer is not None:
+                # Stamp the profile's query id into the trace header so
+                # the two artifacts join without a side channel.
+                tracer.query_id = qid
             prof = profiler.finish(physical, ctx, plan_sig, qid)
         except Exception:  # noqa: BLE001 - profile is an aid, not a gate
             return
@@ -587,8 +658,12 @@ class TpuSession:
             log = None
             if log_dir:
                 if self._event_log is None or self._event_log.dir != log_dir:
+                    from .config import METRICS_EVENT_LOG_MAX_BYTES
                     from .metrics.eventlog import EventLog
-                    self._event_log = EventLog(log_dir)
+                    self._event_log = EventLog(
+                        log_dir,
+                        max_bytes=int(
+                            self.conf.get(METRICS_EVENT_LOG_MAX_BYTES)))
                 log = self._event_log
         if profile_sink is not None:
             try:
@@ -616,6 +691,13 @@ class TpuSession:
         (or ``execute``'s ``profile_sink``) for race-free attribution."""
         with self._profiles_lock:
             return self._last_profile
+
+    def last_trace(self):
+        """The :class:`~spark_rapids_tpu.metrics.trace.Tracer` of the
+        most recent SESSION-created traced query (None when tracing is
+        off or the serving layer owned the tracer) — the
+        last-query-profile shim's tracing twin, for tests/diagnostics."""
+        return self._last_tracer
 
     def explain_metrics(self, logical: L.LogicalPlan) -> str:
         """The metric-annotated EXPLAIN tree (df.explain(metrics=True)):

@@ -511,7 +511,10 @@ class MapOutputTracker:
             if self._recomputes.get(key, 0) >= self.MAX_RECOMPUTES:
                 return None
             self._recomputes[key] = self._recomputes.get(key, 0) + 1
-        out = fn(reduce_id)
+        from ..metrics import trace as TR
+        with TR.span(getattr(ctx, "trace", None), "shuffle.recompute",
+                     cat="shuffle", shuffle=shuffle_id, reduce=reduce_id):
+            out = fn(reduce_id)
         with self._lock:
             self.metrics["recomputes"] += 1
             self.metrics["map_tasks_recomputed"] += len(out)
@@ -533,7 +536,11 @@ class MapOutputTracker:
             fn = self._peer_lineage
         if fn is None:
             return None
-        out = fn(peer, shuffle_id, reduce_id)
+        from ..metrics import trace as TR
+        with TR.span(getattr(ctx, "trace", None), "shuffle.recompute",
+                     cat="shuffle", peer=str(tuple(peer)),
+                     shuffle=shuffle_id, reduce=reduce_id):
+            out = fn(peer, shuffle_id, reduce_id)
         if out is None:
             return None
         with self._lock:
@@ -836,22 +843,29 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         def write_map(rb, ids_np, this_map_id):
             """Serialize one map task's partition slices into the catalog
             (host-only work — blocks are keyed by map_id, so completion
-            order never affects reduce-side contents)."""
-            # Contiguous runs per partition id (ids are sorted).
-            starts = np.searchsorted(ids_np, np.arange(n_parts),
-                                     side="left")
-            ends = np.searchsorted(ids_np, np.arange(n_parts),
-                                   side="right")
-            for p in range(n_parts):
-                if ends[p] > starts[p]:
-                    piece = rb.slice(starts[p], ends[p] - starts[p])
-                    with ctx.registry.timer(
-                            name, "serializationTime",
-                            trace="shuffle.serialize"):
-                        payload = serialize_batch(piece, codec)
-                    ctx.metric(name, "shuffleBytesWritten",
-                               len(payload))
-                    catalog.add_block(shuffle_id, this_map_id, p, payload)
+            order never affects reduce-side contents). Runs on a shared-
+            pool worker under overlap, so its span parents through the
+            trace-root fallback like every other worker lane."""
+            from ..metrics import trace as TR
+            with TR.span(getattr(ctx, "trace", None), "shuffle.map",
+                         cat="shuffle", shuffle=shuffle_id,
+                         map=this_map_id):
+                # Contiguous runs per partition id (ids are sorted).
+                starts = np.searchsorted(ids_np, np.arange(n_parts),
+                                         side="left")
+                ends = np.searchsorted(ids_np, np.arange(n_parts),
+                                       side="right")
+                for p in range(n_parts):
+                    if ends[p] > starts[p]:
+                        piece = rb.slice(starts[p], ends[p] - starts[p])
+                        with ctx.registry.timer(
+                                name, "serializationTime",
+                                trace="shuffle.serialize"):
+                            payload = serialize_batch(piece, codec)
+                        ctx.metric(name, "shuffleBytesWritten",
+                                   len(payload))
+                        catalog.add_block(shuffle_id, this_map_id, p,
+                                          payload)
 
         # Pipeline overlap: map-task serialization runs on the shared
         # pool while the NEXT batch's partition sort dispatches on the

@@ -67,15 +67,46 @@ from .transport import (BlockDescriptor, BounceBufferPool,
                         Transport)
 
 MAGIC = b"SRTPU"
-#: v3: CRC32C in META entries and FETCH responses (ISSUE 7).
-VERSION = 3
+#: v3 added CRC32C in META entries and FETCH responses (ISSUE 7); v4
+#: adds a trace-context header — (trace64, span64) — on every request
+#: (ISSUE 13): the serving side's work stitches into the REQUESTING
+#: query's distributed trace (same-process peers join the live tracer;
+#: cross-process peers record under the same trace id). (0, 0) means
+#: "no trace context" and costs nothing.
+VERSION = 4
 
 _OP_META = 1
 _OP_FETCH = 2
 
-_REQ = struct.Struct("<BIII")  # op, shuffle_id, reduce_id, map_id
+#: op, shuffle_id, reduce_id, map_id, trace64, parent span64 (v4)
+_REQ = struct.Struct("<BIIIQQ")
 _META_ENTRY = struct.Struct("<IQI")  # map_id, length, crc32c
 _FETCH_HEAD = struct.Struct("<QI")  # length, crc32c (after the ok byte)
+
+
+def _wire_trace(tracer) -> Tuple[int, int]:
+    """(trace64, span64) of the caller's current span, or (0, 0)."""
+    if tracer is None:
+        return 0, 0
+    try:
+        return tracer.wire_context()
+    except (AttributeError, TypeError):
+        return 0, 0  # tracing must never fail a fetch
+
+
+def _serve_span(trace64: int, span64: int, name: str, **args):
+    """Server-side span stitched under the requesting client's span —
+    the live-trace registry resolves same-process peers to the ONE
+    tracer; an unknown trace id (cross-process peer whose tracer lives
+    elsewhere) records a flight-recorder event instead."""
+    from ..metrics import trace as TR
+    if not trace64:
+        return TR.NOOP_SPAN
+    tracer = TR.live_tracer(trace64)
+    if tracer is None:
+        TR.record_event(name, **args)
+        return TR.NOOP_SPAN
+    return TR.span(TR.SpanCtx(tracer, span64), name, cat="shuffle", **args)
 
 
 class ShuffleFetchFailedError(Exception):
@@ -128,29 +159,35 @@ class _Handler(socketserver.BaseRequestHandler):
                 req = _recv_exact(self.request, _REQ.size)
             except (ConnectionError, OSError):
                 return
-            op, shuffle_id, reduce_id, map_id = _REQ.unpack(req)
+            op, shuffle_id, reduce_id, map_id, trace64, span64 = \
+                _REQ.unpack(req)
             try:
                 if op == _OP_META:
-                    metas = catalog.block_metas_for_reduce(shuffle_id,
-                                                           reduce_id)
-                    resp = bytearray(struct.pack("<BI", 0, len(metas)))
-                    for entry in metas:
-                        mid, length = entry[0], entry[1]
-                        crc = entry[2] if len(entry) > 2 else 0
-                        resp += _META_ENTRY.pack(mid, length, crc)
-                    self.request.sendall(bytes(resp))
+                    with _serve_span(trace64, span64, "shuffle.serve.meta",
+                                     shuffle=shuffle_id, reduce=reduce_id):
+                        metas = catalog.block_metas_for_reduce(shuffle_id,
+                                                               reduce_id)
+                        resp = bytearray(struct.pack("<BI", 0, len(metas)))
+                        for entry in metas:
+                            mid, length = entry[0], entry[1]
+                            crc = entry[2] if len(entry) > 2 else 0
+                            resp += _META_ENTRY.pack(mid, length, crc)
+                        self.request.sendall(bytes(resp))
                 elif op == _OP_FETCH:
-                    try:
-                        payload, crc = _block_payload_crc(
-                            catalog, shuffle_id, map_id, reduce_id)
-                    except KeyError:
-                        raise KeyError(
-                            f"no block map {map_id} for shuffle "
-                            f"{shuffle_id} reduce {reduce_id}") from None
-                    self.request.sendall(
-                        struct.pack("<B", 0)
-                        + _FETCH_HEAD.pack(len(payload), crc))
-                    self.request.sendall(payload)
+                    with _serve_span(trace64, span64, "shuffle.serve.fetch",
+                                     shuffle=shuffle_id, reduce=reduce_id,
+                                     map=map_id):
+                        try:
+                            payload, crc = _block_payload_crc(
+                                catalog, shuffle_id, map_id, reduce_id)
+                        except KeyError:
+                            raise KeyError(
+                                f"no block map {map_id} for shuffle "
+                                f"{shuffle_id} reduce {reduce_id}") from None
+                        self.request.sendall(
+                            struct.pack("<B", 0)
+                            + _FETCH_HEAD.pack(len(payload), crc))
+                        self.request.sendall(payload)
                 else:
                     raise ValueError(f"bad opcode {op}")
             except (ConnectionError, OSError) as e:
@@ -207,8 +244,12 @@ class NetTransport(Transport):
     exchange)."""
 
     def __init__(self, peer: Tuple[str, int], connect_timeout: float = 5.0,
-                 request_timeout: float = 30.0):
+                 request_timeout: float = 30.0, trace=None):
         self.peer = peer
+        #: the requesting query's Tracer (or None): each request stamps
+        #: the v4 (trace64, span64) header from its CURRENT span so the
+        #: serving side stitches into this query's trace (ISSUE 13)
+        self.trace = trace
         self._sock = socket.create_connection(peer, timeout=connect_timeout)
         self._sock.settimeout(request_timeout)
         greeting = _recv_exact(self._sock, len(MAGIC) + 1)
@@ -230,8 +271,10 @@ class NetTransport(Transport):
 
     def request_metadata(self, shuffle_id: int,
                          reduce_id: int) -> List[BlockDescriptor]:
+        t64, s64 = _wire_trace(self.trace)
         with self._lock:
-            self._sock.sendall(_REQ.pack(_OP_META, shuffle_id, reduce_id, 0))
+            self._sock.sendall(_REQ.pack(_OP_META, shuffle_id, reduce_id, 0,
+                                         t64, s64))
             status = _recv_exact(self._sock, 1)[0]
             self._check_error(status)
             (n,) = struct.unpack("<I", _recv_exact(self._sock, 4))
@@ -249,8 +292,10 @@ class NetTransport(Transport):
 
     def fetch_block_chunks(self, desc: BlockDescriptor, chunk_size: int):
         sid, mid, rid = desc.tag
+        t64, s64 = _wire_trace(self.trace)
         with self._lock:
-            self._sock.sendall(_REQ.pack(_OP_FETCH, sid, rid, mid))
+            self._sock.sendall(_REQ.pack(_OP_FETCH, sid, rid, mid,
+                                         t64, s64))
             status = _recv_exact(self._sock, 1)[0]
             self._check_error(status)
             length, crc = _FETCH_HEAD.unpack(
@@ -329,9 +374,11 @@ class RetryingBlockIterator:
         self.map_range = map_range
         self.with_map_ids = with_map_ids
         self.connect_timeout, self.request_timeout = _net_timeouts(ctx)
+        from ..metrics import trace as TR
+        self._trace = TR.tracer_of(getattr(ctx, "trace", None))
         self._factory = transport_factory or (
             lambda: NetTransport(peer, self.connect_timeout,
-                                 self.request_timeout))
+                                 self.request_timeout, trace=self._trace))
         #: map_id -> verified crc32c (or None for crc-less blocks) of
         #: every block yielded so far — recovery consumers
         #: (fetch_with_recovery) read this instead of re-hashing payloads
@@ -374,7 +421,13 @@ class RetryingBlockIterator:
                     if desc.tag[1] in prev_attempted:
                         self._metric("shuffleBlocksRefetched", 1)
                     attempted.add(desc.tag[1])
-                    with lockdep.blocking("shuffle.fetch_wait"):
+                    from ..metrics import trace as TR
+                    with TR.span(self._trace, "shuffle.fetch",
+                                 cat="shuffle",
+                                 peer=f"{self.peer[0]}:{self.peer[1]}",
+                                 map=desc.tag[1], attempt=attempt,
+                                 refetch=desc.tag[1] in prev_attempted), \
+                            lockdep.blocking("shuffle.fetch_wait"):
                         payload = client.fetch_one(desc)
                     yielded.add(desc.tag[1])
                     self.delivered_crcs[desc.tag[1]] = desc.crc
@@ -397,7 +450,10 @@ class RetryingBlockIterator:
                         f"shuffle.fetch {self.peer[0]}:{self.peer[1]}",
                         self.ctx, self.node)
                     delay = deadline.bound(delay)
-                with lockdep.blocking("shuffle.fetch_backoff"):
+                from ..metrics import trace as TR
+                with TR.span(self._trace, "shuffle.backoff", cat="shuffle",
+                             attempt=attempt), \
+                        lockdep.blocking("shuffle.fetch_backoff"):
                     time.sleep(delay)
         raise ShuffleFetchFailedError(self.peer, self.shuffle_id,
                                       self.reduce_id, last_error,

@@ -128,6 +128,16 @@ class Deadline:
                            "deadlineCancels", 1)
             except Exception:  # noqa: BLE001 - accounting only
                 pass
+        if first:
+            # Flight-recorder dump (metrics/trace.py, ISSUE 13): the
+            # FIRST observation of an expired deadline snapshots what the
+            # engine was doing — by the time a human reads the typed
+            # error, the interesting state is gone. Best-effort, no-op
+            # with tracing off, bounded per reason.
+            from ..metrics import trace as _trace
+            _trace.flight_dump("deadline_exceeded", site=site,
+                               slowest_site=slowest,
+                               limit_s=self.limit_s)
         raise QueryDeadlineExceeded(self.limit_s, site, slowest,
                                     slowest_s, now - self._t0)
 

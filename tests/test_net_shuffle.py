@@ -15,7 +15,7 @@ import time
 import pytest
 
 from spark_rapids_tpu.shuffle.exchange import ShuffleBufferCatalog
-from spark_rapids_tpu.shuffle.net import (MAGIC, NetShuffleServer,
+from spark_rapids_tpu.shuffle.net import (MAGIC, VERSION, NetShuffleServer,
                                           NetTransport,
                                           RetryingBlockIterator,
                                           ShuffleFetchFailedError)
@@ -428,7 +428,7 @@ class TestTwoProcessRecoveryMatrix:
                 except OSError:
                     return
                 try:
-                    conn.sendall(MAGIC + bytes([3]))
+                    conn.sendall(MAGIC + bytes([VERSION]))
                 except OSError:
                     pass
                 # ...and never answer another byte.

@@ -396,6 +396,75 @@ class TestSeededAntiPatterns:
               if v.rule == "pallas-no-oracle"]
         assert len(vs) == 1
 
+    def test_blocking_without_span_flagged(self, fake_pkg):
+        _write(fake_pkg, "exec/waits.py", """
+            from ..utils import lockdep
+
+            def wait(f):
+                with lockdep.blocking("exec.future_wait"):
+                    return f.result()
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg)
+              if v.rule == "blocking-no-span"]
+        assert len(vs) == 1 and "trace span" in vs[0].message
+
+    def test_blocking_sharing_with_statement_with_span_passes(
+            self, fake_pkg):
+        _write(fake_pkg, "exec/waits_ok.py", """
+            from ..metrics import trace as TR
+            from ..utils import lockdep
+
+            def wait(ctx, f):
+                with TR.span(ctx.trace, "pipeline.wait"), \\
+                        lockdep.blocking("exec.future_wait"):
+                    return f.result()
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "blocking-no-span"] == []
+
+    def test_blocking_enclosed_by_outer_span_with_passes(self, fake_pkg):
+        _write(fake_pkg, "shuffle/waits_outer.py", """
+            from ..metrics import trace as TR
+            from ..utils import lockdep
+
+            def fetch(ctx, client, desc):
+                with TR.span(ctx.trace, "shuffle.fetch"):
+                    with lockdep.blocking("shuffle.fetch_wait"):
+                        return client.fetch_one(desc)
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "blocking-no-span"] == []
+
+    def test_blocking_span_in_other_function_does_not_count(
+            self, fake_pkg):
+        # A span-bearing `with` in an OUTER function must not excuse a
+        # nested function's unspanned blocking region.
+        _write(fake_pkg, "memory/nested.py", """
+            from ..metrics import trace as TR
+            from ..utils import lockdep
+
+            def outer(ctx, f):
+                with TR.span(ctx.trace, "outer"):
+                    def inner():
+                        with lockdep.blocking("memory.wait"):
+                            return f.result()
+                    return inner()
+            """)
+        vs = [v for v in TL.lint_tree(fake_pkg)
+              if v.rule == "blocking-no-span"]
+        assert len(vs) == 1
+
+    def test_blocking_rule_scoped_to_device_paths(self, fake_pkg):
+        _write(fake_pkg, "utils/prefetchish.py", """
+            from . import lockdep
+
+            def wait(q):
+                with lockdep.blocking("prefetch.consumer_wait"):
+                    return q.get()
+            """)
+        assert [v for v in TL.lint_tree(fake_pkg)
+                if v.rule == "blocking-no-span"] == []
+
 
 class TestRatchet:
     def _seed(self, fake_pkg, n):

@@ -73,10 +73,22 @@ def run(args) -> dict:
     log_dir = args.event_log_dir or tempfile.mkdtemp(prefix="serve_bench_")
     conf = {
         "spark.rapids.sql.enabled": True,
+        # Same stance as bench.py: float aggregation order differs from
+        # CPU (documented incompat) — without this the q1/q6 aggregates
+        # fall back to the CPU streaming path and the bench measures the
+        # wrong engine.
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
         "spark.rapids.tpu.serve.sessions": args.sessions,
         "spark.rapids.tpu.serve.maxQueueDepth": args.max_queue_depth,
         "spark.rapids.tpu.metrics.eventLog.dir": log_dir,
     }
+    if not args.no_trace:
+        # Distributed tracing ON for the serving bench (ISSUE 13): the
+        # per-tenant queue-vs-execute breakdown and critical path come
+        # from the exported traces (tools/trace_report.py) — the span
+        # overhead is part of the serving configuration being measured.
+        conf["spark.rapids.tpu.trace.enabled"] = True
+        conf["spark.rapids.tpu.trace.dir"] = log_dir
     if args.time_budget_secs > 0:
         conf["spark.rapids.tpu.serve.tenantTimeBudgetSecs"] = \
             f"default:{args.time_budget_secs}"
@@ -128,8 +140,10 @@ def run(args) -> dict:
             t["shed"] += 1
     # Per-tenant attribution from the event log: group the tenant-stamped
     # profiles (ISSUE 12 satellite) — no join against any side channel.
+    # read_all spans the rotated generation too (rotation is on by
+    # default since ISSUE 13's maxBytes cap).
     profile_attr: dict = {}
-    for rec in eventlog.read(eventlog.log_path(log_dir) or ""):
+    for rec in eventlog.read_all(log_dir):
         ten = rec.get("tenant", "")
         a = profile_attr.setdefault(ten, {"queries": 0, "wall_ns": 0,
                                           "spill_bytes": 0})
@@ -147,9 +161,19 @@ def run(args) -> dict:
             "attribution": profile_attr.get(ten, {}),
             **({"stats": stats["tenants"].get(ten, {})}),
         }
+    # Critical-path + per-tenant queue-vs-execute attribution from the
+    # exported traces (ISSUE 13, tools/trace_report.py).
+    trace_section = None
+    if not args.no_trace:
+        try:
+            import tools.trace_report as trace_report
+            trace_section = trace_report.summarize_dir(log_dir)
+        except Exception as e:  # noqa: BLE001 - attribution is an aid
+            trace_section = {"error": str(e)}
     return {
         "bench": "serving", "version": 1,
         "backend": _backend(),
+        "trace_report": trace_section,
         "rows": args.rows, "clients": args.clients,
         "tenants": args.tenants, "requests_per_client": args.requests,
         "queries": mix,
@@ -200,6 +224,9 @@ def main(argv=None) -> int:
     p.add_argument("--time-budget-secs", type=float, default=0.0,
                    help="per-tenant default time budget (0 = none)")
     p.add_argument("--event-log-dir", default=None)
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable distributed tracing (drops the "
+                        "trace_report section)")
     p.add_argument("--out", default="BENCH_serving.json")
     args = p.parse_args(argv)
     payload = {"bench": "serving", "version": 1, "error": "did not finish"}

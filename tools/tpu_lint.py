@@ -49,6 +49,15 @@ corrupt TPU performance or correctness silently:
   ``rlock()`` / ``condition()`` factories, which return the raw
   primitive when ``TPU_LOCKDEP`` is off; lockdep.py's own construction
   sites are the baselined exception.
+* ``blocking-no-span`` (device-path modules): a
+  ``lockdep.blocking("kind")`` region not enclosed by (and not itself
+  opening, in the same ``with`` statement) a trace span
+  (``metrics/trace.py`` ``span(...)``) — every known-blocking wait in
+  device-path code must be visible on the distributed-tracing timeline
+  (ISSUE 13), or p99 analysis shows a gap exactly where the query
+  stalled. Static approximation: some lexically-enclosing ``with`` in
+  the same function (or the blocking call's own ``with``) must include
+  a ``*.span(...)`` item.
 * ``pallas-no-oracle`` (kernel modules, ``ops/kernels/``): a
   ``pallas_call`` site whose enclosing function's docstring does not
   name its jnp oracle twin (the word "oracle"). Every hand-written
@@ -173,6 +182,9 @@ class _FileLinter(ast.NodeVisitor):
         self._funcs: List[Tuple[bool, frozenset]] = []
         #: stack of enclosing-function docstrings (pallas-no-oracle)
         self._func_docs: List[str] = []
+        #: stack of (function depth, with-statement-has-span-item) for
+        #: enclosing ``with`` statements (blocking-no-span)
+        self._withs: List[Tuple[int, bool]] = []
 
     # -- helpers ------------------------------------------------------------
     def _suppressed(self, node: ast.AST) -> bool:
@@ -207,6 +219,25 @@ class _FileLinter(ast.NodeVisitor):
 
     visit_FunctionDef = _visit_func
     visit_AsyncFunctionDef = _visit_func
+
+    @staticmethod
+    def _is_span_call(expr: ast.expr) -> bool:
+        """A ``with`` item that opens a trace span: ``*.span(...)`` or a
+        bare ``span(...)`` (metrics/trace.py's call-site helper)."""
+        if not isinstance(expr, ast.Call):
+            return False
+        f = expr.func
+        return (isinstance(f, ast.Attribute) and f.attr == "span") \
+            or (isinstance(f, ast.Name) and f.id == "span")
+
+    def visit_With(self, node: ast.With):
+        has_span = any(self._is_span_call(item.context_expr)
+                       for item in node.items)
+        self._withs.append((len(self._funcs), has_span))
+        self.generic_visit(node)
+        self._withs.pop()
+
+    visit_AsyncWith = visit_With
 
     def visit_ClassDef(self, node: ast.ClassDef):
         if self.in_exec:
@@ -251,6 +282,8 @@ class _FileLinter(ast.NodeVisitor):
             self._check_nondet(node, func, root)
         if self.in_raw_thread:
             self._check_raw_thread(node, func, root)
+        if self.in_device:
+            self._check_blocking_span(node, func, root)
         self._check_raw_lock(node, func, root)
         if self._funcs and (
                 (root == "jax" and isinstance(func, ast.Attribute)
@@ -342,6 +375,27 @@ class _FileLinter(ast.NodeVisitor):
                        "hold-across-blocking detection, missing from the "
                        "docs/concurrency.md inventory); use "
                        f"lockdep.{factory}(\"<module>.<name>\")")
+
+    def _check_blocking_span(self, node: ast.Call, func, root):
+        """blocking-no-span: a ``lockdep.blocking(...)`` marker in a
+        device-path module must sit inside (or share its ``with``
+        statement with) a trace span — blocking waits are exactly the
+        regions a p99 timeline must show, so an unspanned one is a
+        guaranteed attribution gap (metrics/trace.py, ISSUE 13)."""
+        if not (isinstance(func, ast.Attribute) and func.attr == "blocking"
+                and root is not None and root.lstrip("_") == "lockdep"):
+            return
+        depth = len(self._funcs)
+        for d, has_span in self._withs:
+            if d == depth and has_span:
+                return
+        self._flag(node, "blocking-no-span",
+                   "lockdep.blocking region is not enclosed by (or "
+                   "sharing a `with` statement with) a trace span; every "
+                   "known-blocking wait in device-path code must be "
+                   "visible on the tracing timeline — open a "
+                   "metrics/trace span around it (ISSUE 13, "
+                   "docs/monitoring.md#distributed-tracing)")
 
     def _check_nondet(self, node: ast.Call, func, root):
         if not isinstance(func, ast.Attribute):

@@ -1,0 +1,296 @@
+#!/usr/bin/env python
+"""trace_report — critical-path analysis of exported query traces.
+
+Reads the Chrome trace-event JSON files `metrics/trace.py` exports
+(`trace_<trace_id>.json`, one per query) and answers "where did the time
+go" (ISSUE 13):
+
+* **Critical path** — the chain of spans from the root to the last
+  thing that finished, with each hop's duration and SELF time (duration
+  minus the union of its children's intervals): the list of places
+  where shaving time actually moves the query's wall clock.
+* **Top self-time spans** — aggregate self time by span name across the
+  whole tree: the flat "most expensive stage" ranking.
+* **Overlap efficiency** — for the concurrency-bearing categories
+  (decode / pipeline / dispatch / download / spill / shuffle): the
+  serial sum of their span durations divided by the wall time of their
+  interval union. 1.0 = fully serial; N = N-way concurrency actually
+  achieved — the machine-checkable form of the Theseus data-movement
+  thesis (PAPERS.md): upload/shuffle/spill must OVERLAP compute, and
+  this number says whether they did.
+* **Per-tenant queue-vs-execute** — across a directory of serving
+  traces: how much of each tenant's wall clock was admission queue +
+  slot wait vs actual execution (the serving SLO attribution).
+
+`bench.py` and `tools/serve_bench.py` embed `summarize()` /
+`summarize_dir()` output in their BENCH JSON.
+
+CLI::
+
+    python tools/trace_report.py trace_tenantA-123-1.json
+    python tools/trace_report.py --dir artifacts/tpch_smoke --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: span categories whose overlap is the pipeline's whole point
+OVERLAP_CATS = ("decode", "pipeline", "dispatch", "download", "spill",
+                "shuffle")
+
+#: pure waiting/backoff spans are NOT work: counting a consumer's
+#: 10s pipeline.wait as "overlapped" with the producer it waited on
+#: would report 2-way concurrency where one thread slept
+_WAIT_SUFFIXES = ("wait", "backoff")
+
+
+def _is_wait(name: str) -> bool:
+    return name.rsplit(".", 1)[-1].endswith(_WAIT_SUFFIXES)
+
+#: span names counted as QUEUE time in the tenant breakdown
+QUEUE_SPANS = ("serve.admission", "serve.slot_wait")
+#: span names counted as EXECUTE time
+EXECUTE_SPANS = ("serve.execute",)
+
+
+def load(path: str) -> dict:
+    with open(path, encoding="utf-8") as f:
+        return json.load(f)
+
+
+def spans_of(trace: dict) -> List[dict]:
+    """Reconstruct span records from the complete (X) events: [{name,
+    cat, id, parent, t0, t1, tid}] with times in microseconds."""
+    out = []
+    for ev in trace.get("traceEvents", ()):
+        if ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        out.append({"name": ev.get("name", "?"),
+                    "cat": ev.get("cat", ""),
+                    "id": args.get("id", 0),
+                    "parent": args.get("parent", 0),
+                    "t0": float(ev.get("ts", 0.0)),
+                    "t1": float(ev.get("ts", 0.0)) + float(ev.get("dur", 0.0)),
+                    "tid": ev.get("tid", 0)})
+    return out
+
+
+def _union_us(intervals: List[Tuple[float, float]]) -> float:
+    """Total length of the union of [t0, t1) intervals (microseconds)."""
+    total = 0.0
+    end = None
+    for a, b in sorted(intervals):
+        if b <= a:
+            continue
+        if end is None or a > end:
+            total += b - a
+            end = b
+        elif b > end:
+            total += b - end
+            end = b
+    return total
+
+
+def _children_map(spans: List[dict]) -> Dict[int, List[dict]]:
+    kids: Dict[int, List[dict]] = {}
+    for s in spans:
+        kids.setdefault(s["parent"], []).append(s)
+    return kids
+
+
+def _self_times(spans: List[dict]) -> Dict[int, float]:
+    """Self time per span id: duration minus the union of its children's
+    intervals clipped to the span (concurrent children — boundary
+    workers, IO lanes — must not be double-subtracted)."""
+    kids = _children_map(spans)
+    out: Dict[int, float] = {}
+    for s in spans:
+        clipped = [(max(c["t0"], s["t0"]), min(c["t1"], s["t1"]))
+                   for c in kids.get(s["id"], ())]
+        covered = _union_us(clipped)
+        out[s["id"]] = max(0.0, (s["t1"] - s["t0"]) - covered)
+    return out
+
+
+def _roots(spans: List[dict]) -> List[dict]:
+    ids = {s["id"] for s in spans}
+    return [s for s in spans if s["parent"] not in ids]
+
+
+def critical_path(spans: List[dict]) -> List[dict]:
+    """Root -> ... -> the span that finished last at each level: the
+    chain whose spans bound the query's completion time. Each entry
+    carries duration and self time in milliseconds."""
+    if not spans:
+        return []
+    selfs = _self_times(spans)
+    kids = _children_map(spans)
+    roots = _roots(spans)
+    cur = max(roots, key=lambda s: s["t1"] - s["t0"])
+    path = []
+    while cur is not None:
+        path.append({"name": cur["name"], "cat": cur["cat"],
+                     "dur_ms": round((cur["t1"] - cur["t0"]) / 1e3, 3),
+                     "self_ms": round(selfs[cur["id"]] / 1e3, 3)})
+        cs = kids.get(cur["id"], [])
+        cur = max(cs, key=lambda s: s["t1"]) if cs else None
+    return path
+
+
+def top_self_spans(spans: List[dict], n: int = 10) -> List[dict]:
+    """Aggregate self time by span name, descending — the flat hotspot
+    ranking."""
+    selfs = _self_times(spans)
+    agg: Dict[str, dict] = {}
+    for s in spans:
+        a = agg.setdefault(s["name"], {"name": s["name"], "cat": s["cat"],
+                                       "count": 0, "self_ms": 0.0})
+        a["count"] += 1
+        a["self_ms"] += selfs[s["id"]] / 1e3
+    out = sorted(agg.values(), key=lambda a: -a["self_ms"])[:n]
+    for a in out:
+        a["self_ms"] = round(a["self_ms"], 3)
+    return out
+
+
+def overlap_efficiency(spans: List[dict]) -> dict:
+    """serial_ms / union_ms over the overlap-bearing categories: 1.0
+    means those stages ran strictly one-after-another; higher means the
+    pipeline actually overlapped them. Wait/backoff spans are excluded —
+    they measure stalls, not work."""
+    sel = [s for s in spans
+           if s["cat"] in OVERLAP_CATS and not _is_wait(s["name"])]
+    serial = sum(s["t1"] - s["t0"] for s in sel)
+    union = _union_us([(s["t0"], s["t1"]) for s in sel])
+    return {
+        "categories": list(OVERLAP_CATS),
+        "serial_ms": round(serial / 1e3, 3),
+        "union_ms": round(union / 1e3, 3),
+        "efficiency": round(serial / union, 3) if union > 0 else None,
+        "spans": len(sel),
+    }
+
+
+def tenant_breakdown(traces: List[dict]) -> Dict[str, dict]:
+    """Per-tenant queue-vs-execute milliseconds across serving traces."""
+    out: Dict[str, dict] = {}
+    for t in traces:
+        tenant = (t.get("otherData") or {}).get("tenant") or "default"
+        b = out.setdefault(tenant, {"queries": 0, "queue_ms": 0.0,
+                                    "execute_ms": 0.0, "wall_ms": 0.0})
+        spans = spans_of(t)
+        b["queries"] += 1
+        for s in spans:
+            dur = (s["t1"] - s["t0"]) / 1e3
+            if s["name"] in QUEUE_SPANS:
+                b["queue_ms"] += dur
+            elif s["name"] in EXECUTE_SPANS:
+                b["execute_ms"] += dur
+            if s["name"] == "serve.query":
+                b["wall_ms"] += dur
+    for b in out.values():
+        for k in ("queue_ms", "execute_ms", "wall_ms"):
+            b[k] = round(b[k], 3)
+    return out
+
+
+def summarize(trace: dict, top_n: int = 10) -> dict:
+    """The per-trace report bench.py embeds in its JSON."""
+    spans = spans_of(trace)
+    other = trace.get("otherData") or {}
+    wall = max((s["t1"] for s in spans), default=0.0) \
+        - min((s["t0"] for s in spans), default=0.0)
+    return {
+        "trace_id": other.get("trace_id"),
+        "tenant": other.get("tenant"),
+        "query_id": other.get("query_id"),
+        "spans": len(spans),
+        "dropped_spans": other.get("dropped_spans", 0),
+        "wall_ms": round(wall / 1e3, 3),
+        "critical_path": critical_path(spans),
+        "top_self": top_self_spans(spans, top_n),
+        "overlap": overlap_efficiency(spans),
+    }
+
+
+def summarize_dir(directory: str, top_n: int = 10) -> Optional[dict]:
+    """Directory report: per-tenant breakdown across every trace file
+    plus the full summary of the LONGEST trace (the p-worst query is the
+    one worth a critical path)."""
+    paths = sorted(glob.glob(os.path.join(directory, "trace_*.json")))
+    traces = []
+    for p in paths:
+        try:
+            traces.append(load(p))
+        except (OSError, ValueError):
+            continue
+    if not traces:
+        return None
+    longest = max(traces, key=lambda t: max(
+        (e.get("ts", 0) + e.get("dur", 0)
+         for e in t.get("traceEvents", ()) if e.get("ph") == "X"),
+        default=0))
+    return {
+        "traces": len(traces),
+        "per_tenant": tenant_breakdown(traces),
+        "worst": summarize(longest, top_n),
+    }
+
+
+def _render(rep: dict) -> str:
+    lines = [f"== trace {rep.get('trace_id')} "
+             f"(tenant={rep.get('tenant')}, wall={rep.get('wall_ms')}ms, "
+             f"{rep.get('spans')} spans) =="]
+    lines.append("critical path:")
+    for hop in rep.get("critical_path", ()):
+        lines.append(f"  {hop['name']:<28} dur={hop['dur_ms']:>10.3f}ms "
+                     f"self={hop['self_ms']:>10.3f}ms")
+    lines.append("top self-time spans:")
+    for a in rep.get("top_self", ()):
+        lines.append(f"  {a['name']:<28} x{a['count']:<5} "
+                     f"self={a['self_ms']:>10.3f}ms")
+    ov = rep.get("overlap", {})
+    lines.append(f"overlap: serial={ov.get('serial_ms')}ms "
+                 f"union={ov.get('union_ms')}ms "
+                 f"efficiency={ov.get('efficiency')}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("paths", nargs="*", help="trace_*.json files")
+    p.add_argument("--dir", default=None,
+                   help="summarize every trace_*.json in a directory")
+    p.add_argument("--json", action="store_true",
+                   help="emit machine-readable JSON instead of text")
+    p.add_argument("--top", type=int, default=10)
+    args = p.parse_args(argv)
+    if args.dir:
+        rep = summarize_dir(args.dir, args.top)
+        if rep is None:
+            print(f"no trace_*.json under {args.dir}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(json.dumps(rep, indent=1))
+        else:
+            print(json.dumps(rep["per_tenant"], indent=1))
+            print(_render(rep["worst"]))
+        return 0
+    if not args.paths:
+        p.print_usage()
+        return 2
+    for path in args.paths:
+        rep = summarize(load(path), args.top)
+        print(json.dumps(rep, indent=1) if args.json else _render(rep))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
