@@ -54,6 +54,7 @@ WARN = "warn"
 @dataclasses.dataclass(frozen=True)
 class PlanLintViolation:
     check: str  # schema | cast | transition | partitioning | writer-width
+                # | ml (ModelScore registry contract)
                 # | internal (a lint pass itself could not run)
     severity: str   # error | warn
     node_path: str  # e.g. "DeviceToHostExec/TpuProjectExec[0]"
@@ -425,6 +426,59 @@ def _check_partitioning(node, path, out: List[PlanLintViolation]):
             f"land in different partitions"))
 
 
+def _check_ml(node, path, out: List[PlanLintViolation]):
+    """ModelScore contract verification (exec/ml_score.py): the output
+    schema must be the child schema plus exactly one nullable float
+    score column, and the operator's feature list must satisfy the
+    registered model's feature-schema contract — a mismatched handoff
+    (model dropped or retrained to a different width between DataFrame
+    construction and planning) fails HERE, not as a shape error
+    mid-query (docs/ml-integration.md)."""
+    if not type(node).__name__.endswith("ModelScoreExec"):
+        return
+    child = node.children[0].schema
+    schema = node.schema
+    if len(schema) != len(child) + 1:
+        out.append(PlanLintViolation(
+            "ml", ERROR, _node_path(path),
+            f"ModelScore declares {len(schema)} output columns; the child "
+            f"supplies {len(child)} (+1 score column expected)"))
+        return
+    for i, (cf, of) in enumerate(zip(child, schema)):
+        if cf.data_type.name != of.data_type.name \
+                or not _nullable_ok(cf, of):
+            out.append(PlanLintViolation(
+                "ml", ERROR, _node_path(path),
+                f"ModelScore pass-through column {i} ({of.name!r}) "
+                f"declares {of.data_type} but the child supplies "
+                f"{cf.data_type}"))
+    score = schema[len(schema) - 1]
+    if score.data_type.name != "float" or not score.nullable:
+        out.append(PlanLintViolation(
+            "ml", ERROR, _node_path(path),
+            f"ModelScore score column {score.name!r} must be nullable "
+            f"float, declared {score.data_type}"))
+    reg = getattr(node, "_ml_registry", None)
+    meta = reg.meta_maybe(node.model_name) if reg is not None else None
+    if meta is None:
+        out.append(PlanLintViolation(
+            "ml", ERROR, _node_path(path),
+            f"model {node.model_name!r} is not registered on the "
+            "session ModelRegistry"))
+    elif meta.n_features != len(getattr(node, "exprs", [])):
+        out.append(PlanLintViolation(
+            "ml", ERROR, _node_path(path),
+            f"feature-schema contract: model {node.model_name!r} expects "
+            f"{meta.n_features} features, the operator supplies "
+            f"{len(node.exprs)}"))
+    elif meta.version != getattr(node, "model_version", meta.version):
+        out.append(PlanLintViolation(
+            "ml", WARN, _node_path(path),
+            f"model {node.model_name!r} was re-registered "
+            f"(v{meta.version}) after this plan was built "
+            f"(v{node.model_version}); re-plan to score the new model"))
+
+
 def _check_writer(node, path, out: List[PlanLintViolation]):
     if type(node).__name__ != "TpuWriteFilesExec" \
             or getattr(node, "fmt", None) != "parquet":
@@ -483,6 +537,7 @@ def lint_plan(plan, stage: str = "post-overrides"
         guarded(_check_expressions, node, path)
         guarded(_check_partitioning, node, path)
         guarded(_check_writer, node, path)
+        guarded(_check_ml, node, path)
         for i, c in enumerate(node.children):
             walk(c, path + [f"{type(c).__name__}[{i}]"])
 

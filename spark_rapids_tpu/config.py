@@ -780,6 +780,31 @@ TRACE_FLIGHT_DIR = conf_str(
     "(flight_<reason>_<pid>_<n>.json; bounded per reason so a crash "
     "loop cannot flood it).")
 
+# ---------------------------------------------------------------------------
+# ML scenario subsystem (ml/, exec/ml_score.py, docs/ml-integration.md)
+# ---------------------------------------------------------------------------
+
+TPU_ML_ENABLED = conf_bool(
+    "spark.rapids.tpu.ml.enabled", True,
+    "Run ModelScore (df.with_model_score — batch inference over a "
+    "registered model INSIDE the query plan) on the device: features "
+    "gather straight from the device batch and the prediction kernel "
+    "rides the kernel cache and fused-dispatch machinery. false keeps "
+    "the operator on the CPU oracle path, which evaluates the SAME "
+    "predict function on host-assembled features — the bit-identity "
+    "twin the differential tests compare against. See "
+    "docs/ml-integration.md.")
+
+TPU_ML_MAX_MODELS = conf_int(
+    "spark.rapids.tpu.ml.maxRegisteredModels", 64,
+    "Bound on models a session's ModelRegistry holds at once "
+    "(re-registering an existing name replaces it in place and does not "
+    "count). Registered models are spillable device buffers, so the "
+    "bound caps registry HBM/host residency the way the result cache "
+    "caps serving memory; exceeding it raises instead of silently "
+    "evicting a model a running query may score with. See "
+    "docs/ml-integration.md.")
+
 PLAN_LINT_ENABLED = conf_bool(
     "spark.rapids.tpu.planLint.enabled", True,
     "Statically verify every physical plan after planning and again after "

@@ -1,0 +1,203 @@
+"""ModelScore — batch model inference as a physical plan operator.
+
+Tentpole of the ML scenario subsystem (docs/ml-integration.md): a model
+registered in the session :class:`~..ml.registry.ModelRegistry` scores
+INSIDE queries (``df.with_model_score(name, feature_cols, output_col)``)
+instead of round-tripping results to a host scoring service — the
+Theseus lens (PAPERS.md) applied to inference: keep the data movement
+off the critical path. The Ragged Paged Attention paper (PAPERS.md) is
+the TPU idiom this follows for batched on-device inference as a kernel,
+not a service hop.
+
+Two implementations, differential twins:
+
+* :class:`CpuModelScoreExec` — the oracle: evaluates the SAME predict
+  function (ml/export.py) on host-assembled features. This is what
+  ``spark.rapids.tpu.ml.enabled=false`` runs, and what the bit-identity
+  tests compare against.
+* :class:`TpuModelScoreExec` — the device operator. Features gather
+  straight out of the device batch (zero extra transfers), the
+  prediction kernel routes through the PR-2 kernel cache (model leaves
+  ride as pytree ARGUMENTS, so one compiled program serves every model
+  of the same structure and re-registration never stales a cached
+  program), each batch is wrapped in the PR-4 retry taxonomy (site
+  ``TpuModelScoreExec.score``, halve-by-rows split escalation), model
+  acquisition unspills through the PR-11 state machine (site
+  ``ml.modelAcquire``), and PR-13 trace spans (``ml.modelAcquire`` /
+  ``ml.score``) put scoring on the query timeline. Under whole-stage
+  fusion the operator is a BOUNDARY (the TpuTopKExec stance): its
+  subtree materializes eagerly with the real context — retry/catalog/
+  metrics semantics intact — and its output feeds the fused program as a
+  traced input, padded onto the PR-6 polymorphic tiers like every other
+  boundary.
+
+Null semantics: a row with a null in ANY feature column scores null
+(the feature_matrix masking rule applied per-row).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from .. import types as T
+from ..data.batch import ColumnarBatch, HostBatch
+from ..data.column import DeviceColumn
+from ..ops.expression import Expression, host_to_array
+from ..plan.physical import PhysicalPlan
+from ..utils.kernel_cache import cached_kernel, kernel_key
+from .execs import TpuExec, _tick
+
+
+def _predict_fn(kind: str):
+    from ..ml.export import predict_gbt, predict_logistic
+    return predict_gbt if kind == "gbt" else predict_logistic
+
+
+class CpuModelScoreExec(PhysicalPlan):
+    """Host-side ModelScore oracle: assemble features from host batches
+    (nulls filled with the device's deterministic zero), run the SAME
+    predict function the device kernel traces, null out rows with null
+    features. The bit-identity twin behind
+    ``spark.rapids.tpu.ml.enabled=false``."""
+
+    def __init__(self, child: PhysicalPlan, registry, model_name: str,
+                 model_version: int, feature_exprs: List[Expression],
+                 output_col: str, schema: T.Schema):
+        self.children = [child]
+        #: skipped from plan signatures (utils/kernel_cache.py); the
+        #: (model_name, model_version) statics carry the cache identity.
+        self._ml_registry = registry
+        self.model_name = model_name
+        self.model_version = int(model_version)
+        self.exprs = list(feature_exprs)
+        self.output_col = output_col
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        feats = ", ".join(e.name for e in self.exprs)
+        return (f"CpuModelScore[{self.model_name} v{self.model_version}]"
+                f"({feats}) -> {self.output_col}")
+
+    def execute(self, ctx):
+        meta, model = self._ml_registry.acquire(self.model_name, ctx)
+        predict = _predict_fn(meta.kind)
+        arrow = T.schema_to_arrow(self.schema)
+        name = self.node_name()
+
+        def run(part):
+            for hb in part:
+                n = hb.num_rows
+                valid = np.ones(n, bool)
+                cols = []
+                for e in self.exprs:
+                    arr = host_to_array(e.eval_host(hb), n)
+                    valid &= pc.is_valid(arr).to_numpy(zero_copy_only=False)
+                    filled = pc.fill_null(arr, pa.scalar(0, arr.type))
+                    cols.append(filled.to_numpy(zero_copy_only=False)
+                                .astype(np.float32))
+                if n:
+                    x = np.stack(cols, axis=1)
+                    preds = np.asarray(predict(model, jnp.asarray(x)),
+                                       np.float32)
+                else:
+                    preds = np.zeros(0, np.float32)
+                score = pa.array(preds, pa.float32(), mask=~valid)
+                arrays = list(hb.rb.columns) + [score]
+                arrays = [a.cast(f.type) for a, f in zip(arrays, arrow)]
+                ctx.metric(name, "numOutputBatches", 1)
+                ctx.ml_score_rows.append(n)
+                yield HostBatch(pa.RecordBatch.from_arrays(arrays,
+                                                           schema=arrow))
+        return [run(p) for p in self.children[0].execute(ctx)]
+
+
+class TpuModelScoreExec(TpuExec):
+    """Device ModelScore (see module doc): one cached traced kernel per
+    (child schema, feature ordinals, model structure) — the model's
+    array leaves are pytree arguments, so the program is shared across
+    models and model versions of the same shape."""
+
+    def __init__(self, child: PhysicalPlan, registry, model_name: str,
+                 model_version: int, feature_exprs: List[Expression],
+                 output_col: str, schema: T.Schema):
+        self.children = [child]
+        self._ml_registry = registry
+        self.model_name = model_name
+        self.model_version = int(model_version)
+        self.exprs = list(feature_exprs)
+        self.output_col = output_col
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        feats = ", ".join(e.name for e in self.exprs)
+        return (f"TpuModelScore[{self.model_name} v{self.model_version}]"
+                f"({feats}) -> {self.output_col}")
+
+    def execute(self, ctx):
+        from ..memory import retry as R
+        from ..metrics import trace as TR
+        name = self.node_name()
+        child_schema = self.children[0].schema
+        with TR.span(ctx.trace, "ml.modelAcquire", cat="ml",
+                     model=self.model_name):
+            meta, model = self._ml_registry.acquire(self.model_name, ctx)
+        leaves = {k: v for k, v in model.items() if hasattr(v, "dtype")}
+        static = tuple(sorted((k, v) for k, v in model.items()
+                              if not hasattr(v, "dtype")))
+        f_idx = tuple(child_schema.index_of(e.name) for e in self.exprs)
+        out_schema = self.schema
+        kind = meta.kind
+
+        def build():
+            predict = _predict_fn(kind)
+
+            def score(batch: ColumnarBatch, arrays) -> ColumnarBatch:
+                m = dict(arrays)
+                m.update(dict(static))
+                cols = [batch.columns[i] for i in f_idx]
+                x = jnp.stack([c.data.astype(jnp.float32) for c in cols],
+                              axis=1)
+                pred = predict(m, x).astype(jnp.float32)
+                valid = batch.row_mask()
+                for c in cols:
+                    valid = valid & c.validity
+                out = DeviceColumn(
+                    data=jnp.where(valid, pred, jnp.zeros((), jnp.float32)),
+                    validity=valid, dtype=T.FLOAT)
+                return batch.with_columns(tuple(batch.columns) + (out,),
+                                          out_schema)
+            return score
+        score = cached_kernel(
+            "ml_score",
+            kernel_key(child_schema, f_idx, kind, static, out_schema),
+            build)
+
+        def run(part):
+            import time as _time
+            t0 = _time.perf_counter_ns()
+            for db in part:
+                with TR.span(ctx.trace, "ml.score", cat="ml",
+                             model=self.model_name):
+                    outs = R.with_retry(ctx, "TpuModelScoreExec.score", db,
+                                        lambda b: score(b, leaves),
+                                        split=R.halve_by_rows, node=name)
+                for out in outs:
+                    # Traced live counts; summed by ONE deferred device
+                    # read into the engine.ml profile section.
+                    ctx.ml_score_rows.append(out.n_rows)
+                    t0 = _tick(ctx, name, t0)
+                    yield out
+        return [run(p) for p in self.children[0].execute(ctx)]

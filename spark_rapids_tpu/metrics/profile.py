@@ -111,6 +111,10 @@ class QueryProfile:
         dur = self.engine.get("durability")
         if dur:
             lines.append(f"+ durability  {_fmt_metrics(dur)}")
+        mlsec = self.engine.get("ml")
+        if mlsec and any(v for v in mlsec.values()
+                         if isinstance(v, (int, float))):
+            lines.append(f"+ ml  {_fmt_metrics(mlsec)}")
         pal = self.engine.get("pallas")
         if pal and (pal.get("enabled") or pal.get("kernels")):
             kparts = [f"{k}={m.get('staged', 0)}"
@@ -277,6 +281,13 @@ class QueryProfiler:
                                       _pallas.stats(),
                                       registry.device_timing,
                                       self._pallas_keys0),
+            # ML scenario attribution (ISSUE 14, docs/monitoring.md):
+            # rows exported to trainers, rows scored by ModelScore
+            # operators (one deferred device read of the traced per-batch
+            # counts — the hot path never synced), trainer wall seconds,
+            # and registered-model HBM bytes — so serving/event-log
+            # attribution covers ML work like every other subsystem.
+            "ml": _ml_section(ctx),
             # Distributed-durability counters (ISSUE 7,
             # docs/fault-tolerance.md): a clean run reads all zeros; after
             # an injected or real fault the non-zero counters PROVE the
@@ -356,6 +367,33 @@ def _pallas_section(session, base: dict, now: dict,
                              **({"deviceTimeNs": probe[name]}
                                 if name in probe else {})}
     return {"enabled": enabled, "kernels": kernels}
+
+
+def _ml_section(ctx) -> dict:
+    """The ``engine.ml`` section. ``scoreRows`` is PER QUERY (this
+    query's ModelScore output, from the context's deferred traced
+    counts — one device read here, zero syncs on the hot path). The
+    export/train/model counters are process-CUMULATIVE: that work runs
+    BETWEEN queries (the ETL→train handoff), so a per-query delta would
+    always read zero — consecutive event-log records diff to attribute
+    it, the same way a metrics scraper reads any monotonic counter."""
+    from ..ml import registry as _mlreg
+    now = _mlreg.stats()
+    score_rows = 0
+    vals = getattr(ctx, "ml_score_rows", None)
+    if vals:
+        try:
+            import jax
+            score_rows = int(sum(int(v) for v in jax.device_get(list(vals))))
+        except Exception:  # noqa: BLE001 - attribution is an aid
+            score_rows = 0
+    return {
+        "exportRows": int(now.get("export_rows", 0)),
+        "scoreRows": score_rows,
+        "trainSeconds": round(float(now.get("train_seconds", 0.0)), 3),
+        "modelBytes": int(now.get("model_bytes", 0)),
+        "modelsRegistered": int(now.get("models_registered", 0)),
+    }
 
 
 def _registry_total(registry: MetricsRegistry, name: str) -> int:

@@ -1,8 +1,17 @@
-"""ML integration: zero-copy export of query results to JAX trainers
-(the ml-integration / ColumnarRdd surface of the reference)."""
+"""ML integration: zero-copy export of query results to JAX trainers,
+sharded data-parallel training over the mesh, and a session-scoped model
+registry feeding model scoring as a plan operator
+(``df.with_model_score``) — the ml-integration / ColumnarRdd surface of
+the reference grown into a subsystem (docs/ml-integration.md)."""
 
 from .export import (feature_matrix, predict_gbt, predict_logistic,
-                     train_gbt, train_logistic_regression)
+                     sharded_feature_matrix, train_gbt, train_gbt_sharded,
+                     train_logistic_regression,
+                     train_logistic_regression_sharded)
+from .registry import ModelMeta, ModelRegistry
 
-__all__ = ["feature_matrix", "train_logistic_regression",
-           "predict_logistic", "train_gbt", "predict_gbt"]
+__all__ = ["feature_matrix", "sharded_feature_matrix",
+           "train_logistic_regression",
+           "train_logistic_regression_sharded", "predict_logistic",
+           "train_gbt", "train_gbt_sharded", "predict_gbt",
+           "ModelRegistry", "ModelMeta"]

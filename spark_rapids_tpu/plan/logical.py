@@ -593,6 +593,47 @@ class Expand(LogicalPlan):
         return T.Schema(fields)
 
 
+class ModelScore(LogicalPlan):
+    """Score a registered ML model inside the query — batch inference as
+    a plan operator (docs/ml-integration.md). Output = all child columns
+    plus one float score column; a row with a null in any feature column
+    scores null. The registry's feature-schema CONTRACT is enforced
+    eagerly here (feature count vs the model's ``n_features``) and
+    re-verified by the plan-lint pass on every planned physical tree."""
+
+    def __init__(self, child: LogicalPlan, registry, model_name: str,
+                 feature_cols: List[str], output_col: str = "score"):
+        self.children = [child]
+        self.registry = registry
+        self.model_name = model_name
+        self.feature_exprs = [resolve(col(c), child.schema)
+                              for c in feature_cols]
+        self.output_col = output_col
+        meta = registry.meta(model_name)  # KeyError when unregistered
+        if meta.n_features != len(self.feature_exprs):
+            raise ValueError(
+                f"model {model_name!r} expects {meta.n_features} features "
+                f"but {len(self.feature_exprs)} were supplied "
+                "(the registry feature-schema contract)")
+        for e in self.feature_exprs:
+            if not e.data_type.is_numeric:
+                raise TypeError(
+                    f"model feature {e.name!r} has non-numeric type "
+                    f"{e.data_type}")
+        if child.schema.field_maybe(output_col) is not None:
+            raise ValueError(
+                f"score column {output_col!r} already exists in the input")
+
+    @property
+    def schema(self) -> T.Schema:
+        return T.Schema(list(self.children[0].schema)
+                        + [T.StructField(self.output_col, T.FLOAT, True)])
+
+    def describe(self):
+        feats = ", ".join(e.name for e in self.feature_exprs)
+        return f"ModelScore[{self.model_name}]({feats}) -> {self.output_col}"
+
+
 class Generate(LogicalPlan):
     """One input row -> zero or more output rows from an array generator
     (explode / posexplode; GpuGenerateExec, GpuGenerateExec.scala:101).
@@ -732,6 +773,20 @@ class DataFrame:
     def with_windows(self, **name_to_window_expr) -> "DataFrame":
         """Append several window columns in one Window node."""
         plan = WindowOp(self._plan, list(name_to_window_expr.items()))
+        return DataFrame(plan, self._session)
+
+    def with_model_score(self, model_name: str, feature_cols,
+                         output_col: str = "score") -> "DataFrame":
+        """Append a model-prediction column computed INSIDE the query
+        (batch inference as a plan operator; docs/ml-integration.md).
+        ``model_name`` must be registered on this session's
+        :class:`~spark_rapids_tpu.ml.registry.ModelRegistry`
+        (``session.ml_models``) and ``feature_cols`` must satisfy its
+        feature-schema contract. The device operator is gated by
+        ``spark.rapids.tpu.ml.enabled``; disabled, the CPU oracle path
+        runs the same predict function as the bit-identity twin."""
+        plan = ModelScore(self._plan, self._session.ml_models, model_name,
+                          list(feature_cols), output_col)
         return DataFrame(plan, self._session)
 
     def explode(self, column, name: str = "col",

@@ -577,6 +577,36 @@ def _register_writer_rule():
 _register_writer_rule()
 
 
+def _ml_score_tag(meta: ExecMeta, conf: TpuConf):
+    """ModelScore gating: the subsystem kill-switch keeps the operator on
+    the CPU oracle path (the bit-identity twin, docs/ml-integration.md);
+    feature types must be device-numeric."""
+    from ..config import TPU_ML_ENABLED
+    if not conf.get(TPU_ML_ENABLED):
+        meta.will_not_work(
+            "spark.rapids.tpu.ml.enabled is false: ModelScore stays on "
+            "the CPU oracle path")
+    for e in meta.node.exprs:
+        if not e.data_type.is_numeric:
+            meta.will_not_work(
+                f"model feature {e.name!r} of type {e.data_type} is not "
+                "numeric")
+
+
+def _register_ml_rule():
+    from ..exec.ml_score import CpuModelScoreExec, TpuModelScoreExec
+    EXEC_RULES[CpuModelScoreExec] = ExecRule(
+        "ModelScore",
+        lambda n: list(n.exprs),
+        lambda n, ch, conf: TpuModelScoreExec(
+            ch[0], n._ml_registry, n.model_name, n.model_version,
+            n.exprs, n.output_col, n.schema),
+        tag=_ml_score_tag)
+
+
+_register_ml_rule()
+
+
 def _make_nlj(n: "P.CpuNestedLoopJoinExec", ch):
     from ..exec.joins import (TpuBroadcastExchangeExec,
                               TpuBroadcastNestedLoopJoinExec,

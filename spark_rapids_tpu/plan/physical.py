@@ -115,6 +115,12 @@ class ExecContext:
     #: by boundary forks like the registry; worker threads parent their
     #: spans through trace.fork()/SpanCtx or the trace root fallback.
     trace: object = None
+    #: Per-batch live-row counts recorded by the ModelScore operators
+    #: (exec/ml_score.py): traced scalars on the device path, plain ints
+    #: on the CPU oracle — summed by ONE deferred device read into the
+    #: QueryProfile ``engine.ml.scoreRows`` counter (metrics/profile.py),
+    #: so the hot scoring path never pays a host sync.
+    ml_score_rows: list = dataclasses.field(default_factory=list)
     _join_site: int = 0
     #: Base offset for next_join_site ordinals: pipeline boundary forks
     #: get disjoint deterministic namespaces so concurrent materialization
@@ -161,7 +167,7 @@ class ExecContext:
         is plan-determined and therefore stable across runs."""
         return dataclasses.replace(
             self, cleanups=[], overflow_flags=[], join_totals=[],
-            dense_fails=[], _join_site=0,
+            dense_fails=[], ml_score_rows=[], _join_site=0,
             _site_namespace=(ordinal + 1) << 20)
 
     def absorb_boundary(self, child: "ExecContext") -> None:
@@ -170,6 +176,7 @@ class ExecContext:
         self.overflow_flags.extend(child.overflow_flags)
         self.join_totals.extend(child.join_totals)
         self.dense_fails.extend(child.dense_fails)
+        self.ml_score_rows.extend(child.ml_score_rows)
         self.cleanups.extend(child.cleanups)
         child.cleanups = []
 

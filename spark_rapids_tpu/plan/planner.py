@@ -33,7 +33,7 @@ def estimate_rows(plan: L.LogicalPlan) -> Optional[int]:
         child = estimate_rows(plan.children[0])
         return plan.n if child is None else min(plan.n, child)
     if isinstance(plan, (L.Project, L.Filter, L.Sort, L.WindowOp,
-                         L.Aggregate)):
+                         L.Aggregate, L.ModelScore)):
         return estimate_rows(plan.children[0])
     if isinstance(plan, L.Union):
         ests = [estimate_rows(c) for c in plan.children]
@@ -155,6 +155,17 @@ def plan_physical(plan: L.LogicalPlan,
     if isinstance(plan, L.Expand):
         return P.CpuExpandExec(plan_physical(plan.children[0], conf),
                                plan.projections, plan.schema)
+    if isinstance(plan, L.ModelScore):
+        from ..exec.ml_score import CpuModelScoreExec
+        # Version resolved at PLAN time (not DataFrame construction), so
+        # a retrain-then-rescore of the same DataFrame always plans the
+        # CURRENT model — and the version stamp keys every downstream
+        # plan-signature cache (fused programs, join-capacity learning).
+        meta = plan.registry.meta(plan.model_name)
+        return CpuModelScoreExec(plan_physical(plan.children[0], conf),
+                                 plan.registry, plan.model_name,
+                                 meta.version, plan.feature_exprs,
+                                 plan.output_col, plan.schema)
     if isinstance(plan, L.Generate):
         return P.CpuGenerateExec(plan_physical(plan.children[0], conf),
                                  plan.generator, plan.outer, plan.pos,

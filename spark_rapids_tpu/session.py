@@ -109,6 +109,13 @@ class TpuSession:
         # blacklists persist across queries (docs/fault-tolerance.md).
         from .shuffle.exchange import MapOutputTracker
         self._shuffle_tracker = MapOutputTracker(self.conf)
+        # ML scenario subsystem (ml/registry.py, docs/ml-integration.md):
+        # the model registry is built EAGERLY (cheap: a dict + named
+        # lock; no device work) so with_conf-derived sessions always
+        # share it — a traced or differently-gated twin scores the same
+        # registered models regardless of derive/register order.
+        from .ml.registry import ModelRegistry
+        self._ml_models = ModelRegistry(self)
 
     # -- conf ---------------------------------------------------------------
     def with_conf(self, **kv) -> "TpuSession":
@@ -138,6 +145,8 @@ class TpuSession:
         s._fault_injector = FaultInjector.maybe(s.conf)
         from .shuffle.exchange import MapOutputTracker
         s._shuffle_tracker = MapOutputTracker(s.conf)
+        # Derived sessions score the SAME models (docs/ml-integration.md).
+        s._ml_models = self._ml_models
         return s
 
     def close(self) -> None:
@@ -192,6 +201,17 @@ class TpuSession:
             "pallas_programs": pallas_lib.program_count(),
             "pallas_kernels": pallas_lib.stats(),
         }
+
+    # -- ML scenario subsystem (ml/, docs/ml-integration.md) ----------------
+    @property
+    def ml_models(self):
+        """This session's :class:`~spark_rapids_tpu.ml.registry.
+        ModelRegistry`: register trained models here
+        (``session.ml_models.register(name, model)``) and score them
+        inside queries with ``df.with_model_score``. All
+        ``with_conf``-derived sessions share one registry, regardless of
+        derive/register order."""
+        return self._ml_models
 
     # -- data sources -------------------------------------------------------
     @property

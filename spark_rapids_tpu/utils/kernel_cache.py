@@ -68,8 +68,10 @@ def _expr_signature(e) -> tuple:
 
 
 #: Exec attributes that are per-instance data, not structure.
+#: ``_ml_registry`` (exec/ml_score.py) is the session ModelRegistry
+#: handle — the (model_name, model_version) statics carry its identity.
 PLAN_SIG_SKIP_ATTRS = frozenset({"children", "partitions", "_pf_cache",
-                                 "_tails"})
+                                 "_tails", "_ml_registry"})
 
 
 def plan_signature(p) -> tuple:

@@ -69,9 +69,9 @@ def load(session, tables: dict, cache: bool = True) -> dict:
     return out
 
 
-def etl(t):
-    """The full pipeline: clean -> per-loan features -> join -> report."""
-    perf = (t["performance"]
+def _clean_performance(t):
+    """Stage 1: performance-record cleanup + derived delinquency flags."""
+    return (t["performance"]
             .where(P.GreaterThan(col("current_upb"), lit(0.0)))
             .with_column("ever_delinq",
                          If(P.GreaterThanOrEqual(col("delinq_status"),
@@ -82,25 +82,37 @@ def etl(t):
             .with_column("recent",
                          If(P.GreaterThanOrEqual(col("month"), lit(36)),
                             col("current_upb"), lit(0.0))))
-    loan_features = (perf.group_by(col("loan_id"))
-                     .agg(A.AggregateExpression(A.Count(), "n_records"),
-                          A.AggregateExpression(
-                              A.Sum(col("ever_delinq")), "months_delinq"),
-                          A.AggregateExpression(
-                              A.Sum(col("serious_delinq")),
-                              "months_serious"),
-                          A.AggregateExpression(
-                              A.Max(col("delinq_status")), "worst_status"),
-                          A.AggregateExpression(
-                              A.Sum(col("recent")), "recent_upb")))
-    band = CaseWhen(
+
+
+def _loan_features(perf):
+    """Stage 2: per-loan delinquency feature aggregation."""
+    return (perf.group_by(col("loan_id"))
+            .agg(A.AggregateExpression(A.Count(), "n_records"),
+                 A.AggregateExpression(
+                     A.Sum(col("ever_delinq")), "months_delinq"),
+                 A.AggregateExpression(
+                     A.Sum(col("serious_delinq")),
+                     "months_serious"),
+                 A.AggregateExpression(
+                     A.Max(col("delinq_status")), "worst_status"),
+                 A.AggregateExpression(
+                     A.Sum(col("recent")), "recent_upb")))
+
+
+def _score_band():
+    return CaseWhen(
         [(P.LessThan(col("credit_score"), lit(580)), lit("SUBPRIME")),
          (P.LessThan(col("credit_score"), lit(670)), lit("FAIR")),
          (P.LessThan(col("credit_score"), lit(740)), lit("GOOD"))],
         lit("EXCELLENT"))
+
+
+def etl(t):
+    """The full pipeline: clean -> per-loan features -> join -> report."""
+    loan_features = _loan_features(_clean_performance(t))
     joined = (t["acquisition"]
               .join(loan_features, on="loan_id", how="inner")
-              .with_column("score_band", band)
+              .with_column("score_band", _score_band())
               .with_column("risk_upb",
                            If(P.GreaterThan(col("months_serious"), lit(0)),
                               col("orig_upb").cast(T.DOUBLE), lit(0.0))))
@@ -111,3 +123,54 @@ def etl(t):
                  A.AggregateExpression(A.Sum(col("risk_upb")), "risk_upb"),
                  A.AggregateExpression(A.Average(col("orig_rate")),
                                        "avg_rate")))
+
+
+# ---------------------------------------------------------------------------
+# ML pipeline stages (ETL -> train -> score-in-query -> SQL post-process;
+# the ISSUE-14 benchmarked scenario — tools/ml_bench.py, BENCH_ml.json)
+# ---------------------------------------------------------------------------
+
+#: Feature columns of the per-loan training table. ``months_serious`` and
+#: ``worst_status`` are deliberately EXCLUDED: the label derives from
+#: serious delinquency, and leaking it would make the benchmark's model
+#: trivially perfect instead of representative.
+ML_FEATURES = ["n_records", "months_delinq", "recent_upb", "orig_rate",
+               "orig_upb", "credit_score"]
+ML_LABEL = "serious_flag"
+
+
+def ml_features(t):
+    """The per-loan ML feature table: stage-1/2 cleanup + aggregation
+    joined with acquisition attributes, plus the binary label (the loan
+    ever went seriously delinquent). This is the frame the pipeline
+    exports to the trainer AND later scores in-query
+    (``with_model_score``), so train and inference share one schema."""
+    from ..ops.expression import Alias
+    lf = _loan_features(_clean_performance(t))
+    # Rename the aggregation-side key: the engine's join keeps BOTH
+    # sides' columns, and the per-loan output must stay selectable by
+    # unambiguous names (train and inference share this schema).
+    lf = lf.select(Alias(col("loan_id"), "_fl_id"),
+                   *[col(c) for c in lf.columns if c != "loan_id"])
+    joined = (t["acquisition"]
+              .join(lf, on=P.EqualTo(col("loan_id"), col("_fl_id")),
+                    how="inner")
+              .with_column("score_band", _score_band())
+              .with_column(ML_LABEL,
+                           If(P.GreaterThan(col("months_serious"), lit(0)),
+                              lit(1), lit(0))))
+    keep = ["loan_id", "seller", "score_band"] + ML_FEATURES + [ML_LABEL]
+    return joined.select(*[col(c) for c in keep])
+
+
+def score_report(scored, score_col: str = "risk_score"):
+    """SQL post-process over the scored frame: per (seller, score band)
+    portfolio risk summary — the query that proves scoring happened
+    INSIDE the engine (its input column is a ModelScore output)."""
+    return (scored.group_by(col("seller"), col("score_band"))
+            .agg(A.AggregateExpression(A.Count(), "n_loans"),
+                 A.AggregateExpression(A.Average(col(score_col)),
+                                       "avg_risk"),
+                 A.AggregateExpression(A.Max(col(score_col)), "max_risk"),
+                 A.AggregateExpression(A.Sum(col("months_delinq")),
+                                       "total_delinq_months")))

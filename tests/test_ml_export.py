@@ -99,6 +99,31 @@ class TestZeroCopyTraining:
         assert int(np.asarray(mask).sum()) == 200
 
 
+class TestEmptyExport:
+    """feature_matrix on a legitimately-empty query result (ISSUE 14
+    satellite): the handoff yields a SHAPED empty (X[0, d], y[0],
+    mask[0]) instead of crashing."""
+
+    def test_no_batches_yields_shaped_empty(self):
+        x, y, mask = ml.feature_matrix([], ["f1", "f2", "f3"], "label")
+        assert x.shape == (0, 3)
+        assert y.shape == (0,) and mask.shape == (0,)
+        assert x.dtype == jnp.float32 and mask.dtype == jnp.bool_
+
+    def test_zero_row_query_exports(self):
+        s = _session()
+        df = (s.create_dataframe(_training_frame(200))
+              .where(P.GreaterThan(col("x1"), lit(1e12))))
+        batches = df.to_device_batches()
+        x, y, mask = ml.feature_matrix(batches, ["x1", "x2"], "label")
+        assert x.shape[1] == 2
+        assert int(np.asarray(mask).sum()) == 0
+
+    def test_no_feature_cols_still_rejected(self):
+        with pytest.raises(ValueError, match="at least one feature"):
+            ml.feature_matrix([], [], None)
+
+
 class TestGbtTrainer:
     """BASELINE config 4: query output -> zero-copy handoff -> JAX GBT
     trainer (XGBoost-on-Spark role; ColumnarRdd.scala:41-49)."""

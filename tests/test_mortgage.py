@@ -21,6 +21,16 @@ def test_etl_differential(tables):
         approx=1e-9)
 
 
+def test_ml_features_differential(tables):
+    """The per-loan ML feature table (the train/score frame of the
+    ETL->train->score pipeline, ISSUE 14) matches the CPU oracle."""
+    assert_tpu_and_cpu_are_equal(
+        lambda s: mortgage.ml_features(mortgage.load(s, tables,
+                                                     cache=False)),
+        conf={"spark.rapids.sql.variableFloatAgg.enabled": True},
+        approx=1e-9)
+
+
 def test_etl_shape(tables):
     from harness import tpu_session
     s = tpu_session(**{"spark.rapids.sql.variableFloatAgg.enabled": True})

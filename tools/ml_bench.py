@@ -1,0 +1,288 @@
+"""ML pipeline benchmark: Mortgage ETL -> GBT train -> score-in-query ->
+SQL post-process (the ISSUE-14 twin deliverable) -> BENCH_ml.json.
+
+The four stages of the benchmarked scenario (docs/ml-integration.md):
+
+1. **ETL** — the per-loan feature table (workloads/mortgage.ml_features)
+   built from parquet scans and materialized device-resident.
+2. **Export + train** — zero-copy handoff (feature_matrix) with a
+   spillable park/reclaim round trip through the ModelRegistry
+   (training arrays are memory-QoS citizens), then the on-device GBT
+   trainer; the model registers into the session ModelRegistry.
+3. **Score-in-query** — ``with_model_score`` + the score_report SQL
+   post-process run as ONE engine query (batch inference as a plan
+   operator, no host round trip).
+4. **Oracle check** — the in-query scores are compared BIT-FOR-BIT
+   against host-side ``predict_gbt`` over the same features (the
+   acceptance gate; also asserted in tier-1 at a small scale factor by
+   tests/test_ml_pipeline.py).
+
+bench.py discipline: a cumulative JSON checkpoint is emitted (stdout AND
+``BENCH_ml.json``, atomically) after EVERY stage, and SIGTERM/SIGINT/
+atexit dumpers re-emit the last checkpoint — an external kill can never
+yield a missing or torn artifact. A traced re-run of the score query
+(outside every timed region) embeds a tools/trace_report.py critical-path
+summary.
+
+CLI::
+
+    python -m tools.ml_bench [--rows N] [--out BENCH_ml.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import atexit
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+DEFAULT_ROWS = 1 << 18
+
+_CHECKPOINT = {"payload": None, "done": False, "out": None}
+
+#: cleanups the signal-exit path must run itself: os._exit skips atexit,
+#: so anything registered only there (the parquet/trace staging rmtrees)
+#: would leak on every external SIGTERM/timeout kill — the bench.py
+#: _KILL_CLEANUPS discipline.
+_KILL_CLEANUPS: list = []
+
+
+def _write_out(payload: dict) -> None:
+    out = _CHECKPOINT["out"]
+    if not out:
+        return
+    tmp = out + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, out)
+    except OSError:
+        pass  # the stdout line is the contract of last resort
+
+
+def emit_checkpoint(payload: dict) -> None:
+    """One cumulative JSON line + atomic BENCH_ml.json rewrite NOW: each
+    checkpoint supersedes the previous one, so a kill at any stage
+    leaves the totals up to the last completed stage behind."""
+    payload = dict(payload)
+    payload["partial"] = True
+    _CHECKPOINT["payload"] = payload
+    _write_out(payload)
+    print(json.dumps(payload), flush=True)
+
+
+def emit_final(payload: dict) -> None:
+    _CHECKPOINT["done"] = True
+    _CHECKPOINT["payload"] = payload
+    _write_out(payload)
+    print(json.dumps(payload), flush=True)
+
+
+def install_kill_dump() -> None:
+    def dump(note: str) -> None:
+        if not _CHECKPOINT["done"]:
+            p = dict(_CHECKPOINT["payload"] or _empty_payload(0))
+            p["error"] = note
+            _write_out(p)
+            print(json.dumps(p), flush=True)
+        sys.stdout.flush()
+
+    def on_signal(signum, frame):
+        dump(f"killed by signal {signum} mid-pipeline; totals up to the "
+             "last completed stage")
+        for fn in list(_KILL_CLEANUPS):  # os._exit skips atexit
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 - exiting anyway
+                pass
+        os._exit(0)
+    try:
+        signal.signal(signal.SIGTERM, on_signal)
+        signal.signal(signal.SIGINT, on_signal)
+    except (ValueError, OSError):
+        pass  # not the main thread / restricted platform
+    atexit.register(
+        lambda: dump("process exited mid-pipeline; totals up to the last "
+                     "completed stage"))
+
+
+def _empty_payload(perf_rows: int) -> dict:
+    return {"metric": "mortgage_ml_pipeline_seconds", "value": 0.0,
+            "unit": "s", "rows": {"performance": perf_rows},
+            "stages": {}, "bit_identical": None}
+
+
+def run_pipeline(perf_rows: int = DEFAULT_ROWS,
+                 out_path: str = "BENCH_ml.json",
+                 n_trees: int = 24, max_depth: int = 4,
+                 trace: bool = True) -> dict:
+    """The full benchmarked pipeline; importable (tier-1 runs it at a
+    small scale factor and asserts the bit-identity gate)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu import ml
+    from spark_rapids_tpu.ops.expression import col
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.workloads import mortgage
+
+    _CHECKPOINT["out"] = os.path.abspath(out_path)
+    payload = _empty_payload(perf_rows)
+    t_suite = time.perf_counter()
+
+    session = TpuSession({
+        "spark.rapids.sql.enabled": True,
+        "spark.rapids.sql.variableFloatAgg.enabled": True,
+        "spark.rapids.sql.exportColumnarRdd": True,
+        "spark.rapids.tpu.metrics.level": "ESSENTIAL",
+    })
+
+    # -- stage 0: generate + parquet (scan inside the ETL timed region,
+    # the bench.py parquet-inclusive methodology) -------------------------
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    tables = mortgage.gen_tables(perf_rows=perf_rows, seed=7)
+    pq_dir = tempfile.mkdtemp(prefix="ml_bench_parquet_")
+    import functools
+    import shutil
+    cleanup = functools.partial(shutil.rmtree, pq_dir, ignore_errors=True)
+    atexit.register(cleanup)
+    _KILL_CLEANUPS.append(cleanup)
+    frames = {}
+    for name, rb in tables.items():
+        path = os.path.join(pq_dir, f"{name}.parquet")
+        pq.write_table(pa.Table.from_batches([rb]), path)
+        frames[name] = session.read.parquet(path)
+
+    def stage(name: str, seconds: float) -> None:
+        payload["stages"][name] = round(seconds, 4)
+        payload["value"] = round(time.perf_counter() - t_suite, 3)
+        emit_checkpoint(payload)
+
+    # -- stage 1: ETL -> device-resident feature table --------------------
+    t0 = time.perf_counter()
+    feats = mortgage.ml_features(frames)
+    cached = feats.cache()
+    stage("etl_seconds", time.perf_counter() - t0)
+
+    # -- stage 2: zero-copy export (+ spillable park/reclaim) + train ----
+    t0 = time.perf_counter()
+    batches = cached.to_device_batches()
+    x, y, mask = ml.feature_matrix(batches, mortgage.ML_FEATURES,
+                                   mortgage.ML_LABEL)
+    # Park/reclaim through the registry: exported matrices awaiting a
+    # trainer are spill citizens (a concurrent query's OOM ladder can
+    # evict them) — the contention-arbitration seam of the pipeline.
+    session.ml_models.put_training("mortgage", (x, y, mask))
+    x, y, mask = session.ml_models.take_training("mortgage")
+    n_exported = int(np.asarray(mask).sum())
+    payload["rows"]["exported"] = n_exported
+    stage("export_seconds", time.perf_counter() - t0)
+
+    t0 = time.perf_counter()
+    model = ml.train_gbt(x, y, mask, n_trees=n_trees, max_depth=max_depth)
+    meta = session.ml_models.register("mortgage_risk", model)
+    payload["model"] = {"kind": meta.kind, "version": meta.version,
+                        "n_features": meta.n_features,
+                        "device_bytes": meta.device_bytes,
+                        "n_trees": n_trees, "max_depth": max_depth}
+    stage("train_seconds", time.perf_counter() - t0)
+
+    # -- stage 3: score-in-query + SQL post-process (ONE engine query) ---
+    scored = cached.with_model_score("mortgage_risk", mortgage.ML_FEATURES,
+                                     "risk_score")
+    report_df = mortgage.score_report(scored, "risk_score")
+    t0 = time.perf_counter()
+    report = report_df.collect()
+    stage("score_query_seconds", time.perf_counter() - t0)
+    payload["rows"]["report"] = report.num_rows
+    prof = session.last_query_profile()
+    if prof is not None:
+        payload["engine_ml"] = prof.engine.get("ml", {})
+        emit_checkpoint(payload)
+
+    # -- stage 4: bit-identity vs the host-side predict oracle -----------
+    t0 = time.perf_counter()
+    sc = scored.select(col("loan_id"), col("risk_score")).collect()
+    host_rows = cached.collect()
+    cols = [np.asarray(host_rows.column(c).to_numpy(zero_copy_only=False))
+            .astype(np.float32) for c in mortgage.ML_FEATURES]
+    x_host = np.stack(cols, axis=1)
+    oracle = np.asarray(ml.predict_gbt(model, jnp.asarray(x_host)),
+                        np.float32)
+    by_loan = dict(zip(host_rows.column("loan_id").to_pylist(), oracle))
+    got_ids = sc.column("loan_id").to_pylist()
+    got = np.asarray(sc.column("risk_score").to_numpy(
+        zero_copy_only=False), np.float32)
+    want = np.asarray([by_loan[i] for i in got_ids], np.float32)
+    identical = bool(len(got) == n_exported and np.array_equal(got, want))
+    payload["rows"]["scored"] = int(len(got))
+    payload["bit_identical"] = identical
+    if not identical:
+        payload["error"] = ("ModelScore output differs from the host-side "
+                            "predict oracle")
+    stage("oracle_check_seconds", time.perf_counter() - t0)
+
+    # -- stage 5: traced score-query re-run -> critical-path summary -----
+    if trace:
+        try:
+            import tools.trace_report as trace_report
+            trace_dir = tempfile.mkdtemp(prefix="ml_bench_trace_")
+            tcleanup = functools.partial(shutil.rmtree, trace_dir,
+                                         ignore_errors=True)
+            atexit.register(tcleanup)
+            _KILL_CLEANUPS.append(tcleanup)
+            traced = session.with_conf(**{
+                "spark.rapids.tpu.trace.enabled": True,
+                "spark.rapids.tpu.trace.dir": trace_dir,
+            })
+            traced.execute(report_df._plan)
+            rep = trace_report.summarize_dir(trace_dir)
+            payload["trace_report"] = rep["worst"] if rep else {}
+        except Exception as e:  # noqa: BLE001 - attribution is best-effort
+            print(f"[ml_bench] trace report skipped: {e}", file=sys.stderr)
+        emit_checkpoint(payload)
+
+    payload["value"] = round(time.perf_counter() - t_suite, 3)
+    payload.pop("partial", None)
+    return payload
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(
+        description="Mortgage ETL->train->score ML pipeline bench "
+                    "(always emits one JSON line + BENCH_ml.json, "
+                    "always exits 0)")
+    ap.add_argument("--rows", type=int, default=DEFAULT_ROWS,
+                    help="performance-table rows (loans ~= rows/24)")
+    ap.add_argument("--out", default="BENCH_ml.json",
+                    help="artifact path (atomically rewritten at every "
+                         "stage checkpoint)")
+    ap.add_argument("--trees", type=int, default=24)
+    ap.add_argument("--depth", type=int, default=4)
+    return ap.parse_args(argv)
+
+
+def main():
+    args = parse_args()
+    install_kill_dump()
+    try:
+        result = run_pipeline(perf_rows=args.rows, out_path=args.out,
+                              n_trees=args.trees, max_depth=args.depth)
+    except Exception as e:  # noqa: BLE001 — the JSON line must always land
+        import traceback
+        traceback.print_exc()
+        result = dict(_CHECKPOINT["payload"] or _empty_payload(args.rows))
+        result.pop("partial", None)
+        result["error"] = f"{type(e).__name__}: {e}"
+    emit_final(result)
+
+
+if __name__ == "__main__":
+    main()

@@ -101,7 +101,12 @@ from typing import Dict, List, Optional, Tuple
 KERNEL_SCOPE = ("ops/kernels/",)
 PLAN_SCOPE = ("plan/",)
 EXEC_SCOPE = ("exec/",)
-DEVICE_SCOPE = ("exec/", "memory/", "shuffle/", "io/")
+#: ml/ joins the device-path scopes with ZERO grandfathered sites
+#: (ISSUE 14): the ML subsystem's registry/export/score paths do device
+#: work and must honor the same except-too-broad / blocking-no-span /
+#: raw-thread discipline as every other device layer (raw-lock is
+#: engine-wide already).
+DEVICE_SCOPE = ("exec/", "memory/", "shuffle/", "io/", "ml/")
 #: except-too-broad also covers the serving layer (ISSUE 12, ZERO
 #: grandfathered sites): a handler there that swallows classified faults
 #: breaks the typed-error contract every client depends on.
