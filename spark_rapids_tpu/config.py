@@ -247,14 +247,28 @@ FAULT_INJECTION_NET_FAULTS = conf_str(
     "peerDeath (connection dies mid-fetch), torn (payload truncated "
     "mid-block), bitFlip (one payload bit corrupted — caught by CRC32C), "
     "stall (peer stops sending past "
-    "spark.rapids.tpu.shuffle.net.requestTimeout). A single name pins "
-    "every injected fault to that class.")
+    "spark.rapids.tpu.shuffle.net.requestTimeout), replicaLoss (the "
+    "replication push at the shuffle.replicate seam is silently "
+    "dropped, so a later primary failure must fall through to lineage "
+    "recompute — not in the default set, preserving pre-replication "
+    "fault schedules). A single name pins every injected fault to that "
+    "class.")
 
 FAULT_INJECTION_NET_STALL_SECS = conf_float(
     "spark.rapids.tpu.test.faultInjection.netStallSecs", 0.05,
     "How long an injected 'stall' fault blocks before surfacing as the "
     "request-timeout failure the real stalled peer would produce (kept "
     "small so CI fault matrices stay fast).")
+
+FAULT_INJECTION_MESH_EVERY_N = conf_int(
+    "spark.rapids.tpu.test.faultInjection.meshEveryN", 0,
+    "Raise a synthetic MeshDegradedError (a mid-query device loss) at "
+    "every Nth visit of the matched mesh site (mesh.collect — one visit "
+    "per SPMD dispatch; the 'sites' patterns gate it). Negative N "
+    "faults the first |N| visits then heals. The session records the "
+    "failover (meshFailovers metric, flight-recorder dump) and re-runs "
+    "the query on the single-chip path — the degraded-mesh drill real "
+    "device loss cannot provide in CI. 0 disables.")
 
 HBM_ALLOC_FRACTION = conf_float(
     "spark.rapids.memory.tpu.allocFraction", 0.9,
@@ -443,6 +457,41 @@ SHUFFLE_NET_MAX_PEER_FAILURES = conf_int(
     "refetches) against one peer before the MapOutputTracker "
     "blacklists it for the session: later reads stop dialing it and go "
     "straight to lineage recompute. 0 disables blacklisting.")
+
+SHUFFLE_REPLICATION_FACTOR = conf_int(
+    "spark.rapids.tpu.shuffle.replication.factor", 0,
+    "Replica peers each map output is pushed to (through the wire "
+    "protocol's PUT op, CRC32C-verified at the replica) after the "
+    "exchange's write phase. A dead, stalled, or blacklisted primary "
+    "then answers from a replica instead of paying a lineage recompute, "
+    "and hedged fetches have somewhere to race. Costs factor x the "
+    "shuffle's serialized bytes in replica host/disk storage. 0 "
+    "(default) disables replication. See docs/fault-tolerance.md.")
+
+SHUFFLE_HEDGE_ENABLED = conf_bool(
+    "spark.rapids.tpu.shuffle.hedge.enabled", True,
+    "Hedge straggling shuffle fetches: when one block fetch exceeds "
+    "hedge.quantileFactor x the peer's observed p50 latency (EWMA, "
+    "shuffle/net.py PeerLatencyStats), launch a duplicate request "
+    "against a replica (or the local recompute closure) on the shared "
+    "pipeline pool — first verified result wins, the loser is "
+    "cancelled. Only fires when a hedge source exists (replication "
+    "factor > 0 or a recompute closure), so it is free otherwise. "
+    "See docs/fault-tolerance.md#hedged-fetches.")
+
+SHUFFLE_HEDGE_QUANTILE_FACTOR = conf_float(
+    "spark.rapids.tpu.shuffle.hedge.quantileFactor", 3.0,
+    "Straggler threshold: a block fetch is hedged once it has been "
+    "outstanding longer than this factor x the peer's observed p50 "
+    "fetch latency (never below hedge.minDelayMs). Lower values hedge "
+    "more aggressively (more duplicate work, tighter tail); raise it "
+    "if hedges fire on healthy jitter.")
+
+SHUFFLE_HEDGE_MIN_DELAY_MS = conf_float(
+    "spark.rapids.tpu.shuffle.hedge.minDelayMs", 20.0,
+    "Floor on the hedge delay, milliseconds. Keeps sub-millisecond "
+    "p50s from hedging every fetch; a COLD peer (no observed latency "
+    "yet) is never hedged — the model warms on its first fetch.")
 
 QUERY_DEADLINE_SECS = conf_float(
     "spark.rapids.tpu.query.deadlineSecs", 0.0,
@@ -661,6 +710,22 @@ TPU_MESH_ENABLED = conf_bool(
     "boundaries exchange over ICI via all_to_all (exec/mesh.py). The "
     "engine-integrated form of the reference's GPU-resident shuffle "
     "manager.")
+
+MESH_HEALTH_PROBE_ENABLED = conf_bool(
+    "spark.rapids.tpu.mesh.health.probeEnabled", False,
+    "Probe every mesh device (a tiny put + block_until_ready) before "
+    "dispatching a mesh-capable query as an SPMD program: a device that "
+    "fails the probe degrades the session to the single-chip path "
+    "up front (meshFailovers metric, flight-recorder dump) instead of "
+    "failing mid-collect. Off by default — the probe costs one device "
+    "round-trip per dispatch.")
+
+MESH_HEALTH_REPROBE_SECS = conf_float(
+    "spark.rapids.tpu.mesh.health.reprobeSecs", 0.0,
+    "Seconds after a mesh degradation before the session re-probes the "
+    "mesh and, if every device answers, restores SPMD dispatch. 0 "
+    "(default): a degraded session stays on the single-chip path for "
+    "its lifetime (probe_mesh() re-probes on demand).")
 
 PIPELINE_ENABLED = conf_bool(
     "spark.rapids.tpu.pipeline.enabled", True,

@@ -53,7 +53,8 @@ from ..data.batch import ColumnarBatch
 from ..data.column import DeviceColumn, bucket_capacity
 from ..ops.expression import BoundReference, Expression
 from ..ops.kernels import rowops as KR
-from ..parallel.mesh import PART_AXIS, make_mesh, shard_map
+from ..parallel.mesh import (PART_AXIS, MeshDegradedError, is_device_loss,
+                             make_mesh, shard_map)
 from ..plan.physical import ExecContext
 from ..shuffle import ici
 from ..shuffle.partitioning import pmod_partition, spark_hash_columns_device
@@ -695,29 +696,63 @@ def _shard_source(batch: ColumnarBatch, mesh: Mesh, n_parts: int):
     return cols, counts, shard_cap, kinds, sides
 
 
+def _mesh_fault_check(ctx) -> None:
+    """Deterministic device-loss seam (ISSUE 19). The injector's
+    ``mesh.collect`` site stands in for a chip/host dying mid-dispatch:
+    a scheduled ``deviceLoss`` raises the typed
+    :class:`~..parallel.mesh.MeshDegradedError` BEFORE the SPMD program
+    launches, so the failover travels the exact path a real loss takes —
+    TRANSIENT classification, session failover record, single-chip
+    re-run (docs/fault-tolerance.md#degraded-mesh-fallback)."""
+    from ..utils.fault_injection import register_site
+    register_site("mesh.collect")
+    injector = getattr(ctx, "fault_injector", None)
+    if injector is None:
+        return
+    flavor = injector.check_mesh("mesh.collect")
+    if flavor == "deviceLoss":
+        raise MeshDegradedError(
+            "injected device loss at mesh.collect (mesh.deviceLoss)")
+
+
 def mesh_collect(root: DeviceToHostExec, ctx: ExecContext,
                  mesh: Optional[Mesh] = None
                  ) -> Tuple[Optional[pa.Table], bool]:
     """Run a mesh-capable plan as one SPMD program over the device mesh.
-    Returns (table, overflowed)."""
-    tail, core = _split_tail(root.children[0])
-    if tail:
-        table, overflowed = _mesh_core_collect(core, ctx, mesh)
-        if overflowed or table is None:
-            return None, True
-        # Finish sort/limit/project on the (small) collected result via
-        # the ordinary streaming path.
-        from ..plan.physical import collect_partitions
-        src = DeviceSourceExec(
-            [[ColumnarBatch.from_arrow(rb)
-              for rb in table.combine_chunks().to_batches()]],
-            core.schema)
-        plan = src
-        for op in reversed(tail):
-            plan = op.with_children([plan])
-        out = collect_partitions(DeviceToHostExec(plan), ctx)
-        return out, False
-    return _mesh_core_collect(core, ctx, mesh)
+    Returns (table, overflowed).
+
+    A backend error that reads as device loss (runtime disconnect /
+    device-health markers, :func:`~..parallel.mesh.is_device_loss`) is
+    re-raised as the typed :class:`~..parallel.mesh.MeshDegradedError`
+    so the session fails over to the single-chip path instead of
+    surfacing an opaque XlaRuntimeError."""
+    _mesh_fault_check(ctx)
+    try:
+        tail, core = _split_tail(root.children[0])
+        if tail:
+            table, overflowed = _mesh_core_collect(core, ctx, mesh)
+            if overflowed or table is None:
+                return None, True
+            # Finish sort/limit/project on the (small) collected result
+            # via the ordinary streaming path.
+            from ..plan.physical import collect_partitions
+            src = DeviceSourceExec(
+                [[ColumnarBatch.from_arrow(rb)
+                  for rb in table.combine_chunks().to_batches()]],
+                core.schema)
+            plan = src
+            for op in reversed(tail):
+                plan = op.with_children([plan])
+            out = collect_partitions(DeviceToHostExec(plan), ctx)
+            return out, False
+        return _mesh_core_collect(core, ctx, mesh)
+    except MeshDegradedError:
+        raise
+    except Exception as e:  # tpu-lint: ignore — re-raised unless device loss; XLA surfaces DATA_LOSS as varying exception types
+        if is_device_loss(e):
+            raise MeshDegradedError(
+                f"device loss during mesh dispatch: {e}") from e
+        raise
 
 
 def _mesh_core_collect(device_plan, ctx: ExecContext,

@@ -135,6 +135,13 @@ def classify(exc: BaseException) -> str:
         return Classification.FATAL
     if isinstance(exc, RetryOOM):
         return Classification.OOM
+    from ..parallel.mesh import MeshDegradedError
+    if isinstance(exc, MeshDegradedError):
+        # Device/host loss mid-SPMD-dispatch (ISSUE 19): the session
+        # marks the mesh degraded before this classifies, so the re-run
+        # plans the surviving work onto the single-chip path — a slower
+        # correct answer, never a wrong one.
+        return Classification.TRANSIENT
     from concurrent.futures import CancelledError
     if isinstance(exc, CancelledError):
         # The only canceller of pipeline futures is pool shutdown (a

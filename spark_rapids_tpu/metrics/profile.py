@@ -41,7 +41,8 @@ VERSION = 1
 #: stops surviving dispatch retries).
 DURABILITY_COUNTERS = ("checksumFailures", "shuffleBlocksRefetched",
                        "mapTasksRecomputed", "deadlineCancels",
-                       "peersBlacklisted")
+                       "peersBlacklisted", "hedgedFetches", "hedgeWins",
+                       "replicaReads", "meshFailovers")
 
 #: The subset of DURABILITY_COUNTERS the profile reads from process-wide
 #: stats deltas instead of the per-query registry (they span discarded

@@ -188,6 +188,29 @@ TAXONOMY: Dict[str, MetricSpec] = {s.name: s for s in [
     _spec("peersBlacklisted", MetricKind.SUM, ESSENTIAL,
           "Shuffle peers excluded for the session after repeated fetch "
           "failures (spark.rapids.tpu.shuffle.net.maxPeerFailures)."),
+    _spec("hedgedFetches", MetricKind.SUM, ESSENTIAL,
+          "Shuffle block fetches that exceeded the straggler threshold "
+          "(spark.rapids.tpu.shuffle.hedge.quantileFactor x the peer's "
+          "observed p50) and launched a duplicate request against a "
+          "replica or the local recompute closure (shuffle/net.py). "
+          "Zero on a healthy run."),
+    _spec("hedgeWins", MetricKind.SUM, ESSENTIAL,
+          "Hedged fetches where the DUPLICATE delivered first — the "
+          "straggling primary was cancelled and the partition was "
+          "served without waiting out its stall. Always <= "
+          "hedgedFetches; the difference is hedge losses (wasted "
+          "duplicate work)."),
+    _spec("replicaReads", MetricKind.SUM, ESSENTIAL,
+          "Shuffle blocks served by a replica "
+          "(spark.rapids.tpu.shuffle.replication.factor) because the "
+          "primary was dead, stalled, or blacklisted — each one a "
+          "lineage recompute avoided. Zero on a healthy run."),
+    _spec("meshFailovers", MetricKind.SUM, ESSENTIAL,
+          "Mesh SPMD dispatches abandoned to the single-chip path after "
+          "a device/host loss (MeshDegradedError) or a failed health "
+          "probe (spark.rapids.tpu.mesh.health.probeEnabled): the query "
+          "re-ran degraded instead of failing (exec/mesh.py, "
+          "session.py). Zero on a healthy run."),
 ]}
 
 #: Metrics recorded under names outside the taxonomy (operator-specific

@@ -352,7 +352,32 @@ class QueryService:
         return {"inflight": inflight,
                 "queue_depth": self.gate.depth(),
                 "gate": dict(self.gate.stats),
-                "hbm": hbm}
+                "hbm": hbm,
+                "self_healing": self._self_healing_stats()}
+
+    def _self_healing_stats(self) -> dict:
+        """Recovery-machinery counters summed over the slot pool's
+        session-scoped shuffle trackers (ISSUE 19): hedged/duplicate
+        fetches and their wins, replica reads, lineage recomputes a
+        replica avoided, blacklist/recompute totals, plus how many slot
+        sessions are currently running mesh-DEGRADED (single-chip
+        fallback). Operators watch this section to see the self-healing
+        layer actually absorbing faults (docs/serving.md)."""
+        keys = ("hedged_fetches", "hedge_wins", "replica_reads",
+                "recomputes_avoided_by_replica", "map_tasks_recomputed",
+                "peers_blacklisted")
+        out = {k: 0 for k in keys}
+        degraded = 0
+        for slot in self._all_slots:
+            try:
+                tracker = slot.session._shuffle_tracker
+                for k in keys:
+                    out[k] += int(tracker.metrics.get(k, 0))
+                degraded += 1 if slot.session._mesh_degraded else 0
+            except AttributeError:
+                continue  # introspection aid only — never fail health
+        out["mesh_degraded_slots"] = degraded
+        return out
 
     # -- slot pool ----------------------------------------------------------
     def _borrow_slot(self, deadline: Optional[Deadline]) -> _PooledSlot:

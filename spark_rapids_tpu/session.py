@@ -109,6 +109,13 @@ class TpuSession:
         # blacklists persist across queries (docs/fault-tolerance.md).
         from .shuffle.exchange import MapOutputTracker
         self._shuffle_tracker = MapOutputTracker(self.conf)
+        # Self-healing layer (ISSUE 19): once a mesh dispatch loses a
+        # device (typed MeshDegradedError), the session marks the mesh
+        # DEGRADED and re-plans onto the single-chip path — sticky until
+        # spark.rapids.tpu.mesh.health.reprobeSecs elapses and a health
+        # probe passes (0 = stay degraded; docs/fault-tolerance.md).
+        self._mesh_degraded = False
+        self._mesh_degraded_at = 0.0
         # ML scenario subsystem (ml/registry.py, docs/ml-integration.md):
         # the model registry is built EAGERLY (cheap: a dict + named
         # lock; no device work) so with_conf-derived sessions always
@@ -145,6 +152,8 @@ class TpuSession:
         s._fault_injector = FaultInjector.maybe(s.conf)
         from .shuffle.exchange import MapOutputTracker
         s._shuffle_tracker = MapOutputTracker(s.conf)
+        s._mesh_degraded = False
+        s._mesh_degraded_at = 0.0
         # Derived sessions score the SAME models (docs/ml-integration.md).
         s._ml_models = self._ml_models
         return s
@@ -286,6 +295,53 @@ class TpuSession:
     #: chain of N joins converges in <= N attempts (a truncated join feeds
     #: its consumer an underestimate, which the next attempt corrects).
     _MAX_LEARN_ATTEMPTS = 6
+
+    # -- degraded-mesh fallback (ISSUE 19) ---------------------------------
+    def _mesh_usable(self) -> bool:
+        """Whether this query may take the SPMD mesh path. False while
+        the mesh is marked degraded; with
+        ``spark.rapids.tpu.mesh.health.reprobeSecs`` > 0 a degraded mesh
+        is re-probed once the window elapses and heals on a clean probe
+        (0 keeps it degraded for the session's lifetime — the operator
+        re-probes manually via :meth:`probe_mesh`)."""
+        if not self._mesh_degraded:
+            return True
+        from .config import MESH_HEALTH_REPROBE_SECS
+        reprobe = float(self.conf.get(MESH_HEALTH_REPROBE_SECS))
+        if reprobe <= 0:
+            return False
+        import time
+        if time.monotonic() - self._mesh_degraded_at < reprobe:
+            return False
+        return not self.probe_mesh()
+
+    def probe_mesh(self) -> list:
+        """Health-probe every mesh device now
+        (parallel/mesh.probe_devices); returns the failed devices. A
+        clean probe CLEARS the degraded flag, a failed one (re)marks it —
+        the manual recovery path after the hardware comes back."""
+        import time
+        from .parallel.mesh import probe_devices
+        failed = probe_devices()
+        self._mesh_degraded = bool(failed)
+        if failed:
+            self._mesh_degraded_at = time.monotonic()
+        return failed
+
+    def _record_mesh_failover(self, ctx, exc) -> None:
+        """Mark the mesh degraded and record the failover: the
+        ``meshFailovers`` durability counter (harvested across the
+        discarded attempt), a flight-recorder event, and a flight dump
+        carrying the failover timeline (ISSUE 13 artifact)."""
+        import time
+        from .metrics import trace as TR
+        self._mesh_degraded = True
+        self._mesh_degraded_at = time.monotonic()
+        ctx.metric("TpuSession", "meshFailovers", 1)
+        TR.record_event("mesh.failover", reason=str(exc),
+                        failed_devices=[str(d) for d in getattr(
+                            exc, "failed_devices", ())])
+        TR.flight_dump("mesh_degraded", detail=str(exc))
 
     def _run_with_retries(self, fn, eager_only: bool = False,
                           plan_sig: Optional[tuple] = None,
@@ -539,9 +595,29 @@ class TpuSession:
             # inline); worker-reachability here is generous-taint noise.
             final["ctx"] = ctx  # concurrency: ignore
             if mode == "deferred" and self.conf.sql_enabled \
-                    and self.conf.mesh_enabled \
+                    and self.conf.mesh_enabled and self._mesh_usable() \
                     and _mesh().mesh_capable(physical, self.conf):
-                return _mesh().mesh_collect(physical, ctx)
+                from .config import MESH_HEALTH_PROBE_ENABLED
+                from .parallel.mesh import MeshDegradedError
+                failed = self.probe_mesh() \
+                    if self.conf.get(MESH_HEALTH_PROBE_ENABLED) else []
+                if failed:
+                    # The pre-dispatch probe caught the loss: record the
+                    # failover and continue THIS attempt on the
+                    # single-chip path — no exception round-trip.
+                    self._record_mesh_failover(ctx, MeshDegradedError(
+                        "pre-dispatch health probe failed", failed))
+                else:
+                    try:
+                        return _mesh().mesh_collect(physical, ctx)
+                    except MeshDegradedError as e:
+                        # Mid-dispatch device loss: record, mark the
+                        # mesh degraded, and re-raise — TRANSIENT per
+                        # the retry taxonomy, and the re-run skips the
+                        # degraded mesh branch (single-chip path). Same
+                        # answer, one failover, never a wrong result.
+                        self._record_mesh_failover(ctx, e)
+                        raise
             if mode == "deferred" and self.conf.sql_enabled \
                     and self.conf.fusion_enabled \
                     and fusion.fusable(physical, self.conf):

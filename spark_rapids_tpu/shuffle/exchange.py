@@ -476,9 +476,19 @@ class MapOutputTracker:
         self._peer_failures: Dict[Tuple[str, int], int] = {}
         self._blacklist: set = set()
         self._recomputes: Dict[Tuple[int, int], int] = {}
+        #: shuffle_id -> peers holding a replication-pushed copy of every
+        #: map output (ISSUE 19) — the fetch plane's hedge targets and
+        #: the recovery ladder's cheaper-than-recompute rung.
+        self._replicas: Dict[int, List[Tuple[str, int]]] = {}
         self._lock = lockdep.lock("MapOutputTracker._lock")
+        from .net import PeerLatencyStats
+        #: session-scoped per-peer fetch-latency EWMA driving the
+        #: straggler hedge threshold (net.py HedgePolicy).
+        self.latency = PeerLatencyStats()
         self.metrics = {"map_tasks_recomputed": 0, "recomputes": 0,
-                        "peers_blacklisted": 0}
+                        "peers_blacklisted": 0, "hedged_fetches": 0,
+                        "hedge_wins": 0, "replica_reads": 0,
+                        "recomputes_avoided_by_replica": 0}
 
     # -- lineage ------------------------------------------------------------
     def register_shuffle(self, shuffle_id: int, lineage) -> None:
@@ -491,8 +501,29 @@ class MapOutputTracker:
     def unregister_shuffle(self, shuffle_id: int) -> None:
         with self._lock:
             self._lineage.pop(shuffle_id, None)
+            self._replicas.pop(shuffle_id, None)
             for k in [k for k in self._recomputes if k[0] == shuffle_id]:
                 del self._recomputes[k]
+
+    # -- replication (ISSUE 19) ---------------------------------------------
+    def register_replicas(self, shuffle_id: int, peers) -> None:
+        """Record the peers that successfully received a FULL replication
+        push of ``shuffle_id`` (net.py replicate_shuffle) — the fetch
+        plane hedges against them and the recovery ladder reads them
+        before paying a lineage recompute."""
+        with self._lock:
+            self._replicas[shuffle_id] = [tuple(p) for p in peers]
+
+    def replicas_for(self, shuffle_id: int) -> List[Tuple[str, int]]:
+        with self._lock:
+            return list(self._replicas.get(shuffle_id, ()))
+
+    def tally(self, name: str, n: int = 1) -> None:
+        """Bump one self-healing counter (hedged_fetches / hedge_wins /
+        replica_reads / recomputes_avoided_by_replica) — the serving
+        layer's health view aggregates these across pooled sessions."""
+        with self._lock:
+            self.metrics[name] = self.metrics.get(name, 0) + n
 
     def has_lineage(self, shuffle_id: int) -> bool:
         with self._lock:
@@ -628,20 +659,35 @@ def _missing_from_lineage(regen, delivered, map_range, peer,
 
 def fetch_with_recovery(peer, shuffle_id: int, reduce_id: int,
                         tracker: MapOutputTracker, ctx=None,
-                        node: str = "ShuffleFetch", **iterator_kw):
+                        node: str = "ShuffleFetch",
+                        expected_map_ids=None, **iterator_kw):
     """Fetch one reduce partition from a REMOTE peer with the full
     recovery ladder (the reduce-task entry point for multi-process
-    shuffle): stream-fetch with per-block verify and refetch
-    (:class:`~.net.RetryingBlockIterator`) -> on exhaustion or corruption,
-    count the peer failure (blacklisting it past maxPeerFailures) and
-    regenerate its missing map outputs from peer lineage (delivered
-    blocks are checked against the regenerated bytes — see
-    :func:`_missing_from_lineage`) -> only when no lineage exists,
+    shuffle): stream-fetch with per-block verify, refetch and straggler
+    hedging (:class:`~.net.RetryingBlockIterator`) -> on exhaustion or
+    corruption, count the peer failure (blacklisting it past
+    maxPeerFailures) and read the missing blocks from a REPLICA
+    (``replicas`` kwarg or the tracker's registration — each served
+    block is a lineage recompute avoided) -> then regenerate from peer
+    lineage (delivered blocks are checked against the regenerated bytes
+    — see :func:`_missing_from_lineage`) -> only when no rung answers,
     re-raise the typed error naming the peer. Yields payload bytes in
-    map order; a blacklisted peer skips the dial entirely."""
+    map order; a blacklisted peer skips the dial entirely.
+
+    ``expected_map_ids`` (when the caller knows the partition's full map
+    set) gates the replica rung on COMPLETENESS: a replica with a hole
+    (a lost replication push) is rejected rather than silently
+    under-delivering the partition. Without it the replica's own
+    metadata is trusted — safe for tracker-registered replicas, which
+    only register after a full push."""
     from .net import RetryingBlockIterator, ShuffleFetchFailedError
     from .transport import ShuffleBlockCorruptError
     map_range = iterator_kw.get("map_range")
+    replicas = [tuple(r) for r in
+                (iterator_kw.pop("replicas", None)
+                 or tracker.replicas_for(shuffle_id))]
+    if replicas:
+        iterator_kw["replicas"] = replicas  # arm the straggler hedge
 
     def _regenerated(delivered):
         regen = tracker.recompute_peer(peer, shuffle_id, reduce_id, ctx,
@@ -651,13 +697,43 @@ def fetch_with_recovery(peer, shuffle_id: int, reduce_id: int,
         return _missing_from_lineage(regen, delivered, map_range, peer,
                                      shuffle_id, reduce_id)
 
+    def _from_replicas(delivered):
+        """The missing ``[(map_id, payload)]`` from the first replica
+        that answers COMPLETELY, or None — the recovery rung that costs
+        a re-fetch instead of a recompute."""
+        for rp in replicas:
+            if rp == tuple(peer) or tracker.is_blacklisted(rp):
+                continue
+            rep_it = RetryingBlockIterator(
+                rp, shuffle_id, reduce_id, ctx=ctx, node=node,
+                with_map_ids=True, skip_map_ids=set(delivered),
+                map_range=map_range)
+            try:
+                got = list(rep_it)
+            except (OSError, ShuffleFetchFailedError):  # next rung
+                tracker.record_peer_failure(rp, ctx, node)
+                continue
+            if expected_map_ids is not None and not (
+                    set(expected_map_ids)
+                    <= set(delivered) | {m for m, _ in got}):
+                continue  # replica hole: not a complete answer
+            if ctx is not None and hasattr(ctx, "metric"):
+                ctx.metric(node, "replicaReads", len(got))
+            tracker.tally("replica_reads", len(got))
+            tracker.tally("recomputes_avoided_by_replica")
+            return got
+        return None
+
     if tracker.is_blacklisted(peer):
-        out = _regenerated({})
+        out = _from_replicas({})
+        if out is None:
+            out = _regenerated({})
         if out is None:
             raise ShuffleFetchFailedError(
                 tuple(peer), shuffle_id, reduce_id,
                 f"peer blacklisted after {tracker.peer_failures(peer)} "
-                "fetch failures and no peer lineage is registered")
+                "fetch failures and no replica or peer lineage is "
+                "registered")
         for _mid, payload in out:
             yield payload
         return
@@ -673,7 +749,9 @@ def fetch_with_recovery(peer, shuffle_id: int, reduce_id: int,
         # The iterator already verified every delivered payload against
         # its descriptor checksum — reuse those crcs for the generation
         # guard instead of re-hashing on the healthy path.
-        out = _regenerated(dict(it.delivered_crcs))
+        out = _from_replicas(dict(it.delivered_crcs))
+        if out is None:
+            out = _regenerated(dict(it.delivered_crcs))
         if out is None:
             raise e
     for _mid, payload in out:
@@ -952,9 +1030,34 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         # conf timeouts, streaming refetch — over a real loopback socket.
         # The identical code path a remote peer takes, so the distributed
         # plane is exercised (and fault-injected) by ordinary queries.
-        from ..config import SHUFFLE_NET_ENABLED
+        from ..config import SHUFFLE_NET_ENABLED, SHUFFLE_REPLICATION_FACTOR
         net_server = _net_serve(ctx, catalog) \
             if ctx.conf.get(SHUFFLE_NET_ENABLED) else None
+
+        # Replication push (ISSUE 19): register this exchange's map
+        # outputs on `replication.factor` replica peers through the
+        # protocol-v5 PUT wire, CRC-verified at each replica. A dead or
+        # straggling primary then answers from a replica (hedged fetch /
+        # recovery rung) instead of paying a lineage recompute. Push
+        # failure is DEGRADED replication — the replica is simply not
+        # registered — never a query failure.
+        replicas: List[Tuple[str, int]] = []
+        repl_factor = int(ctx.conf.get(SHUFFLE_REPLICATION_FACTOR)) \
+            if net_server is not None else 0
+        if repl_factor > 0:
+            from .net import replicate_shuffle
+            from ..utils.deadline import QueryDeadlineExceeded
+            for rsrv in _replica_env(ctx, repl_factor):
+                try:
+                    replicate_shuffle(rsrv.address, catalog, shuffle_id,
+                                      ctx=ctx, node=name)
+                except QueryDeadlineExceeded:
+                    raise
+                except OSError:  # degraded replication, not a failure
+                    continue
+                replicas.append(rsrv.address)
+            if replicas:
+                tracker.register_replicas(shuffle_id, replicas)
 
         # READ side (RapidsCachingReader analog): lazy fetch + re-upload.
         # Blocks free once every reduce partition is drained — or at query
@@ -1002,14 +1105,37 @@ class TpuShuffleExchangeExec(PhysicalPlan):
         drained = _DrainLatch(
             len(specs), lambda: catalog.unregister_shuffle(shuffle_id))
 
+        def hedge_fallback_for(p):
+            """map_id -> payload recompute closure the straggler hedge
+            races against a stalled primary (ISSUE 19): regenerates the
+            whole reduce partition ONCE from lineage (through the
+            tracker's recompute budget and metrics) and serves blocks
+            out of it."""
+            cache: Dict[int, bytes] = {}
+
+            def fallback(map_id: int) -> bytes:
+                if not cache:
+                    regen = tracker.recompute(shuffle_id, p, ctx=ctx,
+                                              node=name)
+                    if regen is None:
+                        raise IOError(
+                            f"no lineage / recompute budget for hedge "
+                            f"fallback of shuffle {shuffle_id} reduce {p}")
+                    cache.update(dict(regen))
+                return cache[map_id]
+            return fallback
+
         def recovered_payloads(p, map_range):
             """One reduce partition's verified payloads, in map order,
-            surviving corruption and transport failure: stream from the
-            wire plane (or the verified local catalog), and on a typed
-            durability error regenerate the partition from lineage —
+            surviving corruption, transport failure and stragglers:
+            stream from the wire plane (or the verified local catalog)
+            with the replica-backed hedge armed, and on a typed
+            durability error read the missing blocks from a REPLICA
+            (recompute avoided), falling back to lineage regeneration —
             through the shared :func:`_missing_from_lineage` guard, so a
             diverged recompute raises instead of mixing generations."""
             from ..utils import checksum as CK
+            from ..utils.deadline import QueryDeadlineExceeded
             from .net import RetryingBlockIterator, ShuffleFetchFailedError
             from .transport import ShuffleBlockCorruptError
             delivered_ids: set = set()
@@ -1017,7 +1143,10 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 if net_server is not None:
                     src = RetryingBlockIterator(
                         net_server.address, shuffle_id, p, ctx=ctx,
-                        node=name, map_range=map_range, with_map_ids=True)
+                        node=name, map_range=map_range, with_map_ids=True,
+                        replicas=replicas,
+                        local_fallback=(hedge_fallback_for(p)
+                                        if replicas else None))
                 else:
                     src = catalog.blocks_with_ids_for_reduce(
                         shuffle_id, p, map_range)
@@ -1033,18 +1162,51 @@ class TpuShuffleExchangeExec(PhysicalPlan):
                 # real remote path (fetch_with_recovery).
                 peer = net_server.address if net_server is not None \
                     else ("local", 0)
-                regen = tracker.recompute(shuffle_id, p, ctx=ctx,
-                                          node=name)
-                if regen is None:
-                    raise
                 # Delivered payloads passed verification, so their crcs
                 # ARE the catalog's stored registration crcs — no extra
                 # hashing on the healthy path.
-                stored = {m: c for m, _l, c in
-                          catalog.block_metas_for_reduce(shuffle_id, p)}
-                missing = _missing_from_lineage(
-                    regen, {mid: stored.get(mid) for mid in delivered_ids},
-                    map_range, peer, shuffle_id, p)
+                metas = catalog.block_metas_for_reduce(shuffle_id, p)
+                stored = {m: c for m, _l, c in metas}
+                expected = {m for m, _l, _c in metas
+                            if map_range is None
+                            or map_range[0] <= m < map_range[1]}
+                missing = None
+                # Replica rung first (ISSUE 19): the local catalog knows
+                # the partition's FULL map set, so a replica with a hole
+                # (a lost replication push) is rejected outright — it
+                # can never silently under-deliver.
+                for rp in replicas:
+                    rep_it = RetryingBlockIterator(
+                        rp, shuffle_id, p, ctx=ctx, node=name,
+                        map_range=map_range, with_map_ids=True,
+                        skip_map_ids=set(delivered_ids))
+                    try:
+                        got = list(rep_it)
+                    except (QueryDeadlineExceeded, GeneratorExit):
+                        raise
+                    except (OSError, ShuffleFetchFailedError):  # next rung
+                        continue
+                    got_ids = delivered_ids | {m for m, _ in got}
+                    if not expected <= got_ids or any(
+                            stored.get(m) is not None
+                            and rep_it.delivered_crcs.get(m) is not None
+                            and rep_it.delivered_crcs[m] != stored[m]
+                            for m, _ in got):
+                        continue  # hole or diverged copy: not an answer
+                    ctx.metric(name, "replicaReads", len(got))
+                    tracker.tally("replica_reads", len(got))
+                    tracker.tally("recomputes_avoided_by_replica")
+                    missing = got
+                    break
+                if missing is None:
+                    regen = tracker.recompute(shuffle_id, p, ctx=ctx,
+                                              node=name)
+                    if regen is None:
+                        raise
+                    missing = _missing_from_lineage(
+                        regen,
+                        {mid: stored.get(mid) for mid in delivered_ids},
+                        map_range, peer, shuffle_id, p)
             for _mid, payload in missing:
                 yield payload
 
@@ -1110,3 +1272,32 @@ def _net_serve(ctx: ExecContext, catalog: ShuffleBufferCatalog):
         ctx._shuffle_net_server = server
         ctx.add_cleanup(server.close)
     return server
+
+
+def _replica_env(ctx: ExecContext, factor: int):
+    """Per-context replica shuffle servers (ISSUE 19) — stand-ins for
+    ``replication.factor`` distinct peer processes, shared by every
+    exchange in the query (like production peers serve many shuffles).
+    Each replica holds its OWN ShuffleBufferCatalog fed exclusively by
+    protocol-v5 PUT pushes and serves it back over the same wire a real
+    remote replica would; all are closed at query end."""
+    servers = getattr(ctx, "_shuffle_replica_servers", None)
+    if servers is None:
+        servers = []
+        ctx._shuffle_replica_servers = servers
+    if len(servers) < factor:
+        from ..config import (HOST_SPILL_STORAGE_SIZE,
+                              SHUFFLE_CHECKSUM_ENABLED, SPILL_DIR,
+                              SPILL_IO_THREADS)
+        from .net import NetShuffleServer
+        while len(servers) < factor:
+            rcat = ShuffleBufferCatalog(
+                ctx.conf.get(HOST_SPILL_STORAGE_SIZE),
+                ctx.conf.get(SPILL_DIR),
+                verify_checksums=ctx.conf.get(SHUFFLE_CHECKSUM_ENABLED),
+                io_threads=ctx.conf.get(SPILL_IO_THREADS))
+            rsrv = NetShuffleServer(rcat)
+            servers.append(rsrv)
+            ctx.add_cleanup(rsrv.close)
+            ctx.add_cleanup(rcat.close)
+    return servers[:factor]

@@ -171,13 +171,19 @@ class ShuffleClient:
     stream), and metric attribution."""
 
     def __init__(self, transport: "Transport", bounce: BounceBufferPool,
-                 throttle: Throttle, ctx=None, node: str = "ShuffleFetch"):
+                 throttle: Throttle, ctx=None, node: str = "ShuffleFetch",
+                 injection_site: str = "shuffle.fetchBlock"):
         self.transport = transport
         self.bounce = bounce
         self.throttle = throttle
         self._next_txn = 0
         self._ctx = ctx
         self._node = node
+        #: fault-injection site this client's fetches count against —
+        #: hedged duplicate fetches use a DISTINCT site
+        #: ("shuffle.hedgeFetch") so launching a hedge never perturbs the
+        #: primary path's deterministic fault schedule (ISSUE 19).
+        self.injection_site = injection_site
         self._injector = getattr(ctx, "fault_injector", None)
         self._deadline = getattr(ctx, "deadline", None)
         self.metrics = {"fetches": 0, "bytes": 0, "chunks": 0, "errors": 0,
@@ -217,9 +223,13 @@ class ShuffleClient:
         on short reads, connection errors verbatim — the per-block unit
         the streaming RetryingBlockIterator refetches."""
         if self._deadline is not None:
-            self._deadline.check("shuffle.fetchBlock", self._ctx,
+            self._deadline.check(self.injection_site, self._ctx,
                                  self._node)
-        fault = self._injector.check_net("shuffle.fetchBlock") \
+        # Stream faults only: replicaLoss belongs to the replication push
+        # seam (shuffle.replicate), never to a fetch.
+        fault = self._injector.check_net(
+            self.injection_site,
+            classes=("peerDeath", "torn", "bitFlip", "stall")) \
             if self._injector is not None else None
         self.throttle.acquire(desc.length)
         try:

@@ -81,7 +81,18 @@ class InjectedDiskFault(InjectedFault, OSError):
 #: Network fault classes the injector can apply at transport sites
 #: (ISSUE 7): what each does is implemented by the shuffle client
 #: (shuffle/transport.py applies the returned flavor to its stream).
-NET_FAULT_CLASSES = ("peerDeath", "torn", "bitFlip", "stall")
+#: ``replicaLoss`` (ISSUE 19) only applies at the replication push seam
+#: (``shuffle.replicate``) — the block silently never reaches the
+#: replica, so a later primary failure must fall through the replica
+#: ladder to lineage recompute.
+NET_FAULT_CLASSES = ("peerDeath", "torn", "bitFlip", "stall",
+                     "replicaLoss")
+
+#: Mesh fault classes (ISSUE 19): applied at the SPMD dispatch seam
+#: (``mesh.collect``) — exec/mesh.py raises the typed
+#: ``MeshDegradedError`` so the session re-plans onto the single-chip
+#: path through the retry taxonomy (TRANSIENT, re-run once).
+MESH_FAULT_CLASSES = ("deviceLoss",)
 
 #: Serving-seam fault classes (ISSUE 12): what each does is implemented
 #: by the query service (serve/service.py applies the returned flavor at
@@ -97,7 +108,8 @@ class FaultInjector:
     def __init__(self, seed: int, sites: str, oom_every_n: int,
                  transient_every_n: int, net_every_n: int = 0,
                  net_faults: str = "", net_stall_secs: float = 0.05,
-                 serve_every_n: int = 0, serve_faults: str = ""):
+                 serve_every_n: int = 0, serve_faults: str = "",
+                 mesh_every_n: int = 0):
         self.seed = int(seed)
         self.patterns = [s.strip() for s in sites.split(",") if s.strip()]
         self.oom_every_n = int(oom_every_n)
@@ -111,19 +123,22 @@ class FaultInjector:
         self.serve_faults = tuple(
             f for f in (s.strip() for s in (serve_faults or "").split(","))
             if f in SERVE_FAULT_CLASSES) or SERVE_FAULT_CLASSES
+        self.mesh_every_n = int(mesh_every_n)
         self._counters: Dict[str, int] = {}
         self._lock = lockdep.lock("FaultInjector._lock")
         #: injected-fault tallies by flavor (test assertions read these)
         self.injected = {"oom": 0, "transient": 0, "disk": 0}
         self.injected.update({f"net.{c}": 0 for c in NET_FAULT_CLASSES})
         self.injected.update({f"serve.{c}": 0 for c in SERVE_FAULT_CLASSES})
+        self.injected.update({f"mesh.{c}": 0 for c in MESH_FAULT_CLASSES})
 
     @classmethod
     def maybe(cls, conf) -> Optional["FaultInjector"]:
         """The conf's injector, or None when injection is off (the
         default). Duck-typed: anything without the conf entries (bare
         test contexts) gets None."""
-        from ..config import (FAULT_INJECTION_NET_EVERY_N,
+        from ..config import (FAULT_INJECTION_MESH_EVERY_N,
+                              FAULT_INJECTION_NET_EVERY_N,
                               FAULT_INJECTION_NET_FAULTS,
                               FAULT_INJECTION_NET_STALL_SECS,
                               FAULT_INJECTION_OOM_EVERY_N,
@@ -144,14 +159,15 @@ class FaultInjector:
             net_stall = float(conf.get(FAULT_INJECTION_NET_STALL_SECS))
             serve_n = int(conf.get(FAULT_INJECTION_SERVE_EVERY_N))
             serve_faults = conf.get(FAULT_INJECTION_SERVE_FAULTS) or ""
+            mesh_n = int(conf.get(FAULT_INJECTION_MESH_EVERY_N))
         except (AttributeError, TypeError):
             return None
         if not sites.strip() \
                 or (oom_n == 0 and transient_n == 0 and net_n == 0
-                    and serve_n == 0):
+                    and serve_n == 0 and mesh_n == 0):
             return None
         return cls(seed, sites, oom_n, transient_n, net_n, net_faults,
-                   net_stall, serve_n, serve_faults)
+                   net_stall, serve_n, serve_faults, mesh_n)
 
     def matches(self, site: str) -> bool:
         for p in self.patterns:
@@ -230,26 +246,55 @@ class FaultInjector:
             self.injected[f"serve.{flavor}"] += 1
             return flavor
 
-    def check_net(self, site: str) -> Optional[str]:
+    def check_net(self, site: str, classes=NET_FAULT_CLASSES
+                  ) -> Optional[str]:
         """Count one visit of a TRANSPORT site; return the network fault
         class scheduled for this visit (one of :data:`NET_FAULT_CLASSES`),
-        or None. Unlike :meth:`check` this does not raise — the shuffle
-        client applies the class to its own stream (close the connection,
-        truncate the payload, flip a bit, stall past the request timeout),
-        so the failure arrives through the exact error path the real
-        fault would take. Deterministic like every other schedule: same
-        conf, same visit, same class."""
+        or None. ``classes`` restricts the flavors valid at this seam
+        (replicaLoss only makes sense on the replication push, stream
+        faults only on a fetch) — a seam where no configured flavor
+        applies never faults. Unlike :meth:`check` this does not raise —
+        the shuffle client applies the class to its own stream (close the
+        connection, truncate the payload, flip a bit, stall past the
+        request timeout, drop the replica push), so the failure arrives
+        through the exact error path the real fault would take.
+        Deterministic like every other schedule: same conf, same visit,
+        same class."""
         if self.net_every_n == 0 or not self.matches(site):
+            return None
+        eligible = tuple(f for f in self.net_faults if f in classes)
+        if not eligible:
             return None
         with self._lock:
             n = self._counters.get(site, 0) + 1
             self._counters[site] = n
             if not self._scheduled(n, self.net_every_n):
                 return None
-            flavor = self.net_faults[
+            flavor = eligible[
                 zlib.crc32(f"net:{site}:{n}:{self.seed}".encode())
-                % len(self.net_faults)]
+                % len(eligible)]
             self.injected[f"net.{flavor}"] += 1
+            return flavor
+
+    def check_mesh(self, site: str) -> Optional[str]:
+        """Count one visit of the MESH dispatch seam; return the mesh
+        fault class scheduled for this visit (one of
+        :data:`MESH_FAULT_CLASSES`), or None. exec/mesh.py raises the
+        typed ``MeshDegradedError`` for ``deviceLoss`` so the failover
+        travels the exact path a real device loss takes: retry taxonomy
+        classifies it TRANSIENT, the session records a meshFailover and
+        re-runs the query on the single-chip path."""
+        if self.mesh_every_n == 0 or not self.matches(site):
+            return None
+        with self._lock:
+            n = self._counters.get(site, 0) + 1
+            self._counters[site] = n
+            if not self._scheduled(n, self.mesh_every_n):
+                return None
+            flavor = MESH_FAULT_CLASSES[
+                zlib.crc32(f"mesh:{site}:{n}:{self.seed}".encode())
+                % len(MESH_FAULT_CLASSES)]
+            self.injected[f"mesh.{flavor}"] += 1
             return flavor
 
 

@@ -1,0 +1,108 @@
+"""CI chaos smoke (ISSUE 19 S5): run the chaos soak harness in-process
+on tiny inputs and assert the artifact gates — every injector class
+armed AND recovered, zero wrong answers, hedge wins strictly positive —
+so a regression in any recovery ladder fails tier-1, not a nightly."""
+
+import json
+
+import pytest
+
+import jax
+
+from tools import chaos_bench, multichip_bench
+
+needs_mesh = pytest.mark.skipif(
+    len(jax.devices()) < 8,
+    reason="chaos matrix includes mesh.deviceLoss (8-device virtual mesh)")
+
+
+@pytest.fixture(autouse=True)
+def _preserve_flight_recorder_state():
+    """The chaos soak trips session crashes and deadline kills on
+    purpose. trace.configure is enable-only and STICKY, and the
+    per-reason dump budget (trace._MAX_DUMPS_PER_REASON) is
+    process-global — restore the whole module state so later test
+    files' first-fault dump assertions still fire."""
+    from spark_rapids_tpu.metrics import trace as TR
+    with TR._STATE_LOCK:
+        before = (TR._ENABLED, TR._TRACE_DIR, TR._FLIGHT_DIR,
+                  TR._MAX_FILES, dict(TR._DUMPS))
+    yield
+    with TR._STATE_LOCK:
+        (TR._ENABLED, TR._TRACE_DIR, TR._FLIGHT_DIR,
+         TR._MAX_FILES) = before[:4]
+        TR._DUMPS.clear()
+        TR._DUMPS.update(before[4])
+
+
+@pytest.fixture
+def _chaos_out(tmp_path):
+    """Point the kill-dump checkpoint artifact into the test tmp dir and
+    restore the module state after."""
+    old = dict(chaos_bench._CHECKPOINT)
+    out = tmp_path / "BENCH_chaos.json"
+    chaos_bench._CHECKPOINT.update(
+        {"payload": None, "done": False, "out": str(out)})
+    yield out
+    chaos_bench._CHECKPOINT.update(old)
+
+
+@needs_mesh
+class TestChaosSmoke:
+    def test_all_gates_pass_on_smoke_soak(self, _chaos_out):
+        payload = chaos_bench.run(chaos_bench.make_args(smoke=True))
+        gates = payload["gates"]
+        assert gates["zero_wrong_answers"], payload
+        assert gates["all_classes_recovered"], gates["recovery_per_class"]
+        assert gates["serve_injector_armed"], payload["serving_soak"]
+        assert gates["hedge_wins_positive"], payload["hedge_ab"]
+        # Every matrix class was actually injected — a class that never
+        # fires would pass "recovered" vacuously.
+        for cls, sec in payload["fault_matrix"].items():
+            assert sec["injected"] >= 1, (cls, sec)
+            assert sec["wrong_answers"] == 0, (cls, sec)
+            assert sec["mttr_ms"] >= 0.0, (cls, sec)
+        # The hedged run answered bit-identically to the serial oracle
+        # while winning at least one hedge race.
+        ab = payload["hedge_ab"]
+        assert ab["bit_identical"] and ab["hedge_wins"] >= 1
+        # The checkpointed artifact on disk is the cumulative payload up
+        # to the LAST section; the caller (main) writes the final one.
+        on_disk = json.loads(_chaos_out.read_text())
+        assert on_disk["bench"] == "chaos"
+        assert on_disk["serving_soak"]["wrong_answers"] == 0
+
+    def test_matrix_covers_every_injector_family(self):
+        classes = {cls for cls, _, _ in chaos_bench._MATRIX}
+        # net (wire faults), mesh (device loss), memory (oom), compute
+        # (transient): all four injector families must stay in the soak.
+        assert {"net.peerDeath", "net.torn", "net.bitFlip", "net.stall",
+                "net.replicaLoss", "mesh.deviceLoss", "oom",
+                "transient"} <= classes
+
+    def test_kill_dump_reemits_last_checkpoint(self, _chaos_out, capsys):
+        chaos_bench.emit_checkpoint({"bench": "chaos", "wrong_answers": 0})
+        capsys.readouterr()
+        # Simulate the atexit/kill path without killing the test runner.
+        chaos_bench._CHECKPOINT["done"] = False
+        payload = dict(chaos_bench._CHECKPOINT["payload"])
+        payload["error"] = "killed"
+        chaos_bench._write_out(payload)
+        on_disk = json.loads(_chaos_out.read_text())
+        assert on_disk["partial"] is True or "error" in on_disk
+
+
+@needs_mesh
+class TestMultichipSmoke:
+    def test_every_shape_mesh_capable_and_bit_identical(self):
+        payload = multichip_bench.run(
+            multichip_bench.make_args(rows=1 << 12, runs=1))
+        assert payload["all_mesh_capable"], payload["per_query"]
+        assert payload["all_match"], payload["per_query"]
+        assert set(payload["per_query"]) == {
+            "groupby_sum", "groupby_multi", "filter_project_agg",
+            "join_agg"}
+        for name, entry in payload["per_query"].items():
+            assert entry["speedup"] > 0, (name, entry)
+            # A fault absorbed mid-bench must surface next to the timing.
+            assert "recovery" in entry, name

@@ -1,0 +1,456 @@
+"""Self-healing distributed execution tests (ISSUE 19): shuffle block
+replication over the wire PUT, hedged fetches against stragglers,
+replica-then-lineage recovery laddering, degraded-mesh fallback, and the
+deadline-bounded dial — every scenario must answer bit-identically to
+the fault-free path, never leak pool workers, and count its recovery."""
+
+import glob
+import os
+import socket
+import time
+import types
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+
+from spark_rapids_tpu.config import TpuConf
+from spark_rapids_tpu.session import TpuSession
+from spark_rapids_tpu.shuffle.codec import get_codec
+from spark_rapids_tpu.shuffle.exchange import (MapOutputTracker,
+                                               ShuffleBufferCatalog,
+                                               fetch_with_recovery)
+from spark_rapids_tpu.shuffle.net import (HedgePolicy, NetShuffleServer,
+                                          NetTransport, PeerLatencyStats,
+                                          RetryingBlockIterator,
+                                          replicate_shuffle)
+from spark_rapids_tpu.shuffle.serializer import serialize_batch
+from spark_rapids_tpu.utils import checksum as CK
+from spark_rapids_tpu.utils.deadline import (Deadline,
+                                             QueryDeadlineExceeded)
+from spark_rapids_tpu.utils.fault_injection import FaultInjector
+
+
+@pytest.fixture(autouse=True)
+def _preserve_flight_recorder_state():
+    """Mesh failovers here enable tracing and dump the flight recorder
+    on purpose. trace.configure is enable-only and STICKY: leaving
+    _ENABLED armed makes every later test file's crashes spend the
+    process-global per-reason dump budget (test_serve's crash matrix
+    would drain ``session_crash`` before test_trace's first-dump
+    assertions run). Restore the whole module state — as if this file
+    never ran."""
+    from spark_rapids_tpu.metrics import trace as TR
+    with TR._STATE_LOCK:
+        before = (TR._ENABLED, TR._TRACE_DIR, TR._FLIGHT_DIR,
+                  TR._MAX_FILES, dict(TR._DUMPS))
+    yield
+    with TR._STATE_LOCK:
+        (TR._ENABLED, TR._TRACE_DIR, TR._FLIGHT_DIR,
+         TR._MAX_FILES) = before[:4]
+        TR._DUMPS.clear()
+        TR._DUMPS.update(before[4])
+
+
+def _payload(tag: int = 0, rows: int = 10) -> bytes:
+    rb = pa.RecordBatch.from_pydict({"v": list(range(tag, tag + rows))})
+    return serialize_batch(rb, get_codec("none"))
+
+
+def _ctx(injector=None, tracker=None, **conf):
+    metrics: dict = {}
+
+    def metric(node, name, value):
+        metrics[name] = metrics.get(name, 0) + value
+    ctx = types.SimpleNamespace(conf=TpuConf(conf), deadline=None,
+                                fault_injector=injector,
+                                shuffle_tracker=tracker, metric=metric)
+    ctx.metrics = metrics
+    return ctx
+
+
+@pytest.fixture
+def replicated():
+    """Primary + replica servers, shuffle 21 fully replicated via the
+    protocol-v5 PUT push (3 map blocks of reduce 0)."""
+    cat = ShuffleBufferCatalog()
+    payloads = {}
+    for m in range(3):
+        p = _payload(m * 7)
+        payloads[m] = p
+        cat.add_block(21, m, 0, p)
+    srv = NetShuffleServer(cat)
+    rcat = ShuffleBufferCatalog()
+    rsrv = NetShuffleServer(rcat)
+    pushed = replicate_shuffle(rsrv.address, cat, 21)
+    assert pushed == 3
+    yield srv, cat, rsrv, rcat, payloads
+    for closer in (srv.close, rsrv.close, cat.close, rcat.close):
+        closer()
+
+
+# ---------------------------------------------------------------------------
+# Replication push (protocol v5 PUT)
+# ---------------------------------------------------------------------------
+
+
+class TestReplication:
+    def test_put_roundtrip_crc_preserved(self, replicated):
+        _, cat, _, rcat, payloads = replicated
+        for m, p in payloads.items():
+            assert rcat.read_block(21, m, 0) == p
+        # The replica re-registered the blocks under their own CRCs —
+        # a verified read path, not a blind byte copy.
+        assert rcat.block_metas_for_reduce(21, 0) \
+            == cat.block_metas_for_reduce(21, 0)
+
+    def test_corrupt_push_rejected_at_replica(self):
+        cat = ShuffleBufferCatalog()
+        rcat = ShuffleBufferCatalog()
+        rsrv = NetShuffleServer(rcat)
+        try:
+            t = NetTransport(rsrv.address)
+            p = _payload(5)
+            with pytest.raises(IOError, match="checksum"):
+                t.put_block(9, 0, 0, p, CK.crc32c(p) ^ 0xFF)
+            t.close()
+            # The poisoned push never landed.
+            assert rcat.blocks_for_reduce(9, 0) == []
+        finally:
+            rsrv.close()
+            rcat.close()
+            cat.close()
+
+    def test_replica_loss_seam_leaves_hole(self):
+        """An injected replicaLoss silently drops one block: the push
+        reports fewer blocks, and the replica holds a hole the recovery
+        ladder's completeness gate must detect."""
+        cat = ShuffleBufferCatalog()
+        for m in range(3):
+            cat.add_block(22, m, 0, _payload(m))
+        rcat = ShuffleBufferCatalog()
+        rsrv = NetShuffleServer(rcat)
+        inj = FaultInjector(0, "shuffle.replicate", 0, 0,
+                            net_every_n=-1, net_faults="replicaLoss")
+        try:
+            pushed = replicate_shuffle(
+                rsrv.address, cat, 22, ctx=_ctx(injector=inj))
+            assert pushed == 2
+            assert inj.injected["net.replicaLoss"] == 1
+            assert len(rcat.blocks_for_reduce(22, 0)) == 2
+        finally:
+            rsrv.close()
+            rcat.close()
+            cat.close()
+
+
+# ---------------------------------------------------------------------------
+# Hedged fetches / straggler mitigation (S3)
+# ---------------------------------------------------------------------------
+
+
+def _stall_injector(stall_secs=0.8):
+    """Visit 1 clean (warms the latency EWMA — a cold peer is never
+    hedged), visit 2 stalls long enough that quantileFactor x p50
+    expires first: the hedge MUST fire and win."""
+    return FaultInjector(0, "shuffle.fetchBlock", 0, 0, net_every_n=2,
+                         net_faults="stall", net_stall_secs=stall_secs)
+
+
+class TestHedgedFetch:
+    def test_stalled_primary_replica_answers_bit_identical(
+            self, replicated):
+        srv, _, rsrv, _, payloads = replicated
+        tracker = MapOutputTracker()
+        ctx = _ctx(injector=_stall_injector(), tracker=tracker)
+        got = list(RetryingBlockIterator(
+            srv.address, 21, 0, ctx=ctx, with_map_ids=True,
+            replicas=[rsrv.address]))
+        assert dict(got) == payloads  # bit-identical, in map order
+        assert [m for m, _ in got] == sorted(payloads)
+        assert ctx.metrics.get("hedgedFetches", 0) >= 1
+        assert ctx.metrics.get("hedgeWins", 0) >= 1
+        assert ctx.metrics.get("replicaReads", 0) >= 1
+        assert tracker.metrics["hedge_wins"] >= 1
+
+    def test_serial_oracle_matches_hedged_run(self, replicated):
+        """The hedging-disabled run under the SAME stall schedule takes
+        the refetch ladder instead — slower, same bytes."""
+        srv, _, rsrv, _, payloads = replicated
+        hedged = list(RetryingBlockIterator(
+            srv.address, 21, 0, ctx=_ctx(injector=_stall_injector()),
+            with_map_ids=True, replicas=[rsrv.address]))
+        serial = list(RetryingBlockIterator(
+            srv.address, 21, 0, ctx=_ctx(injector=_stall_injector()),
+            with_map_ids=True, replicas=[rsrv.address],
+            hedge=HedgePolicy(enabled=False)))
+        assert dict(serial) == payloads
+        assert hedged == serial
+
+    def test_hedge_loser_cancellation_leaks_no_pool_workers(
+            self, replicated):
+        srv, _, rsrv, _, payloads = replicated
+        ctx = _ctx(injector=_stall_injector(), tracker=MapOutputTracker())
+        got = list(RetryingBlockIterator(
+            srv.address, 21, 0, ctx=ctx, with_map_ids=True,
+            replicas=[rsrv.address]))
+        assert dict(got) == payloads
+        assert ctx.metrics.get("hedgeWins", 0) >= 1
+        from spark_rapids_tpu.exec import pipeline
+        leaked = pipeline.shutdown(timeout=10)
+        assert leaked == [], [t.name for t in leaked]
+
+    def test_cold_peer_never_hedges(self, replicated):
+        """No latency model yet => no hedge, even with a replica armed:
+        a healthy first fetch must report hedgedFetches == 0."""
+        srv, _, rsrv, _, payloads = replicated
+        ctx = _ctx(tracker=MapOutputTracker())
+        got = list(RetryingBlockIterator(
+            srv.address, 21, 0, ctx=ctx, with_map_ids=True,
+            replicas=[rsrv.address]))
+        assert dict(got) == payloads
+        assert ctx.metrics.get("hedgedFetches", 0) == 0
+
+    def test_latency_ewma_and_policy(self):
+        stats = PeerLatencyStats(alpha=0.5)
+        peer = ("h", 1)
+        assert stats.p50(peer) is None
+        stats.record(peer, 0.1)
+        assert stats.p50(peer) == pytest.approx(0.1)
+        stats.record(peer, 0.3)
+        assert stats.p50(peer) == pytest.approx(0.2)
+        pol = HedgePolicy(quantile_factor=3.0, min_delay_s=0.02)
+        assert pol.delay_s(None) is None  # cold peer: never hedge
+        assert pol.delay_s(0.1) == pytest.approx(0.3)
+        assert pol.delay_s(0.001) == pytest.approx(0.02)  # floor
+
+
+# ---------------------------------------------------------------------------
+# Recovery ladder: replica before lineage, lineage past a corrupt replica
+# ---------------------------------------------------------------------------
+
+
+class TestRecoveryLadder:
+    def _tracker_with_lineage(self, payloads):
+        tracker = MapOutputTracker()
+        tracker.set_peer_lineage(
+            lambda peer, sid, rid: sorted(payloads.items()))
+        return tracker
+
+    def test_dead_primary_answers_from_replica_not_recompute(
+            self, replicated):
+        srv, _, rsrv, _, payloads = replicated
+        tracker = self._tracker_with_lineage(payloads)
+        tracker.register_replicas(21, [rsrv.address])
+        srv.close()  # primary gone before the first byte
+        ctx = _ctx(tracker=tracker)
+        got = list(fetch_with_recovery(
+            srv.address, 21, 0, tracker, ctx=ctx,
+            expected_map_ids=sorted(payloads),
+            max_retries=1, backoff_s=0.01))
+        assert got == [payloads[m] for m in sorted(payloads)]
+        assert tracker.metrics["recomputes_avoided_by_replica"] >= 1
+        assert tracker.metrics["map_tasks_recomputed"] == 0
+        assert ctx.metrics.get("replicaReads", 0) >= len(payloads)
+
+    def test_corrupt_replica_falls_through_to_lineage(self, replicated):
+        srv, _, rsrv, rcat, payloads = replicated
+        tracker = self._tracker_with_lineage(payloads)
+        tracker.register_replicas(21, [rsrv.address])
+        # Rot one replica block: its stored bytes no longer match the
+        # advertised CRC, so the replica rung must be REJECTED whole.
+        key = (21, 1, 0)
+        v = rcat._blocks[key]
+        if isinstance(v, tuple):  # arena tier: flip the stored crc
+            rcat._crcs[key] ^= 0xFFFF
+        else:
+            rcat._blocks[key] = b"\x00" + v[1:]
+        srv.close()  # primary dead too
+        ctx = _ctx(tracker=tracker)
+        got = list(fetch_with_recovery(
+            srv.address, 21, 0, tracker, ctx=ctx,
+            expected_map_ids=sorted(payloads),
+            max_retries=1, backoff_s=0.01))
+        assert got == [payloads[m] for m in sorted(payloads)]
+        assert tracker.metrics["map_tasks_recomputed"] >= 1
+
+    def test_replica_hole_fails_completeness_gate(self, replicated):
+        """A replica missing a block (lost replication push) must not
+        under-deliver the partition: the completeness gate rejects it
+        and lineage recompute answers instead."""
+        srv, _, rsrv, rcat, payloads = replicated
+        tracker = self._tracker_with_lineage(payloads)
+        tracker.register_replicas(21, [rsrv.address])
+        with rcat._lock:
+            rcat._blocks.pop((21, 2, 0), None)
+            rcat._crcs.pop((21, 2, 0), None)
+        srv.close()
+        ctx = _ctx(tracker=tracker)
+        got = list(fetch_with_recovery(
+            srv.address, 21, 0, tracker, ctx=ctx,
+            expected_map_ids=sorted(payloads),
+            max_retries=1, backoff_s=0.01))
+        assert got == [payloads[m] for m in sorted(payloads)]
+        assert tracker.metrics["map_tasks_recomputed"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Deadline-bounded dial (S1 regression)
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlineDial:
+    def test_handshake_stall_bounded_by_deadline(self):
+        """A peer that accepts the TCP connect but never answers the
+        handshake must fail within the query deadline, not the full
+        connect-timeout ladder."""
+        lis = socket.socket()
+        lis.bind(("127.0.0.1", 0))
+        lis.listen(1)  # backlog accepts the connect; nobody ever reads
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(OSError):
+                NetTransport(lis.getsockname(), connect_timeout=30.0,
+                             request_timeout=30.0, deadline=Deadline(0.3))
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            lis.close()
+
+    def test_deadline_checked_between_refetch_rungs(self):
+        """Every visit stalls; the deadline must cancel the fetch inside
+        the retry ladder (stall injector regression, S1) instead of
+        sleeping out max_retries x stall."""
+        cat = ShuffleBufferCatalog()
+        cat.add_block(31, 0, 0, _payload(1))
+        srv = NetShuffleServer(cat)
+        inj = FaultInjector(0, "shuffle.fetchBlock", 0, 0,
+                            net_every_n=-100, net_faults="stall",
+                            net_stall_secs=0.1)
+        ctx = _ctx(injector=inj)
+        ctx.deadline = Deadline(0.25)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(QueryDeadlineExceeded):
+                list(RetryingBlockIterator(srv.address, 31, 0, ctx=ctx,
+                                           backoff_s=0.05))
+            assert time.monotonic() - t0 < 3.0
+            assert ctx.metrics.get("deadlineCancels", 0) == 1
+        finally:
+            srv.close()
+            cat.close()
+
+
+# ---------------------------------------------------------------------------
+# Degraded-mesh fallback (session level)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.skipif(len(jax.devices()) < 8,
+                    reason="needs the 8-device virtual mesh")
+class TestMeshFailover:
+    def _data(self, n=20_000):
+        rng = np.random.default_rng(0)
+        return pa.RecordBatch.from_pydict({
+            "k": rng.integers(0, 64, n).astype(np.int64),
+            "v": rng.integers(-50, 50, n).astype(np.int64)})
+
+    def _q(self, s, rb):
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+        return (s.create_dataframe(rb).group_by(col("k"))
+                .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s")))
+
+    @staticmethod
+    def _rows(table):
+        d = table.to_pydict()
+        return sorted(zip(d["k"], d["s"]))
+
+    def test_device_loss_fails_over_single_chip(self, tmp_path):
+        rb = self._data()
+        cpu = TpuSession({"spark.rapids.sql.enabled": False})
+        oracle = self._rows(self._q(cpu, rb).collect())
+        mesh = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.mesh.enabled": True,
+            "spark.rapids.tpu.trace.enabled": True,
+            "spark.rapids.tpu.trace.flightRecorder.dir": str(tmp_path),
+            "spark.rapids.tpu.test.faultInjection.sites": "mesh.collect",
+            "spark.rapids.tpu.test.faultInjection.meshEveryN": -1})
+        got = self._rows(self._q(mesh, rb).collect())
+        assert got == oracle  # failover re-ran single-chip, same answer
+        dur = mesh.last_query_profile().engine["durability"]
+        assert dur["meshFailovers"] == 1, dur
+        assert mesh._fault_injector.injected["mesh.deviceLoss"] == 1
+        assert mesh._mesh_degraded is True
+        # The failover timeline is a flight-recorder artifact (ISSUE 13).
+        assert glob.glob(os.path.join(str(tmp_path),
+                                      "flight_mesh_degraded_*.json"))
+        # While degraded the mesh seam is never visited again...
+        self._q(mesh, rb).collect()
+        assert mesh._fault_injector.injected["mesh.deviceLoss"] == 1
+        # ...until a manual probe heals it (all virtual devices answer).
+        assert mesh.probe_mesh() == []
+        assert mesh._mesh_degraded is False
+
+    def test_classification_is_transient(self):
+        from spark_rapids_tpu.memory.retry import Classification, classify
+        from spark_rapids_tpu.parallel.mesh import (MeshDegradedError,
+                                                    is_device_loss)
+        assert classify(MeshDegradedError("probe failed")) \
+            == Classification.TRANSIENT
+        assert is_device_loss(RuntimeError("DATA_LOSS: chip 3 gone"))
+        assert not is_device_loss(RuntimeError("INVALID_ARGUMENT: shape"))
+
+    def test_pre_dispatch_probe_heals_by_reprobe_window(self):
+        """probeEnabled probes before every mesh dispatch; a degraded
+        mesh with reprobeSecs > 0 re-probes after the window and heals
+        when the (virtual, always-healthy) devices answer."""
+        rb = self._data(4_000)
+        s = TpuSession({
+            "spark.rapids.sql.enabled": True,
+            "spark.rapids.tpu.mesh.enabled": True,
+            "spark.rapids.tpu.mesh.health.probeEnabled": True,
+            "spark.rapids.tpu.mesh.health.reprobeSecs": 0.01})
+        self._q(s, rb).collect()  # probe passes, mesh path runs
+        assert s._mesh_degraded is False
+        s._mesh_degraded = True  # as if a failover had tripped it
+        s._mesh_degraded_at = time.monotonic() - 1.0  # window elapsed
+        assert s._mesh_usable() is True  # reprobe healed it
+        assert s._mesh_degraded is False
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: replicated TPC-H over the wire stays bit-identical
+# ---------------------------------------------------------------------------
+
+
+from spark_rapids_tpu.workloads import tpch  # noqa: E402
+
+
+class TestReplicatedQuery:
+    def test_replicated_wire_run_bit_identical(self):
+        tables = tpch.gen_tables(1 << 10, seed=13)
+
+        def run(extra):
+            s = TpuSession({
+                "spark.rapids.sql.enabled": True,
+                "spark.rapids.sql.variableFloatAgg.enabled": True,
+                "spark.rapids.tpu.shuffle.net.enabled": True,
+                **extra})
+            t = tpch.load(s, tables)
+            t["lineitem"] = t["lineitem"].repartition(4, "l_orderkey")
+            result = tpch.QUERIES["q1"](t).collect()
+            return result, s
+
+        clean, _ = run({})
+        got, s = run({"spark.rapids.tpu.shuffle.replication.factor": 1})
+        assert got.equals(clean)
+        dur = s.last_query_profile().engine["durability"]
+        # Replication is invisible on a healthy run: no hedges fire
+        # (cold-peer policy), no replica reads, and every replica PUT
+        # was CRC-verified on arrival.
+        assert dur["hedgedFetches"] == 0
+        assert dur["replicaReads"] == 0
+        assert dur["checksumVerified"] > 0

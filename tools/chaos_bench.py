@@ -1,0 +1,501 @@
+"""Chaos soak bench (ISSUE 19) -> BENCH_chaos.json.
+
+Drives the engine and the serving layer with EVERY fault injector armed
+and measures what the self-healing machinery actually delivers:
+
+1. **Fault matrix** — one section per injector class (the four ISSUE-7
+   network classes, ``replicaLoss``, ``mesh.deviceLoss``, synthetic OOM
+   and transient faults): each class runs its query clean to establish a
+   latency baseline, then with the deterministic schedule armed, asserts
+   the faulted answer is BIT-IDENTICAL to the clean one, and reports
+   MTTR (median faulted latency minus median clean latency — the
+   recovery overhead the fault class costs) plus the recovery counters
+   that absorbed it (refetches, recomputes, hedge wins, replica reads,
+   mesh failovers).
+2. **Hedge A/B** — the straggler scenario: a stalled primary with a live
+   replica, hedging OFF (the serial retry-ladder path) vs hedging ON.
+   Both must match the oracle; the hedged run must win at least one
+   hedge.
+3. **Serving soak** — one :class:`~spark_rapids_tpu.serve.QueryService`
+   with the serving-seam injector armed for every serve class at once
+   plus per-tenant session-level chaos confs (wire-shuffle network
+   faults for one tenant, dispatch OOM for another), driven for N
+   requests; every successful answer is compared to the oracle.
+4. **Gates** — ``zero_wrong_answers`` (global, across every section) and
+   ``recovery_per_class`` (>= 1 recovery/absorbed fault per armed
+   class). The CI smoke (tests/test_chaos_bench.py) asserts both.
+
+bench.py discipline: a cumulative JSON checkpoint is emitted (stdout AND
+the artifact, atomically) after every section, and SIGTERM/SIGINT/atexit
+dumpers re-emit the last checkpoint — an external kill never yields a
+missing or torn artifact.
+
+CLI::
+
+    python -m tools.chaos_bench [--rows N] [--smoke] [--out BENCH_chaos.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import atexit
+import json
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_CHECKPOINT = {"payload": None, "done": False, "out": None}
+
+_FI = "spark.rapids.tpu.test.faultInjection."
+
+#: session-level fault classes the matrix drives (serve classes soak via
+#: the QueryService section). Each entry: (class label, extra conf, which
+#: recovery counters prove the fault was absorbed).
+_NET_RECOVERY = ("shuffleBlocksRefetched", "mapTasksRecomputed",
+                 "hedgeWins", "replicaReads")
+_MATRIX = [
+    ("net.peerDeath", {}, _NET_RECOVERY),
+    ("net.torn", {}, _NET_RECOVERY),
+    ("net.bitFlip", {}, _NET_RECOVERY),
+    ("net.stall", {"spark.rapids.tpu.shuffle.net.requestTimeout": 0.3,
+                   _FI + "netStallSecs": 0.02}, _NET_RECOVERY),
+    # replicaLoss fires on the replication PUSH: the block silently never
+    # reaches the replica and the query must complete correct anyway —
+    # the absorbed-fault count is the recovery evidence.
+    ("net.replicaLoss",
+     {"spark.rapids.tpu.shuffle.replication.factor": 1}, ()),
+    ("mesh.deviceLoss", {}, ("meshFailovers",)),
+    ("oom", {}, ()),
+    ("transient", {}, ()),
+]
+
+
+def _write_out(payload: dict) -> None:
+    out = _CHECKPOINT["out"]
+    if not out:
+        return
+    tmp = out + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        os.replace(tmp, out)
+    except OSError:
+        pass  # the stdout line is the contract of last resort
+
+
+def emit_checkpoint(payload: dict) -> None:
+    """One cumulative JSON line + atomic artifact rewrite NOW: each
+    checkpoint supersedes the previous one, so a kill at any section
+    leaves the totals up to the last completed section behind."""
+    payload = dict(payload)
+    payload["partial"] = True
+    _CHECKPOINT["payload"] = payload
+    _write_out(payload)
+    print(json.dumps(payload), flush=True)
+
+
+def emit_final(payload: dict) -> None:
+    _CHECKPOINT["done"] = True
+    _CHECKPOINT["payload"] = payload
+    _write_out(payload)
+    print(json.dumps(payload), flush=True)
+
+
+def install_kill_dump() -> None:
+    def dump(note: str) -> None:
+        if not _CHECKPOINT["done"]:
+            p = dict(_CHECKPOINT["payload"] or {"bench": "chaos"})
+            p["error"] = note
+            _write_out(p)
+            print(json.dumps(p), flush=True)
+        sys.stdout.flush()
+
+    def on_signal(signum, frame):
+        dump(f"killed by signal {signum} mid-soak; totals up to the last "
+             "completed section")
+        os._exit(0)
+    try:
+        signal.signal(signal.SIGTERM, on_signal)
+        signal.signal(signal.SIGINT, on_signal)
+    except (ValueError, OSError):
+        pass  # not the main thread / restricted platform
+    atexit.register(
+        lambda: dump("process exited mid-soak; totals up to the last "
+                     "completed section"))
+
+
+def _median(vals):
+    s = sorted(vals)
+    return s[len(s) // 2] if s else 0.0
+
+
+def _rows_of(table):
+    from spark_rapids_tpu.workloads.compare import rows
+    return rows(table)
+
+
+def _fault_conf(cls: str, extra: dict) -> dict:
+    """The deterministic injection conf arming exactly one fault class
+    (test_durability's schedule stance: negative everyN = the first |N|
+    visits fault, then the site heals and the query finishes)."""
+    if cls.startswith("net."):
+        flavor = cls.split(".", 1)[1]
+        sites = "shuffle.replicate" if flavor == "replicaLoss" \
+            else "shuffle.fetchBlock"
+        conf = {_FI + "sites": sites, _FI + "netEveryN": -2,
+                _FI + "netFaults": flavor, _FI + "seed": 3}
+    elif cls == "mesh.deviceLoss":
+        conf = {_FI + "sites": "mesh.collect", _FI + "meshEveryN": -1}
+    elif cls == "oom":
+        conf = {_FI + "sites": "session.dispatch", _FI + "oomEveryN": -1}
+    else:  # transient
+        conf = {_FI + "sites": "session.dispatch",
+                _FI + "transientEveryN": -1}
+    conf.update(extra)
+    return conf
+
+
+def _run_query(tables, extra_conf: dict, mesh: bool):
+    """One engine query under ``extra_conf``: TPC-H q1 over the wire
+    shuffle (the durability layer's unit of coverage) or, for the mesh
+    class, a mesh-capable grouped aggregate. Returns
+    (rows, wall_ms, session)."""
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.workloads import tpch
+    conf = {"spark.rapids.sql.enabled": True,
+            "spark.rapids.sql.variableFloatAgg.enabled": True}
+    if mesh:
+        conf["spark.rapids.tpu.mesh.enabled"] = True
+    else:
+        conf["spark.rapids.tpu.shuffle.net.enabled"] = True
+    conf.update(extra_conf)
+    s = TpuSession(conf)
+    t0 = time.perf_counter()
+    if mesh:
+        from spark_rapids_tpu.ops import aggregates as AGG
+        from spark_rapids_tpu.ops.expression import col
+        df = (s.create_dataframe(tables["mesh_rb"])
+              .group_by(col("k"))
+              .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s")))
+        table = df.collect()
+    else:
+        t = tpch.load(s, tables["tpch"])
+        # Force a real exchange into the plan (test_durability stance).
+        t["lineitem"] = t["lineitem"].repartition(4, "l_orderkey")
+        table = tpch.QUERIES["q1"](t).collect()
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    return _rows_of(table), wall_ms, s
+
+
+def _gen_tables(rows: int):
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.workloads import tpch
+    rng = np.random.default_rng(0)
+    n = max(rows, 1024)
+    mesh_rb = pa.RecordBatch.from_pydict({
+        "k": rng.integers(0, 64, n).astype(np.int64),
+        "v": rng.integers(-50, 50, n).astype(np.int64)})
+    return {"tpch": tpch.gen_tables(rows, seed=13), "mesh_rb": mesh_rb}
+
+
+def _durability(session) -> dict:
+    prof = session.last_query_profile()
+    return dict(prof.engine["durability"]) if prof is not None else {}
+
+
+def run_fault_matrix(tables, clean_runs: int, fault_runs: int,
+                     payload: dict) -> None:
+    """Section 1: per-class clean baseline, faulted runs, MTTR."""
+    matrix: dict = {}
+    oracle: dict = {}
+    # Clean baselines per query shape (wire / mesh), shared by classes.
+    baselines: dict = {}
+    for shape, mesh in (("wire", False), ("mesh", True)):
+        # Untimed warm-up first: the process-wide kernel cache means the
+        # first run pays XLA compilation, which would inflate the clean
+        # baseline and clamp every MTTR to zero.
+        oracle[shape] = _run_query(tables, {}, mesh)[0]
+        lats = []
+        for _ in range(clean_runs):
+            rows, wall_ms, _s = _run_query(tables, {}, mesh)
+            assert rows == oracle[shape]
+            lats.append(wall_ms)
+        baselines[shape] = _median(lats)
+    payload["clean_p50_ms"] = {k: round(v, 3)
+                               for k, v in baselines.items()}
+    wrong_total = 0
+    for cls, extra, recovery_counters in _MATRIX:
+        mesh = cls == "mesh.deviceLoss"
+        shape = "mesh" if mesh else "wire"
+        lats, recoveries, injected_total, wrong = [], 0, 0, 0
+        dur_last: dict = {}
+        for _ in range(fault_runs):
+            rows, wall_ms, s = _run_query(
+                tables, _fault_conf(cls, extra), mesh)
+            lats.append(wall_ms)
+            if rows != oracle[shape]:
+                wrong += 1
+            dur_last = _durability(s)
+            injected = s._fault_injector.injected if s._fault_injector \
+                else {}
+            injected_total += sum(v for k, v in injected.items() if v)
+            if recovery_counters:
+                recoveries += sum(dur_last.get(c, 0)
+                                  for c in recovery_counters)
+            else:
+                # No downstream counter flips (absorbed silently / retried
+                # at dispatch): the injected-and-still-correct count IS
+                # the recovery evidence.
+                recoveries += sum(v for k, v in injected.items() if v)
+        mttr = max(0.0, _median(lats) - baselines[shape])
+        matrix[cls] = {
+            "runs": fault_runs,
+            "faulted_p50_ms": round(_median(lats), 3),
+            "mttr_ms": round(mttr, 3),
+            "injected": injected_total,
+            "recoveries": recoveries,
+            "wrong_answers": wrong,
+            "durability": dur_last,
+        }
+        wrong_total += wrong
+    payload["fault_matrix"] = matrix
+    payload["wrong_answers"] = payload.get("wrong_answers", 0) + wrong_total
+
+
+def run_hedge_ab(tables, payload: dict) -> None:
+    """Section 2: stalled primary + live replica, hedging off vs on.
+    The stall (0.8s) dwarfs the warm p50 so the hedge threshold
+    (quantileFactor x p50) expires deterministically before the
+    primary's request timeout (3s) — hedging ON must answer from the
+    replica while the serial path eats the full retry ladder."""
+    base = {
+        "spark.rapids.tpu.shuffle.replication.factor": 1,
+        "spark.rapids.tpu.shuffle.net.requestTimeout": 3.0,
+        _FI + "sites": "shuffle.fetchBlock",
+        _FI + "netEveryN": 2,  # visit 1 clean (warms the EWMA), 2 stalls
+        _FI + "netFaults": "stall",
+        _FI + "netStallSecs": 0.8,
+        _FI + "seed": 0,
+    }
+    out: dict = {}
+    rows_by_mode: dict = {}
+    for mode, hedge in (("serial", False), ("hedged", True)):
+        conf = dict(base)
+        conf["spark.rapids.tpu.shuffle.hedge.enabled"] = hedge
+        rows, wall_ms, s = _run_query(tables, conf, mesh=False)
+        dur = _durability(s)
+        rows_by_mode[mode] = rows
+        out[mode] = {"wall_ms": round(wall_ms, 3),
+                     "hedgedFetches": dur.get("hedgedFetches", 0),
+                     "hedgeWins": dur.get("hedgeWins", 0),
+                     "replicaReads": dur.get("replicaReads", 0)}
+    out["bit_identical"] = rows_by_mode["serial"] == rows_by_mode["hedged"]
+    out["hedge_wins"] = out["hedged"]["hedgeWins"]
+    payload["hedge_ab"] = out
+    if not out["bit_identical"]:
+        payload["wrong_answers"] = payload.get("wrong_answers", 0) + 1
+
+
+def run_serving_soak(tables, requests: int, payload: dict) -> None:
+    """Section 3: one QueryService, every serving-seam injector armed at
+    once, plus per-tenant session-level chaos (wire-shuffle net faults
+    for one tenant, dispatch OOM for another). Typed rejections are
+    expected; wrong answers are not."""
+    from spark_rapids_tpu.serve import QueryService
+    from spark_rapids_tpu.workloads import tpch
+
+    def chaos_q1(dfs):
+        return tpch.QUERIES["q1"](
+            {**dfs,
+             "lineitem": dfs["lineitem"].repartition(4, "l_orderkey")})
+
+    queries = {"q1": chaos_q1, "q6": tpch.QUERIES["q6"]}
+    # Oracle from a clean service (identical tables/builders, no faults).
+    clean = QueryService(
+        conf={"spark.rapids.sql.enabled": True,
+              "spark.rapids.sql.variableFloatAgg.enabled": True,
+              "spark.rapids.tpu.shuffle.net.enabled": True},
+        tables=tables["tpch"], queries=queries)
+    oracle = {}
+    try:
+        for name in queries:
+            oracle[name] = _rows_of(clean.execute("oracle", name).table)
+    finally:
+        clean.close()
+
+    tenant_conf = {
+        # Wire-shuffle network chaos, replication + hedging armed.
+        "t-net": {_FI + "sites": "shuffle.fetchBlock",
+                  _FI + "netEveryN": -2, _FI + "seed": 3,
+                  _FI + "netFaults": "peerDeath,torn,bitFlip",
+                  "spark.rapids.tpu.shuffle.replication.factor": 1},
+        # Dispatch-level synthetic OOM: full spill-down + re-run.
+        "t-oom": {_FI + "sites": "session.dispatch",
+                  _FI + "oomEveryN": -1},
+    }
+    svc = QueryService(
+        conf={"spark.rapids.sql.enabled": True,
+              "spark.rapids.sql.variableFloatAgg.enabled": True,
+              "spark.rapids.tpu.shuffle.net.enabled": True,
+              "spark.rapids.tpu.serve.sessions": 2,
+              _FI + "sites": "serve.",
+              _FI + "serveEveryN": 3, _FI + "seed": 1,
+              _FI + "serveFaults":
+                  "tenantKill,sessionCrash,cachePoison,admissionStall"},
+        tables=tables["tpch"], queries=queries,
+        tenant_conf=tenant_conf)
+    tenants = ["t-net", "t-oom", "t-plain"]
+    completed, wrong, typed_errors = 0, 0, {}
+    t0 = time.perf_counter()
+    try:
+        for i in range(requests):
+            tenant = tenants[i % len(tenants)]
+            name = "q1" if i % 2 == 0 else "q6"
+            try:
+                res = svc.execute(tenant, name)
+            except Exception as e:  # noqa: BLE001 - typed chaos rejections
+                typed_errors[type(e).__name__] = \
+                    typed_errors.get(type(e).__name__, 0) + 1
+                continue
+            completed += 1
+            if _rows_of(res.table) != oracle[name]:
+                wrong += 1
+        stats = svc.stats()
+        health = svc.health()
+        # Per-tenant session injector tallies (net/oom chaos lives in the
+        # derived tenant sessions, not the service-level injector).
+        tenant_injected: dict = {}
+        for slot in svc._all_slots:
+            for tenant, sess in slot._tenant_sessions.items():
+                inj = getattr(sess, "_fault_injector", None)
+                if inj is None:
+                    continue
+                agg = tenant_injected.setdefault(tenant, {})
+                for k, v in inj.injected.items():
+                    if v:
+                        agg[k] = agg.get(k, 0) + v
+    finally:
+        svc.close()
+    payload["serving_soak"] = {
+        "requests": requests,
+        "completed": completed,
+        "wrong_answers": wrong,
+        "typed_errors": typed_errors,
+        "wall_secs": round(time.perf_counter() - t0, 3),
+        "serve_injected": stats.get("injected", {}),
+        "tenant_injected": tenant_injected,
+        "recoveries": {
+            "sessions_replaced": stats.get("sessions_replaced", 0),
+            "crash_reruns": stats.get("crash_reruns", 0),
+            "cache_corrupt_dropped":
+                stats.get("cache", {}).get("corrupt_dropped", 0),
+            "shed": stats.get("gate", {}).get("shed", 0),
+        },
+        "self_healing": health.get("self_healing", {}),
+    }
+    payload["wrong_answers"] = payload.get("wrong_answers", 0) + wrong
+
+
+def _gates(payload: dict) -> dict:
+    per_class = {cls: sec.get("recoveries", 0) >= 1
+                 for cls, sec in payload.get("fault_matrix", {}).items()}
+    soak = payload.get("serving_soak", {})
+    soak_armed = sum(soak.get("serve_injected", {}).values()) >= 1
+    hedge = payload.get("hedge_ab", {})
+    return {
+        "zero_wrong_answers": payload.get("wrong_answers", 0) == 0,
+        "recovery_per_class": per_class,
+        "all_classes_recovered": bool(per_class)
+        and all(per_class.values()),
+        "serve_injector_armed": soak_armed,
+        "hedge_wins_positive": hedge.get("hedge_wins", 0) >= 1,
+    }
+
+
+def run(args) -> dict:
+    import jax
+    payload = {"bench": "chaos", "version": 1,
+               "backend": jax.default_backend(),
+               "devices": len(jax.devices()),
+               "rows": args.rows, "smoke": bool(args.smoke),
+               "wrong_answers": 0}
+    tables = _gen_tables(args.rows)
+    t0 = time.perf_counter()
+    run_fault_matrix(tables, args.clean_runs, args.fault_runs, payload)
+    emit_checkpoint(payload)
+    run_hedge_ab(tables, payload)
+    emit_checkpoint(payload)
+    run_serving_soak(tables, args.soak_requests, payload)
+    emit_checkpoint(payload)
+    payload["wall_secs"] = round(time.perf_counter() - t0, 3)
+    payload["gates"] = _gates(payload)
+    payload.pop("partial", None)
+    return payload
+
+
+def make_args(**kv) -> argparse.Namespace:
+    """Programmatic args (the tier-1 smoke test builds these in-process)."""
+    p = _parser()
+    args = p.parse_args([])
+    for k, v in kv.items():
+        setattr(args, k, v)
+    if args.smoke:
+        args.rows = min(args.rows, 1 << 10)
+        args.clean_runs = 1
+        args.fault_runs = 1
+        args.soak_requests = min(args.soak_requests, 6)
+    return args
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--rows", type=int, default=1 << 12,
+                   help="lineitem rows for the generated TPC-H tables")
+    p.add_argument("--clean-runs", dest="clean_runs", type=int, default=3,
+                   help="clean baseline runs per query shape")
+    p.add_argument("--fault-runs", dest="fault_runs", type=int, default=2,
+                   help="faulted runs per injector class")
+    p.add_argument("--soak-requests", dest="soak_requests", type=int,
+                   default=18, help="serving-soak requests")
+    p.add_argument("--smoke", action="store_true",
+                   help="CI smoke: tiny rows, one run per class")
+    p.add_argument("--out", default="BENCH_chaos.json")
+    return p
+
+
+def main(argv=None) -> int:
+    # The mesh fault class needs a multi-device mesh; on a CPU-only host
+    # carve the virtual 8-device mesh the tests use (conftest stance).
+    # Must happen before jax initializes — main() runs before run()'s
+    # lazy imports, so a CLI invocation is safe.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    args = _parser().parse_args(argv)
+    if args.smoke:
+        args = make_args(**vars(args))
+    _CHECKPOINT["out"] = args.out
+    install_kill_dump()
+    rc = 1
+    try:
+        payload = run(args)
+        rc = 0
+    finally:
+        if rc != 0:
+            # kill-dump stance: the atexit dumper re-emits the last
+            # checkpoint with an error note.
+            return rc
+    emit_final(payload)
+    print(json.dumps({"gates": payload["gates"],
+                      "wall_secs": payload["wall_secs"]}, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

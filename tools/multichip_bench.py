@@ -1,0 +1,212 @@
+"""Multichip scaling bench (ISSUE 19 satellite) -> BENCH_multichip.json.
+
+Runs a suite of mesh-capable query shapes twice — single-chip (mesh
+disabled: the ordinary fused path) and as ONE SPMD program over the
+device mesh (exec/mesh.py) — and emits **per-query scaling
+efficiency**::
+
+    speedup    = single_chip_p50 / mesh_p50
+    efficiency = speedup / n_devices
+
+plus the self-healing recovery counters (hedgedFetches, hedgeWins,
+replicaReads, meshFailovers, refetches, recomputes) from each run's
+query profile, so a degraded or fault-absorbing run is visible next to
+its timing instead of silently skewing it. Every mesh answer is checked
+row-identical against its single-chip twin (rel 1e-9) — a wrong answer
+fails the bench, never ships in the artifact as a timing.
+
+On a CPU-only host the 8-device virtual mesh is carved via XLA_FLAGS
+exactly like the test suite (conftest). The JSON is written on every
+exit path (the bench.py kill-dump stance).
+
+CLI::
+
+    python -m tools.multichip_bench [--rows N] [--runs K] \
+        [--out BENCH_multichip.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: recovery counters surfaced next to every timed run (ISSUE 19): a
+#: fault absorbed mid-bench must be visible beside the number it skewed.
+_RECOVERY = ("hedgedFetches", "hedgeWins", "replicaReads",
+             "meshFailovers", "shuffleBlocksRefetched",
+             "mapTasksRecomputed", "checksumFailures")
+
+
+def _median(vals):
+    s = sorted(vals)
+    return s[len(s) // 2] if s else 0.0
+
+
+def _queries(rows: int):
+    """Mesh-capable shapes (the test_mesh suite's coverage): grouped
+    aggregate, multi-function aggregate, filter+project+aggregate, and
+    a shuffled join feeding an aggregate."""
+    import numpy as np
+    import pyarrow as pa
+    from spark_rapids_tpu.ops import aggregates as AGG
+    from spark_rapids_tpu.ops import predicates as P
+    from spark_rapids_tpu.ops.arithmetic import Multiply
+    from spark_rapids_tpu.ops.expression import col, lit
+    rng = np.random.default_rng(0)
+    rb = pa.RecordBatch.from_pydict({
+        "k": rng.integers(0, 64, rows).astype(np.int64),
+        "v": rng.integers(-50, 50, rows).astype(np.int64),
+        "x": rng.normal(size=rows)})
+    dim = pa.RecordBatch.from_pydict({
+        "k": np.arange(64, dtype=np.int64),
+        "w": rng.integers(0, 10, 64).astype(np.int64)})
+
+    def groupby_sum(s):
+        return (s.create_dataframe(rb).cache().group_by(col("k"))
+                .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s")))
+
+    def groupby_multi(s):
+        return (s.create_dataframe(rb).cache().group_by(col("k"))
+                .agg(AGG.AggregateExpression(AGG.Sum(col("v")), "s"),
+                     AGG.AggregateExpression(AGG.Count(), "c"),
+                     AGG.AggregateExpression(AGG.Min(col("x")), "mn"),
+                     AGG.AggregateExpression(AGG.Max(col("x")), "mx")))
+
+    def filter_project_agg(s):
+        return (s.create_dataframe(rb).cache()
+                .where(P.GreaterThan(col("v"), lit(-10)))
+                .with_column("y", Multiply(col("v"), lit(3)))
+                .group_by(col("k"))
+                .agg(AGG.AggregateExpression(AGG.Sum(col("y")), "sy")))
+
+    def join_agg(s):
+        probe = s.create_dataframe(rb).cache()
+        build = s.create_dataframe(dim).cache()
+        return (probe.join(build, on="k", how="inner")
+                .group_by(col("k"))
+                .agg(AGG.AggregateExpression(AGG.Sum(col("w")), "sw")))
+
+    return {"groupby_sum": groupby_sum, "groupby_multi": groupby_multi,
+            "filter_project_agg": filter_project_agg,
+            "join_agg": join_agg}
+
+
+def _recovery_of(session) -> dict:
+    prof = session.last_query_profile()
+    if prof is None:
+        return {}
+    dur = prof.engine.get("durability", {})
+    return {k: dur.get(k, 0) for k in _RECOVERY if dur.get(k, 0)}
+
+
+def run(args) -> dict:
+    import jax
+    from spark_rapids_tpu.exec import mesh as M
+    from spark_rapids_tpu.session import TpuSession
+    from spark_rapids_tpu.workloads.compare import rows, rows_match
+
+    n_devices = len(jax.devices())
+    queries = _queries(args.rows)
+    single = TpuSession({"spark.rapids.sql.enabled": True})
+    mesh = TpuSession({"spark.rapids.sql.enabled": True,
+                       "spark.rapids.tpu.mesh.enabled": True})
+    per_query: dict = {}
+    all_mesh_capable, all_match = True, True
+    try:
+        for name, q in queries.items():
+            capable = M.mesh_capable(mesh.plan(q(mesh)._plan), mesh.conf)
+            all_mesh_capable = all_mesh_capable and capable
+            entry: dict = {"mesh_capable": capable}
+            timings: dict = {}
+            recovery: dict = {}
+            oracle = None
+            for mode, sess in (("single_chip", single), ("mesh", mesh)):
+                lats = []
+                q(sess).collect()  # untimed warm-up (compile)
+                for _ in range(args.runs):
+                    t0 = time.perf_counter()
+                    table = q(sess).collect()
+                    lats.append((time.perf_counter() - t0) * 1e3)
+                timings[mode] = _median(lats)
+                recovery[mode] = _recovery_of(sess)
+                if mode == "single_chip":
+                    oracle = rows(table)
+                else:
+                    matched = rows_match(rows(table), oracle,
+                                         rel_tol=1e-9, abs_tol=1e-9)
+                    entry["match"] = matched
+                    all_match = all_match and matched
+            entry["single_chip_p50_ms"] = round(timings["single_chip"], 3)
+            entry["mesh_p50_ms"] = round(timings["mesh"], 3)
+            speedup = timings["single_chip"] / timings["mesh"] \
+                if timings["mesh"] > 0 else 0.0
+            entry["speedup"] = round(speedup, 3)
+            entry["scaling_efficiency"] = round(speedup / n_devices, 4)
+            entry["recovery"] = recovery
+            per_query[name] = entry
+    finally:
+        single.close()
+        mesh.close()
+    return {
+        "bench": "multichip", "version": 1,
+        "backend": jax.default_backend(),
+        "devices": n_devices,
+        "rows": args.rows, "runs": args.runs,
+        "per_query": per_query,
+        "all_mesh_capable": all_mesh_capable,
+        "all_match": all_match,
+    }
+
+
+def make_args(**kv) -> argparse.Namespace:
+    """Programmatic args (the tier-1 smoke test builds these in-process)."""
+    args = _parser().parse_args([])
+    for k, v in kv.items():
+        setattr(args, k, v)
+    return args
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    p.add_argument("--rows", type=int, default=1 << 18)
+    p.add_argument("--runs", type=int, default=3,
+                   help="timed runs per (query, mode); median reported")
+    p.add_argument("--out", default="BENCH_multichip.json")
+    return p
+
+
+def main(argv=None) -> int:
+    # Carve the virtual 8-device mesh on CPU-only hosts (conftest
+    # stance) — must precede jax initialization, and run()'s imports are
+    # lazy, so setting it here covers the CLI path.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            (flags + " --xla_force_host_platform_device_count=8").strip()
+    args = _parser().parse_args(argv)
+    payload = {"bench": "multichip", "version": 1,
+               "error": "did not finish"}
+    rc = 1
+    try:
+        payload = run(args)
+        rc = 0 if payload["all_match"] else 2
+    finally:
+        # The kill-dump stance (bench.py, ISSUE 11): ANY exit leaves a
+        # parseable artifact.
+        with open(args.out, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=1)
+            f.write("\n")
+        print(f"wrote {args.out}")
+    print(json.dumps({n: {k: e[k] for k in
+                          ("speedup", "scaling_efficiency", "match")}
+                      for n, e in payload["per_query"].items()}, indent=1))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
